@@ -1,0 +1,81 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+A brand-new framework with the capabilities of Ray (reference:
+``/root/reference``, surveyed in SURVEY.md): tasks, actors, an object
+store, placement groups, and AI libraries (data / train / tune / serve /
+rllib) — re-designed TPU-first. Compute runs under jax/XLA/pjit over
+``jax.sharding.Mesh``es; collectives ride ICI within a slice and DCN
+across slices; the scheduler treats ICI-connected TPU slices as atomic,
+gang-scheduled units.
+
+Public core API (analog of ray's L4, SURVEY.md §1):
+    ray_tpu.init / shutdown
+    @ray_tpu.remote            -> RemoteFunction / ActorClass
+    ray_tpu.get / put / wait
+    ray_tpu.ObjectRef
+    ray_tpu.placement_group
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.core.api import (
+    init,
+    shutdown,
+    is_initialized,
+    remote,
+    get,
+    put,
+    wait,
+    cancel,
+    kill,
+    get_actor,
+    available_resources,
+    cluster_resources,
+    nodes,
+    timeline,
+    method,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.actor import ActorHandle
+from ray_tpu.core.exceptions import (
+    RayTpuError,
+    TaskError,
+    ActorError,
+    ActorDiedError,
+    ObjectLostError,
+    GetTimeoutError,
+)
+from ray_tpu.core.placement_group import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "cancel",
+    "kill",
+    "get_actor",
+    "method",
+    "available_resources",
+    "cluster_resources",
+    "nodes",
+    "timeline",
+    "ObjectRef",
+    "ActorHandle",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+]

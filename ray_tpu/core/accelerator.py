@@ -1,0 +1,42 @@
+"""TPU accelerator detection.
+
+Analog of the reference's ``TPUAcceleratorManager``
+(``python/ray/_private/accelerators/tpu.py:71``): detect chips from
+``/dev/accel*`` / ``/dev/vfio/*`` device files, with env-var override,
+without importing jax (importing jax grabs the TPU runtime, which must
+only happen in the process that will own the chips).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+
+def detect_tpu_chips() -> int:
+    override = os.environ.get("RAY_TPU_CHIPS")
+    if override:
+        try:
+            return int(override)
+        except ValueError:
+            pass
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    # Under the axon tunnel there are no local device files but jax sees
+    # one chip; treat presence of the tunnel env as one chip.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("axon"):
+        return 1
+    return 0
+
+
+def tpu_pod_type() -> str | None:
+    """GCE metadata accelerator-type (e.g. v5litepod-8); None off-GCE."""
+    return os.environ.get("TPU_ACCELERATOR_TYPE")
+
+
+def tpu_worker_id() -> int:
+    return int(os.environ.get("TPU_WORKER_ID", "0"))

@@ -1,0 +1,161 @@
+"""Public core API (reference L4: ray.init/get/put/wait/remote)."""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Sequence
+
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.config import Config, set_config, reset_config
+from ray_tpu.core.exceptions import RuntimeNotInitializedError
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+
+_runtime = None
+_runtime_lock = threading.Lock()
+_actor_context: ActorID | None = None
+
+
+def _set_runtime(rt) -> None:
+    global _runtime
+    _runtime = rt
+
+
+def _set_actor_context(actor_id: ActorID) -> None:
+    global _actor_context
+    _actor_context = actor_id
+
+
+def get_runtime():
+    if _runtime is None:
+        raise RuntimeNotInitializedError()
+    return _runtime
+
+
+def get_runtime_or_none():
+    return _runtime
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def init(num_cpus: int | None = None,
+         num_tpus: int | None = None,
+         resources: dict[str, float] | None = None,
+         local_mode: bool = False,
+         ignore_reinit_error: bool = False,
+         _system_config: dict[str, Any] | None = None):
+    """Start the single-node runtime in this process (driver).
+
+    Reference analog: ``ray.init`` (python/ray/_private/worker.py:1240).
+    ``_system_config`` injects config overrides for the whole session —
+    same test pattern as the reference's conftest injection.
+    """
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return _runtime
+            raise RuntimeError(
+                "ray_tpu.init() called twice; pass "
+                "ignore_reinit_error=True to allow")
+        cfg = Config.from_env(_system_config)
+        set_config(cfg)
+        from ray_tpu.core.runtime import DriverRuntime
+        _runtime = DriverRuntime(
+            cfg, num_cpus=num_cpus, num_tpus=num_tpus,
+            resources=resources, local_mode=local_mode)
+        atexit.register(_shutdown_at_exit)
+        return _runtime
+
+
+def _shutdown_at_exit():
+    try:
+        shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def shutdown() -> None:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            return
+        rt = _runtime
+        _runtime = None
+        reset_config()
+    rt.shutdown()
+
+
+def remote(*args, **kwargs):
+    """Decorator: turn a function into a RemoteFunction or a class into
+    an ActorClass. Usable bare (``@remote``) or with options
+    (``@remote(num_cpus=2)``)."""
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("remote() takes keyword options only")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    return decorator
+
+
+def method(num_returns: int = 1):
+    """Decorator for actor methods declaring multiple returns
+    (reference: ray.method)."""
+    def decorator(fn):
+        fn.__ray_tpu_num_returns__ = num_returns
+        return fn
+    return decorator
+
+
+def put(value) -> ObjectRef:
+    return get_runtime().put(value)
+
+
+def get(refs, timeout: float | None = None):
+    return get_runtime().get(refs, timeout)
+
+
+def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
+         timeout: float | None = None):
+    return get_runtime().wait(list(refs), num_returns, timeout)
+
+
+def cancel(ref: ObjectRef, force: bool = False) -> None:
+    get_runtime().cancel(ref, force)
+
+
+def kill(handle: ActorHandle, no_restart: bool = True) -> None:
+    get_runtime().kill_actor(handle.actor_id, no_restart)
+
+
+def get_actor(name: str) -> ActorHandle:
+    actor_id = get_runtime().get_named_actor(name)
+    return ActorHandle(actor_id)
+
+
+def available_resources() -> dict[str, float]:
+    return get_runtime().available_resources()
+
+
+def cluster_resources() -> dict[str, float]:
+    return get_runtime().cluster_resources()
+
+
+def nodes() -> list[dict]:
+    return get_runtime().nodes()
+
+
+def timeline() -> list[dict]:
+    return get_runtime().timeline()

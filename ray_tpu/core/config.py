@@ -1,0 +1,121 @@
+"""Config flag system.
+
+Analog of the reference's ``RAY_CONFIG(type, name, default)`` X-macro list
+(``src/ray/common/ray_config_def.h``): every flag is declared once with a
+type and default, is overridable via a ``RAY_TPU_<NAME>`` environment
+variable, and can be overridden per-session via
+``ray_tpu.init(_system_config={...})`` — the whole local cluster sees one
+consistent config (tests use this to crank failure timeouts down, same
+pattern as the reference's ``_system_config`` injection).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+@dataclass
+class Config:
+    # --- scheduling ---
+    # Max worker processes for task execution (0 = num_cpus).
+    max_workers: int = 0
+    # Seconds an idle pooled worker survives before being reaped
+    # (reference: worker_pool idle reaping, worker_pool.cc).
+    idle_worker_ttl_s: float = 60.0
+    # Workers to prestart at init (reference: WorkerPool::PrestartWorkers).
+    prestart_workers: int = 0
+    # Lease reuse: a leased worker is retained per scheduling key for this
+    # long awaiting more same-shape tasks (reference: NormalTaskSubmitter
+    # lease caching, normal_task_submitter.cc).
+    lease_reuse_timeout_s: float = 10.0
+
+    # --- objects ---
+    # Objects at or above this size go to the shared-memory store instead
+    # of the in-process memory store (reference: plasma threshold).
+    max_direct_call_object_size: int = 100 * 1024
+    # Shared-memory object store capacity in bytes (0 = 30% of RAM,
+    # like the reference's default object_store_memory).
+    object_store_memory: int = 0
+    # Directory for object spilling (reference: local_object_manager).
+    spill_dir: str = "/tmp/ray_tpu_spill"
+    # Begin spilling when the store is this full.
+    object_spilling_threshold: float = 0.8
+
+    # --- fault tolerance ---
+    # Default task max retries (reference: max_retries=3 default).
+    task_max_retries: int = 3
+    # Default actor max restarts.
+    actor_max_restarts: int = 0
+    # Health-check period for actor/worker processes.
+    health_check_period_s: float = 1.0
+    # Missed health checks before a process is declared dead
+    # (reference: GcsHealthCheckManager thresholds, ray_config_def.h:847).
+    health_check_failure_threshold: int = 5
+
+    # --- timeouts ---
+    get_timeout_default_s: float = 0.0  # 0 = no timeout
+    actor_creation_timeout_s: float = 120.0
+
+    # --- logging / events ---
+    # Task lifecycle events ring-buffer capacity per worker
+    # (reference: TaskEventBuffer, task_event_buffer.h:220).
+    task_event_buffer_size: int = 10000
+    log_dir: str = "/tmp/ray_tpu/logs"
+
+    # --- TPU / device ---
+    # Treat a multi-host TPU slice as an atomic gang-scheduled unit.
+    gang_schedule_slices: bool = True
+    # Coordinator port for jax.distributed rendezvous.
+    coordinator_port: int = 8476
+
+    @classmethod
+    def from_env(cls, overrides: dict[str, Any] | None = None) -> "Config":
+        kwargs: dict[str, Any] = {}
+        for f in fields(cls):
+            env_key = _ENV_PREFIX + f.name.upper()
+            if env_key in os.environ:
+                kwargs[f.name] = _coerce(os.environ[env_key], f.type
+                                         if isinstance(f.type, type)
+                                         else type(f.default))
+        if overrides:
+            valid = {f.name for f in fields(cls)}
+            for k, v in overrides.items():
+                if k not in valid:
+                    raise ValueError(f"unknown config flag: {k}")
+                kwargs[k] = v
+        return cls(**kwargs)
+
+
+_global: Config | None = None
+_lock = threading.Lock()
+
+
+def get_config() -> Config:
+    global _global
+    with _lock:
+        if _global is None:
+            _global = Config.from_env()
+        return _global
+
+
+def set_config(cfg: Config) -> None:
+    global _global
+    with _lock:
+        _global = cfg
+
+
+def reset_config() -> None:
+    global _global
+    with _lock:
+        _global = None

@@ -1,0 +1,69 @@
+"""Exception hierarchy for ray_tpu (analog of python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception while executing.
+
+    Re-raised at every ``get`` of the task's output, carrying the remote
+    traceback text (reference: RayTaskError in python/ray/exceptions.py).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"task {function_name} failed:\n{traceback_str}"
+        )
+
+    def __reduce__(self):
+        # The cause may itself be unpicklable; the traceback text is the
+        # contract (reference: RayTaskError carries the formatted remote
+        # traceback).
+        return (type(self), (self.function_name, self.traceback_str, None))
+
+
+class ActorError(TaskError):
+    """An actor method raised an exception."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead (process exited or was killed)."""
+
+    def __init__(self, actor_id_hex: str = "", reason: str = ""):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"actor {actor_id_hex} is dead: {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id_hex, self.reason))
+
+
+class ObjectLostError(RayTpuError):
+    """An object was lost from the store and could not be reconstructed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` timed out before the object was ready."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before/while running."""
+
+
+class RuntimeNotInitializedError(RayTpuError):
+    def __init__(self):
+        super().__init__(
+            "ray_tpu has not been initialized; call ray_tpu.init() first."
+        )
+
+
+class PlacementGroupError(RayTpuError):
+    """Placement group could not be created/scheduled."""

@@ -1,0 +1,86 @@
+"""ObjectRef — a future for a value in the object store.
+
+Analog of the reference's ``ObjectRef`` (Cython class in _raylet.pyx).
+Serializes as just its ObjectID; on deserialization inside a worker it
+re-binds to that process's runtime, so refs can be passed as task args
+and stored inside objects (borrower semantics: the runtime tracks refs
+that cross process boundaries — see core/ref_counting.py).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ray_tpu.core.ids import ObjectID
+
+if TYPE_CHECKING:
+    from ray_tpu.core.runtime import Runtime
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_hint", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint: str | None = None):
+        self._id = object_id
+        self._owner_hint = owner_hint
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        # Cross-process serialization: mark the ref "escaped" so the
+        # owner pins the object while out-of-process borrowers may hold
+        # it (conservative stand-in for the reference's distributed
+        # borrower protocol, reference_count.h; refined in later rounds).
+        from ray_tpu.core.api import get_runtime_or_none
+        rt = get_runtime_or_none()
+        if rt is not None:
+            try:
+                rt.on_ref_escaped(self._id)
+            except Exception:
+                pass
+        return (_rehydrate_ref, (self._id.binary(), self._owner_hint))
+
+    # Allow `await ref` when running inside async actors.
+    def __await__(self):
+        from ray_tpu.core.api import get_runtime
+        return get_runtime().get_async(self).__await__()
+
+    def future(self):
+        """concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu.core.api import get_runtime
+        return get_runtime().as_future(self)
+
+
+def _rehydrate_ref(id_bytes: bytes, owner_hint):
+    ref = ObjectRef(ObjectID(id_bytes), owner_hint)
+    # Register the deserializing process as a borrower so the owner keeps
+    # the object alive while this ref exists (reference: borrower tracking
+    # in reference_count.h).
+    try:
+        from ray_tpu.core.api import get_runtime_or_none
+        rt = get_runtime_or_none()
+        if rt is not None:
+            rt.on_ref_deserialized(ref)
+    except Exception:
+        pass
+    return ref

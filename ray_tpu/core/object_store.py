@@ -1,0 +1,231 @@
+"""Object stores.
+
+Two tiers, mirroring the reference's design (SURVEY.md §2.1 N10/N16):
+
+- ``MemoryStore``: per-process in-memory map for small objects and
+  pending futures (reference: CoreWorkerMemoryStore, memory_store.h:43).
+- ``SharedMemoryStore``: plasma analog — objects at or above
+  ``max_direct_call_object_size`` live in OS shared memory
+  (``multiprocessing.shared_memory``) so any worker process on the node
+  maps the same pages: zero-copy reads of large numpy buffers. Includes
+  LRU-ordered spilling to disk when over the capacity threshold
+  (reference: eviction_policy.cc + local_object_manager.h:41).
+
+Both store ``SerializedObject``s; deserialization happens in the reading
+process so shared pages stay immutable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.serialization import SerializedObject
+from ray_tpu.core.exceptions import ObjectLostError
+
+
+@dataclass
+class _Entry:
+    obj: SerializedObject | None
+    # For shared-memory objects: segment names + buffer sizes.
+    shm_names: list[str] = field(default_factory=list)
+    shm_sizes: list[int] = field(default_factory=list)
+    data: bytes = b""
+    size: int = 0
+    spilled_path: str | None = None
+    created_at: float = 0.0
+
+
+class MemoryStore:
+    """In-process store for small objects; thread-safe; supports waiters."""
+
+    def __init__(self):
+        self._objects: dict[ObjectID, SerializedObject] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def put(self, object_id: ObjectID, obj: SerializedObject) -> None:
+        with self._cv:
+            self._objects[object_id] = obj
+            self._cv.notify_all()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get(self, object_id: ObjectID,
+            timeout: float | None = None) -> SerializedObject:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while object_id not in self._objects:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(object_id.hex())
+                self._cv.wait(remaining)
+            return self._objects[object_id]
+
+    def try_get(self, object_id: ObjectID) -> SerializedObject | None:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._cv:
+            self._objects.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class SharedMemoryStore:
+    """Plasma-analog: large objects in OS shared memory with LRU spill.
+
+    The driver process owns segment lifecycle (create/unlink); worker
+    processes attach read-only by name. Layout per object: the pickle
+    stream is kept inline in the index (it is small — buffers are out of
+    band), each out-of-band buffer gets its own segment so readers can
+    build zero-copy memoryviews over the mapped pages.
+    """
+
+    def __init__(self, capacity_bytes: int, spill_dir: str,
+                 spill_threshold: float = 0.8):
+        self._capacity = capacity_bytes
+        self._spill_dir = spill_dir
+        self._threshold = spill_threshold
+        self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._used = 0
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    # -- write path (owner side) --
+
+    def put(self, object_id: ObjectID, obj: SerializedObject) -> _Entry:
+        with self._lock:
+            self._seq += 1
+            names, sizes = [], []
+            for i, buf in enumerate(obj.buffers):
+                seg = shared_memory.SharedMemory(
+                    create=True, size=max(1, len(buf)),
+                    name=f"rt_{os.getpid()}_{self._seq}_{i}")
+                seg.buf[: len(buf)] = buf
+                names.append(seg.name)
+                sizes.append(len(buf))
+                seg.close()  # keep segment alive via its name; unlink later
+            entry = _Entry(obj=None, shm_names=names, shm_sizes=sizes,
+                           data=obj.data, size=obj.total_size,
+                           created_at=time.time())
+            self._entries[object_id] = entry
+            self._used += entry.size
+            self._maybe_spill_locked()
+            return entry
+
+    def _maybe_spill_locked(self) -> None:
+        if self._capacity <= 0:
+            return
+        limit = int(self._capacity * self._threshold)
+        while self._used > limit and len(self._entries) > 1:
+            # Spill least-recently-used first.
+            oid, entry = next(iter(self._entries.items()))
+            if entry.spilled_path is not None:
+                self._entries.move_to_end(oid)
+                continue
+            self._spill_locked(oid, entry)
+
+    def _spill_locked(self, oid: ObjectID, entry: _Entry) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, oid.hex())
+        with open(path, "wb") as f:
+            f.write(len(entry.data).to_bytes(8, "little"))
+            f.write(entry.data)
+            f.write(len(entry.shm_sizes).to_bytes(8, "little"))
+            for name, size in zip(entry.shm_names, entry.shm_sizes):
+                seg = shared_memory.SharedMemory(name=name)
+                f.write(size.to_bytes(8, "little"))
+                f.write(bytes(seg.buf[:size]))
+                seg.close()
+                seg.unlink()
+        self._used -= entry.size
+        entry.spilled_path = path
+        entry.shm_names = []
+        entry.shm_sizes = []
+        entry.data = b""
+
+    # -- read path (any process) --
+
+    def get_descriptor(self, object_id: ObjectID):
+        """(data, shm_names, shm_sizes, spilled_path) for cross-process reads."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return None
+            self._entries.move_to_end(object_id)
+            return (entry.data, list(entry.shm_names),
+                    list(entry.shm_sizes), entry.spilled_path)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.pop(object_id, None)
+            if entry is None:
+                return
+            self._used -= entry.size if entry.spilled_path is None else 0
+        if entry.spilled_path:
+            try:
+                os.unlink(entry.spilled_path)
+            except OSError:
+                pass
+        for name in entry.shm_names:
+            try:
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def shutdown(self) -> None:
+        with self._lock:
+            ids = list(self._entries)
+        for oid in ids:
+            self.delete(oid)
+
+
+def read_descriptor(desc) -> SerializedObject:
+    """Materialize a SerializedObject from a store descriptor.
+
+    Shared-memory buffers are copied out here for safety of segment
+    lifetime; zero-copy mapping is used on the owner process fast path
+    (MemoryStore) which retains the original buffers.
+    """
+    data, names, sizes, spilled_path = desc
+    if spilled_path is not None:
+        try:
+            with open(spilled_path, "rb") as f:
+                dlen = int.from_bytes(f.read(8), "little")
+                data = f.read(dlen)
+                nbuf = int.from_bytes(f.read(8), "little")
+                buffers = []
+                for _ in range(nbuf):
+                    blen = int.from_bytes(f.read(8), "little")
+                    buffers.append(f.read(blen))
+        except FileNotFoundError:
+            raise ObjectLostError(spilled_path)
+        return SerializedObject(data=data, buffers=buffers)
+    buffers = []
+    for name, size in zip(names, sizes):
+        seg = shared_memory.SharedMemory(name=name)
+        buffers.append(bytes(seg.buf[:size]))
+        seg.close()
+    return SerializedObject(data=data, buffers=buffers)

@@ -1,0 +1,73 @@
+"""Placement groups (reference: python/ray/util/placement_group.py).
+
+Round-1 semantics on a single node: a placement group reserves its
+bundles' resources atomically from the node pool (all-or-nothing, the
+2-phase-commit analog degenerates to one atomic acquire). Strategies
+PACK / STRICT_PACK / SPREAD / STRICT_SPREAD are recorded and will drive
+node selection once the multi-node scheduler lands; on TPU, STRICT_PACK
+over an ICI slice is the gang-scheduling primitive (SURVEY.md §7.3.2).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.core.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: list[dict[str, float]], strategy: str):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: float | None = None) -> bool:
+        from ray_tpu.core.api import get_runtime
+        return get_runtime().pg_ready(self.id, timeout)
+
+    def wait(self, timeout_seconds: float | None = None) -> bool:
+        return self.ready(timeout_seconds)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs,
+                                 self.strategy))
+
+    def __repr__(self):
+        return (f"PlacementGroup({self.id.hex()[:12]}, "
+                f"{len(self.bundle_specs)} bundles, {self.strategy})")
+
+
+def placement_group(bundles: list[dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy!r}; "
+                         f"one of {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    from ray_tpu.core.api import get_runtime
+    pg_id = get_runtime().create_placement_group(bundles, strategy)
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu.core.api import get_runtime
+    get_runtime().remove_placement_group(pg.id)
+
+
+class PlacementGroupSchedulingStrategy:
+    """Scheduling-strategy object accepted by task/actor options
+    (reference: python/ray/util/scheduling_strategies.py)."""
+
+    def __init__(self, placement_group: PlacementGroup,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = \
+            placement_group_capture_child_tasks

@@ -1,0 +1,49 @@
+"""Wire protocol between driver runtime and worker/actor processes.
+
+Two channels per worker process, mirroring the reference's split between
+the task-push path (raylet/owner -> worker gRPC PushTask) and the
+CoreWorker -> GCS/raylet client path:
+
+- **exec channel** (driver -> worker Pipe): driver pushes tasks, worker
+  replies with results. One in-flight task per worker (lease model).
+- **client channel** (worker -> driver unix socket): the worker-side
+  runtime proxies the public API (submit/put/get/wait/actor ops) to the
+  driver, which is the single-node control plane (GCS analog).
+
+Messages are tuples; multiprocessing.connection handles framing and
+pickling of the envelope. Payloads that must survive closures/lambdas
+are pre-serialized with cloudpickle by the sender (``blob`` fields).
+"""
+
+from __future__ import annotations
+
+# exec channel, driver -> worker
+EXEC_TASK = "task"            # (EXEC_TASK, task_id_bytes, fn_id, fn_blob|None,
+                              #  args_blob, arg_objects, num_returns, options)
+EXEC_ACTOR_INIT = "actor_init"  # (.., actor_id_bytes, cls_blob, args_blob, arg_objects)
+EXEC_ACTOR_CALL = "actor_call"  # (.., task_id_bytes, method, args_blob, arg_objects, num_returns)
+EXEC_SHUTDOWN = "shutdown"    # (EXEC_SHUTDOWN,)
+
+# exec channel, worker -> driver
+RESULT_OK = "ok"              # (RESULT_OK, task_id_bytes, results_blob_list)
+RESULT_ERR = "err"            # (RESULT_ERR, task_id_bytes, err_blob)
+RESULT_READY = "ready"        # worker finished booting / actor __init__ done
+
+# client channel, worker -> driver: (req_id, op, payload...)
+OP_SUBMIT = "submit"
+OP_CREATE_ACTOR = "create_actor"
+OP_SUBMIT_ACTOR = "submit_actor"
+OP_PUT = "put"
+OP_GET = "get"
+OP_WAIT = "wait"
+OP_KILL = "kill"
+OP_CANCEL = "cancel"
+OP_GET_ACTOR = "get_actor"
+OP_BORROW = "borrow"
+OP_RESOURCES = "resources"
+OP_PG_CREATE = "pg_create"
+OP_PG_REMOVE = "pg_remove"
+
+# client channel, driver -> worker: (req_id, status, payload)
+ST_OK = "ok"
+ST_ERR = "err"

@@ -1,0 +1,80 @@
+"""@remote functions (reference: python/ray/remote_function.py:266)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from ray_tpu.core.runtime import TaskOptions
+
+
+def _build_resources(num_cpus=None, num_tpus=None, resources=None,
+                     ) -> dict[str, float]:
+    out: dict[str, float] = {}
+    out["CPU"] = float(num_cpus) if num_cpus is not None else 1.0
+    if num_tpus:
+        out["TPU"] = float(num_tpus)
+    if resources:
+        out.update(resources)
+    return out
+
+
+def make_task_options(**opts: Any) -> TaskOptions:
+    resources = _build_resources(
+        opts.get("num_cpus"), opts.get("num_tpus"), opts.get("resources"))
+    pg = opts.get("placement_group")
+    sched = opts.get("scheduling_strategy", "DEFAULT")
+    if sched is not None and not isinstance(sched, str):
+        # PlacementGroupSchedulingStrategy-style object
+        pg = getattr(sched, "placement_group", pg)
+        sched = "PLACEMENT_GROUP"
+    return TaskOptions(
+        num_returns=opts.get("num_returns", 1),
+        resources=resources,
+        max_retries=opts.get("max_retries", -1),
+        retry_exceptions=bool(opts.get("retry_exceptions", False)),
+        name=opts.get("name", ""),
+        runtime_env=opts.get("runtime_env"),
+        placement_group=pg,
+        placement_group_bundle_index=opts.get(
+            "placement_group_bundle_index", -1),
+        scheduling_strategy=sched if isinstance(sched, str) else "DEFAULT",
+    )
+
+
+class RemoteFunction:
+    """Handle created by ``@ray_tpu.remote``; call via ``.remote()``."""
+
+    def __init__(self, fn, **default_opts):
+        self._fn = fn
+        self._default_opts = default_opts
+        self._fn_id: str | None = None
+        self._fn_blob: bytes | None = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__} cannot be called "
+            f"directly; use .remote()")
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {**self._default_opts, **opts}
+        rf = RemoteFunction(self._fn, **merged)
+        rf._fn_id, rf._fn_blob = self._fn_id, self._fn_blob
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.api import get_runtime
+        rt = get_runtime()
+        if self._fn_id is None:
+            self._fn_id, self._fn_blob = rt.register_function(self._fn)
+        options = make_task_options(**self._default_opts)
+        if not self._default_opts.get("name"):
+            options.name = self._fn.__name__
+        refs = rt.submit_task(self._fn_id, self._fn_blob,
+                              self._fn.__name__, args, kwargs, options)
+        return refs[0] if options.num_returns == 1 else refs
+
+    @property
+    def underlying_function(self):
+        return self._fn

@@ -1,0 +1,1309 @@
+"""Single-node driver runtime: scheduler, worker pool, object directory.
+
+This is the round-1 control plane. It plays the roles the reference
+splits across three C++ processes (SURVEY.md §1 L2):
+
+- *GCS analog*: actor table, named actors, placement groups, resource
+  view — all in the driver process.
+- *Raylet analog*: worker pool with per-runtime-env caching and a
+  dispatch loop (``_dispatch_loop`` ~ ClusterTaskManager::
+  ScheduleAndDispatchTasks, cluster_task_manager.cc:136), resource
+  accounting, lease-style worker reuse keyed by env.
+- *Object manager analog*: two-tier store (memory + shared memory) with
+  an object directory and spilling.
+
+Worker processes proxy the public API back here over a unix socket
+(``_serve_client`` — the worker→raylet/GCS client path), which is what
+makes nested patterns work: a Tune trial actor creating a Train worker
+group creates real actors through this runtime.
+
+Multi-node (GCS over gRPC/DCN, remote raylets) layers on in later
+rounds; the scheduler interfaces are written so a remote node is "a
+worker pool we reach over a socket" — same dispatch protocol.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+from multiprocessing import connection as mpc
+from typing import Any, Callable
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.accelerator import detect_tpu_chips
+from ray_tpu.core.config import Config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+)
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import (
+    MemoryStore,
+    SharedMemoryStore,
+    read_descriptor,
+)
+from ray_tpu.core.serialization import SerializedObject
+
+
+# --------------------------------------------------------------------------
+# Task/actor bookkeeping structures
+# --------------------------------------------------------------------------
+
+@dataclass
+class TaskOptions:
+    num_returns: int = 1
+    resources: dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+    max_retries: int = -1          # -1 = use config default
+    retry_exceptions: bool = False
+    name: str = ""
+    runtime_env: dict | None = None
+    placement_group: Any = None    # PlacementGroup | None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: str = "DEFAULT"
+
+
+@dataclass
+class TaskRecord:
+    task_id: TaskID
+    fn_id: str
+    name: str
+    args_blob: bytes
+    arg_refs: list[ObjectRef]
+    options: TaskOptions
+    return_ids: list[ObjectID]
+    attempts: int = 0
+    state: str = "PENDING"         # PENDING/RUNNING/FINISHED/FAILED/CANCELLED
+    worker: "WorkerHandle | None" = None
+    worker_index: int = -1
+    submitted_at: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    name: str
+    cls_name: str
+    cls_blob: bytes
+    init_args_blob: bytes
+    init_arg_refs: list[ObjectRef]
+    options: TaskOptions
+    max_restarts: int
+    max_concurrency: int
+    worker: "WorkerHandle | None" = None
+    state: str = "PENDING"         # PENDING/ALIVE/RESTARTING/DEAD
+    restart_count: int = 0
+    in_flight: dict[TaskID, tuple] = field(default_factory=dict)
+    ready_event: threading.Event = field(default_factory=threading.Event)
+    creation_error: Exception | None = None
+    # Per-actor ordered submit queue + single pusher thread (reference:
+    # SequentialActorSubmitQueue, actor_task_submitter.h:75) — preserves
+    # per-handle call ordering.
+    submit_queue: "deque | None" = None
+    queue_cv: threading.Condition = field(
+        default_factory=threading.Condition)
+    pusher: "threading.Thread | None" = None
+
+
+@dataclass
+class PGRecord:
+    pg_id: PlacementGroupID
+    bundles: list[dict[str, float]]
+    strategy: str
+    # Resources still unclaimed inside the reservation; tasks/actors
+    # scheduled into the PG draw from here, not the node pool.
+    avail: dict[str, float] = field(default_factory=dict)
+    ready: threading.Event = field(default_factory=threading.Event)
+    created: bool = False
+
+
+class WorkerHandle:
+    """A pooled worker process plus its exec channel.
+
+    Workers are standalone processes running a dedicated entry module
+    (``python -m ray_tpu.core.worker_entry``) that dials back to the
+    driver's unix socket — the reference's model (raylet spawns
+    ``default_worker.py``), deliberately NOT multiprocessing-spawn,
+    which would re-import the user's ``__main__`` and re-execute
+    unguarded driver scripts inside every worker.
+    """
+
+    _counter = itertools.count()
+    BOOT_TIMEOUT_S = 120.0
+
+    def __init__(self, runtime: "DriverRuntime", env_key: str,
+                 env_vars: dict[str, str]):
+        self.index = next(self._counter)
+        self.env_key = env_key
+        self.busy = False
+        self.is_actor = False
+        self.actor_id: ActorID | None = None
+        self.dead = False
+        self.last_idle = time.monotonic()
+        self.sent_fn_ids: set[str] = set()
+        self._runtime = runtime
+        self.send_lock = threading.Lock()
+        self.token = os.urandom(8).hex()
+        self.conn = None
+        self._conn_ready = threading.Event()
+
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env.update(env_vars)
+        env["RAY_TPU_WORKER"] = "1"
+        # Propagate the driver's import path so workers resolve the same
+        # modules (incl. a repo added to sys.path by the driver script).
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] +
+            [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_entry",
+             runtime.client_address, self.token],
+            env=env,
+            cwd=os.getcwd(),
+        )
+        runtime._register_pending_worker(self)
+
+    def attach_conn(self, conn) -> None:
+        """Called by the runtime's accept loop once the worker dials in."""
+        self.conn = conn
+        self._conn_ready.set()
+        self.reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"worker_reader_{self.index}")
+        self.reader.start()
+
+    def send(self, msg: tuple) -> None:
+        if not self._conn_ready.wait(self.BOOT_TIMEOUT_S):
+            raise RuntimeError(
+                f"worker {self.index} failed to connect within "
+                f"{self.BOOT_TIMEOUT_S}s (pid={self.proc.pid})")
+        with self.send_lock:
+            self.conn.send(msg)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = self.conn.recv()
+                try:
+                    self._runtime._on_worker_message(self, msg)
+                except Exception:  # noqa: BLE001
+                    # A malformed message must not kill the reader —
+                    # that would strand the worker's in-flight task.
+                    import traceback as tb
+                    tb.print_exc()
+        except (EOFError, OSError):
+            pass
+        finally:
+            self.dead = True
+            self._runtime._on_worker_exit(self)
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        try:
+            if self._conn_ready.is_set():
+                with self.send_lock:
+                    self.conn.send((P.EXEC_SHUTDOWN,))
+        except (OSError, BrokenPipeError):
+            pass
+        try:
+            self.proc.wait(timeout)
+        except Exception:  # noqa: BLE001
+            self.proc.terminate()
+            try:
+                self.proc.wait(1.0)
+            except Exception:  # noqa: BLE001
+                self.proc.kill()
+
+
+# --------------------------------------------------------------------------
+# Driver runtime
+# --------------------------------------------------------------------------
+
+class DriverRuntime:
+    def __init__(self, config: Config, num_cpus: int | None = None,
+                 num_tpus: int | None = None,
+                 resources: dict[str, float] | None = None,
+                 local_mode: bool = False):
+        self.config = config
+        self.job_id = JobID.next()
+        self.local_mode = local_mode
+        self._shutdown = False
+
+        ncpu = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
+        ntpu = num_tpus if num_tpus is not None else detect_tpu_chips()
+        self.total_resources: dict[str, float] = {"CPU": float(ncpu)}
+        if ntpu:
+            self.total_resources["TPU"] = float(ntpu)
+        if resources:
+            self.total_resources.update(resources)
+        self.avail = dict(self.total_resources)
+        self._res_cv = threading.Condition()
+
+        # Object plane
+        self.memory_store = MemoryStore()
+        cap = config.object_store_memory
+        if cap <= 0:
+            try:
+                total_ram = os.sysconf("SC_PHYS_PAGES") * os.sysconf(
+                    "SC_PAGE_SIZE")
+            except (ValueError, OSError):
+                total_ram = 8 << 30
+            cap = int(total_ram * 0.3)
+        self.shm_store = SharedMemoryStore(
+            cap, config.spill_dir, config.object_spilling_threshold)
+        self._obj_cv = threading.Condition()
+        self._errors: dict[ObjectID, bytes] = {}   # oid -> error blob
+        self._obj_locations: dict[ObjectID, str] = {}  # "mem" | "shm"
+        self._put_counter = itertools.count()
+
+        # Reference counting (driver-local; see object_ref docstring)
+        self._refcounts: dict[ObjectID, int] = {}
+        self._escaped: set[ObjectID] = set()
+        self._ref_lock = threading.Lock()
+
+        # Task plane
+        self._tasks: dict[TaskID, TaskRecord] = {}
+        self._done_tasks: deque[TaskRecord] = deque(
+            maxlen=config.task_event_buffer_size)
+        self._pending: deque[TaskRecord] = deque()
+        self._task_lock = threading.Lock()
+        self._fn_cache: dict[str, bytes] = {}
+
+        # Worker pool
+        self._workers: list[WorkerHandle] = []
+        self._idle: dict[str, list[WorkerHandle]] = {}
+        self._pool_lock = threading.Lock()
+        self.max_workers = config.max_workers or max(2, ncpu)
+
+        # Actor plane
+        self._actors: dict[ActorID, ActorRecord] = {}
+        self._named_actors: dict[str, ActorID] = {}
+        self._actor_lock = threading.Lock()
+
+        # Placement groups
+        self._pgs: dict[PlacementGroupID, PGRecord] = {}
+        self._pg_lock = threading.Lock()
+
+        # Events / timeline
+        self._events: deque = deque(maxlen=config.task_event_buffer_size)
+
+        # Client listener (worker -> driver API proxy + exec channels)
+        sock_dir = f"/tmp/ray_tpu/{os.getpid()}"
+        os.makedirs(sock_dir, exist_ok=True)
+        self.client_address = os.path.join(sock_dir, "runtime.sock")
+        self._listener = mpc.Listener(self.client_address, family="AF_UNIX")
+        self._pending_workers: dict[str, WorkerHandle] = {}
+        self._pending_workers_lock = threading.Lock()
+        self._client_threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="client_accept")
+        self._accept_thread.start()
+
+        if not local_mode:
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True, name="dispatcher")
+            self._dispatch_thread.start()
+
+    # ---------------- object plane ----------------
+
+    def register_ref(self, ref: ObjectRef) -> ObjectRef:
+        with self._ref_lock:
+            self._refcounts[ref.id] = self._refcounts.get(ref.id, 0) + 1
+        import weakref
+        weakref.finalize(ref, self._dec_ref, ref.id)
+        return ref
+
+    def _dec_ref(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            cnt = self._refcounts.get(oid, 0) - 1
+            if cnt > 0:
+                self._refcounts[oid] = cnt
+                return
+            self._refcounts.pop(oid, None)
+            if oid in self._escaped:
+                # The ref was serialized into a task arg / another object;
+                # a borrower may still resolve it. Pin until shutdown
+                # (distributed borrower tracking is a later round).
+                return
+        self.memory_store.delete(oid)
+        self.shm_store.delete(oid)
+        with self._obj_cv:
+            self._obj_locations.pop(oid, None)
+
+    def on_ref_escaped(self, oid: ObjectID) -> None:
+        with self._ref_lock:
+            self._escaped.add(oid)
+
+    def on_ref_deserialized(self, ref: ObjectRef) -> None:
+        # Driver re-receiving one of its own refs: nothing to do; the
+        # object is pinned via _escaped.
+        pass
+
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.for_put(next(self._put_counter))
+        self._store_value(oid, ser.serialize(value))
+        return self.register_ref(ObjectRef(oid))
+
+    def put_serialized(self, obj: SerializedObject) -> ObjectRef:
+        oid = ObjectID.for_put(next(self._put_counter))
+        self._store_value(oid, obj)
+        return self.register_ref(ObjectRef(oid))
+
+    def _store_value(self, oid: ObjectID, obj: SerializedObject) -> None:
+        if obj.total_size >= self.config.max_direct_call_object_size:
+            self.shm_store.put(oid, obj)
+            loc = "shm"
+        else:
+            self.memory_store.put(oid, obj)
+            loc = "mem"
+        with self._obj_cv:
+            self._obj_locations[oid] = loc
+            self._obj_cv.notify_all()
+        # Wake the dispatcher: a pending task's dependency may be ready.
+        with self._res_cv:
+            self._res_cv.notify_all()
+
+    def _store_error(self, oid: ObjectID, err_blob: bytes) -> None:
+        with self._obj_cv:
+            self._errors[oid] = err_blob
+            self._obj_locations[oid] = "err"
+            self._obj_cv.notify_all()
+        with self._res_cv:
+            self._res_cv.notify_all()
+
+    def _object_available(self, oid: ObjectID) -> bool:
+        return oid in self._obj_locations
+
+    def wait_available(self, oids: list[ObjectID], num_returns: int,
+                       timeout: float | None) -> tuple[list, list]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._obj_cv:
+            while True:
+                ready = [o for o in oids if o in self._obj_locations]
+                if len(ready) >= num_returns:
+                    ready_set = set(ready[:num_returns])
+                    done = [o for o in oids if o in ready_set]
+                    rest = [o for o in oids if o not in ready_set]
+                    return done, rest
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    ready_set = set(ready)
+                    return ([o for o in oids if o in ready_set],
+                            [o for o in oids if o not in ready_set])
+                self._obj_cv.wait(remaining)
+
+    def get_serialized(self, oid: ObjectID,
+                       timeout: float | None = None) -> SerializedObject:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._obj_cv:
+            while oid not in self._obj_locations:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(oid.hex())
+                self._obj_cv.wait(remaining)
+            loc = self._obj_locations[oid]
+            if loc == "err":
+                raise ser.loads(self._errors[oid])
+        if loc == "mem":
+            obj = self.memory_store.try_get(oid)
+            if obj is not None:
+                return obj
+        desc = self.shm_store.get_descriptor(oid)
+        if desc is None:
+            # raced a deletion
+            obj = self.memory_store.try_get(oid)
+            if obj is None:
+                raise ObjectLostError(oid.hex())
+            return obj
+        return read_descriptor(desc)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = [ser.deserialize(self.get_serialized(r.id, timeout))
+               for r in refs]
+        return out[0] if single else out
+
+    async def get_async(self, ref: ObjectRef):
+        import asyncio
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.get, ref)
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def wait(self, refs: list[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None):
+        done_ids, rest_ids = self.wait_available(
+            [r.id for r in refs], num_returns, timeout)
+        by_id = {r.id: r for r in refs}
+        return [by_id[i] for i in done_ids], [by_id[i] for i in rest_ids]
+
+    # ---------------- function cache ----------------
+
+    def register_function(self, fn: Callable) -> tuple[str, bytes]:
+        blob = ser.dumps(fn)
+        fn_id = hashlib.sha1(blob).hexdigest()
+        self._fn_cache.setdefault(fn_id, blob)
+        return fn_id, blob
+
+    # ---------------- task plane ----------------
+
+    def submit_task(self, fn_id: str, fn_blob: bytes | None,
+                    fn_name: str, args: tuple, kwargs: dict,
+                    options: TaskOptions) -> list[ObjectRef]:
+        if fn_blob is not None:
+            self._fn_cache.setdefault(fn_id, fn_blob)
+        task_id = TaskID.for_normal_task(self.job_id)
+        return_ids = [ObjectID.for_return(task_id, i)
+                      for i in range(options.num_returns)]
+        args_blob, arg_refs = self._pack_args(args, kwargs)
+        rec = TaskRecord(
+            task_id=task_id, fn_id=fn_id, name=fn_name or "task",
+            args_blob=args_blob, arg_refs=arg_refs, options=options,
+            return_ids=return_ids, submitted_at=time.time())
+        with self._task_lock:
+            self._tasks[task_id] = rec
+        self._event(rec, "PENDING")
+
+        if self.local_mode:
+            self._execute_local(rec)
+        else:
+            with self._res_cv:
+                self._pending.append(rec)
+                self._res_cv.notify_all()
+        return [self.register_ref(ObjectRef(oid)) for oid in return_ids]
+
+    def _pack_args(self, args: tuple, kwargs: dict):
+        # Top-level ObjectRefs are resolved to values before execution
+        # (reference: LocalDependencyResolver / plasma arg fetch). Nested
+        # refs pass through as refs.
+        arg_refs = [a for a in list(args) + list(kwargs.values())
+                    if isinstance(a, ObjectRef)]
+        return ser.dumps((args, kwargs)), arg_refs
+
+    def _resolve_args_payload(self, rec_args_blob: bytes,
+                              arg_refs: list[ObjectRef]):
+        # Ship resolved (serialized) values of top-level refs alongside.
+        resolved = {}
+        for r in arg_refs:
+            obj = self.get_serialized(r.id)
+            resolved[r.id.binary()] = (obj.data, obj.buffers)
+        return resolved
+
+    def _execute_local(self, rec: TaskRecord) -> None:
+        fn = ser.loads(self._fn_cache[rec.fn_id])
+        args, kwargs = ser.loads(rec.args_blob)
+        args = tuple(self.get(a) if isinstance(a, ObjectRef) else a
+                     for a in args)
+        kwargs = {k: (self.get(v) if isinstance(v, ObjectRef) else v)
+                  for k, v in kwargs.items()}
+        rec.state = "RUNNING"
+        rec.started_at = time.time()
+        try:
+            result = fn(*args, **kwargs)
+            self._store_returns(rec, result)
+            rec.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            err = TaskError(rec.name, tb, e)
+            blob = ser.dumps(err)
+            for oid in rec.return_ids:
+                self._store_error(oid, blob)
+            rec.state = "FAILED"
+        rec.finished_at = time.time()
+        self._event(rec, rec.state)
+        self._prune_task(rec)
+
+    def _store_returns(self, rec: TaskRecord, result) -> None:
+        n = rec.options.num_returns
+        if n == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != n:
+                raise ValueError(
+                    f"task {rec.name} declared num_returns={n} but "
+                    f"returned {len(values)} values")
+        for oid, v in zip(rec.return_ids, values):
+            self._store_value(oid, v if isinstance(v, SerializedObject)
+                              else ser.serialize(v))
+
+    # ---------------- dispatch loop (raylet analog) ----------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._shutdown:
+            with self._res_cv:
+                rec = self._next_schedulable_locked()
+                while rec is None and not self._shutdown:
+                    self._res_cv.wait(0.5)
+                    self._reap_idle_workers()
+                    rec = self._next_schedulable_locked()
+                if self._shutdown:
+                    return
+                if rec.state == "FAILED":
+                    # dependency error — already propagated to returns
+                    self._prune_task(rec)
+                    continue
+                self._acquire_locked(self._effective_resources(rec.options),
+                                     rec.options.placement_group)
+            try:
+                self._dispatch(rec)
+            except Exception:  # noqa: BLE001
+                self._release(self._effective_resources(rec.options),
+                              rec.options.placement_group)
+                err = TaskError(rec.name, traceback.format_exc())
+                blob = ser.dumps(err)
+                for oid in rec.return_ids:
+                    self._store_error(oid, blob)
+
+    def _effective_resources(self, options: TaskOptions) -> dict[str, float]:
+        return options.resources or {"CPU": 1.0}
+
+    def _deps_state(self, rec: TaskRecord) -> str:
+        """'ready' | 'waiting' | 'error' for the task's arg objects
+        (reference: DependencyManager gating before dispatch,
+        dependency_manager.cc)."""
+        for r in rec.arg_refs:
+            loc = self._obj_locations.get(r.id)
+            if loc is None:
+                return "waiting"
+            if loc == "err":
+                return "error"
+        return "ready"
+
+    def _next_schedulable_locked(self) -> TaskRecord | None:
+        for i, rec in enumerate(self._pending):
+            deps = self._deps_state(rec)
+            if deps == "error":
+                # Propagate the dependency's error to this task's
+                # returns (reference: error propagation through lineage).
+                del self._pending[i]
+                for r in rec.arg_refs:
+                    blob = self._errors.get(r.id)
+                    if blob is not None:
+                        for oid in rec.return_ids:
+                            self._store_error(oid, blob)
+                        break
+                rec.state = "FAILED"
+                return rec
+            if deps != "ready":
+                continue
+            need = self._effective_resources(rec.options)
+            if self._fits_locked(need, rec.options.placement_group):
+                del self._pending[i]
+                return rec
+        return None
+
+    def _pool_for(self, pg) -> dict[str, float]:
+        """Resource pool a task draws from: the node pool, or its
+        placement group's reservation (reference: bundles own their
+        reserved resources; tasks in a PG consume from the bundle,
+        not the node — placement_group_resource_manager.cc)."""
+        if pg is not None:
+            pg_rec = self._pgs.get(pg.id)
+            if pg_rec is not None:
+                return pg_rec.avail
+        return self.avail
+
+    def _fits_locked(self, need: dict[str, float], pg=None) -> bool:
+        pool = self._pool_for(pg)
+        if pg is not None:
+            pg_rec = self._pgs.get(pg.id)
+            if pg_rec is None or not pg_rec.created:
+                return False
+        return all(pool.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+    def _acquire_locked(self, need: dict[str, float], pg=None) -> None:
+        pool = self._pool_for(pg)
+        for k, v in need.items():
+            pool[k] = pool.get(k, 0.0) - v
+
+    def acquire_resources(self, need: dict[str, float],
+                          timeout: float | None = None,
+                          pg=None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._res_cv:
+            while not self._fits_locked(need, pg):
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._res_cv.wait(remaining)
+            self._acquire_locked(need, pg)
+            return True
+
+    def _release(self, resources: dict[str, float], pg=None) -> None:
+        with self._res_cv:
+            pool = self._pool_for(pg)
+            for k, v in resources.items():
+                pool[k] = pool.get(k, 0.0) + v
+            self._res_cv.notify_all()
+
+    def _env_for_options(self, options: TaskOptions) -> tuple[str, dict]:
+        env_vars: dict[str, str] = {}
+        need = self._effective_resources(options)
+        if need.get("TPU", 0) <= 0:
+            # CPU-only workers must not grab the TPU runtime.
+            env_vars["JAX_PLATFORMS"] = "cpu"
+        if options.runtime_env and "env_vars" in options.runtime_env:
+            env_vars.update(options.runtime_env["env_vars"])
+        key = hashlib.sha1(
+            ser.dumps(sorted(env_vars.items()))).hexdigest()[:12]
+        return key, env_vars
+
+    def _take_worker(self, env_key: str, env_vars: dict) -> WorkerHandle:
+        with self._pool_lock:
+            pool = self._idle.get(env_key, [])
+            while pool:
+                w = pool.pop()
+                if not w.dead:
+                    w.busy = True
+                    return w
+            w = WorkerHandle(self, env_key, env_vars)
+            w.busy = True
+            self._workers.append(w)
+            return w
+
+    def _return_worker(self, w: WorkerHandle) -> None:
+        if w.dead:
+            return
+        with self._pool_lock:
+            w.busy = False
+            w.last_idle = time.monotonic()
+            self._idle.setdefault(w.env_key, []).append(w)
+
+    def _reap_idle_workers(self) -> None:
+        ttl = self.config.idle_worker_ttl_s
+        now = time.monotonic()
+        with self._pool_lock:
+            for key, pool in self._idle.items():
+                keep = []
+                for w in pool:
+                    if now - w.last_idle > ttl and len(self._workers) > 1:
+                        self._workers.remove(w)
+                        threading.Thread(target=w.shutdown,
+                                         daemon=True).start()
+                    else:
+                        keep.append(w)
+                self._idle[key] = keep
+
+    def _dispatch(self, rec: TaskRecord) -> None:
+        env_key, env_vars = self._env_for_options(rec.options)
+        w = self._take_worker(env_key, env_vars)
+        rec.worker = w
+        rec.worker_index = w.index
+        rec.state = "RUNNING"
+        rec.started_at = time.time()
+        rec.attempts += 1
+        fn_blob = None
+        if rec.fn_id not in w.sent_fn_ids:
+            fn_blob = self._fn_cache[rec.fn_id]
+            w.sent_fn_ids.add(rec.fn_id)
+        resolved = self._resolve_args_payload(rec.args_blob, rec.arg_refs)
+        w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id, fn_blob,
+                rec.args_blob, resolved, rec.options.num_returns))
+        self._event(rec, "RUNNING")
+
+    # ---------------- worker message handling ----------------
+
+    def _on_worker_message(self, w: WorkerHandle, msg: tuple) -> None:
+        kind = msg[0]
+        if kind == P.RESULT_OK:
+            _, task_id_bytes, results = msg
+            task_id = TaskID(task_id_bytes)
+            if w.is_actor:
+                self._finish_actor_task(w, task_id, results, None)
+            else:
+                self._finish_task(w, task_id, results, None)
+        elif kind == P.RESULT_ERR:
+            _, task_id_bytes, err_blob = msg
+            if w.is_actor and len(task_id_bytes) == ActorID.SIZE:
+                # Actor __init__ failed: the id on the wire is the
+                # 16-byte actor id, not a 24-byte task id. Surface the
+                # real traceback as the creation error.
+                rec = self._actors.get(ActorID(task_id_bytes))
+                if rec is not None:
+                    rec.creation_error = ser.loads(err_blob)
+                    rec.state = "DEAD"
+                    rec.ready_event.set()
+                return
+            task_id = TaskID(task_id_bytes)
+            if w.is_actor:
+                self._finish_actor_task(w, task_id, None, err_blob)
+            else:
+                self._finish_task(w, task_id, None, err_blob)
+        elif kind == P.RESULT_READY:
+            if w.is_actor and w.actor_id is not None:
+                rec = self._actors.get(w.actor_id)
+                if rec is not None:
+                    rec.state = "ALIVE"
+                    rec.ready_event.set()
+
+    def _finish_task(self, w: WorkerHandle, task_id: TaskID,
+                     results, err_blob) -> None:
+        with self._task_lock:
+            rec = self._tasks.get(task_id)
+        if rec is None:
+            return
+        if err_blob is None:
+            vals = [SerializedObject(data=d, buffers=list(bufs))
+                    for d, bufs in results]
+            for oid, v in zip(rec.return_ids, vals):
+                self._store_value(oid, v)
+            rec.state = "FINISHED"
+        else:
+            for oid in rec.return_ids:
+                self._store_error(oid, err_blob)
+            rec.state = "FAILED"
+        rec.finished_at = time.time()
+        self._event(rec, rec.state)
+        self._release(self._effective_resources(rec.options),
+                      rec.options.placement_group)
+        self._return_worker(w)
+        self._prune_task(rec)
+
+    def _on_worker_exit(self, w: WorkerHandle) -> None:
+        if self._shutdown:
+            return
+        with self._pool_lock:
+            if w in self._workers:
+                self._workers.remove(w)
+            for pool in self._idle.values():
+                if w in pool:
+                    pool.remove(w)
+        if w.is_actor and w.actor_id is not None:
+            self._on_actor_death(w.actor_id)
+            return
+        # A pooled worker died mid-task: retry or fail the task
+        # (reference: owner-side TaskManager retries, task_manager.cc).
+        with self._task_lock:
+            victim = None
+            for rec in self._tasks.values():
+                if rec.worker is w and rec.state in ("RUNNING",
+                                                     "CANCELLED"):
+                    victim = rec
+                    break
+        if victim is None:
+            return
+        self._release(self._effective_resources(victim.options),
+                      victim.options.placement_group)
+        if victim.state == "CANCELLED":
+            # cancel(force=True): error already stored; never retry.
+            self._prune_task(victim)
+            return
+        max_retries = (victim.options.max_retries
+                       if victim.options.max_retries >= 0
+                       else self.config.task_max_retries)
+        if victim.attempts <= max_retries:
+            victim.state = "PENDING"
+            victim.worker = None
+            with self._res_cv:
+                self._pending.append(victim)
+                self._res_cv.notify_all()
+        else:
+            err = TaskError(
+                victim.name,
+                f"worker process died (pid={w.proc.pid}, "
+                f"exitcode={w.proc.returncode}) after "
+                f"{victim.attempts} attempts")
+            blob = ser.dumps(err)
+            for oid in victim.return_ids:
+                self._store_error(oid, blob)
+            victim.state = "FAILED"
+            self._event(victim, "FAILED")
+            self._prune_task(victim)
+
+    def _prune_task(self, rec: TaskRecord) -> None:
+        """Drop the payload of a finished task and evict the record to a
+        bounded buffer — records otherwise accumulate for the process
+        lifetime (the timeline keeps a ring-buffered view)."""
+        rec.args_blob = b""
+        rec.arg_refs = []
+        rec.worker = None
+        with self._task_lock:
+            self._tasks.pop(rec.task_id, None)
+            self._done_tasks.append(rec)
+
+    # ---------------- actor plane (GCS actor manager analog) ----------
+
+    def create_actor(self, cls_blob: bytes, cls_name: str,
+                     args: tuple, kwargs: dict, options: TaskOptions,
+                     name: str = "", max_restarts: int = 0,
+                     max_concurrency: int = 1) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        args_blob, arg_refs = self._pack_args(args, kwargs)
+        rec = ActorRecord(
+            actor_id=actor_id, name=name, cls_name=cls_name,
+            cls_blob=cls_blob, init_args_blob=args_blob,
+            init_arg_refs=arg_refs, options=options,
+            max_restarts=max_restarts, max_concurrency=max_concurrency)
+        with self._actor_lock:
+            if name:
+                if name in self._named_actors:
+                    raise ValueError(f"actor name {name!r} already taken")
+                self._named_actors[name] = actor_id
+            self._actors[actor_id] = rec
+        threading.Thread(target=self._start_actor, args=(rec,),
+                         daemon=True).start()
+        return actor_id
+
+    def _start_actor(self, rec: ActorRecord) -> None:
+        try:
+            need = self._effective_resources(rec.options)
+            ok = self.acquire_resources(
+                need, timeout=self.config.actor_creation_timeout_s,
+                pg=rec.options.placement_group)
+            if not ok:
+                raise TimeoutError(
+                    f"could not acquire resources {need} for actor "
+                    f"{rec.cls_name} within "
+                    f"{self.config.actor_creation_timeout_s}s")
+            env_key, env_vars = self._env_for_options(rec.options)
+            w = WorkerHandle(self, f"actor_{rec.actor_id.hex()[:8]}",
+                             env_vars)
+            w.is_actor = True
+            w.actor_id = rec.actor_id
+            w.busy = True
+            rec.worker = w
+            with self._pool_lock:
+                self._workers.append(w)
+            resolved = self._resolve_args_payload(
+                rec.init_args_blob, rec.init_arg_refs)
+            w.send((P.EXEC_ACTOR_INIT, rec.actor_id.binary(),
+                    rec.cls_blob, rec.init_args_blob, resolved,
+                    rec.max_concurrency))
+        except Exception as e:  # noqa: BLE001
+            rec.creation_error = e
+            rec.state = "DEAD"
+            rec.ready_event.set()
+
+    def submit_actor_task(self, actor_id: ActorID, method: str,
+                          args: tuple, kwargs: dict,
+                          num_returns: int = 1) -> list[ObjectRef]:
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            raise ActorDiedError(actor_id.hex(), "unknown actor")
+        task_id = TaskID.for_actor_task(actor_id)
+        return_ids = [ObjectID.for_return(task_id, i)
+                      for i in range(num_returns)]
+        args_blob, arg_refs = self._pack_args(args, kwargs)
+        refs = [self.register_ref(ObjectRef(oid)) for oid in return_ids]
+        with rec.queue_cv:
+            if rec.submit_queue is None:
+                rec.submit_queue = deque()
+            rec.submit_queue.append(
+                (task_id, return_ids, method, args_blob, arg_refs,
+                 num_returns))
+            if rec.pusher is None:
+                rec.pusher = threading.Thread(
+                    target=self._actor_push_loop, args=(rec,),
+                    daemon=True,
+                    name=f"actor_push_{rec.actor_id.hex()[:8]}")
+                rec.pusher.start()
+            rec.queue_cv.notify_all()
+        return refs
+
+    def _actor_push_loop(self, rec: ActorRecord) -> None:
+        """Single pusher per actor: drains the submit queue in FIFO
+        order, waiting out starts/restarts (reference: client-side
+        queueing while actor restarts, ActorTaskSubmitter)."""
+        while not self._shutdown:
+            with rec.queue_cv:
+                while not rec.submit_queue:
+                    rec.queue_cv.wait(1.0)
+                    if self._shutdown:
+                        return
+                item = rec.submit_queue.popleft()
+            (task_id, return_ids, method, args_blob, arg_refs,
+             num_returns) = item
+            try:
+                if not rec.ready_event.wait(
+                        self.config.actor_creation_timeout_s):
+                    raise ActorDiedError(rec.actor_id.hex(),
+                                         "actor failed to start in time")
+                if rec.state == "DEAD":
+                    raise rec.creation_error or ActorDiedError(
+                        rec.actor_id.hex(), "actor is dead")
+                resolved = self._resolve_args_payload(args_blob, arg_refs)
+                rec.in_flight[task_id] = (return_ids, method)
+                rec.worker.send((P.EXEC_ACTOR_CALL, task_id.binary(),
+                                 method, args_blob, resolved, num_returns))
+            except Exception as e:  # noqa: BLE001
+                rec.in_flight.pop(task_id, None)
+                blob = ser.dumps(e if isinstance(e, ActorDiedError) else
+                                 TaskError(method, traceback.format_exc(),
+                                           e))
+                for oid in return_ids:
+                    self._store_error(oid, blob)
+
+    def _finish_actor_task(self, w: WorkerHandle, task_id: TaskID,
+                           results, err_blob) -> None:
+        rec = self._actors.get(w.actor_id) if w.actor_id else None
+        if rec is None:
+            return
+        entry = rec.in_flight.pop(task_id, None)
+        if entry is None:
+            return
+        return_ids, _method = entry
+        if err_blob is None:
+            vals = [SerializedObject(data=d, buffers=list(bufs))
+                    for d, bufs in results]
+            for oid, v in zip(return_ids, vals):
+                self._store_value(oid, v)
+        else:
+            for oid in return_ids:
+                self._store_error(oid, err_blob)
+
+    def _on_actor_death(self, actor_id: ActorID) -> None:
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return
+        was_alive = rec.state == "ALIVE"
+        # Fail all in-flight calls.
+        err = ActorDiedError(actor_id.hex(), "actor process exited")
+        blob = ser.dumps(err)
+        for return_ids, _m in rec.in_flight.values():
+            for oid in return_ids:
+                self._store_error(oid, blob)
+        rec.in_flight.clear()
+        self._release(self._effective_resources(rec.options),
+                      rec.options.placement_group)
+        if (was_alive and rec.restart_count < rec.max_restarts
+                and not self._shutdown):
+            # GCS actor restart state machine analog
+            # (gcs_actor_manager.cc:1358 RestartActor).
+            rec.restart_count += 1
+            rec.state = "RESTARTING"
+            rec.ready_event.clear()
+            threading.Thread(target=self._start_actor, args=(rec,),
+                             daemon=True).start()
+        else:
+            rec.state = "DEAD"
+            rec.creation_error = err
+            rec.ready_event.set()
+            with self._actor_lock:
+                if rec.name and self._named_actors.get(rec.name) == actor_id:
+                    del self._named_actors[rec.name]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        rec = self._actors.get(actor_id)
+        if rec is None or rec.worker is None:
+            return
+        if no_restart:
+            rec.max_restarts = rec.restart_count  # disable further restarts
+        # Leave rec.state alone: _on_actor_death decides restart-vs-dead
+        # from (state == ALIVE, restarts remaining); with no_restart the
+        # capped max_restarts forces the permanent-death branch.
+        rec.worker.proc.terminate()
+
+    def get_named_actor(self, name: str) -> ActorID:
+        with self._actor_lock:
+            if name not in self._named_actors:
+                raise ValueError(f"no actor named {name!r}")
+            return self._named_actors[name]
+
+    def actor_state(self, actor_id: ActorID) -> str:
+        rec = self._actors.get(actor_id)
+        return rec.state if rec else "DEAD"
+
+    def wait_actor_ready(self, actor_id: ActorID,
+                         timeout: float | None = None) -> None:
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            raise ActorDiedError(actor_id.hex(), "unknown actor")
+        rec.ready_event.wait(timeout)
+        if rec.state == "DEAD":
+            raise rec.creation_error or ActorDiedError(
+                actor_id.hex(), "actor failed to start")
+
+    # ---------------- placement groups ----------------
+
+    def create_placement_group(self, bundles: list[dict[str, float]],
+                               strategy: str) -> PlacementGroupID:
+        pg_id = PlacementGroupID.from_random()
+        rec = PGRecord(pg_id=pg_id, bundles=bundles, strategy=strategy)
+        with self._pg_lock:
+            self._pgs[pg_id] = rec
+
+        def reserve():
+            total: dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            if self.acquire_resources(total, timeout=None):
+                with self._res_cv:
+                    rec.avail = dict(total)
+                    rec.created = True
+                    self._res_cv.notify_all()
+                rec.ready.set()
+
+        threading.Thread(target=reserve, daemon=True).start()
+        return pg_id
+
+    def pg_ready(self, pg_id: PlacementGroupID,
+                 timeout: float | None = None) -> bool:
+        rec = self._pgs.get(pg_id)
+        if rec is None:
+            return False
+        return rec.ready.wait(timeout)
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._pg_lock:
+            rec = self._pgs.pop(pg_id, None)
+        if rec and rec.created:
+            # Return only the unclaimed share; resources held by still-
+            # running PG tasks flow back to the node pool when they
+            # finish (after removal, _pool_for resolves to the node).
+            self._release(rec.avail)
+
+    # ---------------- cancellation ----------------
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        task_id = ref.id.task_id()
+        with self._res_cv:
+            for i, rec in enumerate(self._pending):
+                if rec.task_id == task_id:
+                    del self._pending[i]
+                    blob = ser.dumps(TaskCancelledError(rec.name))
+                    for oid in rec.return_ids:
+                        self._store_error(oid, blob)
+                    rec.state = "CANCELLED"
+                    return
+        if force:
+            rec = self._tasks.get(task_id)
+            if rec is not None and rec.worker is not None \
+                    and rec.state == "RUNNING":
+                # Mark cancelled and store the error BEFORE terminating:
+                # _on_worker_exit must see CANCELLED, not RUNNING, or it
+                # would retry the task we are killing.
+                rec.state = "CANCELLED"
+                blob = ser.dumps(TaskCancelledError(rec.name))
+                for oid in rec.return_ids:
+                    self._store_error(oid, blob)
+                rec.worker.proc.terminate()
+
+    # ---------------- introspection ----------------
+
+    def available_resources(self) -> dict[str, float]:
+        with self._res_cv:
+            return dict(self.avail)
+
+    def cluster_resources(self) -> dict[str, float]:
+        return dict(self.total_resources)
+
+    def nodes(self) -> list[dict]:
+        return [{
+            "NodeID": "local",
+            "Alive": True,
+            "Resources": dict(self.total_resources),
+            "alive_workers": len(self._workers),
+        }]
+
+    def _event(self, rec: TaskRecord, state: str) -> None:
+        self._events.append({
+            "task_id": rec.task_id.hex(), "name": rec.name,
+            "state": state, "ts": time.time(),
+        })
+
+    def timeline(self) -> list[dict]:
+        # Chrome-trace "X" events derived from task records
+        # (reference: chrome_tracing_dump, _private/state.py:438).
+        out = []
+        with self._task_lock:
+            records = list(self._done_tasks) + list(self._tasks.values())
+        for rec in records:
+            if rec.started_at and rec.finished_at:
+                out.append({
+                    "name": rec.name, "ph": "X", "pid": 0,
+                    "tid": rec.worker_index,
+                    "ts": rec.started_at * 1e6,
+                    "dur": (rec.finished_at - rec.started_at) * 1e6,
+                    "cat": "task",
+                })
+        return out
+
+    # ---------------- client service (worker -> driver API) -----------
+
+    def _register_pending_worker(self, w: WorkerHandle) -> None:
+        with self._pending_workers_lock:
+            self._pending_workers[w.token] = w
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            t = threading.Thread(target=self._handshake, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._client_threads.append(t)
+
+    def _handshake(self, conn) -> None:
+        # First message identifies the connection: ("hello", "exec",
+        # token) pairs an exec channel with its WorkerHandle;
+        # ("hello", "client", _) starts an API-proxy session.
+        try:
+            hello = conn.recv()
+        except (EOFError, OSError):
+            return
+        if not (isinstance(hello, tuple) and len(hello) == 3
+                and hello[0] == "hello"):
+            conn.close()
+            return
+        _, kind, token = hello
+        if kind == "exec":
+            with self._pending_workers_lock:
+                w = self._pending_workers.pop(token, None)
+            if w is None:
+                conn.close()
+                return
+            w.attach_conn(conn)
+        else:
+            self._serve_client(conn)
+
+    def _serve_client(self, conn) -> None:
+        send_lock = threading.Lock()
+
+        def reply(req_id, status, payload):
+            try:
+                with send_lock:
+                    conn.send((req_id, status, payload))
+            except (OSError, BrokenPipeError):
+                pass
+
+        def handle(req_id, op, payload):
+            try:
+                result = self._handle_client_op(op, payload)
+                reply(req_id, P.ST_OK, result)
+            except BaseException as e:  # noqa: BLE001
+                reply(req_id, P.ST_ERR, ser.dumps(e))
+
+        try:
+            while True:
+                req_id, op, payload = conn.recv()
+                threading.Thread(target=handle,
+                                 args=(req_id, op, payload),
+                                 daemon=True).start()
+        except (EOFError, OSError):
+            pass
+
+    def _handle_client_op(self, op: str, payload):
+        if op == P.OP_SUBMIT:
+            fn_id, fn_blob, fn_name, args_kwargs_blob, opts_blob = payload
+            args, kwargs = ser.loads(args_kwargs_blob)
+            options = ser.loads(opts_blob)
+            refs = self.submit_task(fn_id, fn_blob, fn_name, args,
+                                    kwargs, options)
+            # The only holder of these refs is the remote worker: pin
+            # them so driver-side GC of the transient ObjectRef objects
+            # doesn't delete the results out from under it.
+            for r in refs:
+                self.on_ref_escaped(r.id)
+            return [r.id.binary() for r in refs]
+        if op == P.OP_PUT:
+            data, buffers = payload
+            ref = self.put_serialized(
+                SerializedObject(data=data, buffers=list(buffers)))
+            self.on_ref_escaped(ref.id)  # a remote process holds it
+            return ref.id.binary()
+        if op == P.OP_GET:
+            oid_bytes, timeout = payload
+            obj = self.get_serialized(ObjectID(oid_bytes), timeout)
+            return (obj.data, obj.buffers)
+        if op == P.OP_WAIT:
+            oid_bytes_list, num_returns, timeout = payload
+            done, rest = self.wait_available(
+                [ObjectID(b) for b in oid_bytes_list], num_returns, timeout)
+            return ([o.binary() for o in done], [o.binary() for o in rest])
+        if op == P.OP_CREATE_ACTOR:
+            (cls_blob, cls_name, args_kwargs_blob, opts_blob, name,
+             max_restarts, max_concurrency) = payload
+            args, kwargs = ser.loads(args_kwargs_blob)
+            options = ser.loads(opts_blob)
+            actor_id = self.create_actor(
+                cls_blob, cls_name, args, kwargs, options, name,
+                max_restarts, max_concurrency)
+            return actor_id.binary()
+        if op == P.OP_SUBMIT_ACTOR:
+            actor_id_bytes, method, args_kwargs_blob, num_returns = payload
+            args, kwargs = ser.loads(args_kwargs_blob)
+            refs = self.submit_actor_task(
+                ActorID(actor_id_bytes), method, args, kwargs, num_returns)
+            for r in refs:
+                self.on_ref_escaped(r.id)
+            return [r.id.binary() for r in refs]
+        if op == P.OP_GET_ACTOR:
+            name = payload
+            return self.get_named_actor(name).binary()
+        if op == P.OP_KILL:
+            actor_id_bytes, no_restart = payload
+            self.kill_actor(ActorID(actor_id_bytes), no_restart)
+            return None
+        if op == P.OP_CANCEL:
+            oid_bytes, force = payload
+            self.cancel(ObjectRef(ObjectID(oid_bytes)), force)
+            return None
+        if op == P.OP_BORROW:
+            self.on_ref_escaped(ObjectID(payload))
+            return None
+        if op == P.OP_RESOURCES:
+            return (self.available_resources(), self.cluster_resources())
+        if op == P.OP_PG_CREATE:
+            bundles, strategy = payload
+            return self.create_placement_group(bundles, strategy).binary()
+        if op == P.OP_PG_REMOVE:
+            self.remove_placement_group(PlacementGroupID(payload))
+            return None
+        raise ValueError(f"unknown client op: {op}")
+
+    # ---------------- shutdown ----------------
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        with self._res_cv:
+            self._res_cv.notify_all()
+        with self._pool_lock:
+            workers = list(self._workers)
+            self._workers.clear()
+            self._idle.clear()
+        for w in workers:
+            w.shutdown(timeout=1.0)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.client_address)
+        except OSError:
+            pass
+        self.shm_store.shutdown()

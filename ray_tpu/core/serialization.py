@@ -1,0 +1,87 @@
+"""Serialization for task args / returns / stored objects.
+
+Reference analog: ``python/ray/_private/serialization.py`` (cloudpickle +
+custom reducers + zero-copy numpy). We use cloudpickle (for closures /
+lambdas / locally-defined classes) with out-of-band buffers (pickle
+protocol 5) so large numpy / jax host arrays are carried as raw buffers
+and can be placed in (and mapped back out of) shared memory without a
+copy.
+
+jax device arrays are moved to host on serialize; on deserialize they
+come back as numpy and are re-``device_put`` lazily by user code. Device
+-resident transfer between processes is the collective plane's job
+(SURVEY.md §5.8), never the object store's.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import sys
+from dataclasses import dataclass
+
+import cloudpickle
+
+
+@dataclass
+class SerializedObject:
+    """A pickled payload plus its out-of-band buffers.
+
+    ``data`` is the pickle bytestream; ``buffers`` are the PickleBuffer
+    payloads (raw array memory). Total size is what the object store
+    accounts.
+    """
+
+    data: bytes
+    buffers: list[bytes]
+
+    @property
+    def total_size(self) -> int:
+        return len(self.data) + sum(len(b) for b in self.buffers)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    """cloudpickle with a host-copy reducer for jax device arrays.
+
+    ``reducer_override`` (not ``dispatch_table``) because pickle looks
+    dispatch tables up by exact concrete type and runtime jax arrays
+    are ``ArrayImpl``, not the ``jax.Array`` ABC. jax is only consulted
+    if it is already imported — serialization must never pull the TPU
+    runtime into a process that doesn't own it.
+    """
+
+    def reducer_override(self, obj):
+        jax = sys.modules.get("jax")
+        if jax is not None and isinstance(obj, jax.Array):
+            import numpy as np
+            return (_from_parts, (np.asarray(obj),))
+        return NotImplemented
+
+
+def serialize(value) -> SerializedObject:
+    buffers: list[pickle.PickleBuffer] = []
+    buf = io.BytesIO()
+    pickler = _Pickler(buf, protocol=5, buffer_callback=buffers.append)
+    pickler.dump(value)
+    return SerializedObject(
+        data=buf.getvalue(),
+        buffers=[b.raw().tobytes() for b in buffers],
+    )
+
+
+def _from_parts(np_arr):
+    return np_arr
+
+
+def deserialize(obj: SerializedObject):
+    return pickle.loads(obj.data, buffers=[memoryview(b)
+                                           for b in obj.buffers])
+
+
+def dumps(value) -> bytes:
+    """One-shot in-band serialization (small control-plane payloads)."""
+    return cloudpickle.dumps(value, protocol=5)
+
+
+def loads(data: bytes):
+    return pickle.loads(data)

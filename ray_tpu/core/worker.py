@@ -1,0 +1,375 @@
+"""Worker process: task execution loop + client-side runtime proxy.
+
+Analog of the reference's worker side (SURVEY.md §3.1-3.3):
+``default_worker.py`` main loop + the CoreWorker execution callback
+(``_raylet.pyx:2222 task_execution_handler``). A worker process
+
+- receives tasks/actor calls on its **exec channel** (a Pipe from the
+  driver), executes them, and replies with serialized results;
+- exposes the full public API to user code running inside it by proxying
+  over its **client channel** (unix socket to the driver runtime) — this
+  is what makes nested ``.remote()`` calls and actor-creating-actors
+  work (ClientRuntime below).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import traceback
+from multiprocessing import connection as mpc
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.exceptions import ActorError, GetTimeoutError, TaskError
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.serialization import SerializedObject
+
+
+class ClientRuntime:
+    """Worker-side proxy of the driver runtime over the unix socket.
+
+    Requests are tagged with ids and demuxed by a receiver thread, so
+    multiple user threads (or a blocking ``get`` concurrent with a
+    ``put``) never deadlock on the single connection.
+    """
+
+    def __init__(self, address: str):
+        self._conn = mpc.Client(address, family="AF_UNIX")
+        self._conn.send(("hello", "client", ""))
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._pending_lock = threading.Lock()
+        self._req_counter = itertools.count()
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name="client_recv")
+        self._recv_thread.start()
+        self.local_mode = False
+
+    def _recv_loop(self):
+        try:
+            while True:
+                req_id, status, payload = self._conn.recv()
+                with self._pending_lock:
+                    entry = self._pending.pop(req_id, None)
+                if entry is not None:
+                    event, slot = entry
+                    slot.append((status, payload))
+                    event.set()
+        except (EOFError, OSError):
+            # Driver went away; fail all pending requests.
+            with self._pending_lock:
+                for event, slot in self._pending.values():
+                    slot.append((P.ST_ERR, ser.dumps(
+                        ConnectionError("driver connection lost"))))
+                    event.set()
+                self._pending.clear()
+
+    def _call(self, op: str, payload, timeout: float | None = None):
+        req_id = next(self._req_counter)
+        event = threading.Event()
+        slot: list = []
+        with self._pending_lock:
+            self._pending[req_id] = (event, slot)
+        with self._send_lock:
+            self._conn.send((req_id, op, payload))
+        if not event.wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise GetTimeoutError(f"driver op {op} timed out")
+        status, result = slot[0]
+        if status == P.ST_ERR:
+            raise ser.loads(result)
+        return result
+
+    # -- object API --
+
+    def put(self, value) -> ObjectRef:
+        obj = ser.serialize(value)
+        oid_bytes = self._call(P.OP_PUT, (obj.data, obj.buffers))
+        return ObjectRef(ObjectID(oid_bytes))
+
+    def get_serialized(self, oid: ObjectID,
+                       timeout: float | None = None) -> SerializedObject:
+        data, buffers = self._call(P.OP_GET, (oid.binary(), timeout))
+        return SerializedObject(data=data, buffers=list(buffers))
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = [ser.deserialize(self.get_serialized(r.id, timeout))
+               for r in refs]
+        return out[0] if single else out
+
+    async def get_async(self, ref: ObjectRef):
+        import asyncio
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.get, ref)
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def wait(self, refs, num_returns: int = 1,
+             timeout: float | None = None):
+        done_b, rest_b = self._call(
+            P.OP_WAIT, ([r.id.binary() for r in refs], num_returns,
+                        timeout))
+        by_id = {r.id.binary(): r for r in refs}
+        return [by_id[b] for b in done_b], [by_id[b] for b in rest_b]
+
+    # -- task / actor API --
+
+    def submit_task(self, fn_id: str, fn_blob: bytes | None, fn_name: str,
+                    args: tuple, kwargs: dict, options) -> list[ObjectRef]:
+        ref_bytes = self._call(P.OP_SUBMIT, (
+            fn_id, fn_blob, fn_name, ser.dumps((args, kwargs)),
+            ser.dumps(options)))
+        return [ObjectRef(ObjectID(b)) for b in ref_bytes]
+
+    def register_function(self, fn):
+        import hashlib
+        blob = ser.dumps(fn)
+        return hashlib.sha1(blob).hexdigest(), blob
+
+    def create_actor(self, cls_blob: bytes, cls_name: str, args: tuple,
+                     kwargs: dict, options, name: str = "",
+                     max_restarts: int = 0,
+                     max_concurrency: int = 1) -> ActorID:
+        actor_id_bytes = self._call(P.OP_CREATE_ACTOR, (
+            cls_blob, cls_name, ser.dumps((args, kwargs)),
+            ser.dumps(options), name, max_restarts, max_concurrency))
+        return ActorID(actor_id_bytes)
+
+    def submit_actor_task(self, actor_id: ActorID, method: str,
+                          args: tuple, kwargs: dict,
+                          num_returns: int = 1) -> list[ObjectRef]:
+        ref_bytes = self._call(P.OP_SUBMIT_ACTOR, (
+            actor_id.binary(), method, ser.dumps((args, kwargs)),
+            num_returns))
+        return [ObjectRef(ObjectID(b)) for b in ref_bytes]
+
+    def get_named_actor(self, name: str) -> ActorID:
+        return ActorID(self._call(P.OP_GET_ACTOR, name))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._call(P.OP_KILL, (actor_id.binary(), no_restart))
+
+    def wait_actor_ready(self, actor_id: ActorID,
+                         timeout: float | None = None):
+        # The driver queues calls until the actor is up; nothing to do
+        # client-side.
+        return None
+
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        self._call(P.OP_CANCEL, (ref.id.binary(), force))
+
+    def on_ref_escaped(self, oid: ObjectID):
+        self._call(P.OP_BORROW, oid.binary())
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        pass
+
+    def available_resources(self):
+        return self._call(P.OP_RESOURCES, None)[0]
+
+    def cluster_resources(self):
+        return self._call(P.OP_RESOURCES, None)[1]
+
+    def nodes(self):
+        return [{"NodeID": "local", "Alive": True,
+                 "Resources": self.cluster_resources()}]
+
+    def timeline(self):
+        return []
+
+    def create_placement_group(self, bundles, strategy):
+        return PlacementGroupIDFromBytes(
+            self._call(P.OP_PG_CREATE, (bundles, strategy)))
+
+    def pg_ready(self, pg_id, timeout=None):
+        return True
+
+    def remove_placement_group(self, pg_id):
+        self._call(P.OP_PG_REMOVE, pg_id.binary())
+
+    def shutdown(self):
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+def PlacementGroupIDFromBytes(b):
+    from ray_tpu.core.ids import PlacementGroupID
+    return PlacementGroupID(b)
+
+
+# --------------------------------------------------------------------------
+# Execution helpers
+# --------------------------------------------------------------------------
+
+def _materialize_args(args_blob: bytes, resolved: dict):
+    """Deserialize (args, kwargs), substituting driver-resolved values
+    for top-level ObjectRefs (reference: plasma arg fetch before
+    execute_task, _raylet.pyx:2055)."""
+    args, kwargs = ser.loads(args_blob)
+    cache = {}
+
+    def sub(v):
+        if isinstance(v, ObjectRef):
+            key = v.id.binary()
+            if key in resolved:
+                if key not in cache:
+                    data, buffers = resolved[key]
+                    cache[key] = ser.deserialize(
+                        SerializedObject(data=data, buffers=list(buffers)))
+                return cache[key]
+        return v
+
+    args = tuple(sub(a) for a in args)
+    kwargs = {k: sub(v) for k, v in kwargs.items()}
+    return args, kwargs
+
+
+def _serialize_returns(result, num_returns: int) -> list[tuple]:
+    if num_returns == 1:
+        values = [result]
+    else:
+        values = list(result)
+        if len(values) != num_returns:
+            raise ValueError(
+                f"declared num_returns={num_returns} but returned "
+                f"{len(values)} values")
+    out = []
+    for v in values:
+        obj = ser.serialize(v)
+        out.append((obj.data, obj.buffers))
+    return out
+
+
+def _run_maybe_async(fn, args, kwargs):
+    import inspect
+    if inspect.iscoroutinefunction(fn):
+        import asyncio
+        return asyncio.run(fn(*args, **kwargs))
+    result = fn(*args, **kwargs)
+    if inspect.iscoroutine(result):
+        import asyncio
+        return asyncio.run(result)
+    return result
+
+
+def worker_main(conn, client_address: str) -> None:
+    from ray_tpu.core import api
+
+    client = ClientRuntime(client_address)
+    api._set_runtime(client)
+
+    fn_cache: dict[str, object] = {}
+    actor_instance = None
+    actor_lock = threading.Lock()
+    send_lock = threading.Lock()
+
+    def send(msg):
+        with send_lock:
+            conn.send(msg)
+
+    def exec_task(task_id_bytes, fn_id, fn_blob, args_blob, resolved,
+                  num_returns):
+        try:
+            if fn_id not in fn_cache:
+                fn_cache[fn_id] = ser.loads(fn_blob)
+            fn = fn_cache[fn_id]
+            args, kwargs = _materialize_args(args_blob, resolved)
+            result = _run_maybe_async(fn, args, kwargs)
+            send((P.RESULT_OK, task_id_bytes,
+                  _serialize_returns(result, num_returns)))
+        except BaseException as e:  # noqa: BLE001
+            name = getattr(fn_cache.get(fn_id), "__name__", "task")
+            err = TaskError(name, traceback.format_exc(), None) \
+                if not isinstance(e, TaskError) else e
+            send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
+
+    serialize_calls = True  # False when max_concurrency > 1
+
+    def exec_actor_call(task_id_bytes, method, args_blob, resolved,
+                        num_returns):
+        try:
+            args, kwargs = _materialize_args(args_blob, resolved)
+            bound = getattr(actor_instance, method)
+            if serialize_calls:
+                with actor_lock:
+                    result = _run_maybe_async(bound, args, kwargs)
+            else:
+                result = _run_maybe_async(bound, args, kwargs)
+            send((P.RESULT_OK, task_id_bytes,
+                  _serialize_returns(result, num_returns)))
+        except BaseException:  # noqa: BLE001
+            err = ActorError(method, traceback.format_exc(), None)
+            send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
+
+    executor = None  # thread pool for max_concurrency > 1
+
+    try:
+        while True:
+            msg = conn.recv()
+            kind = msg[0]
+            if kind == P.EXEC_SHUTDOWN:
+                break
+            elif kind == P.EXEC_TASK:
+                (_, task_id_bytes, fn_id, fn_blob, args_blob, resolved,
+                 num_returns) = msg
+                exec_task(task_id_bytes, fn_id, fn_blob, args_blob,
+                          resolved, num_returns)
+            elif kind == P.EXEC_ACTOR_INIT:
+                (_, actor_id_bytes, cls_blob, args_blob, resolved,
+                 max_concurrency) = msg
+                try:
+                    cls = ser.loads(cls_blob)
+                    args, kwargs = _materialize_args(args_blob, resolved)
+                    actor_instance = cls(*args, **kwargs)
+                    api._set_actor_context(ActorID(actor_id_bytes))
+                    if max_concurrency > 1:
+                        from concurrent.futures import ThreadPoolExecutor
+                        executor = ThreadPoolExecutor(
+                            max_workers=max_concurrency)
+                        serialize_calls = False
+                    send((P.RESULT_READY, actor_id_bytes, None))
+                except BaseException:  # noqa: BLE001
+                    err = ActorError("__init__", traceback.format_exc())
+                    send((P.RESULT_ERR, actor_id_bytes, ser.dumps(err)))
+                    break
+            elif kind == P.EXEC_ACTOR_CALL:
+                (_, task_id_bytes, method, args_blob, resolved,
+                 num_returns) = msg
+                if executor is not None:
+                    executor.submit(exec_actor_call, task_id_bytes, method,
+                                    args_blob, resolved, num_returns)
+                else:
+                    exec_actor_call(task_id_bytes, method, args_blob,
+                                    resolved, num_returns)
+    except (EOFError, OSError, KeyboardInterrupt):
+        pass
+    finally:
+        # Give the actor a chance to clean up (reference: atexit handlers
+        # + __ray_terminate__).
+        if actor_instance is not None:
+            terminate = getattr(actor_instance, "__on_exit__", None)
+            if callable(terminate):
+                try:
+                    terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+        client.shutdown()

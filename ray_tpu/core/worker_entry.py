@@ -1,0 +1,25 @@
+"""Worker process entry point.
+
+Analog of the reference's ``default_worker.py``
+(``python/ray/_private/workers/default_worker.py:289``): a dedicated
+module run as ``python -m ray_tpu.core.worker_entry <socket> <token>``,
+so worker processes never re-import the driver's ``__main__`` (the
+multiprocessing-spawn hazard) and carry no inherited interpreter state.
+"""
+
+from __future__ import annotations
+
+import sys
+from multiprocessing import connection as mpc
+
+
+def main() -> None:
+    address, token = sys.argv[1], sys.argv[2]
+    conn = mpc.Client(address, family="AF_UNIX")
+    conn.send(("hello", "exec", token))
+    from ray_tpu.core.worker import worker_main
+    worker_main(conn, address)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Multi-device tests run on a virtual 8-device CPU mesh — the analog of
+the reference's multi-node-on-one-machine pattern (SURVEY.md §4.2:
+``ray.cluster_utils.Cluster``): N simulated devices on the XLA CPU
+backend let all sharding/collective invariants run without TPU
+hardware. These env vars must be set before jax is first imported
+anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt():
+    """A fresh multiprocess runtime per test."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=False)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt_local():
+    """In-process (local_mode) runtime — fast, for API-shape tests."""
+    import ray_tpu
+    ray_tpu.init(local_mode=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,181 @@
+"""Core task API tests (reference analog: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_simple_task(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_many_tasks(rt):
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    refs = [square.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_task_with_large_numpy(rt):
+    @ray_tpu.remote
+    def make(n):
+        return np.ones((n, n), dtype=np.float32)
+
+    arr = ray_tpu.get(make.remote(512))  # 1 MiB -> shared memory path
+    assert arr.shape == (512, 512)
+    assert arr.dtype == np.float32
+    assert float(arr.sum()) == 512 * 512
+
+
+def test_object_ref_args(rt):
+    @ray_tpu.remote
+    def make_data():
+        return np.arange(1000)
+
+    @ray_tpu.remote
+    def total(arr):
+        return int(arr.sum())
+
+    data_ref = make_data.remote()
+    assert ray_tpu.get(total.remote(data_ref)) == sum(range(1000))
+
+
+def test_put_get(rt):
+    ref = ray_tpu.put({"x": np.zeros(10), "y": [1, 2, 3]})
+    val = ray_tpu.get(ref)
+    assert val["y"] == [1, 2, 3]
+    assert val["x"].shape == (10,)
+
+
+def test_task_exception(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ray_tpu.TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_num_returns(rt):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    done, rest = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert done == [f]
+    assert rest == [s]
+
+
+def test_get_timeout(rt):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(rt):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        # Nested submission from inside a worker process.
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(5)) == 16
+
+
+def test_options_override(rt):
+    @ray_tpu.remote
+    def f():
+        return 42
+
+    assert ray_tpu.get(f.options(num_cpus=2).remote()) == 42
+
+
+def test_closure_capture(rt):
+    factor = 7
+
+    @ray_tpu.remote
+    def mul(x):
+        return x * factor
+
+    assert ray_tpu.get(mul.remote(6)) == 42
+
+
+def test_resources_accounting(rt):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= total["CPU"]
+
+
+def test_local_mode(rt_local):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(2, 3)) == 5
+
+
+def test_task_retry_on_worker_death(rt):
+    @ray_tpu.remote(max_retries=2)
+    def sometimes_dies(path):
+        import os
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            os._exit(1)  # simulate worker crash on first attempt
+        return "survived"
+
+    import tempfile
+    path = tempfile.mktemp()
+    assert ray_tpu.get(sometimes_dies.remote(path), timeout=60) == "survived"
+
+
+def test_cancel_pending(rt):
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(30)
+
+    @ray_tpu.remote
+    def victim():
+        return 1
+
+    # Saturate the 4 CPUs, then cancel a queued task.
+    blockers = [blocker.options(num_cpus=1).remote() for _ in range(4)]
+    time.sleep(0.5)
+    v = victim.remote()
+    ray_tpu.cancel(v)
+    from ray_tpu.core.exceptions import TaskCancelledError
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(v, timeout=10)
+    for b in blockers:
+        ray_tpu.cancel(b, force=True)
