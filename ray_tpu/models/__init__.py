@@ -1,0 +1,6 @@
+"""Model zoo: flax implementations annotated for mesh sharding."""
+
+from ray_tpu.models.gpt2 import GPT2, GPT2Config
+from ray_tpu.models.resnet import ResNet, ResNet50Config
+
+__all__ = ["GPT2", "GPT2Config", "ResNet", "ResNet50Config"]

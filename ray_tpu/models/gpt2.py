@@ -1,0 +1,222 @@
+"""GPT-2 in flax, designed for mesh sharding.
+
+The flagship model for the north-star benchmark (BASELINE.json: GPT-2
+tokens/sec/chip). TPU-first choices:
+
+- bfloat16 compute / float32 params (MXU-native).
+- param names line up with ``parallel.sharding.DEFAULT_PARAM_PATTERNS``
+  so dp/fsdp/tp sharding is a table lookup, no per-model plumbing.
+- attention is pluggable: dense (``jax.nn.dot_product_attention`` — XLA
+  fuses to the TPU attention kernel) or ring attention over an ``sp``
+  mesh axis for long context (SURVEY.md §5.7 — capability the
+  reference lacks natively).
+- activations carry logical sharding constraints ("batch", "seq") so
+  pjit propagates the intended layout instead of guessing.
+- optional remat (``jax.checkpoint``) per block: trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import causal_attention, ring_attention
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304          # 50257 padded up for MXU tiling
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16        # compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "dense"         # "dense" | "ring"
+    sp_axis: str = "sp"
+
+    @staticmethod
+    def small(**kw) -> "GPT2Config":
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def medium(**kw) -> "GPT2Config":
+        return GPT2Config(n_layer=24, n_head=16, n_embd=1024, **kw)
+
+    @staticmethod
+    def large(**kw) -> "GPT2Config":
+        return GPT2Config(n_layer=36, n_head=20, n_embd=1280, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        """Test-size config for CPU-mesh runs."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("n_layer", 2)
+        kw.setdefault("n_head", 4)
+        kw.setdefault("n_embd", 64)
+        kw.setdefault("seq_len", 64)
+        return GPT2Config(**kw)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    def num_params(self) -> int:
+        e, l, v, s = self.n_embd, self.n_layer, self.vocab_size, \
+            self.seq_len
+        per_block = 12 * e * e + 13 * e  # qkv+proj+mlp + norms/biases
+        return v * e + s * e + l * per_block + 2 * e
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, attn_fn: Callable, deterministic: bool = True):
+        cfg = self.config
+        B, T, _ = x.shape
+        dense = partial(nn.Dense, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        kernel_init=nn.initializers.normal(0.02))
+        q = dense(cfg.n_embd, name="q")(x)
+        k = dense(cfg.n_embd, name="k")(x)
+        v = dense(cfg.n_embd, name="v")(x)
+        q = q.reshape(B, T, cfg.n_head, cfg.head_dim)
+        k = k.reshape(B, T, cfg.n_head, cfg.head_dim)
+        v = v.reshape(B, T, cfg.n_head, cfg.head_dim)
+        y = attn_fn(q, k, v)
+        y = y.reshape(B, T, cfg.n_embd)
+        y = dense(cfg.n_embd, name="proj",
+                  kernel_init=nn.initializers.normal(
+                      0.02 / (2 * cfg.n_layer) ** 0.5))(y)
+        if cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        dense = partial(nn.Dense, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        kernel_init=nn.initializers.normal(0.02))
+        h = dense(4 * cfg.n_embd, name="fc")(x)
+        h = nn.gelu(h)
+        h = dense(cfg.n_embd, name="proj",
+                  kernel_init=nn.initializers.normal(
+                      0.02 / (2 * cfg.n_layer) ** 0.5))(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, attn_fn: Callable, deterministic: bool = True):
+        cfg = self.config
+        ln = partial(nn.LayerNorm, epsilon=1e-5, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            ln(name="ln_1")(x), attn_fn, deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            ln(name="ln_2")(x), deterministic)
+        return x
+
+
+class GPT2(nn.Module):
+    """GPT-2 LM. ``__call__(tokens) -> logits``; weights tied wte/lm."""
+
+    config: GPT2Config
+    mesh: Any = None  # jax.sharding.Mesh | None — enables sp attention
+
+    def _attn_fn(self) -> Callable:
+        cfg = self.config
+        if cfg.attn_impl == "ring" and self.mesh is not None \
+                and self.mesh.shape.get(cfg.sp_axis, 1) > 1:
+            from ray_tpu.ops.attention import (
+                make_sharded_causal_attention,
+            )
+            return make_sharded_causal_attention(
+                self.mesh, seq_axis=cfg.sp_axis)
+        if cfg.attn_impl == "ring":
+            # single sp shard degenerates to dense
+            return causal_attention
+        return causal_attention
+
+    def _constrain(self, x):
+        if self.mesh is None:
+            return x
+        from ray_tpu.parallel.sharding import constrain
+        return constrain(x, self.mesh, "batch", "seq", None)
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.config
+        B, T = tokens.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte",
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       embedding_init=nn.initializers.normal(0.02))
+        wpe = nn.Embed(cfg.seq_len, cfg.n_embd, name="wpe",
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       embedding_init=nn.initializers.normal(0.01))
+        pos = jnp.arange(T)[None, :]
+        x = wte(tokens) + wpe(pos)
+        x = self._constrain(x)
+        if cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        attn_fn = self._attn_fn()
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block, static_argnums=(2, 3),
+                policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x, attn_fn, deterministic)
+            x = self._constrain(x)
+        x = nn.LayerNorm(epsilon=1e-5, name="ln_f", dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype)(x)
+        # Tied LM head: logits in float32 for a stable softmax (explicit
+        # einsum — wte.attend would cast back to the module's bf16).
+        logits = jnp.einsum(
+            "bte,ve->btv", x.astype(jnp.float32),
+            wte.embedding.astype(jnp.float32))
+        return logits
+
+    def init_params(self, rng, batch_size: int = 2):
+        tokens = jnp.zeros((batch_size, self.config.seq_len),
+                           dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+
+def cross_entropy_loss(logits, targets, ignore_index: int = -1):
+    """Mean token cross-entropy; positions == ignore_index are masked."""
+    vocab = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    mask = targets != ignore_index
+    safe = jnp.where(mask, targets, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(mask, nll, 0.0)
+    return nll.sum() / jnp.maximum(mask.sum(), 1)
+
+
+def gpt2_loss_fn(model: GPT2):
+    """(params, batch) -> scalar loss; batch = {tokens, targets}."""
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["tokens"])
+        return cross_entropy_loss(logits, batch["targets"])
+
+    return loss_fn

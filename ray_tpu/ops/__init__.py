@@ -1,0 +1,5 @@
+"""TPU compute ops: attention (dense + ring), fused kernels (Pallas)."""
+
+from ray_tpu.ops.attention import causal_attention, ring_attention
+
+__all__ = ["causal_attention", "ring_attention"]

@@ -1,0 +1,127 @@
+"""Attention ops.
+
+- ``causal_attention``: dense causal attention; delegates to
+  ``jax.nn.dot_product_attention`` so XLA picks the fused TPU path.
+- ``ring_attention``: sequence-parallel causal attention over an ICI
+  ring. The reference has NO sequence parallelism in-tree (SURVEY.md
+  §5.7); here it is first-class: K/V blocks rotate around the ``sp``
+  mesh axis via ``lax.ppermute`` while each device streams blockwise
+  softmax over its local queries (log-sum-exp accumulation, the
+  RingAttention / blockwise-attention recipe). Designed to run inside
+  ``shard_map`` with the sequence dim sharded on ``sp``.
+
+Shapes follow jax convention: [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     scale: float | None = None) -> jax.Array:
+    """Dense causal attention [B, T, H, D] -> [B, T, H, D]."""
+    return jax.nn.dot_product_attention(q, k, v, scale=scale,
+                                        is_causal=True)
+
+
+def _block_attend(q, k, v, acc, row_max, row_sum, mask_mode, scale):
+    """One blockwise-attention step with streaming softmax.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]
+    acc: [B, Tq, H, D] running numerator
+    row_max/row_sum: [B, Tq, H] running logsumexp state
+    mask_mode: 0 = full block visible, 1 = causal within block,
+               2 = fully masked (skip)
+    """
+    # scores: [B, H, Tq, Tk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    tq, tk = q.shape[1], k.shape[1]
+    causal = jnp.tril(jnp.ones((tq, tk), dtype=bool))
+    mask = jnp.where(
+        mask_mode == 1, causal[None, None],
+        jnp.full((1, 1, tq, tk), mask_mode == 0))
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    block_max = jnp.max(scores, axis=-1)               # [B, H, Tq]
+    new_max = jnp.maximum(row_max, block_max.transpose(0, 2, 1))
+    correction = jnp.exp(row_max - new_max)            # [B, Tq, H]
+    p = jnp.exp(scores - new_max.transpose(0, 2, 1)[:, :, :, None])
+    p = jnp.where(mask, p, 0.0)                        # kill -inf rows
+    block_sum = p.sum(axis=-1).transpose(0, 2, 1)      # [B, Tq, H]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype))
+    acc = acc * correction[..., None] + pv
+    row_sum = row_sum * correction + block_sum
+    return acc, new_max, row_sum
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp",
+                   scale: float | None = None) -> jax.Array:
+    """Causal ring attention; call inside shard_map with seq sharded on
+    ``axis_name``. Each of the S ring steps overlaps compute of the
+    current K/V block with the ICI rotation of the next (XLA schedules
+    the ppermute async against the einsums).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    sp = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    b, tq, h, d = q.shape
+    qf = q.astype(jnp.float32)
+    acc0 = jnp.zeros((b, tq, h, d), jnp.float32)
+    max0 = jnp.full((b, tq, h), _NEG_INF, jnp.float32)
+    sum0 = jnp.zeros((b, tq, h), jnp.float32)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(i, carry):
+        acc, row_max, row_sum, kb, vb = carry
+        # K/V block currently held arrived from device (my_idx - i).
+        src = (my_idx - i) % sp
+        # Causal across blocks: src < me -> fully visible; src == me ->
+        # causal inside; src > me -> masked out.
+        mask_mode = jnp.where(src == my_idx, 1,
+                              jnp.where(src < my_idx, 0, 2))
+        acc, row_max, row_sum = _block_attend(
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+            acc, row_max, row_sum, mask_mode, scale)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return acc, row_max, row_sum, kb, vb
+
+    acc, row_max, row_sum, _, _ = lax.fori_loop(
+        0, sp, step, (acc0, max0, sum0, k, v))
+    out = acc / jnp.maximum(row_sum, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
+                                  seq_axis="sp", head_axis="tp"):
+    """Build an attention fn for activations sharded
+    [batch->dp/fsdp, seq->sp, heads->tp]: shard_map-wrapped ring
+    attention when the mesh has a real sp axis, dense attention
+    otherwise."""
+    from jax.sharding import PartitionSpec as P
+
+    sp = mesh.shape.get(seq_axis, 1)
+    if sp <= 1:
+        def dense(q, k, v):
+            return causal_attention(q, k, v)
+        return dense
+
+    batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    spec = P(batch if batch else None, seq_axis,
+             head_axis if mesh.shape.get(head_axis, 1) > 1 else None,
+             None)
+    ring = functools.partial(ring_attention, axis_name=seq_axis)
+    return jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)

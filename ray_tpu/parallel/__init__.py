@@ -1,0 +1,41 @@
+"""Parallelism layer: device meshes, sharding rules, collectives.
+
+TPU-first replacements for the reference's NCCL-centric stack
+(SURVEY.md §2.4): every strategy is a mesh axis + XLA collectives over
+ICI, not a process-group wrapper.
+
+Axis conventions (SURVEY.md §5.7, scaling-book recipe):
+    dp    data parallel            (batch split; psum grads)
+    fsdp  fully-sharded data par.  (batch + param shards; ZeRO analog)
+    tp    tensor parallel          (model dim split; matmul collectives)
+    sp    sequence/context par.    (sequence split; ring attention)
+    ep    expert parallel          (MoE expert split; all_to_all)
+    pp    pipeline parallel        (stage split; ppermute microbatches)
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    local_mesh,
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_TP,
+    AXIS_SP,
+    AXIS_EP,
+    AXIS_PP,
+)
+from ray_tpu.parallel.sharding import (
+    LogicalAxisRules,
+    DEFAULT_RULES,
+    logical_to_mesh,
+    named_sharding,
+    shard_params,
+    constrain,
+)
+
+__all__ = [
+    "MeshSpec", "make_mesh", "local_mesh",
+    "AXIS_DP", "AXIS_FSDP", "AXIS_TP", "AXIS_SP", "AXIS_EP", "AXIS_PP",
+    "LogicalAxisRules", "DEFAULT_RULES", "logical_to_mesh",
+    "named_sharding", "shard_params", "constrain",
+]

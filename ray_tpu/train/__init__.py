@@ -1,0 +1,20 @@
+"""ray_tpu.train — distributed training (Ray Train analog, TPU-first).
+
+Two layers:
+- ``step``: jit/pjit train-step machinery over a mesh (grads psum over
+  dp via sharding propagation — the NCCL-allreduce analog is compiled
+  into the step, SURVEY.md §2.4 row 1).
+- ``JaxTrainer`` / ``WorkerGroup``: actor-based orchestration across
+  hosts (reference: DataParallelTrainer + BackendExecutor).
+"""
+
+from ray_tpu.train.step import (
+    TrainState,
+    init_train_state,
+    make_train_step,
+    shard_batch,
+)
+
+__all__ = [
+    "TrainState", "init_train_state", "make_train_step", "shard_batch",
+]

@@ -1,0 +1,95 @@
+"""Sharded train-step machinery.
+
+The hot path of the framework: everything here compiles to ONE XLA
+program per step — forward, backward, the data-parallel gradient
+reduction (psum over ``dp``/``fsdp`` inserted by sharding propagation,
+riding ICI), optimizer update, all fused. The reference's equivalent
+path is user torch code + NCCL allreduce orchestrated per-step from
+Python (SURVEY.md §3.4); here the collective IS part of the program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ray_tpu.parallel.sharding import place_params
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    extra: Any = None          # e.g. batch_stats for BN models
+
+    def num_params(self) -> int:
+        return sum(x.size for x in jax.tree_util.tree_leaves(self.params))
+
+
+def init_train_state(params, optimizer, mesh=None, extra=None,
+                     patterns=None) -> TrainState:
+    """Place params per the sharding rule table and build matching
+    optimizer state (jit propagates the param shardings into the Adam
+    moments — optimizer-state sharding, the ZeRO analog, for free)."""
+    if mesh is not None:
+        params = place_params(params, mesh, patterns)
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=opt_state, extra=extra)
+
+
+def make_train_step(loss_fn: Callable, optimizer,
+                    has_extra: bool = False,
+                    donate: bool = True) -> Callable:
+    """Build the jitted step.
+
+    loss_fn: (params, batch) -> loss            (has_extra=False)
+             (params, extra, batch) -> (loss, new_extra)  (True)
+    Returns step(state, batch) -> (state, metrics).
+    """
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        if has_extra:
+            (loss, new_extra), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, state.extra, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+            new_extra = state.extra
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        import optax
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt, extra=new_extra)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def batch_spec(mesh, *, seq_sharded: bool = False):
+    """PartitionSpec for a [batch, ...] array on this mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1)
+    first = batch_axes if batch_axes else None
+    if seq_sharded and mesh.shape.get("sp", 1) > 1:
+        return P(first, "sp")
+    return P(first)
+
+
+def shard_batch(batch, mesh, seq_sharded: bool = False):
+    """device_put a host batch across the mesh: batch dim over dp/fsdp,
+    optionally seq dim over sp (for ring attention)."""
+    from jax.sharding import NamedSharding
+
+    def put(x):
+        spec = batch_spec(mesh, seq_sharded=seq_sharded and x.ndim >= 2)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)

@@ -10,11 +10,20 @@ anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient env points JAX_PLATFORMS at the real TPU
+# (axon tunnel) and its sitecustomize imports jax at interpreter start,
+# so env vars are too late — use jax.config, which still works because
+# backends initialize lazily. Tests must never grab the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,131 @@
+"""Mesh / sharding / ring-attention tests on the virtual 8-device CPU
+mesh (conftest sets xla_force_host_platform_device_count=8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel import MeshSpec, make_mesh
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES, logical_to_mesh, shard_params, place_params,
+)
+from ray_tpu.ops.attention import (
+    causal_attention, make_sharded_causal_attention,
+)
+
+
+def test_device_count():
+    assert jax.device_count() == 8
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(dp=-1).resolve(8) == {
+        "pp": 1, "dp": 8, "fsdp": 1, "ep": 1, "sp": 1, "tp": 1}
+    assert MeshSpec(dp=2, tp=4).resolve(8)["tp"] == 4
+    # smaller-than-device-count meshes use a device subset
+    assert MeshSpec(dp=3).resolve(8)["dp"] == 3
+    with pytest.raises(ValueError):
+        MeshSpec(dp=16).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["tp"] == 4
+    assert mesh.shape["sp"] == 1
+
+
+def test_logical_to_mesh():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    spec = logical_to_mesh(("batch", "seq", "heads"), mesh)
+    assert spec == P("dp", None, "tp")
+    # axis used once only
+    spec2 = logical_to_mesh(("mlp", "heads"), mesh)
+    assert spec2 == P("tp")
+
+
+def test_shard_params_gpt2_patterns():
+    from ray_tpu.models import GPT2, GPT2Config
+
+    mesh = make_mesh({"fsdp": 2, "tp": 4})
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init_params(jax.random.key(0))
+    shardings = shard_params(params, mesh)
+
+    flat = dict(jax.tree_util.tree_flatten_with_path(shardings)[0])
+    by_name = { "/".join(str(k) for k in path): s
+                for path, s in jax.tree_util.tree_flatten_with_path(
+                    shardings)[0] }
+
+    def find(sub):
+        return [s for name, s in by_name.items() if sub in name]
+
+    # wte: (vocab->tp, embed->fsdp)
+    wte = find("wte")[0]
+    assert wte.spec == jax.sharding.PartitionSpec("tp", "fsdp")
+    # attention q kernel: (embed->fsdp, heads->tp)
+    qk = [s for name, s in by_name.items()
+          if "attn" in name and "'q'" in name and "kernel" in name][0]
+    assert qk.spec == jax.sharding.PartitionSpec("fsdp", "tp")
+    # layer norm scale: replicated
+    ln = [s for name, s in by_name.items() if "ln_1" in name][0]
+    assert ln.spec == jax.sharding.PartitionSpec()
+
+
+def test_ring_attention_matches_dense():
+    mesh = make_mesh({"sp": 8})
+    B, T, H, D = 2, 64, 4, 16
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, H, D), jnp.float32)
+
+    dense = causal_attention(q, k, v)
+    ring_fn = make_sharded_causal_attention(mesh)
+    ring = jax.jit(ring_fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_with_dp_and_tp():
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    B, T, H, D = 4, 32, 4, 8
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, H, D), jnp.float32)
+
+    dense = causal_attention(q, k, v)
+    ring_fn = make_sharded_causal_attention(mesh)
+    ring = jax.jit(ring_fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grad():
+    mesh = make_mesh({"sp": 4})
+    B, T, H, D = 1, 32, 2, 8
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, H, D), jnp.float32)
+
+    ring_fn = make_sharded_causal_attention(mesh)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_fn(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   atol=5e-4, rtol=5e-4)
