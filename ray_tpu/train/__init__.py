@@ -14,7 +14,22 @@ from ray_tpu.train.step import (
     make_train_step,
     shard_batch,
 )
+from ray_tpu.train.config import (
+    ScalingConfig,
+    RunConfig,
+    FailureConfig,
+    CheckpointConfig,
+)
+from ray_tpu.train.session import (
+    Checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result
 
 __all__ = [
     "TrainState", "init_train_state", "make_train_step", "shard_batch",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "Checkpoint", "get_context", "report",
+    "JaxTrainer", "Result",
 ]

@@ -1,0 +1,56 @@
+"""Sharding-aware pytree checkpointing (orbax-backed).
+
+The reference's checkpoint layer is a directory + fs URI moved around
+by rank 0 (SURVEY.md §5.4). On TPU the state is a sharded pytree
+spread over a mesh, so save/restore must be sharding-aware: orbax
+writes each host's shards in parallel and restores to a target
+sharding tree. Falls back to pickled host arrays when orbax is
+unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+def save_pytree(tree: Any, directory: str) -> str:
+    os.makedirs(directory, exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+        path = os.path.join(os.path.abspath(directory), "state")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, tree, force=True)
+        ckptr.wait_until_finished()
+        return path
+    except ImportError:
+        import pickle
+        import jax
+        import numpy as np
+        host = jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+        path = os.path.join(directory, "state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(host, f)
+        return path
+
+
+def restore_pytree(directory: str, target: Any = None) -> Any:
+    """Restore; ``target`` (a pytree of arrays or ShapeDtypeStructs with
+    shardings) directs sharded placement on load."""
+    path = os.path.join(os.path.abspath(directory), "state")
+    if os.path.exists(path):
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        if target is not None:
+            import jax
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None)),
+                target)
+            return ckptr.restore(path, abstract)
+        return ckptr.restore(path)
+    pkl = os.path.join(directory, "state.pkl")
+    with open(pkl, "rb") as f:
+        import pickle
+        return pickle.load(f)

@@ -1,0 +1,51 @@
+"""Train/run configuration dataclasses.
+
+Analog of AIR's ScalingConfig / RunConfig / FailureConfig /
+CheckpointConfig (reference: python/ray/air/config.py:102,593,394,444),
+re-based for TPU: scaling is expressed in workers × chips-per-worker,
+and a worker group maps onto an ICI slice (gang-scheduled placement
+group, STRICT_PACK).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    # Chips each worker owns (a worker = one host process of the slice).
+    tpu_chips_per_worker: int = 0
+    resources_per_worker: dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "STRICT_PACK"
+
+    def worker_resources(self) -> dict[str, float]:
+        res = {"CPU": 1.0}
+        res.update(self.resources_per_worker)
+        if self.tpu_chips_per_worker:
+            res["TPU"] = float(self.tpu_chips_per_worker)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"   # "max" | "min"
+
+
+@dataclass
+class RunConfig:
+    name: str = ""
+    storage_path: str = "/tmp/ray_tpu/experiments"
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    verbose: bool = False

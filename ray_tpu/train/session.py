@@ -1,0 +1,127 @@
+"""Worker-side training session.
+
+Analog of the reference's ``_TrainSession``
+(python/ray/train/_internal/session.py:111,403,667): the user's
+``train_loop_per_worker`` calls ``report(metrics, checkpoint=...)``;
+results queue up in the worker actor and are drained by the trainer's
+poll loop. Checkpoints are persisted worker-side directly to storage
+(reference: worker uploads to StorageContext, storage.py:352), so large
+states never transit the driver.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    experiment_name: str = ""
+    storage_path: str = ""
+    trial_dir: str = ""
+    restored_checkpoint_dir: str | None = None
+    loop_config: dict = field(default_factory=dict)
+
+
+@dataclass
+class ReportedResult:
+    metrics: dict[str, Any]
+    checkpoint_dir: str | None
+    rank: int
+    index: int
+
+
+_session: "_TrainSession | None" = None
+
+
+class _TrainSession:
+    def __init__(self, context: TrainContext):
+        self.context = context
+        self.results: "queue.Queue[ReportedResult]" = queue.Queue()
+        self._index = 0
+        self._lock = threading.Lock()
+
+    def report(self, metrics: dict[str, Any],
+               checkpoint: "Checkpoint | None" = None) -> None:
+        ckpt_dir = None
+        if checkpoint is not None:
+            ckpt_dir = checkpoint.persist(
+                self.context.trial_dir,
+                index=self._index,
+                rank=self.context.world_rank)
+        with self._lock:
+            r = ReportedResult(metrics=dict(metrics),
+                               checkpoint_dir=ckpt_dir,
+                               rank=self.context.world_rank,
+                               index=self._index)
+            self._index += 1
+        self.results.put(r)
+
+
+def init_session(context: TrainContext) -> _TrainSession:
+    global _session
+    _session = _TrainSession(context)
+    return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    _session = None
+
+
+def get_session() -> _TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "no train session active — report()/get_context() are only "
+            "valid inside train_loop_per_worker")
+    return _session
+
+
+def report(metrics: dict[str, Any], checkpoint=None) -> None:
+    """Report metrics (and optionally a checkpoint) from the training
+    loop — the worker-side API (reference: train.report)."""
+    get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return get_session().context
+
+
+class Checkpoint:
+    """A directory of checkpoint data (reference:
+    python/ray/train/_checkpoint.py:56 — dir + filesystem URI).
+
+    Create with ``Checkpoint.from_directory(tmp)`` in the training loop;
+    ``persist`` moves/copies it into experiment storage. For sharded
+    jax state use ``ray_tpu.train.checkpoint.save_pytree`` (orbax) into
+    the directory first.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    def to_directory(self) -> str:
+        return self.path
+
+    def persist(self, trial_dir: str, index: int, rank: int) -> str:
+        import shutil
+        dest = os.path.join(trial_dir,
+                            f"checkpoint_{index:06d}")
+        os.makedirs(dest, exist_ok=True)
+        # Rank directories let multi-host sharded saves coexist.
+        rank_dest = os.path.join(dest, f"rank_{rank}") \
+            if rank else dest
+        if os.path.abspath(self.path) != os.path.abspath(rank_dest):
+            shutil.copytree(self.path, rank_dest, dirs_exist_ok=True)
+        return dest

@@ -1,0 +1,147 @@
+"""JaxTrainer — the DataParallelTrainer analog, TPU-first.
+
+Reference call stack being re-based (SURVEY.md §3.4): BaseTrainer.fit →
+WorkerGroup of actors → backend process-group setup → per-worker loop →
+report()/checkpoint → poll. Differences by design:
+
+- the "backend" is jax.distributed over the gang (coordinator address
+  rendezvous), after which ALL collectives are compiled into the user's
+  jitted step over ICI — no NCCL process group object to babysit;
+- a worker = one host of the slice, owning its local chips; a
+  single-worker trainer runs SPMD over every local chip via the mesh,
+  so data-parallelism inside one host needs no worker group at all;
+- failure handling restarts the whole gang from the latest checkpoint
+  (SPMD slice semantics: one host down ⇒ slice restart, SURVEY.md
+  §7.3.2), driven by FailureConfig(max_failures).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    metrics: dict[str, Any]
+    checkpoint_dir: str | None
+    path: str
+    metrics_history: list[dict[str, Any]] = field(default_factory=list)
+    error: str | None = None
+
+    @property
+    def checkpoint(self):
+        from ray_tpu.train.session import Checkpoint
+        if self.checkpoint_dir is None:
+            return None
+        return Checkpoint(self.checkpoint_dir)
+
+
+class JaxTrainer:
+    """Distributed data-parallel (and beyond) JAX training.
+
+    train_loop_per_worker runs inside each gang worker; it uses
+    ``ray_tpu.train.get_context()`` for rank/size and
+    ``ray_tpu.train.report(metrics, checkpoint=...)`` to stream results.
+    """
+
+    def __init__(self,
+                 train_loop_per_worker: Callable,
+                 *,
+                 train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None):
+        self.train_loop = train_loop_per_worker
+        self.loop_config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    # -- public API --
+
+    def fit(self) -> Result:
+        name = self.run_config.name or f"train_{int(time.time())}"
+        trial_dir = os.path.join(self.run_config.storage_path, name)
+        os.makedirs(trial_dir, exist_ok=True)
+
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        restored: str | None = None
+        while True:
+            try:
+                return self._fit_once(trial_dir, restored)
+            except _WorkerGroupError as e:
+                attempt += 1
+                if max_failures >= 0 and attempt > max_failures:
+                    return Result(metrics={}, checkpoint_dir=e.latest_ckpt,
+                                  path=trial_dir, error=e.error)
+                # Elastic slice restart from the latest checkpoint.
+                restored = e.latest_ckpt
+
+    # -- internals --
+
+    def _fit_once(self, trial_dir: str, restored: str | None) -> Result:
+        group = WorkerGroup(
+            num_workers=self.scaling.num_workers,
+            resources_per_worker=self.scaling.worker_resources(),
+            placement_strategy=self.scaling.placement_strategy,
+        )
+        latest_ckpt: str | None = restored
+        history: list[dict] = []
+        try:
+            group.barrier()
+            if self.scaling.num_workers > 1:
+                coordinator = f"127.0.0.1:{_free_port()}"
+                group.run("setup_distributed", coordinator, timeout=120)
+            ctx_kwargs = {
+                "experiment_name": os.path.basename(trial_dir),
+                "storage_path": self.run_config.storage_path,
+                "trial_dir": trial_dir,
+                "restored_checkpoint_dir": restored,
+            }
+            group.run("start_loop", (self.train_loop, self.loop_config),
+                      ctx_kwargs, timeout=120)
+
+            final_metrics: dict = {}
+            done = [False] * group.num_workers
+            while not all(done):
+                polls = group.run("poll", timeout=600)
+                for i, p in enumerate(polls):
+                    if p["error"]:
+                        raise _WorkerGroupError(p["error"], latest_ckpt)
+                    for r in p["results"]:
+                        if r["rank"] == 0:
+                            history.append(r["metrics"])
+                            final_metrics = r["metrics"]
+                        if r["checkpoint_dir"]:
+                            latest_ckpt = r["checkpoint_dir"]
+                    done[i] = p["done"]
+                if not all(done):
+                    time.sleep(0.05)
+            return Result(metrics=final_metrics,
+                          checkpoint_dir=latest_ckpt, path=trial_dir,
+                          metrics_history=history)
+        except _WorkerGroupError:
+            raise
+        except Exception as e:  # noqa: BLE001 — actor/infra failure
+            raise _WorkerGroupError(str(e), latest_ckpt) from e
+        finally:
+            group.shutdown()
+
+
+class _WorkerGroupError(Exception):
+    def __init__(self, error: str, latest_ckpt: str | None):
+        super().__init__(error)
+        self.error = error
+        self.latest_ckpt = latest_ckpt
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

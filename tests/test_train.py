@@ -1,0 +1,146 @@
+"""JaxTrainer end-to-end tests (reference analog: train e2e suite).
+
+Worker actors are separate processes with 8 virtual CPU devices each
+(XLA_FLAGS is inherited), mirroring the reference's
+multi-node-on-one-machine test pattern.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint, JaxTrainer, RunConfig, FailureConfig, ScalingConfig,
+    get_context, report,
+)
+
+
+def _loop_gpt_tiny(config):
+    import jax
+    import optax
+
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.models.gpt2 import gpt2_loss_fn
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.train import (
+        init_train_state, make_train_step, shard_batch, report,
+    )
+
+    mesh = make_mesh({"dp": -1})
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg, mesh=mesh)
+    params = model.init_params(jax.random.key(0))
+    opt = optax.adamw(1e-2)
+    state = init_train_state(params, opt, mesh)
+    step = make_train_step(gpt2_loss_fn(model), opt)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size,
+                          (8, cfg.seq_len)).astype(np.int32)
+    batch = shard_batch(
+        {"tokens": tokens, "targets": np.roll(tokens, -1, 1)}, mesh)
+    for i in range(config.get("steps", 3)):
+        state, metrics = step(state, batch)
+        report({"loss": float(metrics["loss"]), "step": i})
+
+
+def test_trainer_single_worker(rt):
+    trainer = JaxTrainer(
+        _loop_gpt_tiny,
+        train_loop_config={"steps": 4},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path="/tmp/ray_tpu_test_exp"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    assert len(result.metrics_history) == 4
+    assert np.isfinite(result.metrics["loss"])
+
+
+def _loop_with_checkpoint(config):
+    import json
+    import tempfile
+
+    from ray_tpu.train import Checkpoint, get_context, report
+
+    ctx = get_context()
+    start = 0
+    if ctx.restored_checkpoint_dir:
+        with open(os.path.join(ctx.restored_checkpoint_dir,
+                               "state.json")) as f:
+            start = json.load(f)["step"] + 1
+    for i in range(start, config["steps"]):
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "state.json"), "w") as f:
+            json.dump({"step": i}, f)
+        if config.get("crash_at") == i and not ctx.restored_checkpoint_dir:
+            os._exit(1)
+        report({"step": i}, checkpoint=Checkpoint.from_directory(d))
+
+
+def test_trainer_checkpoint_and_restore_after_failure(rt):
+    trainer = JaxTrainer(
+        _loop_with_checkpoint,
+        train_loop_config={"steps": 5, "crash_at": 3},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path="/tmp/ray_tpu_test_exp",
+            failure_config=FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # completed through step 4 after restart from step-2 checkpoint
+    assert result.metrics["step"] == 4
+    assert result.checkpoint_dir is not None
+    assert os.path.exists(result.checkpoint_dir)
+
+
+def test_trainer_user_error_no_retry(rt):
+    def bad_loop(config):
+        raise ValueError("training exploded")
+
+    trainer = JaxTrainer(
+        bad_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path="/tmp/ray_tpu_test_exp"),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+    assert "training exploded" in result.error
+
+
+def _loop_rank_report(config):
+    from ray_tpu.train import get_context, report
+    ctx = get_context()
+    report({"rank": ctx.world_rank, "world": ctx.world_size})
+
+
+def test_trainer_two_workers_context(rt):
+    trainer = JaxTrainer(
+        _loop_rank_report,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path="/tmp/ray_tpu_test_exp"),
+    )
+    # Two workers needs jax.distributed across processes; our loop
+    # doesn't use collectives, but rendezvous must succeed.
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["world"] == 2
+    assert result.metrics["rank"] == 0
+
+
+def test_checkpoint_pytree_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_tpu.train.checkpoint import restore_pytree, save_pytree
+
+    tree = {"w": jnp.arange(8.0), "b": {"x": jnp.ones((2, 2))}}
+    save_pytree(tree, str(tmp_path))
+    out = restore_pytree(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.arange(8.0))
+    np.testing.assert_allclose(np.asarray(out["b"]["x"]),
+                               np.ones((2, 2)))
