@@ -1,0 +1,21 @@
+"""ray_tpu.tune — hyperparameter search (Ray Tune analog).
+
+Trials are actors scheduled by the core runtime; on TPU fleets each
+trial's trainer gang occupies its own slice (placement-group per trial,
+SURVEY.md §2.4 "one pod slice per trial").
+"""
+
+from ray_tpu.tune.search import (
+    grid_search, choice, uniform, loguniform, randint,
+    BasicVariantGenerator,
+)
+from ray_tpu.tune.schedulers import FIFOScheduler, ASHAScheduler
+from ray_tpu.tune.tune import (
+    Tuner, TuneConfig, Trial, ResultGrid, TrialResult,
+)
+
+__all__ = [
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "BasicVariantGenerator", "FIFOScheduler", "ASHAScheduler",
+    "Tuner", "TuneConfig", "Trial", "ResultGrid", "TrialResult",
+]

@@ -1,0 +1,71 @@
+"""Trial schedulers: FIFO and ASHA.
+
+Reference analog: python/ray/tune/schedulers/async_hyperband.py — the
+asynchronous successive-halving algorithm: rungs at
+min_t * eta^k; when a trial reports at a rung boundary it continues
+only if its metric is in the top 1/eta of completed results at that
+rung, else it is stopped early.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass
+
+
+@dataclass
+class ASHAScheduler:
+    metric: str = "loss"
+    mode: str = "min"                 # "min" | "max"
+    time_attr: str = "training_iteration"
+    max_t: int = 100
+    grace_period: int = 1
+    reduction_factor: int = 4
+
+    _rungs: list[int] = field(default_factory=list)
+    _rung_results: dict[int, list[float]] = field(
+        default_factory=lambda: defaultdict(list))
+    _trial_rung: dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        t = self.grace_period
+        while t < self.max_t:
+            self._rungs.append(t)
+            t *= self.reduction_factor
+        self._rungs = sorted(self._rungs, reverse=True)
+
+    def _value(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return -v if self.mode == "max" else v
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP  # budget exhausted (normal completion)
+        for rung in self._rungs:     # highest rung first (ASHA rule)
+            if t >= rung and self._trial_rung.get(trial_id, -1) < rung:
+                self._trial_rung[trial_id] = rung
+                value = self._value(result)
+                peers = self._rung_results[rung]
+                peers.append(value)
+                if len(peers) >= self.reduction_factor:
+                    k = max(1, len(peers) // self.reduction_factor)
+                    cutoff = sorted(peers)[k - 1]
+                    if value > cutoff:
+                        return STOP
+                return CONTINUE
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        self._trial_rung.pop(trial_id, None)

@@ -1,0 +1,128 @@
+"""Search spaces and suggestion algorithms.
+
+Reference analog: python/ray/tune/search/ — the basic variant
+generator (grid + random sampling) plus a Searcher interface that
+external algorithms (optuna-style) can implement.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class _GridSearch:
+    values: tuple
+
+
+@dataclass(frozen=True)
+class _Choice:
+    values: tuple
+
+
+@dataclass(frozen=True)
+class _Uniform:
+    low: float
+    high: float
+
+
+@dataclass(frozen=True)
+class _LogUniform:
+    low: float
+    high: float
+
+
+@dataclass(frozen=True)
+class _RandInt:
+    low: int
+    high: int
+
+
+def grid_search(values) -> _GridSearch:
+    return _GridSearch(tuple(values))
+
+
+def choice(values) -> _Choice:
+    return _Choice(tuple(values))
+
+
+def uniform(low: float, high: float) -> _Uniform:
+    return _Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> _LogUniform:
+    return _LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> _RandInt:
+    return _RandInt(low, high)
+
+
+def _sample(spec, rng: random.Random):
+    import math
+    if isinstance(spec, _Choice):
+        return rng.choice(list(spec.values))
+    if isinstance(spec, _Uniform):
+        return rng.uniform(spec.low, spec.high)
+    if isinstance(spec, _LogUniform):
+        return math.exp(rng.uniform(math.log(spec.low),
+                                    math.log(spec.high)))
+    if isinstance(spec, _RandInt):
+        return rng.randrange(spec.low, spec.high)
+    if callable(spec):
+        return spec()
+    return spec
+
+
+class Searcher:
+    """Suggestion interface (reference: tune.search.Searcher)."""
+
+    def suggest(self, trial_id: str) -> dict | None:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid axes are fully enumerated; every other axis is sampled per
+    variant; the whole grid is repeated num_samples times (reference
+    semantics: tune.run num_samples multiplies the grid)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = self._build()
+        self._i = 0
+
+    def _build(self) -> list[dict]:
+        grid_keys = [k for k, v in self.param_space.items()
+                     if isinstance(v, _GridSearch)]
+        grids = [self.param_space[k].values for k in grid_keys]
+        out = []
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grids) if grids else [()]:
+                cfg = {}
+                for k, v in self.param_space.items():
+                    if k in grid_keys:
+                        cfg[k] = combo[grid_keys.index(k)]
+                    else:
+                        cfg[k] = _sample(v, self.rng)
+                out.append(cfg)
+        return out
+
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg

@@ -1,0 +1,226 @@
+"""Tuner + trial controller.
+
+Reference call stack being re-based (SURVEY.md §3.4 / §2.3 Tune):
+``Tuner.fit`` → controller event loop managing trials as actors.
+A trial is one TrainWorker-style actor (function trainables), or a
+whole JaxTrainer (its gang nests through the core runtime — actors
+creating actors). The ASHA scheduler prunes at rung boundaries by
+killing the trial actor; FailureConfig-style retry is per-trial.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+
+
+@dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 0      # 0 = resource-bound
+    metric: str | None = None
+    mode: str = "min"
+    scheduler: Any = None               # FIFOScheduler | ASHAScheduler
+    search_alg: Searcher | None = None
+    resources_per_trial: dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1.0})
+    seed: int | None = None
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: dict
+    metrics: dict
+    metrics_history: list[dict]
+    checkpoint_dir: str | None
+    state: str
+    error: str | None = None
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    state: str = "PENDING"   # PENDING/RUNNING/COMPLETED/STOPPED/ERROR
+    actor: Any = None
+    iteration: int = 0
+    metrics: dict = field(default_factory=dict)
+    history: list = field(default_factory=list)
+    checkpoint_dir: str | None = None
+    error: str | None = None
+
+
+class ResultGrid:
+    def __init__(self, results: list[TrialResult]):
+        self._results = results
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: str, mode: str = "min"
+                        ) -> TrialResult:
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return (min if mode == "min" else max)(scored, key=key)
+
+    @property
+    def errors(self) -> list[TrialResult]:
+        return [r for r in self._results if r.state == "ERROR"]
+
+
+class Tuner:
+    def __init__(self, trainable: Callable | Any,
+                 *,
+                 param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self.param_space, tc.num_samples, seed=tc.seed)
+        scheduler = tc.scheduler or FIFOScheduler()
+
+        exp_name = self.run_config.name or f"tune_{int(time.time())}"
+        exp_dir = os.path.join(self.run_config.storage_path, exp_name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        fn = _as_function_trainable(self.trainable)
+
+        # Materialize trials up front from the searcher.
+        trials: list[Trial] = []
+        while True:
+            tid = f"trial_{len(trials):05d}_{uuid.uuid4().hex[:6]}"
+            cfg = searcher.suggest(tid)
+            if cfg is None:
+                break
+            trials.append(Trial(trial_id=tid, config=cfg))
+
+        max_conc = tc.max_concurrent_trials or self._resource_bound(tc)
+        pending = list(trials)
+        running: list[Trial] = []
+
+        while pending or running:
+            while pending and len(running) < max_conc:
+                t = pending.pop(0)
+                self._start_trial(t, fn, exp_dir, tc)
+                running.append(t)
+            time.sleep(0.05)
+            still = []
+            for t in running:
+                if self._poll_trial(t, scheduler, searcher):
+                    still.append(t)
+            running = still
+
+        results = [TrialResult(
+            trial_id=t.trial_id, config=t.config, metrics=t.metrics,
+            metrics_history=t.history, checkpoint_dir=t.checkpoint_dir,
+            state=t.state, error=t.error) for t in trials]
+        return ResultGrid(results)
+
+    # -- internals --
+
+    def _resource_bound(self, tc: TuneConfig) -> int:
+        total = ray_tpu.cluster_resources()
+        per = tc.resources_per_trial.get("CPU", 1.0) or 1.0
+        return max(1, int(total.get("CPU", 1.0) // per))
+
+    def _start_trial(self, t: Trial, fn, exp_dir: str,
+                     tc: TuneConfig) -> None:
+        trial_dir = os.path.join(exp_dir, t.trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        t.actor = TrainWorker.options(
+            num_cpus=tc.resources_per_trial.get("CPU", 1.0),
+            resources={k: v for k, v in tc.resources_per_trial.items()
+                       if k != "CPU"},
+        ).remote(0, 1, {})
+        ctx_kwargs = {
+            "experiment_name": os.path.basename(exp_dir),
+            "storage_path": self.run_config.storage_path,
+            "trial_dir": trial_dir,
+            "restored_checkpoint_dir": None,
+        }
+        t.state = "RUNNING"
+        t.actor.start_loop.remote((fn, t.config), ctx_kwargs)
+
+    def _poll_trial(self, t: Trial, scheduler, searcher) -> bool:
+        """Poll one trial; True if still running."""
+        try:
+            p = ray_tpu.get(t.actor.poll.remote(), timeout=60)
+        except Exception as e:  # noqa: BLE001 — actor died
+            t.state = "ERROR"
+            t.error = str(e)
+            searcher.on_trial_complete(t.trial_id, None, error=True)
+            return False
+        decision = CONTINUE
+        for r in p["results"]:
+            t.iteration += 1
+            m = dict(r["metrics"])
+            m.setdefault("training_iteration", t.iteration)
+            t.metrics = m
+            t.history.append(m)
+            if r["checkpoint_dir"]:
+                t.checkpoint_dir = r["checkpoint_dir"]
+            decision = scheduler.on_result(t.trial_id, m)
+            if decision == STOP:
+                break
+        if decision == STOP and not p["done"]:
+            t.state = "STOPPED"
+            ray_tpu.kill(t.actor)
+            scheduler.on_trial_complete(t.trial_id)
+            searcher.on_trial_complete(t.trial_id, t.metrics)
+            return False
+        if p["done"]:
+            t.state = "ERROR" if p["error"] else "COMPLETED"
+            t.error = p["error"]
+            scheduler.on_trial_complete(t.trial_id)
+            searcher.on_trial_complete(t.trial_id, t.metrics,
+                                       error=bool(p["error"]))
+            ray_tpu.kill(t.actor)
+            return False
+        return True
+
+
+def _as_function_trainable(trainable) -> Callable:
+    from ray_tpu.train.trainer import JaxTrainer
+
+    if isinstance(trainable, JaxTrainer):
+        def run_trainer(config):
+            from ray_tpu.train import report
+            import copy
+            trainer = JaxTrainer(
+                trainable.train_loop,
+                train_loop_config={**trainable.loop_config, **config},
+                scaling_config=trainable.scaling,
+                run_config=trainable.run_config,
+            )
+            result = trainer.fit()
+            if result.error:
+                raise RuntimeError(result.error)
+            report(result.metrics)
+        return run_trainer
+    if callable(trainable):
+        return trainable
+    raise TypeError(f"unsupported trainable: {type(trainable)}")
