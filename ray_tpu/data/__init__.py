@@ -1,0 +1,26 @@
+"""ray_tpu.data — distributed datasets (Ray Data analog).
+
+Lazy blocks + fused transforms + streaming execution with
+backpressure; ``streaming_split`` feeds trainer gangs and
+``iter_device_batches`` prefetches sharded device batches onto the
+mesh (SURVEY.md §2.3/§2.4).
+"""
+
+from ray_tpu.data.dataset import DataIterator, Dataset
+from ray_tpu.data.io import (
+    from_items,
+    from_numpy,
+    from_pandas,
+    range as range_,  # noqa: A001 — re-exported as .range below
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+# public name mirrors the reference: ray.data.range
+range = range_  # noqa: A001
+
+__all__ = [
+    "Dataset", "DataIterator", "range", "from_items", "from_numpy",
+    "from_pandas", "read_parquet", "read_csv", "read_json",
+]

@@ -1,0 +1,96 @@
+"""Blocks: the unit of data movement.
+
+Reference analog: Ray Data's Arrow blocks behind ObjectRefs (SURVEY.md
+§2.3). A block is a pyarrow Table; batches surface as dicts of numpy
+arrays (the jax-friendly format). Blocks live in the object store and
+move between operators as ObjectRefs — the plasma path, zero-copy for
+the numpy payloads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+
+def to_block(rows_or_batch) -> "pyarrow.Table":  # noqa: F821
+    import pyarrow as pa
+
+    if isinstance(rows_or_batch, pa.Table):
+        return rows_or_batch
+    if isinstance(rows_or_batch, dict):
+        return pa.table({
+            k: _to_arrow_array(v) for k, v in rows_or_batch.items()})
+    if isinstance(rows_or_batch, list):
+        if not rows_or_batch:
+            return pa.table({})
+        if isinstance(rows_or_batch[0], dict):
+            cols = {k: [r[k] for r in rows_or_batch]
+                    for k in rows_or_batch[0]}
+            return pa.table({k: _to_arrow_array(v)
+                             for k, v in cols.items()})
+        return pa.table({"item": _to_arrow_array(rows_or_batch)})
+    raise TypeError(f"cannot make a block from {type(rows_or_batch)}")
+
+
+def _to_arrow_array(v):
+    import pyarrow as pa
+
+    arr = np.asarray(v)
+    if arr.ndim <= 1:
+        return pa.array(arr.tolist() if arr.dtype == object else arr)
+    # N-d columns -> FixedSizeList nesting (tensors per row).
+    flat = arr.reshape(len(arr), -1)
+    inner = pa.array(flat.ravel())
+    for dim in reversed(arr.shape[1:]):
+        inner = pa.FixedSizeListArray.from_arrays(inner, dim)
+    return inner
+
+
+def block_to_batch(block) -> dict[str, np.ndarray]:
+    """Block -> dict of numpy (tensor columns restored to N-d)."""
+    out = {}
+    for name in block.column_names:
+        col = block.column(name)
+        out[name] = _column_to_numpy(col)
+    return out
+
+
+def _column_to_numpy(col) -> np.ndarray:
+    import pyarrow as pa
+
+    typ = col.type
+    dims = []
+    while pa.types.is_fixed_size_list(typ):
+        dims.append(typ.list_size)
+        typ = typ.value_type
+    arr = col.combine_chunks()
+    if dims:
+        flat = arr.flatten()
+        for _ in range(len(dims) - 1):
+            flat = flat.flatten()
+        np_flat = flat.to_numpy(zero_copy_only=False)
+        return np_flat.reshape((len(col), *dims))
+    return arr.to_numpy(zero_copy_only=False)
+
+
+def block_num_rows(block) -> int:
+    return block.num_rows
+
+
+def block_rows(block) -> Iterable[dict[str, Any]]:
+    batch = block_to_batch(block)
+    keys = list(batch)
+    for i in range(block.num_rows):
+        yield {k: batch[k][i] for k in keys}
+
+
+def concat_blocks(blocks: list) -> "pyarrow.Table":  # noqa: F821
+    import pyarrow as pa
+    blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+    return pa.concat_tables(blocks)
+
+
+def slice_block(block, start: int, end: int):
+    return block.slice(start, end - start)

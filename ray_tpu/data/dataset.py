@@ -1,0 +1,421 @@
+"""Lazy Dataset with streaming execution.
+
+Reference analog (SURVEY.md §2.3 / §3.6): logical plan → rule-based
+optimizer → physical operators → pull-based streaming executor with
+backpressure. Round-1 design keeps the same shape, specialized:
+
+- logical ops are recorded lazily on the Dataset;
+- the optimizer fuses chains of row/batch transforms into ONE task per
+  block (the reference's map-fusion rule — its biggest win);
+- the streaming executor is a generator that keeps at most
+  ``max_in_flight`` block tasks outstanding (backpressure), yielding
+  block ObjectRefs as they complete, in order;
+- all-to-all ops (repartition, random_shuffle) are barriers, as in the
+  reference.
+
+Blocks execute as core-runtime tasks, so a Dataset streams across the
+cluster's CPU workers while consumers (trainer actors / device
+prefetch) pull concurrently.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    block_num_rows, block_rows, block_to_batch, concat_blocks,
+    slice_block, to_block,
+)
+
+DEFAULT_MAX_IN_FLIGHT = 16
+
+
+# -- logical ops -----------------------------------------------------------
+
+@dataclass
+class _Source:
+    read_fns: list[Callable[[], Any]]      # each returns a block
+
+
+@dataclass
+class _MapBatches:
+    fn: Callable
+    fn_kwargs: dict = field(default_factory=dict)
+
+
+@dataclass
+class _MapRows:
+    fn: Callable
+
+
+@dataclass
+class _FlatMap:
+    fn: Callable
+
+
+@dataclass
+class _Filter:
+    fn: Callable
+
+
+@dataclass
+class _Repartition:
+    num_blocks: int
+
+
+@dataclass
+class _RandomShuffle:
+    seed: int | None
+
+
+@dataclass
+class _Limit:
+    n: int
+
+
+_FUSABLE = (_MapBatches, _MapRows, _FlatMap, _Filter)
+
+
+def _apply_fused(block, ops: list):
+    """Run a fused chain of transforms on one block (executes inside a
+    worker task)."""
+    for op in ops:
+        if isinstance(op, _MapBatches):
+            batch = block_to_batch(block)
+            out = op.fn(batch, **op.fn_kwargs)
+            block = to_block(out)
+        elif isinstance(op, _MapRows):
+            rows = [op.fn(r) for r in block_rows(block)]
+            block = to_block(rows)
+        elif isinstance(op, _FlatMap):
+            rows = [o for r in block_rows(block) for o in op.fn(r)]
+            block = to_block(rows)
+        elif isinstance(op, _Filter):
+            rows = [r for r in block_rows(block) if op.fn(r)]
+            block = to_block(rows)
+    return block
+
+
+@ray_tpu.remote
+def _read_and_transform(read_fn, ops):
+    return _apply_fused(read_fn(), ops)
+
+
+@ray_tpu.remote
+def _transform_block(block, ops):
+    return _apply_fused(block, ops)
+
+
+@ray_tpu.remote
+def _split_block(block, starts_ends):
+    return tuple(slice_block(block, s, e) for s, e in starts_ends) \
+        if len(starts_ends) > 1 else slice_block(block, *starts_ends[0])
+
+
+class Dataset:
+    """Lazy, immutable, distributed dataset (reference: ray.data.Dataset)."""
+
+    def __init__(self, plan: list):
+        self._plan = plan
+
+    # -- transforms (lazy) --
+
+    def _append(self, op) -> "Dataset":
+        return Dataset(self._plan + [op])
+
+    def map_batches(self, fn: Callable, **fn_kwargs) -> "Dataset":
+        return self._append(_MapBatches(fn, fn_kwargs))
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._append(_MapRows(fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._append(_FlatMap(fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._append(_Filter(fn))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(_Repartition(num_blocks))
+
+    def random_shuffle(self, seed: int | None = None) -> "Dataset":
+        return self._append(_RandomShuffle(seed))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(_Limit(n))
+
+    # -- execution ---------------------------------------------------------
+
+    def _stream_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                       ) -> Iterator[ray_tpu.ObjectRef]:
+        """The streaming executor: yields block refs in order with at
+        most max_in_flight tasks outstanding."""
+        stages = _split_stages(self._plan)
+        refs = None
+        for kind, payload in stages:
+            if kind == "source":
+                read_fns, fused = payload
+                refs = _bounded_submit(
+                    ((_read_and_transform, (rf, fused))
+                     for rf in read_fns), max_in_flight)
+            elif kind == "fused":
+                upstream, fused = refs, payload
+                refs = _bounded_submit(
+                    ((_transform_block, (r, fused)) for r in upstream),
+                    max_in_flight)
+            elif kind == "repartition":
+                refs = iter(_do_repartition(list(refs), payload))
+            elif kind == "shuffle":
+                refs = iter(_do_shuffle(list(refs), payload))
+            elif kind == "limit":
+                refs = _do_limit(refs, payload)
+        return refs
+
+    def iter_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+        for ref in self._stream_blocks(max_in_flight):
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, batch_size: int | None = None,
+                     drop_last: bool = False,
+                     max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                     ) -> Iterator[dict[str, np.ndarray]]:
+        carry = None
+        for block in self.iter_blocks(max_in_flight):
+            if block.num_rows == 0:
+                continue
+            if batch_size is None:
+                yield block_to_batch(block)
+                continue
+            block = block if carry is None else concat_blocks(
+                [carry, block])
+            carry = None
+            start = 0
+            while start + batch_size <= block.num_rows:
+                yield block_to_batch(
+                    slice_block(block, start, start + batch_size))
+                start += batch_size
+            if start < block.num_rows:
+                carry = slice_block(block, start, block.num_rows)
+        if carry is not None and not drop_last:
+            yield block_to_batch(carry)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for block in self.iter_blocks():
+            yield from block_rows(block)
+
+    def take(self, n: int = 20) -> list[dict]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list[dict]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def schema(self):
+        for block in self.iter_blocks():
+            return block.schema
+        return None
+
+    def materialize(self) -> "Dataset":
+        blocks = list(self.iter_blocks())
+        return Dataset([_Source([(lambda b=b: b) for b in blocks])])
+
+    def num_blocks(self) -> int:
+        n = 0
+        for _ in self._stream_blocks():
+            n += 1
+        return n
+
+    # -- split for trainers --
+
+    def streaming_split(self, n: int) -> list["DataIterator"]:
+        """n iterators, block i -> shard i%n (reference:
+        Dataset.streaming_split feeding per-trainer iterators)."""
+        return [DataIterator(self, shard=i, num_shards=n)
+                for i in range(n)]
+
+    def split(self, n: int) -> list["Dataset"]:
+        mat = self.materialize()
+        src: _Source = mat._plan[0]
+        return [Dataset([_Source(src.read_fns[i::n])]) for i in range(n)]
+
+    # -- io --
+
+    def write_parquet(self, path: str) -> None:
+        import os
+        import pyarrow.parquet as pq
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            pq.write_table(block, f"{path}/part-{i:05d}.parquet")
+
+    def __repr__(self):
+        return f"Dataset(stages={len(self._plan)})"
+
+
+class DataIterator:
+    """Picklable per-consumer shard iterator (usable inside trainer
+    actors; execution happens in the consuming process, streaming
+    through the shared driver runtime)."""
+
+    def __init__(self, ds: Dataset, shard: int, num_shards: int):
+        self._ds = ds
+        self._shard = shard
+        self._num_shards = num_shards
+
+    def _shard_refs(self):
+        for i, ref in enumerate(self._ds._stream_blocks()):
+            if i % self._num_shards == self._shard:
+                yield ref
+
+    def iter_batches(self, batch_size: int | None = None,
+                     drop_last: bool = False):
+        carry = None
+        for ref in self._shard_refs():
+            block = ray_tpu.get(ref)
+            if block.num_rows == 0:
+                continue
+            if batch_size is None:
+                yield block_to_batch(block)
+                continue
+            block = block if carry is None else concat_blocks(
+                [carry, block])
+            carry = None
+            start = 0
+            while start + batch_size <= block.num_rows:
+                yield block_to_batch(
+                    slice_block(block, start, start + batch_size))
+                start += batch_size
+            if start < block.num_rows:
+                carry = slice_block(block, start, block.num_rows)
+        if carry is not None and not drop_last:
+            yield block_to_batch(carry)
+
+    def iter_device_batches(self, batch_size: int, mesh=None,
+                            seq_sharded: bool = False, prefetch: int = 2):
+        """Double-buffered device feed: host batches are device_put
+        ahead of consumption (the multi-host device-prefetch path,
+        SURVEY.md §2.4 data-pipeline row)."""
+        from ray_tpu.train.step import shard_batch
+        import collections
+        buf = collections.deque()
+        it = self.iter_batches(batch_size, drop_last=True)
+        for batch in it:
+            if mesh is not None:
+                batch = shard_batch(batch, mesh, seq_sharded=seq_sharded)
+            buf.append(batch)
+            if len(buf) > prefetch:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+
+
+# -- executor helpers ------------------------------------------------------
+
+def _split_stages(plan: list) -> list[tuple[str, Any]]:
+    """Optimizer: fuse transform chains; barriers separate stages."""
+    stages: list[tuple[str, Any]] = []
+    i = 0
+    assert isinstance(plan[0], _Source), "plan must start with a source"
+    fused: list = []
+    i = 1
+    while i < len(plan) and isinstance(plan[i], _FUSABLE):
+        fused.append(plan[i])
+        i += 1
+    stages.append(("source", (plan[0].read_fns, fused)))
+    while i < len(plan):
+        op = plan[i]
+        if isinstance(op, _Repartition):
+            stages.append(("repartition", op.num_blocks))
+            i += 1
+        elif isinstance(op, _RandomShuffle):
+            stages.append(("shuffle", op.seed))
+            i += 1
+        elif isinstance(op, _Limit):
+            stages.append(("limit", op.n))
+            i += 1
+        else:
+            fused = []
+            while i < len(plan) and isinstance(plan[i], _FUSABLE):
+                fused.append(plan[i])
+                i += 1
+            stages.append(("fused", fused))
+    return stages
+
+
+def _bounded_submit(task_iter, max_in_flight: int):
+    """Submit lazily, keeping <= max_in_flight outstanding; yield refs
+    in submission order (the backpressure loop)."""
+    pending: list = []
+    for fn, args in task_iter:
+        while len(pending) >= max_in_flight:
+            ray_tpu.wait(pending, num_returns=1)
+            yield pending.pop(0)
+        pending.append(fn.remote(*args))
+    while pending:
+        yield pending.pop(0)
+
+
+@ray_tpu.remote
+def _concat_task(*blocks):
+    return concat_blocks(list(blocks))
+
+
+def _do_repartition(refs: list, num_blocks: int) -> list:
+    total_ref = _concat_task.remote(*refs)
+    total = ray_tpu.get(total_ref)
+    n = total.num_rows
+    per = max(1, n // num_blocks)
+    bounds = [(i * per, min(n, (i + 1) * per) if i < num_blocks - 1
+               else n) for i in range(num_blocks)]
+    bounds = [(s, e) for s, e in bounds if s < e or n == 0]
+    return [_slice_task.remote(total_ref, s, e) for s, e in bounds]
+
+
+@ray_tpu.remote
+def _slice_task(block, start, end):
+    return slice_block(block, start, end)
+
+
+@ray_tpu.remote
+def _local_shuffle(block, seed):
+    import numpy as np
+    batch = block_to_batch(block)
+    n = block.num_rows
+    perm = np.random.default_rng(seed).permutation(n)
+    return to_block({k: np.asarray(v)[perm] for k, v in batch.items()})
+
+
+def _do_shuffle(refs: list, seed: int | None) -> list:
+    """Blockwise shuffle: permute block order + permute within blocks
+    (the reference's push-based full shuffle is a later round)."""
+    import random
+    order = list(range(len(refs)))
+    random.Random(seed).shuffle(order)
+    return [_local_shuffle.remote(refs[i], (seed or 0) + i)
+            for i in order]
+
+
+def _do_limit(refs, n: int):
+    taken = 0
+    for ref in refs:
+        if taken >= n:
+            break
+        block = ray_tpu.get(ref)
+        rows = block.num_rows
+        if taken + rows <= n:
+            taken += rows
+            yield ref
+        else:
+            yield _slice_task.remote(ref, 0, n - taken)
+            taken = n

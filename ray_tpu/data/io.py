@@ -1,0 +1,130 @@
+"""Datasources (reference: python/ray/data/_internal/datasource/).
+
+Each source materializes as N read tasks (callables returning one block
+each) so reads execute distributed and stream through the executor.
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as globlib
+from typing import Any, Iterable
+
+import numpy as np
+
+from ray_tpu.data.block import to_block
+from ray_tpu.data.dataset import Dataset, _Source
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    fns = []
+    for i in builtins.range(parallelism):
+        lo, hi = i * per, min(n, (i + 1) * per)
+        if lo >= hi:
+            break
+        fns.append(lambda lo=lo, hi=hi: to_block(
+            {"id": np.arange(lo, hi)}))
+    return Dataset([_Source(fns)])
+
+
+def from_items(items: list, *, parallelism: int = 8) -> Dataset:
+    items = list(items)
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    per = (len(items) + parallelism - 1) // parallelism
+    fns = []
+    for i in builtins.range(parallelism):
+        chunk = items[i * per:(i + 1) * per]
+        if not chunk:
+            break
+        fns.append(lambda c=chunk: to_block(
+            c if isinstance(c[0], dict) else [{"item": x} for x in c]))
+    return Dataset([_Source(fns)])
+
+
+def from_numpy(arrays: dict[str, np.ndarray] | np.ndarray,
+               *, parallelism: int = 8) -> Dataset:
+    if not isinstance(arrays, dict):
+        arrays = {"data": arrays}
+    n = len(next(iter(arrays.values())))
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    fns = []
+    for i in builtins.range(parallelism):
+        lo, hi = i * per, min(n, (i + 1) * per)
+        if lo >= hi:
+            break
+        chunk = {k: v[lo:hi] for k, v in arrays.items()}
+        fns.append(lambda c=chunk: to_block(c))
+    return Dataset([_Source(fns)])
+
+
+def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+    import pyarrow as pa
+    table = pa.Table.from_pandas(df)
+    n = table.num_rows
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    fns = []
+    for i in builtins.range(parallelism):
+        lo, hi = i * per, min(n, (i + 1) * per)
+        if lo >= hi:
+            break
+        chunk = table.slice(lo, hi - lo)
+        fns.append(lambda c=chunk: c)
+    return Dataset([_Source(fns)])
+
+
+def _expand(paths: str | list[str], suffix: str) -> list[str]:
+    import os
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(globlib.glob(f"{p}/**/*{suffix}",
+                                           recursive=True)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+def read_parquet(paths: str | list[str]) -> Dataset:
+    files = _expand(paths, ".parquet")
+
+    def make(f):
+        def read():
+            import pyarrow.parquet as pq
+            return pq.read_table(f)
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
+
+
+def read_csv(paths: str | list[str]) -> Dataset:
+    files = _expand(paths, ".csv")
+
+    def make(f):
+        def read():
+            import pyarrow.csv as pacsv
+            return pacsv.read_csv(f)
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
+
+
+def read_json(paths: str | list[str]) -> Dataset:
+    files = _expand(paths, ".json")
+
+    def make(f):
+        def read():
+            import pyarrow.json as pajson
+            return pajson.read_json(f)
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
