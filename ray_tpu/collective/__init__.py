@@ -1,0 +1,34 @@
+"""ray_tpu.collective — collective communication groups.
+
+Reference analog: ``ray.util.collective`` (collective.py:120-655, NCCL/
+Gloo backends). Re-based for TPU's two planes (SURVEY.md §5.8):
+
+- **device plane (ICI)**: collectives *inside* jitted programs over the
+  mesh — ``ici`` module wrappers (psum/pmean/all_gather/all_to_all/
+  ppermute by axis name). There is no "communicator object": XLA owns
+  the transport; groups are mesh axes.
+- **host plane (DCN/gloo analog)**: actor-to-actor collectives on host
+  arrays via a rendezvous store actor — ``init_collective_group`` +
+  allreduce/broadcast/allgather/reducescatter/barrier/send/recv with
+  the reference's group API, for control-plane tensors and cross-slice
+  coordination.
+"""
+
+from ray_tpu.collective.host import (
+    init_collective_group,
+    destroy_collective_group,
+    allreduce,
+    allgather,
+    reducescatter,
+    broadcast,
+    barrier,
+    send,
+    recv,
+)
+from ray_tpu.collective import ici
+
+__all__ = [
+    "init_collective_group", "destroy_collective_group",
+    "allreduce", "allgather", "reducescatter", "broadcast", "barrier",
+    "send", "recv", "ici",
+]

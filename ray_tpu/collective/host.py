@@ -1,0 +1,205 @@
+"""Host-plane collective groups over a rendezvous actor.
+
+Reference analog: the Gloo path of ``ray.util.collective``
+(gloo_collective_group.py) with NCCL's rendezvous-via-named-store
+pattern (nccl_collective_group.py): a named store actor per group keys
+each op by a monotonically increasing sequence number per rank;
+reductions happen once in the store; ranks poll for the result.
+
+This plane is for host arrays (control tensors, cross-slice
+coordination, parameter broadcast between gangs) — NOT the training
+hot path, which compiles device collectives over ICI (see
+collective.ici).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+
+_GROUP_PREFIX = "ray_tpu_collective:"
+_local = {}  # group_name -> (handle, rank, world_size, seq counters)
+
+
+@ray_tpu.remote
+class _GroupStore:
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.ops: dict[tuple, dict] = {}     # (op_kind, seq) -> state
+        self.p2p: dict[tuple, Any] = {}      # (src, dst, seq) -> value
+
+    def _entry(self, key):
+        if key not in self.ops:
+            self.ops[key] = {"parts": {}, "result": None, "fetched": 0}
+        return self.ops[key]
+
+    def contribute(self, op: str, seq: int, rank: int, value,
+                   reduce_op: str):
+        e = self._entry((op, seq))
+        e["parts"][rank] = value
+        if len(e["parts"]) == self.world_size and e["result"] is None:
+            parts = [e["parts"][r] for r in range(self.world_size)]
+            if op == "allreduce":
+                acc = np.asarray(parts[0]).copy()
+                for p in parts[1:]:
+                    if reduce_op == "sum":
+                        acc = acc + np.asarray(p)
+                    elif reduce_op == "max":
+                        acc = np.maximum(acc, p)
+                    elif reduce_op == "min":
+                        acc = np.minimum(acc, p)
+                    else:
+                        raise ValueError(reduce_op)
+                if reduce_op == "sum":
+                    pass
+                e["result"] = acc
+            elif op == "allgather":
+                e["result"] = parts
+            elif op == "reducescatter":
+                acc = np.asarray(parts[0]).copy()
+                for p in parts[1:]:
+                    acc = acc + np.asarray(p)
+                e["result"] = np.array_split(acc, self.world_size)
+            elif op == "barrier":
+                e["result"] = True
+        return e["result"] is not None
+
+    def fetch(self, op: str, seq: int, rank: int):
+        e = self.ops.get((op, seq))
+        if e is None or e["result"] is None:
+            return None, False
+        if op == "reducescatter":
+            result = e["result"][rank]
+        else:
+            result = e["result"]
+        e["fetched"] += 1
+        if e["fetched"] == self.world_size:
+            del self.ops[(op, seq)]
+        return result, True
+
+    def put_p2p(self, src: int, dst: int, seq: int, value):
+        self.p2p[(src, dst, seq)] = value
+
+    def get_p2p(self, src: int, dst: int, seq: int):
+        if (src, dst, seq) in self.p2p:
+            return self.p2p.pop((src, dst, seq)), True
+        return None, False
+
+
+class _GroupState:
+    def __init__(self, handle, rank: int, world_size: int):
+        self.handle = handle
+        self.rank = rank
+        self.world_size = world_size
+        self.seq: dict[str, int] = {}
+        self.p2p_seq: dict[tuple, int] = {}
+
+    def next_seq(self, op: str) -> int:
+        s = self.seq.get(op, 0)
+        self.seq[op] = s + 1
+        return s
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> None:
+    """Join (rank 0 creates) the named group store."""
+    name = _GROUP_PREFIX + group_name
+    if rank == 0:
+        handle = _GroupStore.options(name=name, num_cpus=0).remote(
+            world_size)
+    else:
+        handle = _wait_for_actor(name)
+    _local[group_name] = _GroupState(handle, rank, world_size)
+    barrier(group_name)
+
+
+def _wait_for_actor(name: str, timeout: float = 60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return ray_tpu.get_actor(name)
+        except ValueError:
+            time.sleep(0.05)
+    raise TimeoutError(f"collective group actor {name} never appeared")
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    st = _local.pop(group_name, None)
+    if st is not None and st.rank == 0:
+        try:
+            ray_tpu.kill(st.handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _group(group_name: str) -> _GroupState:
+    if group_name not in _local:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            f"process — call init_collective_group first")
+    return _local[group_name]
+
+
+def _collective(op: str, value, group_name: str,
+                reduce_op: str = "sum", timeout: float = 120.0):
+    st = _group(group_name)
+    seq = st.next_seq(op)
+    ray_tpu.get(st.handle.contribute.remote(op, seq, st.rank, value,
+                                            reduce_op))
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result, ok = ray_tpu.get(st.handle.fetch.remote(op, seq, st.rank))
+        if ok:
+            return result
+        time.sleep(0.005)
+    raise TimeoutError(f"collective {op} timed out in {group_name!r}")
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    return _collective("allreduce", np.asarray(tensor), group_name, op)
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    return _collective("allgather", np.asarray(tensor), group_name)
+
+
+def reducescatter(tensor, group_name: str = "default"):
+    return _collective("reducescatter", np.asarray(tensor), group_name)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    parts = _collective("allgather", np.asarray(tensor), group_name)
+    return parts[src_rank]
+
+
+def barrier(group_name: str = "default") -> None:
+    _collective("barrier", 0, group_name)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    st = _group(group_name)
+    key = (st.rank, dst_rank)
+    seq = st.p2p_seq.get(key, 0)
+    st.p2p_seq[key] = seq + 1
+    ray_tpu.get(st.handle.put_p2p.remote(st.rank, dst_rank, seq,
+                                         np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default",
+         timeout: float = 120.0):
+    st = _group(group_name)
+    key = (src_rank, st.rank)
+    seq = st.p2p_seq.get(key, 0)
+    st.p2p_seq[key] = seq + 1
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value, ok = ray_tpu.get(
+            st.handle.get_p2p.remote(src_rank, st.rank, seq))
+        if ok:
+            return value
+        time.sleep(0.005)
+    raise TimeoutError(f"recv from {src_rank} timed out")

@@ -1,0 +1,59 @@
+"""Device-plane collectives (inside jit/shard_map over mesh axes).
+
+Thin, name-stable wrappers so user code reads like the reference's
+collective API while compiling to XLA ICI collectives. Use inside
+``jax.shard_map`` (or jit with explicit axes).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def allreduce(x, axis: str = "dp", op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported op {op!r}")
+
+
+def allgather(x, axis: str = "dp", tiled: bool = False):
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reducescatter(x, axis: str = "dp", scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis,
+                            scatter_dimension=scatter_dimension,
+                            tiled=True)
+
+
+def all_to_all(x, axis: str = "sp", split_axis: int = 0,
+               concat_axis: int = 0):
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis: str, perm: list[tuple[int, int]]):
+    return lax.ppermute(x, axis, perm)
+
+
+def ring_shift(x, axis: str, shift: int = 1):
+    """Rotate shards around the ring by ``shift`` (ring-attention /
+    pipeline building block)."""
+    n = lax.psum(1, axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.psum(1, axis)

@@ -1,0 +1,105 @@
+"""Collective group tests (reference analog: ray.util.collective tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def join(self, group):
+        from ray_tpu.collective import init_collective_group
+        init_collective_group(self.world, self.rank, group)
+        return True
+
+    def do_allreduce(self, group):
+        from ray_tpu.collective import allreduce
+        out = allreduce(np.full(4, float(self.rank + 1)), group)
+        return out.tolist()
+
+    def do_allgather(self, group):
+        from ray_tpu.collective import allgather
+        return [v.tolist() for v in allgather(
+            np.array([self.rank]), group)]
+
+    def do_reducescatter(self, group):
+        from ray_tpu.collective import reducescatter
+        return reducescatter(np.arange(4.0), group).tolist()
+
+    def do_broadcast(self, group):
+        from ray_tpu.collective import broadcast
+        val = np.array([42.0]) if self.rank == 0 else np.array([0.0])
+        return broadcast(val, src_rank=0, group_name=group).tolist()
+
+    def do_sendrecv(self, group):
+        from ray_tpu.collective import recv, send
+        if self.rank == 0:
+            send(np.array([7.0]), dst_rank=1, group_name=group)
+            return None
+        return recv(0, group).tolist()
+
+
+def _make_group(n, group):
+    members = [Member.remote(r, n) for r in range(n)]
+    ray_tpu.get([m.join.remote(group) for m in members], timeout=60)
+    return members
+
+
+def test_host_allreduce(rt):
+    ms = _make_group(3, "g1")
+    outs = ray_tpu.get([m.do_allreduce.remote("g1") for m in ms],
+                       timeout=60)
+    assert all(o == [6.0] * 4 for o in outs)   # 1+2+3
+
+
+def test_host_allgather_broadcast(rt):
+    ms = _make_group(2, "g2")
+    outs = ray_tpu.get([m.do_allgather.remote("g2") for m in ms],
+                       timeout=60)
+    assert all(o == [[0], [1]] for o in outs)
+    outs = ray_tpu.get([m.do_broadcast.remote("g2") for m in ms],
+                       timeout=60)
+    assert all(o == [42.0] for o in outs)
+
+
+def test_host_reducescatter_sendrecv(rt):
+    ms = _make_group(2, "g3")
+    outs = ray_tpu.get([m.do_reducescatter.remote("g3") for m in ms],
+                       timeout=60)
+    assert outs[0] == [0.0, 2.0]   # sum over 2 ranks of arange / split
+    assert outs[1] == [4.0, 6.0]
+    outs = ray_tpu.get([m.do_sendrecv.remote("g3") for m in ms],
+                       timeout=60)
+    assert outs[1] == [7.0]
+
+
+def test_ici_wrappers_in_shard_map():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.collective import ici
+    from ray_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 8})
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        total = ici.allreduce(x, "dp")
+        idx = ici.axis_index("dp").reshape(1)
+        gathered = ici.allgather(x, "dp")
+        shifted = ici.ring_shift(x, "dp", 1)
+        return total, idx, gathered, shifted
+
+    x = jnp.arange(8.0)
+    fn = jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                       out_specs=(P("dp"), P("dp"), P("dp"), P("dp")))
+    total, idx, gathered, shifted = fn(x)
+    np.testing.assert_allclose(np.asarray(total), np.full(8, 28.0))
+    assert list(np.asarray(idx)) == list(range(8))
+    np.testing.assert_allclose(np.asarray(shifted),
+                               np.roll(np.arange(8.0), 1))
