@@ -23,6 +23,7 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from multiprocessing import shared_memory
+from typing import Any
 
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.serialization import SerializedObject
@@ -202,13 +203,179 @@ class SharedMemoryStore:
             self.delete(oid)
 
 
+class NativeSharedMemoryStore:
+    """C++ arena-backed store (ray_tpu/native/store.cpp): all objects
+    live in ONE process-shared mmap (plasma model) instead of one
+    posix-shm segment per buffer. Python keeps the LRU order and runs
+    the spilling policy; C++ owns allocation/lookup.
+
+    Record layout in the arena per object:
+      [u64 data_len][data][u32 nbuf]([u64 buf_len])*nbuf [buf bytes]*
+    """
+
+    def __init__(self, capacity_bytes: int, spill_dir: str,
+                 spill_threshold: float = 0.8):
+        from ray_tpu.native.store import NativeStore
+        self.name = f"/rts_{os.getpid()}"
+        self._store = NativeStore(self.name, capacity_bytes, create=True)
+        self._capacity = capacity_bytes
+        self._spill_dir = spill_dir
+        self._threshold = spill_threshold
+        self._lru: "OrderedDict[ObjectID, int]" = OrderedDict()
+        self._spilled: dict[ObjectID, str] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _encode(obj: SerializedObject) -> bytes:
+        parts = [len(obj.data).to_bytes(8, "little"), obj.data,
+                 len(obj.buffers).to_bytes(4, "little")]
+        for b in obj.buffers:
+            parts.append(len(b).to_bytes(8, "little"))
+        parts.extend(obj.buffers)
+        return b"".join(parts)
+
+    @staticmethod
+    def decode(record) -> SerializedObject:
+        mv = memoryview(record)
+        dlen = int.from_bytes(mv[:8], "little")
+        data = bytes(mv[8:8 + dlen])
+        pos = 8 + dlen
+        nbuf = int.from_bytes(mv[pos:pos + 4], "little")
+        pos += 4
+        lens = []
+        for _ in range(nbuf):
+            lens.append(int.from_bytes(mv[pos:pos + 8], "little"))
+            pos += 8
+        buffers = []
+        for ln in lens:
+            buffers.append(bytes(mv[pos:pos + ln]))
+            pos += ln
+        return SerializedObject(data=data, buffers=buffers)
+
+    def put(self, object_id: ObjectID, obj: SerializedObject) -> None:
+        record = self._encode(obj)
+        with self._lock:
+            self._maybe_spill_locked(incoming=len(record))
+            ok = self._store.put(object_id.binary(), record)
+            if not ok:
+                # Arena full even after spilling: spill this object
+                # directly (fallback allocation analog).
+                self._spill_record_locked(object_id, record)
+                return
+            self._lru[object_id] = len(record)
+
+    def _maybe_spill_locked(self, incoming: int = 0) -> None:
+        if self._capacity <= 0:
+            return
+        limit = int(self._capacity * self._threshold)
+        while (self._store.used_bytes() + incoming > limit
+               and self._lru):
+            oid, _size = next(iter(self._lru.items()))
+            view = self._store.get(oid.binary())
+            if view is not None:
+                self._spill_record_locked(oid, bytes(view))
+                self._store.delete(oid.binary())
+            self._lru.pop(oid, None)
+
+    def _spill_record_locked(self, oid: ObjectID, record: bytes) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, oid.hex())
+        with open(path, "wb") as f:
+            f.write(record)
+        self._spilled[oid] = path
+
+    def get_descriptor(self, object_id: ObjectID):
+        with self._lock:
+            if object_id in self._lru:
+                self._lru.move_to_end(object_id)
+                return ("nat", self.name, object_id.binary(), None)
+            path = self._spilled.get(object_id)
+            if path is not None:
+                return ("nat", self.name, object_id.binary(), path)
+            return None
+
+    def read_local(self, object_id: ObjectID) -> SerializedObject | None:
+        """Owner-process fast path."""
+        view = self._store.get(object_id.binary())
+        if view is not None:
+            return self.decode(view)
+        path = self._spilled.get(object_id)
+        if path is not None:
+            with open(path, "rb") as f:
+                return self.decode(f.read())
+        return None
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._lru or object_id in self._spilled
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._lru.pop(object_id, None)
+            self._store.delete(object_id.binary())
+            path = self._spilled.pop(object_id, None)
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def used_bytes(self) -> int:
+        return self._store.used_bytes()
+
+    def shutdown(self) -> None:
+        for path in self._spilled.values():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._store.close()
+
+
+_attached_stores: dict[str, Any] = {}
+
+
+def _attach(name: str):
+    if name not in _attached_stores:
+        from ray_tpu.native.store import NativeStore
+        _attached_stores[name] = NativeStore(name)
+    return _attached_stores[name]
+
+
+def make_shared_store(capacity: int, spill_dir: str, threshold: float):
+    """Prefer the C++ arena store; fall back to per-segment python shm
+    when the native build is unavailable."""
+    if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE") != "1":
+        try:
+            from ray_tpu.native.store import native_store_available
+            if native_store_available():
+                return NativeSharedMemoryStore(capacity, spill_dir,
+                                               threshold)
+        except Exception:  # noqa: BLE001
+            pass
+    return SharedMemoryStore(capacity, spill_dir, threshold)
+
+
 def read_descriptor(desc) -> SerializedObject:
     """Materialize a SerializedObject from a store descriptor.
 
-    Shared-memory buffers are copied out here for safety of segment
-    lifetime; zero-copy mapping is used on the owner process fast path
-    (MemoryStore) which retains the original buffers.
+    Buffers are copied out of shared memory here: a reader must not
+    hold pointers into pages the owner may free (the zero-copy pinned
+    path needs distributed refcounts on readers — later round).
     """
+    if desc[0] == "nat":
+        _tag, store_name, id_bytes, spilled_path = desc
+        if spilled_path is not None:
+            try:
+                with open(spilled_path, "rb") as f:
+                    return NativeSharedMemoryStore.decode(f.read())
+            except FileNotFoundError:
+                raise ObjectLostError(spilled_path)
+        view = _attach(store_name).get(id_bytes)
+        if view is None:
+            raise ObjectLostError(id_bytes.hex())
+        return NativeSharedMemoryStore.decode(view)
+
     data, names, sizes, spilled_path = desc
     if spilled_path is not None:
         try:

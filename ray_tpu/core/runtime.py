@@ -262,7 +262,8 @@ class DriverRuntime:
             except (ValueError, OSError):
                 total_ram = 8 << 30
             cap = int(total_ram * 0.3)
-        self.shm_store = SharedMemoryStore(
+        from ray_tpu.core.object_store import make_shared_store
+        self.shm_store = make_shared_store(
             cap, config.spill_dir, config.object_spilling_threshold)
         self._obj_cv = threading.Condition()
         self._errors: dict[ObjectID, bytes] = {}   # oid -> error blob
@@ -421,6 +422,11 @@ class DriverRuntime:
                 raise ser.loads(self._errors[oid])
         if loc == "mem":
             obj = self.memory_store.try_get(oid)
+            if obj is not None:
+                return obj
+        read_local = getattr(self.shm_store, "read_local", None)
+        if read_local is not None:
+            obj = read_local(oid)
             if obj is not None:
                 return obj
         desc = self.shm_store.get_descriptor(oid)

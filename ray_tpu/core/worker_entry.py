@@ -14,6 +14,22 @@ from multiprocessing import connection as mpc
 
 
 def main() -> None:
+    import os
+
+    # Enforce the runtime-env platform via jax.config, not just env
+    # vars: this image's sitecustomize imports jax at interpreter start
+    # and force-registers the TPU backend, so JAX_PLATFORMS=cpu in the
+    # env alone is too late — a "CPU" worker would silently claim the
+    # one TPU chip through the relay and serialize the whole cluster
+    # on it. Backends initialize lazily, so config update here wins.
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms and "jax" in sys.modules:
+        import jax
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except Exception:  # noqa: BLE001 — older jax w/o the flag
+            pass
+
     address, token = sys.argv[1], sys.argv[2]
     conn = mpc.Client(address, family="AF_UNIX")
     conn.send(("hello", "exec", token))

@@ -1,0 +1,5 @@
+"""Native (C++) components and their bindings."""
+
+from ray_tpu.native.store import NativeStore, native_store_available
+
+__all__ = ["NativeStore", "native_store_available"]

@@ -1,0 +1,345 @@
+// raytpu_store — shared-memory object store (plasma analog).
+//
+// Re-implements the role of the reference's plasma store
+// (src/ray/object_manager/plasma/: mmap'd slabs + object table +
+// eviction hooks) as a single POSIX shared-memory arena that every
+// worker process on the node maps at the same time:
+//
+//   [ Header | object table (fixed slots) | data arena ... ]
+//
+// - allocation: first-fit over an embedded free list (merge on free),
+//   the dlmalloc-in-shm role, kept deliberately simple;
+// - concurrency: one process-shared robust mutex in the header (the
+//   store is a node-local control structure, not a hot compute path);
+// - readers get (offset, size) descriptors and map the bytes in place:
+//   zero-copy reads, like plasma clients mmap'ing the same memory;
+// - eviction/spilling policy stays in Python (LocalObjectManager
+//   analog): the native layer only provides alloc/free/lookup.
+//
+// Built as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cerrno>
+#include <cstdio>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x52545053;  // "RTPS"
+constexpr uint32_t kIdSize = 28;         // ObjectID size (ids.py)
+constexpr uint32_t kMaxObjects = 16384;
+constexpr uint32_t kMaxFreeBlocks = 16384;
+
+struct Entry {
+  uint8_t id[kIdSize];
+  uint8_t used;
+  uint8_t padding[3];
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Header {
+  uint32_t magic;
+  uint32_t version;
+  pthread_mutex_t mutex;
+  uint64_t capacity;       // bytes in the data arena
+  uint64_t used;           // bytes allocated
+  uint64_t data_start;     // arena offset from map base
+  uint32_t num_entries;    // live objects
+  uint32_t num_free;       // free-list length
+  Entry entries[kMaxObjects];
+  FreeBlock free_list[kMaxFreeBlocks];
+};
+
+struct Store {
+  Header* header;
+  uint8_t* base;
+  uint64_t map_size;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+uint64_t align8(uint64_t v) { return (v + 7) & ~uint64_t(7); }
+
+class Locker {
+ public:
+  explicit Locker(Header* h) : h_(h) {
+    int rc = pthread_mutex_lock(&h_->mutex);
+    if (rc == EOWNERDEAD) {
+      // A process died holding the lock; the table may be mid-update
+      // but slots are flipped 'used' last on insert, so recover.
+      pthread_mutex_consistent(&h_->mutex);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&h_->mutex); }
+
+ private:
+  Header* h_;
+};
+
+Entry* find_entry(Header* h, const uint8_t* id) {
+  // Linear probe from a hash start (open addressing over fixed slots).
+  uint64_t hash = 1469598103934665603ull;
+  for (uint32_t i = 0; i < kIdSize; ++i) {
+    hash = (hash ^ id[i]) * 1099511628211ull;
+  }
+  uint32_t start = static_cast<uint32_t>(hash % kMaxObjects);
+  for (uint32_t probe = 0; probe < kMaxObjects; ++probe) {
+    Entry* e = &h->entries[(start + probe) % kMaxObjects];
+    if (e->used && std::memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return nullptr;
+}
+
+Entry* find_slot(Header* h, const uint8_t* id) {
+  uint64_t hash = 1469598103934665603ull;
+  for (uint32_t i = 0; i < kIdSize; ++i) {
+    hash = (hash ^ id[i]) * 1099511628211ull;
+  }
+  uint32_t start = static_cast<uint32_t>(hash % kMaxObjects);
+  for (uint32_t probe = 0; probe < kMaxObjects; ++probe) {
+    Entry* e = &h->entries[(start + probe) % kMaxObjects];
+    if (!e->used) return e;
+    if (std::memcmp(e->id, id, kIdSize) == 0) return nullptr;  // dup
+  }
+  return nullptr;  // table full
+}
+
+// First-fit allocation from the free list.
+int64_t arena_alloc(Header* h, uint64_t size) {
+  size = align8(size ? size : 8);
+  for (uint32_t i = 0; i < h->num_free; ++i) {
+    FreeBlock* b = &h->free_list[i];
+    if (b->size >= size) {
+      uint64_t off = b->offset;
+      b->offset += size;
+      b->size -= size;
+      if (b->size == 0) {
+        h->free_list[i] = h->free_list[h->num_free - 1];
+        h->num_free--;
+      }
+      h->used += size;
+      return static_cast<int64_t>(off);
+    }
+  }
+  return -1;
+}
+
+void arena_free(Header* h, uint64_t offset, uint64_t size) {
+  size = align8(size ? size : 8);
+  h->used -= size;
+  // Insert and merge with adjacent blocks (linear scan; list is small).
+  uint64_t end = offset + size;
+  for (uint32_t i = 0; i < h->num_free; ++i) {
+    FreeBlock* b = &h->free_list[i];
+    if (b->offset + b->size == offset) {          // extend left block
+      b->size += size;
+      // try to merge with a block that starts at our end
+      for (uint32_t j = 0; j < h->num_free; ++j) {
+        if (h->free_list[j].offset == end) {
+          b->size += h->free_list[j].size;
+          h->free_list[j] = h->free_list[h->num_free - 1];
+          h->num_free--;
+          break;
+        }
+      }
+      return;
+    }
+    if (b->offset == end) {                       // extend right block
+      b->offset = offset;
+      b->size += size;
+      return;
+    }
+  }
+  if (h->num_free < kMaxFreeBlocks) {
+    h->free_list[h->num_free++] = {offset, size};
+  }
+  // else: leak the block (bounded by table size; compaction is a
+  // later-round concern, mirroring plasma's fallback allocation)
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a store backed by shm name; returns handle or null.
+void* rts_create(const char* name, uint64_t capacity) {
+  uint64_t map_size = sizeof(Header) + capacity;
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(map_size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = static_cast<Header*>(mem);
+  std::memset(h, 0, sizeof(Header));
+  h->magic = kMagic;
+  h->version = 1;
+  h->capacity = capacity;
+  h->used = 0;
+  h->data_start = sizeof(Header);
+  h->num_entries = 0;
+  h->num_free = 1;
+  h->free_list[0] = {0, capacity};
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  Store* s = new Store();
+  s->header = h;
+  s->base = static_cast<uint8_t*>(mem);
+  s->map_size = map_size;
+  s->fd = fd;
+  s->owner = true;
+  std::snprintf(s->name, sizeof(s->name), "%s", name);
+  return s;
+}
+
+void* rts_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = static_cast<Header*>(mem);
+  if (h->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->header = h;
+  s->base = static_cast<uint8_t*>(mem);
+  s->map_size = static_cast<uint64_t>(st.st_size);
+  s->fd = fd;
+  s->owner = false;
+  std::snprintf(s->name, sizeof(s->name), "%s", name);
+  return s;
+}
+
+// Returns arena offset >= 0, or -1 (no space), -2 (duplicate/full).
+int64_t rts_put(void* handle, const uint8_t* id, const uint8_t* data,
+                uint64_t size) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  Locker lock(h);
+  Entry* slot = find_slot(h, id);
+  if (slot == nullptr) return -2;
+  int64_t off = arena_alloc(h, size);
+  if (off < 0) return -1;
+  std::memcpy(s->base + h->data_start + off, data, size);
+  std::memcpy(slot->id, id, kIdSize);
+  slot->offset = static_cast<uint64_t>(off);
+  slot->size = size;
+  slot->used = 1;
+  h->num_entries++;
+  return off;
+}
+
+// Reserve without copying (caller writes via rts_data_ptr + offset).
+int64_t rts_reserve(void* handle, const uint8_t* id, uint64_t size) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  Locker lock(h);
+  Entry* slot = find_slot(h, id);
+  if (slot == nullptr) return -2;
+  int64_t off = arena_alloc(h, size);
+  if (off < 0) return -1;
+  std::memcpy(slot->id, id, kIdSize);
+  slot->offset = static_cast<uint64_t>(off);
+  slot->size = size;
+  slot->used = 1;
+  h->num_entries++;
+  return off;
+}
+
+// Lookup: fills offset/size; returns 1 found, 0 missing.
+int rts_get(void* handle, const uint8_t* id, uint64_t* offset,
+            uint64_t* size) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  Locker lock(h);
+  Entry* e = find_entry(h, id);
+  if (e == nullptr) return 0;
+  *offset = e->offset;
+  *size = e->size;
+  return 1;
+}
+
+int rts_delete(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  Locker lock(h);
+  Entry* e = find_entry(h, id);
+  if (e == nullptr) return 0;
+  arena_free(h, e->offset, e->size);
+  e->used = 0;
+  h->num_entries--;
+  return 1;
+}
+
+uint8_t* rts_data_ptr(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  return s->base + s->header->data_start;
+}
+
+uint64_t rts_used_bytes(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s->header);
+  return s->header->used;
+}
+
+uint64_t rts_capacity(void* handle) {
+  return static_cast<Store*>(handle)->header->capacity;
+}
+
+uint32_t rts_num_objects(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s->header);
+  return s->header->num_entries;
+}
+
+void rts_close(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  bool owner = s->owner;
+  char name[256];
+  std::snprintf(name, sizeof(name), "%s", s->name);
+  munmap(s->base, s->map_size);
+  close(s->fd);
+  delete s;
+  if (owner) shm_unlink(name);
+}
+
+}  // extern "C"

@@ -1,0 +1,136 @@
+"""ctypes binding for the C++ shared-memory store (see store.cpp).
+
+Owner process creates the arena; worker processes attach by name and
+read object bytes in place (zero-copy memoryview over the mapped
+pages) — the plasma-client model.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+_lib = None
+_lib_lock = threading.Lock()
+_ID_SIZE = 28
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        from ray_tpu.native.build import ensure_built
+        path = ensure_built()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.rts_create.restype = ctypes.c_void_p
+        lib.rts_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rts_attach.restype = ctypes.c_void_p
+        lib.rts_attach.argtypes = [ctypes.c_char_p]
+        lib.rts_put.restype = ctypes.c_int64
+        lib.rts_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_uint64]
+        lib.rts_reserve.restype = ctypes.c_int64
+        lib.rts_reserve.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.rts_get.restype = ctypes.c_int
+        lib.rts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.rts_delete.restype = ctypes.c_int
+        lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_data_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.rts_data_ptr.argtypes = [ctypes.c_void_p]
+        lib.rts_used_bytes.restype = ctypes.c_uint64
+        lib.rts_used_bytes.argtypes = [ctypes.c_void_p]
+        lib.rts_capacity.restype = ctypes.c_uint64
+        lib.rts_capacity.argtypes = [ctypes.c_void_p]
+        lib.rts_num_objects.restype = ctypes.c_uint32
+        lib.rts_num_objects.argtypes = [ctypes.c_void_p]
+        lib.rts_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_store_available() -> bool:
+    return _load() is not None
+
+
+class NativeStore:
+    """One shm arena; create (owner) or attach (worker) by name."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        self.name = name
+        if create:
+            self._h = lib.rts_create(name.encode(), capacity)
+        else:
+            self._h = lib.rts_attach(name.encode())
+        if not self._h:
+            raise OSError(
+                f"could not {'create' if create else 'attach'} native "
+                f"store {name!r} (errno={ctypes.get_errno()})")
+        self._closed = False
+
+    def _check_id(self, object_id: bytes) -> bytes:
+        if len(object_id) != _ID_SIZE:
+            raise ValueError(f"object id must be {_ID_SIZE} bytes")
+        return object_id
+
+    def put(self, object_id: bytes, data: bytes) -> bool:
+        """False when the arena is full (caller should spill)."""
+        rc = self._lib.rts_put(self._h, self._check_id(object_id),
+                               bytes(data), len(data))
+        if rc == -2:
+            raise KeyError("duplicate object id or table full")
+        return rc >= 0
+
+    def get(self, object_id: bytes) -> memoryview | None:
+        """Zero-copy view over the mapped bytes (valid until delete)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        found = self._lib.rts_get(self._h, self._check_id(object_id),
+                                  ctypes.byref(off), ctypes.byref(size))
+        if not found:
+            return None
+        base = self._lib.rts_data_ptr(self._h)
+        addr = ctypes.addressof(base.contents) + off.value
+        buf = (ctypes.c_uint8 * size.value).from_address(addr)
+        return memoryview(buf).cast("B")
+
+    def contains(self, object_id: bytes) -> bool:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        return bool(self._lib.rts_get(
+            self._h, self._check_id(object_id),
+            ctypes.byref(off), ctypes.byref(size)))
+
+    def delete(self, object_id: bytes) -> bool:
+        return bool(self._lib.rts_delete(self._h,
+                                         self._check_id(object_id)))
+
+    def used_bytes(self) -> int:
+        return self._lib.rts_used_bytes(self._h)
+
+    def capacity(self) -> int:
+        return self._lib.rts_capacity(self._h)
+
+    def num_objects(self) -> int:
+        return self._lib.rts_num_objects(self._h)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.rts_close(self._h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,106 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` axis.
+
+The reference has no in-tree MoE (SURVEY.md §2.4 row 6: delegated to
+user frameworks). TPU-first design: top-1 (switch) routing expressed as
+dense one-hot dispatch/combine einsums (MXU-friendly, static shapes),
+experts sharded over ``ep``, tokens exchanged with ``lax.all_to_all``
+over ICI. Runs inside shard_map; degenerates to a local grouped MLP on
+a 1-sized axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_dispatch(router_logits: jnp.ndarray, num_experts: int,
+                  capacity: int):
+    """Build switch-routing dispatch/combine tensors.
+
+    router_logits: [T, E]. Returns (dispatch [T, E, C] bool-ish float,
+    combine [T, E, C] float, aux_loss scalar).
+    Tokens beyond an expert's capacity are dropped (standard switch
+    behavior); aux_loss is the load-balancing loss.
+    """
+    probs = jax.nn.softmax(router_logits, axis=-1)          # [T, E]
+    expert_idx = jnp.argmax(probs, axis=-1)                 # [T]
+    expert_mask = jax.nn.one_hot(expert_idx, num_experts)   # [T, E]
+    # Position of each token within its expert's queue.
+    position = jnp.cumsum(expert_mask, axis=0) * expert_mask - 1.0
+    in_capacity = (position < capacity) & (expert_mask > 0)
+    pos_clipped = jnp.clip(position, 0, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_clipped, capacity)      # [T, E, C]
+    dispatch = pos_onehot * in_capacity[..., None]
+    gate = jnp.max(probs * expert_mask, axis=-1)            # [T]
+    combine = dispatch * gate[:, None, None]
+    # Load-balance aux loss (Switch Transformer eq. 4).
+    density = expert_mask.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = num_experts * jnp.sum(density * density_proxy)
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, router_w, w_up, w_down, axis: str = "ep",
+            capacity_factor: float = 2.0):
+    """Expert-parallel switch FFN; call inside shard_map.
+
+    x:        [T, D]   local tokens (token dim NOT sharded on ep here;
+                        each ep rank routes its own tokens)
+    router_w: [D, E]   replicated
+    w_up:     [E_local, D, H] local experts (expert dim sharded on ep)
+    w_down:   [E_local, H, D]
+    Returns (y [T, D], aux_loss).
+    """
+    ep = lax.psum(1, axis)
+    e_local = w_up.shape[0]
+    num_experts = e_local * ep
+    t = x.shape[0]
+    capacity = max(1, int(capacity_factor * t / num_experts))
+
+    logits = x @ router_w                                   # [T, E]
+    dispatch, combine, aux = top1_dispatch(logits, num_experts,
+                                           capacity)
+    d = x.shape[-1]
+    # Dispatch tokens to expert queues: [E, C, D].
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    # Exchange over ep. [E, C, D] -> [ep_dst, e_local, C, D]; piece i
+    # goes to rank i; received pieces stack as a new leading source-
+    # rank dim: [ep_src, e_local, C, D].
+    expert_in = expert_in.reshape(ep, e_local, capacity, d)
+    expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                               concat_axis=0, tiled=False)
+    # Each local expert processes the queues from every source rank.
+    expert_in = jnp.moveaxis(expert_in, 0, 1).reshape(
+        e_local, ep * capacity, d)
+
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w_up))
+    out = jnp.einsum("ech,ehd->ecd", h, w_down)
+
+    # Route back: regroup by source rank and apply the inverse
+    # exchange (all_to_all with the same specs is an involution here).
+    out = out.reshape(e_local, ep, capacity, d)
+    out = jnp.moveaxis(out, 1, 0)                  # [ep_src, e_local, C, D]
+    out = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                         tiled=False)              # [ep_owner, e_local, C, D]
+    out = out.reshape(num_experts, capacity, d)    # [E, C, D]
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y, aux
+
+
+def dense_switch_ffn_reference(x, router_w, w_up_full, w_down_full,
+                               capacity_factor: float = 2.0):
+    """Single-device reference for tests: same math, no all_to_all.
+    w_*_full carry ALL experts."""
+    num_experts = w_up_full.shape[0]
+    t = x.shape[0]
+    capacity = max(1, int(capacity_factor * t / num_experts))
+    logits = x @ router_w
+    dispatch, combine, aux = top1_dispatch(logits, num_experts,
+                                           capacity)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w_up_full))
+    out = jnp.einsum("ech,ehd->ecd", h, w_down_full)
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    return y, aux

@@ -1,0 +1,95 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference has no pipeline trainer; its substrate for PP is the
+compiled DAG's static schedules + NCCL channels (SURVEY.md §2.4 row 4:
+actor-per-stage, channel-per-edge). TPU-first, the whole pipeline is
+instead ONE jitted SPMD program: every pp rank holds one stage's
+weights, microbatch activations circulate between neighbors with
+``ppermute`` over ICI, and the GPipe fill/drain schedule becomes a
+``lax.scan`` of length (num_microbatches + pp - 1). XLA overlaps each
+step's ppermute with the next step's stage compute.
+
+(An actor-per-stage pipeline over the compiled-graph channels also
+exists — see ray_tpu.cgraph — for cross-slice pipelining where stages
+live on different meshes/hosts.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def spmd_pipeline(stage_fn: Callable, num_microbatches: int,
+                  axis: str = "pp"):
+    """Build a pipelined apply: ``f(stage_params, x) -> y``.
+
+    - ``stage_fn(params_for_my_stage, activation) -> activation`` must
+      keep the activation shape (classic homogeneous-stage pipeline).
+    - Call the result INSIDE shard_map; ``stage_params`` must be the
+      local stage's params (stage dim sharded over ``axis``) and ``x``
+      the full batch, replicated over ``axis``; the batch splits into
+      ``num_microbatches`` along dim 0.
+    - Returns y replicated over ``axis``.
+    """
+
+    def pipelined(stage_params, x):
+        pp = lax.psum(1, axis)
+        rank = lax.axis_index(axis)
+        # Inside shard_map the stacked stage dim survives with local
+        # size 1 — drop it so stage_fn sees one stage's params.
+        stage_params = jax.tree_util.tree_map(
+            lambda a: a[0], stage_params)
+        b = x.shape[0]
+        mb = b // num_microbatches
+        micro = x.reshape(num_microbatches, mb, *x.shape[1:])
+
+        total_steps = num_microbatches + pp - 1
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def step(carry, t):
+            incoming, outputs = carry
+            # Rank 0 feeds microbatch t while t < num_microbatches;
+            # other ranks consume what arrived from the left neighbor.
+            feed_idx = jnp.clip(t, 0, num_microbatches - 1)
+            my_input = jnp.where(rank == 0, micro[feed_idx], incoming)
+            out = stage_fn(stage_params, my_input)
+            # Last rank finishes microbatch (t - (pp-1)) at step t.
+            done_idx = t - (pp - 1)
+            write = jnp.logical_and(rank == pp - 1, done_idx >= 0)
+            safe_idx = jnp.clip(done_idx, 0, num_microbatches - 1)
+            outputs = lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, out, safe_idx, 0),
+                lambda o: o,
+                outputs)
+            # Rotate activations to the right neighbor.
+            incoming = lax.ppermute(out, axis, fwd_perm)
+            return (incoming, outputs), None
+
+        incoming0 = jnp.zeros_like(micro[0])
+        outputs0 = jnp.zeros_like(micro)
+        (_, outputs), _ = lax.scan(
+            step, (incoming0, outputs0), jnp.arange(total_steps))
+        # Replicate final outputs from the last rank to all ranks.
+        outputs = jnp.where(rank == pp - 1, outputs, 0.0)
+        outputs = lax.psum(outputs, axis)
+        return outputs.reshape(b, *x.shape[1:])
+
+    return pipelined
+
+
+def shard_stages(params_per_stage, mesh, axis: str = "pp"):
+    """device_put a [pp, ...] stacked stage-param pytree with the stage
+    dim sharded over the pp axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(x):
+        spec = [axis] + [None] * (x.ndim - 1)
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree_util.tree_map(put, params_per_stage)

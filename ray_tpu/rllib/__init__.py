@@ -1,0 +1,19 @@
+"""ray_tpu.rllib — reinforcement learning (RLlib analog, new API stack).
+
+Reference shape being re-based (SURVEY.md §3.7): EnvRunnerGroup of
+actors collects episodes → Learner does SGD → weights broadcast back.
+TPU-first: the Learner is a **JaxLearner** whose whole update
+(GAE + minibatch epochs + grad allreduce) compiles to one jitted
+program per minibatch over the learner mesh — the reference's
+torch-DDP learner loop (torch_learner.py:508-522) becomes sharding
+propagation.
+"""
+
+from ray_tpu.rllib.algorithm import AlgorithmConfig, PPO, PPOConfig
+from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup, Episode
+from ray_tpu.rllib.learner import JaxLearner
+
+__all__ = [
+    "AlgorithmConfig", "PPO", "PPOConfig",
+    "EnvRunner", "EnvRunnerGroup", "Episode", "JaxLearner",
+]

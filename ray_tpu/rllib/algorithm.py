@@ -1,0 +1,109 @@
+"""Algorithm: the training driver (reference: Algorithm.training_step,
+ppo.py:402 — sample via EnvRunnerGroup, update via Learner, broadcast
+weights)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner import JaxLearner, PPOHyperparams
+
+
+@dataclass
+class AlgorithmConfig:
+    """Fluent config builder (reference: AlgorithmConfig)."""
+
+    env: Any = None                         # name or callable
+    policy_config: dict = field(default_factory=dict)
+    num_env_runners: int = 2
+    train_batch_size: int = 512
+    hparams: PPOHyperparams = field(default_factory=PPOHyperparams)
+    seed: int = 0
+
+    def environment(self, env, *, obs_dim: int, num_actions: int,
+                    hidden: tuple = (64, 64)) -> "AlgorithmConfig":
+        return replace(self, env=env, policy_config={
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hidden": hidden})
+
+    def env_runners(self, num_env_runners: int) -> "AlgorithmConfig":
+        return replace(self, num_env_runners=num_env_runners)
+
+    def training(self, *, train_batch_size: int | None = None,
+                 **hp_overrides) -> "AlgorithmConfig":
+        hp = replace(self.hparams, **hp_overrides)
+        return replace(
+            self, hparams=hp,
+            train_batch_size=train_batch_size or self.train_batch_size)
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+PPOConfig = AlgorithmConfig
+
+
+class PPO:
+    """Proximal Policy Optimization on the new-API-stack layout."""
+
+    def __init__(self, config: AlgorithmConfig):
+        assert config.env is not None, "call .environment(...) first"
+        self.config = config
+        self.learner = JaxLearner(config.policy_config, config.hparams,
+                                  seed=config.seed)
+        self.runners = EnvRunnerGroup(
+            config.env, config.policy_config,
+            num_runners=config.num_env_runners, seed=config.seed)
+        self.iteration = 0
+        # Sync initial weights so sampling matches the learner.
+        self.runners.set_weights(self.learner.get_weights())
+
+    def train(self) -> dict:
+        """One training iteration (reference: training_step)."""
+        t0 = time.time()
+        per_runner = max(
+            1, self.config.train_batch_size
+            // max(1, self.config.num_env_runners))
+        episodes = self.runners.sample(per_runner)
+        sample_time = time.time() - t0
+
+        t1 = time.time()
+        metrics = self.learner.update_from_episodes(episodes)
+        learn_time = time.time() - t1
+
+        self.runners.set_weights(self.learner.get_weights())
+        self.iteration += 1
+
+        finished = [e for e in episodes if e.terminated or e.truncated]
+        mean_reward = (sum(e.total_reward for e in finished)
+                       / len(finished)) if finished else float("nan")
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_reward,
+            "episodes_this_iter": len(finished),
+            "num_env_steps_sampled": sum(e.length for e in episodes),
+            "time_sample_s": round(sample_time, 3),
+            "time_learn_s": round(learn_time, 3),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        self.runners.shutdown()
+
+    # -- Tune integration: PPO as a trainable --
+
+    @staticmethod
+    def as_trainable(config_builder: Callable[[dict], AlgorithmConfig],
+                     num_iterations: int = 10):
+        def trainable(tune_config: dict):
+            from ray_tpu.train import report
+            algo = config_builder(tune_config).build()
+            try:
+                for _ in range(num_iterations):
+                    report(algo.train())
+            finally:
+                algo.stop()
+        return trainable

@@ -1,0 +1,136 @@
+"""Env runners: actor-hosted environment stepping.
+
+Reference analog: SingleAgentEnvRunner actors inside an EnvRunnerGroup
+(single_agent_env_runner.py:61, env_runner_group.py:71). Runners hold
+gymnasium envs and a CPU copy of the policy; sampling is the hot loop
+(env.step + policy forward) and stays on host CPU — the TPU belongs to
+the learner.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+import ray_tpu
+
+
+@dataclass
+class Episode:
+    obs: list = field(default_factory=list)
+    actions: list = field(default_factory=list)
+    rewards: list = field(default_factory=list)
+    logps: list = field(default_factory=list)
+    values: list = field(default_factory=list)
+    terminated: bool = False
+    truncated: bool = False
+    last_value: float = 0.0
+
+    @property
+    def length(self) -> int:
+        return len(self.actions)
+
+    @property
+    def total_reward(self) -> float:
+        return float(sum(self.rewards))
+
+
+@ray_tpu.remote
+class EnvRunner:
+    """One sampling actor: vectorized-ish env loop with a host policy."""
+
+    def __init__(self, env_maker_or_name, policy_config: dict,
+                 seed: int = 0):
+        import jax
+
+        from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+
+        if isinstance(env_maker_or_name, str):
+            import gymnasium
+            self.env = gymnasium.make(env_maker_or_name)
+        else:
+            self.env = env_maker_or_name()
+        self.rng = np.random.default_rng(seed)
+        self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        self.params = self.model.init_params(jax.random.key(seed))
+        self._fwd = jax.jit(
+            lambda p, o: self.model.apply({"params": p}, o))
+        self._obs, _ = self.env.reset(seed=seed)
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, num_steps: int) -> list:
+        """Collect ~num_steps of experience as Episode chunks."""
+        import jax.nn as jnn
+
+        episodes: list[Episode] = []
+        ep = Episode()
+        for _ in range(num_steps):
+            logits, value = self._fwd(self.params, self._obs[None])
+            probs = np.asarray(jnn.softmax(logits[0]))
+            action = int(self.rng.choice(len(probs), p=probs))
+            logp = float(np.log(probs[action] + 1e-9))
+            next_obs, reward, term, trunc, _ = self.env.step(action)
+            ep.obs.append(np.asarray(self._obs, dtype=np.float32))
+            ep.actions.append(action)
+            ep.rewards.append(float(reward))
+            ep.logps.append(logp)
+            ep.values.append(float(value[0]))
+            self._obs = next_obs
+            if term or trunc:
+                ep.terminated, ep.truncated = term, trunc
+                ep.last_value = 0.0
+                episodes.append(ep)
+                ep = Episode()
+                self._obs, _ = self.env.reset()
+        if ep.length:
+            _, last_v = self._fwd(self.params, self._obs[None])
+            ep.last_value = float(last_v[0])
+            episodes.append(ep)
+        return episodes
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class EnvRunnerGroup:
+    """Manages N runner actors; tolerates runner loss by respawning
+    (reference: EnvRunnerGroup probe-and-restore)."""
+
+    def __init__(self, env_maker_or_name, policy_config: dict,
+                 num_runners: int = 2, seed: int = 0):
+        self._maker = env_maker_or_name
+        self._policy_config = policy_config
+        self._seed = seed
+        self.runners = [
+            EnvRunner.remote(env_maker_or_name, policy_config, seed + i)
+            for i in range(num_runners)
+        ]
+
+    def sample(self, steps_per_runner: int) -> list[Episode]:
+        refs = [r.sample.remote(steps_per_runner) for r in self.runners]
+        episodes: list[Episode] = []
+        for i, ref in enumerate(refs):
+            try:
+                episodes.extend(ray_tpu.get(ref, timeout=300))
+            except Exception:  # noqa: BLE001 — respawn lost runner
+                self.runners[i] = EnvRunner.remote(
+                    self._maker, self._policy_config,
+                    self._seed + i + 1000)
+        return episodes
+
+    def set_weights(self, params) -> None:
+        ref = ray_tpu.put(params)   # broadcast via object store
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners],
+                    timeout=120)
+
+    def shutdown(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

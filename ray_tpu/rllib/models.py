@@ -1,0 +1,43 @@
+"""RLModule analog: flax actor-critic policies."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ActorCriticConfig:
+    obs_dim: int
+    num_actions: int
+    hidden: tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+class ActorCritic(nn.Module):
+    """Discrete-action policy + value head (the RLModule analog)."""
+
+    config: ActorCriticConfig
+
+    @nn.compact
+    def __call__(self, obs):
+        cfg = self.config
+        x = obs.astype(cfg.dtype)
+        for i, h in enumerate(cfg.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"fc{i}",
+                                 dtype=cfg.dtype)(x))
+        logits = nn.Dense(cfg.num_actions, name="pi",
+                          kernel_init=nn.initializers.orthogonal(0.01),
+                          dtype=cfg.dtype)(x)
+        value = nn.Dense(1, name="vf",
+                         kernel_init=nn.initializers.orthogonal(1.0),
+                         dtype=cfg.dtype)(x)[..., 0]
+        return logits, value
+
+    def init_params(self, rng):
+        obs = jnp.zeros((1, self.config.obs_dim))
+        return self.init(rng, obs)["params"]

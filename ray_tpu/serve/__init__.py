@@ -1,0 +1,26 @@
+"""ray_tpu.serve — online serving (Ray Serve analog).
+
+Reference shape being re-based (SURVEY.md §3.5): a singleton
+ServeController actor reconciles deployments into replica actors; an
+HTTP proxy actor (aiohttp) routes ingress; handles route directly to
+replicas with power-of-two-choices load balancing. TPU angle: replicas
+are ordinary actors, so a replica can own chips and serve a jitted
+model; batching (@serve.batch) aggregates requests into one device
+program call.
+"""
+
+from ray_tpu.serve.api import (
+    deployment,
+    run,
+    shutdown,
+    get_deployment_handle,
+    batch,
+    Application,
+    Deployment,
+    DeploymentHandle,
+)
+
+__all__ = [
+    "deployment", "run", "shutdown", "get_deployment_handle", "batch",
+    "Application", "Deployment", "DeploymentHandle",
+]

@@ -1,0 +1,263 @@
+"""Serve public API: @deployment, bind, run, handles.
+
+Reference analog: python/ray/serve/api.py (serve.run at :510) and the
+deployment-graph build: ``A.bind(x)`` produces an Application node;
+``serve.run`` deploys the node's dependency tree bottom-up (nested
+binds become their own deployments whose handles are injected as init
+args — model composition, deployment_graph_build.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.core import serialization as ser
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.router import Router
+
+_proxy = None
+_proxy_port: int | None = None
+
+
+@dataclass
+class Deployment:
+    cls: type
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: dict = field(default_factory=dict)
+    user_config: Any = None
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def options(self, *, num_replicas: int | None = None,
+                name: str | None = None,
+                ray_actor_options: dict | None = None) -> "Deployment":
+        return Deployment(
+            cls=self.cls,
+            name=name or self.name,
+            num_replicas=num_replicas or self.num_replicas,
+            ray_actor_options=ray_actor_options
+            or self.ray_actor_options,
+            user_config=self.user_config)
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    init_args: tuple
+    init_kwargs: dict
+
+
+class DeploymentHandle:
+    """Client handle routing to a deployment's replicas (reference:
+    handle.py:710). ``handle.remote(...)`` and
+    ``handle.method.remote(...)`` return ObjectRefs."""
+
+    def __init__(self, deployment_name: str, controller=None):
+        self._name = deployment_name
+        self._controller = controller or ray_tpu.get_actor(
+            CONTROLLER_NAME)
+        self._router = Router(self._controller, deployment_name)
+
+    def remote(self, *args, **kwargs):
+        return self._router.assign("__call__", args, kwargs)
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        class _Method:
+            def __init__(self, outer, name):
+                self._outer = outer
+                self._name = name
+
+            def remote(self, *args, **kwargs):
+                return self._outer._router.assign(self._name, args,
+                                                  kwargs)
+
+        return _Method(self, method)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name,))
+
+
+def deployment(cls: type | None = None, *, name: str | None = None,
+               num_replicas: int = 1,
+               ray_actor_options: dict | None = None):
+    """Decorator turning a class (or function) into a Deployment."""
+    def wrap(target):
+        return Deployment(
+            cls=target, name=name or target.__name__,
+            num_replicas=num_replicas,
+            ray_actor_options=ray_actor_options or {})
+    if cls is not None:
+        return wrap(cls)
+    return wrap
+
+
+def _ensure_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return ServeController.options(
+            name=CONTROLLER_NAME, num_cpus=0,
+            max_concurrency=16).remote()
+
+
+def _deploy_tree(app: Application, controller) -> str:
+    """Deploy nested Applications depth-first; replace them with
+    DeploymentHandles in the parent's init args."""
+    def resolve(v):
+        if isinstance(v, Application):
+            child = _deploy_tree(v, controller)
+            return DeploymentHandle(child, controller)
+        return v
+
+    args = tuple(resolve(a) for a in app.init_args)
+    kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
+    d = app.deployment
+    resources = dict(d.ray_actor_options.get("resources", {}))
+    if "num_cpus" in d.ray_actor_options:
+        resources["CPU"] = d.ray_actor_options["num_cpus"]
+    if "num_tpus" in d.ray_actor_options:
+        resources["TPU"] = d.ray_actor_options["num_tpus"]
+    ray_tpu.get(controller.deploy.remote(
+        d.name, ser.dumps(d.cls), args, kwargs, d.num_replicas,
+        resources), timeout=120)
+    return d.name
+
+
+def run(app: Application, *, route_prefix: str = "/",
+        http_port: int | None = None,
+        blocking: bool = False) -> DeploymentHandle:
+    global _proxy, _proxy_port
+    controller = _ensure_controller()
+    name = _deploy_tree(app, controller)
+    # wait until replicas are live
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        version, replicas = ray_tpu.get(
+            controller.get_replicas.remote(name))
+        if replicas:
+            break
+        time.sleep(0.1)
+    if http_port is not None:
+        if _proxy is None or _proxy_port != http_port:
+            from ray_tpu.serve.proxy import ProxyActor
+            _proxy = ProxyActor.options(
+                num_cpus=0, max_concurrency=32).remote(http_port)
+            _proxy_port = http_port
+            ray_tpu.get(_proxy.ready.remote(), timeout=30)
+        routes = {route_prefix: name}
+        ray_tpu.get(_proxy.set_routes.remote(routes))
+    handle = DeploymentHandle(name, controller)
+    if blocking:
+        while True:
+            time.sleep(1)
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def shutdown() -> None:
+    global _proxy, _proxy_port
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except ValueError:
+        pass
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:  # noqa: BLE001
+            pass
+        _proxy = None
+        _proxy_port = None
+
+
+_batch_init_lock = None  # created lazily per process (picklability)
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Request batching decorator (reference: serve.batching): queued
+    single calls coalesce into one list-call of the wrapped method —
+    on TPU this turns N requests into one jitted batched forward.
+
+    All state (queue + worker thread) is created lazily per instance in
+    the replica process, so decorated classes stay picklable.
+    """
+
+    def wrap(fn):
+        attr = f"__serve_batch_state_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def inner(self, single_arg):
+            import queue as queue_mod
+            import threading
+
+            global _batch_init_lock
+            if _batch_init_lock is None:
+                _batch_init_lock = threading.Lock()
+            state = getattr(self, attr, None)
+            if state is None:
+                with _batch_init_lock:
+                    state = getattr(self, attr, None)
+                    if state is None:
+                        state = {"q": queue_mod.Queue()}
+
+                        def worker():
+                            q = state["q"]
+                            while True:
+                                items = [q.get()]
+                                deadline = (time.monotonic()
+                                            + batch_wait_timeout_s)
+                                while len(items) < max_batch_size:
+                                    remaining = (deadline
+                                                 - time.monotonic())
+                                    if remaining <= 0:
+                                        break
+                                    try:
+                                        items.append(
+                                            q.get(timeout=remaining))
+                                    except queue_mod.Empty:
+                                        break
+                                args = [it[0] for it in items]
+                                events = [it[1] for it in items]
+                                slots = [it[2] for it in items]
+                                try:
+                                    results = fn(self, args)
+                                    for s, e, r in zip(slots, events,
+                                                       results):
+                                        s.append((True, r))
+                                        e.set()
+                                except Exception as exc:  # noqa: BLE001
+                                    for s, e in zip(slots, events):
+                                        s.append((False, exc))
+                                        e.set()
+
+                        threading.Thread(target=worker,
+                                         daemon=True).start()
+                        setattr(self, attr, state)
+            event = threading.Event()
+            slot: list = []
+            state["q"].put((single_arg, event, slot))
+            event.wait(60)
+            ok, result = slot[0]
+            if not ok:
+                raise result
+            return result
+
+        return inner
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,130 @@
+"""ServeController: the reconciling control plane.
+
+Reference analog: ServeController (controller.py:86) + DeploymentState
+reconcile (deployment_state.py:1232): desired state (deployments map)
+vs live state (replica actors); a background loop starts/stops
+replicas to converge, respawns dead ones, and bumps a version so
+routers refresh their replica sets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import ray_tpu
+from ray_tpu.serve.replica import Replica
+
+CONTROLLER_NAME = "ray_tpu_serve_controller"
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        # name -> spec dict(cls, args, kwargs, num_replicas, resources)
+        self.desired: dict[str, dict] = {}
+        self.replicas: dict[str, list] = {}
+        self.versions: dict[str, int] = {}
+        self._stop = False
+        self._rec_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- desired state --
+
+    def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
+               num_replicas: int, resources: dict) -> bool:
+        from ray_tpu.core import serialization as ser
+        self.desired[name] = {
+            "cls": ser.loads(cls_blob),
+            "args": init_args, "kwargs": init_kwargs,
+            "num_replicas": num_replicas,
+            "resources": resources or {},
+        }
+        self.versions.setdefault(name, 0)
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        self.desired.pop(name, None)
+        self._reconcile_once()
+        return True
+
+    # -- live state queries (router/long-poll surface) --
+
+    def get_version(self, name: str) -> int:
+        return self.versions.get(name, 0)
+
+    def get_replicas(self, name: str):
+        return self.versions.get(name, 0), list(
+            self.replicas.get(name, []))
+
+    def list_deployments(self) -> dict:
+        return {name: {"num_replicas": len(self.replicas.get(name, [])),
+                       "desired": spec["num_replicas"]}
+                for name, spec in self.desired.items()}
+
+    # -- reconciliation --
+
+    def _reconcile_loop(self):
+        while not self._stop:
+            try:
+                self._reconcile_once()
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+
+    def _reconcile_once(self):
+        with self._rec_lock:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self):
+        # remove deleted deployments
+        for name in list(self.replicas):
+            if name not in self.desired:
+                for r in self.replicas.pop(name):
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self.versions[name] = self.versions.get(name, 0) + 1
+        for name, spec in self.desired.items():
+            live = self.replicas.setdefault(name, [])
+            # drop dead replicas (health probe)
+            alive = []
+            changed = False
+            for r in live:
+                try:
+                    ray_tpu.get(r.queue_len.remote(), timeout=5)
+                    alive.append(r)
+                except Exception:  # noqa: BLE001
+                    changed = True
+            live = alive
+            while len(live) < spec["num_replicas"]:
+                tag = f"{name}#{len(live)}_{int(time.time()*1e3)%100000}"
+                resources = dict(spec["resources"])
+                live.append(Replica.options(
+                    num_cpus=resources.pop("CPU", 1.0),
+                    num_tpus=resources.pop("TPU", 0) or None,
+                    resources=resources or None,
+                    max_concurrency=8,
+                ).remote(spec["cls"], spec["args"], spec["kwargs"], tag))
+                changed = True
+            while len(live) > spec["num_replicas"]:
+                victim = live.pop()
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:  # noqa: BLE001
+                    pass
+                changed = True
+            self.replicas[name] = live
+            if changed:
+                self.versions[name] = self.versions.get(name, 0) + 1
+
+    def graceful_shutdown(self) -> bool:
+        self._stop = True
+        for name in list(self.desired):
+            self.desired.pop(name)
+        self._reconcile_once()
+        return True

@@ -1,0 +1,100 @@
+"""HTTP proxy actor (aiohttp ingress).
+
+Reference analog: ProxyActor/HTTPProxy (proxy.py:1140,766). Routes
+``<route_prefix>`` to the matching deployment's router; request bodies
+parse as JSON (or raw bytes fall through), responses JSON-encode.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self, port: int):
+        self.port = port
+        self.routes: dict[str, str] = {}     # route_prefix -> deployment
+        self._routers: dict[str, object] = {}
+        self._controller = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._started.wait(10)
+
+    def set_routes(self, routes: dict[str, str]) -> bool:
+        self.routes = dict(routes)
+        return True
+
+    def ready(self) -> int:
+        return self.port
+
+    def _router_for(self, deployment: str):
+        if deployment not in self._routers:
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+            from ray_tpu.serve.router import Router
+            if self._controller is None:
+                self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            self._routers[deployment] = Router(self._controller,
+                                               deployment)
+        return self._routers[deployment]
+
+    def _serve_forever(self):
+        import asyncio
+
+        from aiohttp import web
+
+        async def handler(request: "web.Request"):
+            path = request.path
+            target = None
+            # longest-prefix route match
+            for prefix in sorted(self.routes, key=len, reverse=True):
+                if path == prefix or path.startswith(
+                        prefix.rstrip("/") + "/") or prefix == "/":
+                    target = self.routes[prefix]
+                    break
+            if target is None:
+                return web.json_response(
+                    {"error": f"no route for {path}"}, status=404)
+            body = await request.read()
+            if body:
+                try:
+                    payload = json.loads(body)
+                except json.JSONDecodeError:
+                    payload = body.decode("utf-8", "replace")
+            else:
+                payload = dict(request.query)
+            router = self._router_for(target)
+            loop = asyncio.get_running_loop()
+
+            def call():
+                ref = router.assign("__call__", (payload,), {})
+                return ray_tpu.get(ref, timeout=120)
+
+            try:
+                result = await loop.run_in_executor(None, call)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response(
+                    {"error": str(e)[:500]}, status=500)
+            if isinstance(result, (bytes, str)):
+                return web.Response(
+                    body=result if isinstance(result, bytes)
+                    else result.encode())
+            return web.json_response(result)
+
+        async def run():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", handler)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            await site.start()
+            self._started.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        asyncio.new_event_loop().run_until_complete(run())

@@ -1,0 +1,65 @@
+"""Replica actor: hosts one copy of a deployment's user class.
+
+Reference analog: serve replica (replica.py: UserCallableWrapper).
+Runs with max_concurrency > 1 so the in-flight counter is meaningful
+for power-of-two routing probes (pow_2_scheduler.py:51 probes queue
+lengths the same way).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Replica:
+    def __init__(self, cls_or_fn, init_args, init_kwargs,
+                 replica_tag: str):
+        self.tag = replica_tag
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._total = 0
+        if isinstance(cls_or_fn, type):
+            self.callable = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self.callable = cls_or_fn
+
+    def handle_request(self, method_name: str, args, kwargs):
+        with self._lock:
+            self._inflight += 1
+            self._total += 1
+        try:
+            target = (self.callable if method_name == "__call__"
+                      and not isinstance(self.callable, object.__class__)
+                      else None)
+            fn = (getattr(self.callable, method_name)
+                  if hasattr(self.callable, method_name)
+                  else self.callable)
+            result = fn(*args, **kwargs)
+            import inspect
+            if inspect.iscoroutine(result):
+                import asyncio
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def queue_len(self) -> int:
+        return self._inflight
+
+    def stats(self) -> dict:
+        return {"tag": self.tag, "inflight": self._inflight,
+                "total": self._total}
+
+    def reconfigure(self, user_config) -> bool:
+        if hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+        return True
+
+    def health_check(self) -> str:
+        if hasattr(self.callable, "check_health"):
+            self.callable.check_health()
+        return "ok"

@@ -1,0 +1,53 @@
+"""Request router: power-of-two-choices replica selection.
+
+Reference analog: PowerOfTwoChoicesReplicaScheduler
+(replica_scheduler/pow_2_scheduler.py:51): sample two replicas, probe
+their queue lengths, pick the shorter. Probes are fire-and-forget
+actor calls; the replica set refreshes from the controller on a
+version bump (the long-poll analog is a poll-on-version-mismatch).
+"""
+
+from __future__ import annotations
+
+import random
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._replicas: list = []
+        self._version = -1
+        self._rng = random.Random()
+
+    def _refresh(self) -> None:
+        version, replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name))
+        self._version = version
+        self._replicas = replicas
+
+    def pick_replica(self):
+        version = ray_tpu.get(
+            self._controller.get_version.remote(self._name))
+        if version != self._version or not self._replicas:
+            self._refresh()
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment {self._name!r} has no replicas")
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = self._rng.sample(self._replicas, 2)
+        try:
+            qa, qb = ray_tpu.get(
+                [a.queue_len.remote(), b.queue_len.remote()],
+                timeout=5)
+        except Exception:  # noqa: BLE001 — probe failure: refresh next
+            self._version = -1
+            return a
+        return a if qa <= qb else b
+
+    def assign(self, method_name: str, args, kwargs):
+        replica = self.pick_replica()
+        return replica.handle_request.remote(method_name, args, kwargs)

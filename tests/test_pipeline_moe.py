@@ -1,0 +1,128 @@
+"""Pipeline-parallel and expert-parallel op tests (8-dev CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel import make_mesh
+from ray_tpu.parallel.pipeline import shard_stages, spmd_pipeline
+from ray_tpu.ops.moe import (
+    dense_switch_ffn_reference, moe_ffn, top1_dispatch,
+)
+
+
+def test_spmd_pipeline_matches_sequential():
+    mesh = make_mesh({"pp": 4})
+    d = 16
+    n_stages = 4
+    rng = jax.random.key(0)
+    ws = jax.random.normal(rng, (n_stages, d, d)) * 0.3
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    pipe = spmd_pipeline(stage_fn, num_microbatches=8, axis="pp")
+    f = jax.jit(jax.shard_map(
+        pipe, mesh=mesh,
+        in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False))
+
+    x = jax.random.normal(jax.random.key(1), (32, d))
+    y_pipe = f(ws, x)
+
+    y_seq = x
+    for i in range(n_stages):
+        y_seq = jnp.tanh(y_seq @ ws[i])
+    np.testing.assert_allclose(np.asarray(y_pipe), np.asarray(y_seq),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_with_dp():
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    d = 8
+
+    def stage_fn(w, x):
+        return x @ w + 1.0
+
+    ws = jnp.stack([jnp.eye(d) * (i + 1) for i in range(4)])
+    pipe = spmd_pipeline(stage_fn, num_microbatches=4, axis="pp")
+    f = jax.jit(jax.shard_map(
+        pipe, mesh=mesh,
+        in_specs=(P("pp"), P("dp")), out_specs=P("dp"),
+        check_vma=False))
+    x = jnp.ones((16, d))
+    y = f(ws, x)
+    expect = x
+    for i in range(4):
+        expect = expect @ (jnp.eye(d) * (i + 1)) + 1.0
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                               atol=1e-5)
+
+
+def test_top1_dispatch_capacity():
+    logits = jnp.array([[9.0, 0.0], [9.0, 0.0], [9.0, 0.0],
+                        [0.0, 9.0]])
+    dispatch, combine, aux = top1_dispatch(logits, 2, capacity=2)
+    # three tokens want expert 0 but capacity is 2: token 2 dropped
+    assert float(dispatch[0].sum()) == 1.0
+    assert float(dispatch[1].sum()) == 1.0
+    assert float(dispatch[2].sum()) == 0.0
+    assert float(dispatch[3].sum()) == 1.0
+    assert np.isfinite(float(aux))
+
+
+def test_moe_ffn_matches_dense_reference():
+    mesh = make_mesh({"ep": 4})
+    T, D, H, E = 32, 8, 16, 8          # 2 experts per rank
+    ks = jax.random.split(jax.random.key(0), 4)
+    x = jax.random.normal(ks[0], (T, D))
+    router_w = jax.random.normal(ks[1], (D, E)) * 0.5
+    w_up = jax.random.normal(ks[2], (E, D, H)) * 0.3
+    w_down = jax.random.normal(ks[3], (E, H, D)) * 0.3
+
+    # Every rank routes the same local tokens in the sharded version
+    # (token dim replicated over ep) so dense reference must match
+    # exactly when capacity math aligns: C_sharded uses global E.
+    def sharded(x, rw, wu, wd):
+        y, aux = moe_ffn(x, rw, wu, wd, axis="ep",
+                         capacity_factor=8.0)
+        return y, aux
+
+    f = jax.jit(jax.shard_map(
+        sharded, mesh=mesh,
+        in_specs=(P(), P(), P("ep"), P("ep")),
+        out_specs=(P(), P()),
+        check_vma=False))
+    y_sharded, aux_s = f(x, router_w, w_up, w_down)
+    y_dense, aux_d = dense_switch_ffn_reference(
+        x, router_w, w_up, w_down, capacity_factor=8.0)
+    np.testing.assert_allclose(np.asarray(y_sharded),
+                               np.asarray(y_dense),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(aux_s), float(aux_d), rtol=1e-5)
+
+
+def test_moe_grad_flows():
+    mesh = make_mesh({"ep": 2})
+    T, D, H, E = 16, 4, 8, 2
+    ks = jax.random.split(jax.random.key(1), 4)
+    x = jax.random.normal(ks[0], (T, D))
+    router_w = jax.random.normal(ks[1], (D, E)) * 0.5
+    w_up = jax.random.normal(ks[2], (E, D, H)) * 0.3
+    w_down = jax.random.normal(ks[3], (E, H, D)) * 0.3
+
+    def loss(wu, wd):
+        def inner(x, rw, wu, wd):
+            y, aux = moe_ffn(x, rw, wu, wd, axis="ep")
+            return y, aux
+        f = jax.shard_map(inner, mesh=mesh,
+                          in_specs=(P(), P(), P("ep"), P("ep")),
+                          out_specs=(P(), P()), check_vma=False)
+        y, aux = f(x, router_w, wu, wd)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1)))(w_up, w_down)
+    assert all(np.isfinite(np.asarray(gi)).all() for gi in g)
+    assert float(jnp.abs(g[0]).sum()) > 0

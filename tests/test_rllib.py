@@ -1,0 +1,72 @@
+"""RLlib-analog tests: PPO on CartPole must learn."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.learner import JaxLearner, PPOHyperparams
+from ray_tpu.rllib.env_runner import Episode
+
+
+def test_gae_computation():
+    learner = JaxLearner({"obs_dim": 2, "num_actions": 2},
+                         PPOHyperparams(gamma=0.5, gae_lambda=1.0))
+    ep = Episode(
+        obs=[np.zeros(2, np.float32)] * 3,
+        actions=[0, 1, 0],
+        rewards=[1.0, 1.0, 1.0],
+        logps=[-0.7] * 3,
+        values=[0.0, 0.0, 0.0],
+        terminated=True,
+    )
+    batch = learner.compute_advantages([ep])
+    # returns with gamma=0.5: [1.75, 1.5, 1.0]
+    np.testing.assert_allclose(batch["returns"], [1.75, 1.5, 1.0],
+                               rtol=1e-5)
+    assert batch["obs"].shape == (3, 2)
+    # advantages are normalized
+    assert abs(batch["advantages"].mean()) < 1e-6
+
+
+def test_learner_update_improves_surrogate():
+    rng = np.random.default_rng(0)
+    learner = JaxLearner({"obs_dim": 4, "num_actions": 2},
+                         PPOHyperparams(minibatch_size=32,
+                                        num_epochs=2))
+    ep = Episode(
+        obs=list(rng.standard_normal((64, 4)).astype(np.float32)),
+        actions=list(rng.integers(0, 2, 64)),
+        rewards=list(rng.standard_normal(64)),
+        logps=list(np.full(64, -0.69)),
+        values=list(np.zeros(64)),
+        terminated=True,
+    )
+    metrics = learner.update_from_episodes([ep])
+    assert np.isfinite(metrics["total_loss"])
+    assert np.isfinite(metrics["entropy"])
+
+
+@pytest.mark.slow
+def test_ppo_cartpole_learns(rt):
+    algo = (PPOConfig()
+            .environment("CartPole-v1", obs_dim=4, num_actions=2)
+            .env_runners(2)
+            .training(train_batch_size=1024, lr=3e-3,
+                      minibatch_size=128, num_epochs=6)
+            .build())
+    try:
+        first = None
+        best = -np.inf
+        for i in range(12):
+            result = algo.train()
+            r = result["episode_reward_mean"]
+            if first is None and np.isfinite(r):
+                first = r
+            best = max(best, r if np.isfinite(r) else best)
+        # CartPole starts ~20 reward with a random policy; PPO should
+        # clearly improve within a few iterations.
+        assert first is not None
+        assert best > first + 30, (first, best)
+    finally:
+        algo.stop()
