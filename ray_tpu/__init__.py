@@ -33,6 +33,7 @@ from ray_tpu.core.api import (
     nodes,
     timeline,
     method,
+    get_runtime_context,
 )
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.actor import ActorHandle
@@ -67,6 +68,7 @@ __all__ = [
     "cluster_resources",
     "nodes",
     "timeline",
+    "get_runtime_context",
     "ObjectRef",
     "ActorHandle",
     "RayTpuError",

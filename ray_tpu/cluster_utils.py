@@ -1,0 +1,87 @@
+"""Multi-node-on-one-host test cluster.
+
+Reference: ``ray.cluster_utils.Cluster`` (python/ray/cluster_utils.py:
+135,201) — the workhorse of the reference's distributed test suite
+(SURVEY.md §4.2): every scheduling/spillback/failure invariant is
+testable on one machine because "a node" is just a resource pool with
+its own worker processes. ``add_node`` registers a logical node with
+the driver runtime's node table; ``remove_node`` simulates node
+failure (workers killed, tasks retried elsewhere, actors restarted).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class ClusterNode:
+    def __init__(self, node_id: str, resources: dict[str, float]):
+        self.node_id = node_id
+        self.resources = resources
+
+    def __repr__(self):
+        return f"ClusterNode({self.node_id})"
+
+
+class Cluster:
+    """Start a head node and add/remove logical worker nodes."""
+
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: dict[str, Any] | None = None):
+        import ray_tpu
+        self._ray = ray_tpu
+        self._nodes: list[ClusterNode] = []
+        self.head_node: ClusterNode | None = None
+        if initialize_head:
+            args = dict(head_node_args or {})
+            args.setdefault("num_cpus", 2)
+            rt = ray_tpu.init(**args)
+            self._rt = rt
+            self.head_node = ClusterNode(
+                rt.head_node_id,
+                dict(rt._nodes[rt.head_node_id].resources))
+            self._nodes.append(self.head_node)
+        else:
+            self._rt = None
+
+    def connect(self) -> None:
+        """No-op: the driver is already connected (kept for reference
+        API compatibility)."""
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: dict[str, float] | None = None,
+                 labels: dict[str, str] | None = None) -> ClusterNode:
+        if self._rt is None:
+            import ray_tpu
+            ray_tpu.init(num_cpus=int(num_cpus), resources=resources)
+            self._rt = ray_tpu.core.api.get_runtime()  # type: ignore
+            node = ClusterNode(self._rt.head_node_id,
+                               dict(resources or {"CPU": num_cpus}))
+            self.head_node = node
+            self._nodes.append(node)
+            return node
+        res: dict[str, float] = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        if resources:
+            res.update(resources)
+        node_id = self._rt.add_node(res, labels)
+        node = ClusterNode(node_id, res)
+        self._nodes.append(node)
+        return node
+
+    def remove_node(self, node: ClusterNode,
+                    allow_graceful: bool = True) -> None:
+        self._rt.remove_node(node.node_id)
+        if node in self._nodes:
+            self._nodes.remove(node)
+
+    @property
+    def list_all_nodes(self) -> list[ClusterNode]:
+        return list(self._nodes)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        ray_tpu.shutdown()
+        self._rt = None
+        self._nodes.clear()

@@ -32,6 +32,11 @@ class ActorMethod:
             f"actor method {self._name} cannot be called directly; "
             f"use .remote()")
 
+    def bind(self, *args, **kwargs):
+        """Bind this method on a live actor into a DAG."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
 
 class ActorHandle:
     """Serializable handle; pickles to the actor id and re-binds to the
@@ -115,6 +120,11 @@ class ActorClass:
             max_restarts=int(opts.get("max_restarts", 0)),
             max_concurrency=int(opts.get("max_concurrency", 1)))
         return ActorHandle(actor_id, self._method_meta)
+
+    def bind(self, *args, **kwargs):
+        """Lazily bind actor construction into a DAG."""
+        from ray_tpu.dag.dag_node import ClassNode
+        return ClassNode(self, args, kwargs)
 
     @property
     def underlying_class(self) -> type:

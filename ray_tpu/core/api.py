@@ -159,3 +159,28 @@ def nodes() -> list[dict]:
 
 def timeline() -> list[dict]:
     return get_runtime().timeline()
+
+
+class RuntimeContext:
+    """Reference: ray.get_runtime_context() (runtime_context.py)."""
+
+    def get_node_id(self) -> str:
+        import os
+        nid = os.environ.get("RAY_TPU_NODE_ID", "")
+        if nid:
+            return nid
+        rt = get_runtime_or_none()
+        return rt.head_node_id if rt is not None and hasattr(
+            rt, "head_node_id") else "driver"
+
+    def get_actor_id(self) -> str | None:
+        return _actor_context.hex() if _actor_context else None
+
+    def get_job_id(self) -> str:
+        rt = get_runtime_or_none()
+        return rt.job_id.hex() if rt is not None and hasattr(
+            rt, "job_id") else ""
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

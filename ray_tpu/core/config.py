@@ -39,6 +39,11 @@ class Config:
     # long awaiting more same-shape tasks (reference: NormalTaskSubmitter
     # lease caching, normal_task_submitter.cc).
     lease_reuse_timeout_s: float = 10.0
+    # Hybrid scheduling: pack onto earlier nodes until CPU utilization
+    # crosses this fraction, then spread to the least-loaded node
+    # (reference: RAY_scheduler_spread_threshold = 0.5,
+    # hybrid_scheduling_policy.cc).
+    scheduler_spread_threshold: float = 0.5
 
     # --- objects ---
     # Objects at or above this size go to the shared-memory store instead
@@ -71,7 +76,7 @@ class Config:
     # Task lifecycle events ring-buffer capacity per worker
     # (reference: TaskEventBuffer, task_event_buffer.h:220).
     task_event_buffer_size: int = 10000
-    log_dir: str = "/tmp/ray_tpu/logs"
+    log_dir: str = "/tmp/ray_tpu_sessions/logs"
 
     # --- TPU / device ---
     # Treat a multi-host TPU slice as an atomic gang-scheduled unit.

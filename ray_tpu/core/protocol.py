@@ -41,6 +41,7 @@ OP_CANCEL = "cancel"
 OP_GET_ACTOR = "get_actor"
 OP_BORROW = "borrow"
 OP_RESOURCES = "resources"
+OP_STATE = "state"            # (kind, filters) -> list[dict] | dict
 OP_PG_CREATE = "pg_create"
 OP_PG_REMOVE = "pg_remove"
 

@@ -24,10 +24,23 @@ def make_task_options(**opts: Any) -> TaskOptions:
         opts.get("num_cpus"), opts.get("num_tpus"), opts.get("resources"))
     pg = opts.get("placement_group")
     sched = opts.get("scheduling_strategy", "DEFAULT")
+    node_id = ""
+    soft = False
+    bundle_index = opts.get("placement_group_bundle_index", -1)
     if sched is not None and not isinstance(sched, str):
-        # PlacementGroupSchedulingStrategy-style object
-        pg = getattr(sched, "placement_group", pg)
-        sched = "PLACEMENT_GROUP"
+        if hasattr(sched, "placement_group"):
+            # PlacementGroupSchedulingStrategy
+            pg = sched.placement_group
+            bundle_index = getattr(
+                sched, "placement_group_bundle_index", bundle_index)
+            sched = "PLACEMENT_GROUP"
+        elif hasattr(sched, "node_id"):
+            # NodeAffinitySchedulingStrategy
+            node_id = sched.node_id
+            soft = bool(getattr(sched, "soft", False))
+            sched = "NODE_AFFINITY"
+        else:
+            sched = "DEFAULT"
     return TaskOptions(
         num_returns=opts.get("num_returns", 1),
         resources=resources,
@@ -36,9 +49,10 @@ def make_task_options(**opts: Any) -> TaskOptions:
         name=opts.get("name", ""),
         runtime_env=opts.get("runtime_env"),
         placement_group=pg,
-        placement_group_bundle_index=opts.get(
-            "placement_group_bundle_index", -1),
+        placement_group_bundle_index=bundle_index,
         scheduling_strategy=sched if isinstance(sched, str) else "DEFAULT",
+        node_id=node_id,
+        soft=soft,
     )
 
 
@@ -74,6 +88,11 @@ class RemoteFunction:
         refs = rt.submit_task(self._fn_id, self._fn_blob,
                               self._fn.__name__, args, kwargs, options)
         return refs[0] if options.num_returns == 1 else refs
+
+    def bind(self, *args, **kwargs):
+        """Lazily bind into a DAG (reference: dag_node.py bind)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+        return FunctionNode(self, args, kwargs)
 
     @property
     def underlying_function(self):

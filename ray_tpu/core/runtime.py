@@ -70,7 +70,25 @@ class TaskOptions:
     runtime_env: dict | None = None
     placement_group: Any = None    # PlacementGroup | None
     placement_group_bundle_index: int = -1
-    scheduling_strategy: str = "DEFAULT"
+    scheduling_strategy: str = "DEFAULT"  # DEFAULT|SPREAD|NODE_AFFINITY
+    node_id: str = ""              # NODE_AFFINITY target
+    soft: bool = False             # NODE_AFFINITY soft fallback
+
+
+@dataclass
+class NodeRecord:
+    """One logical node (raylet analog). Multi-node-on-one-host: each
+    node owns a resource pool and its worker processes carry its id —
+    the reference's ``Cluster.add_node`` pattern (SURVEY.md §4.2,
+    python/ray/cluster_utils.py:135,201) where "a node" is a process
+    group with its own resource spec, schedulable and killable."""
+    node_id: str
+    resources: dict[str, float]
+    avail: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    is_head: bool = False
+    started_at: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -86,6 +104,8 @@ class TaskRecord:
     state: str = "PENDING"         # PENDING/RUNNING/FINISHED/FAILED/CANCELLED
     worker: "WorkerHandle | None" = None
     worker_index: int = -1
+    node_id: str = ""              # node running the task
+    pg_bundle: int = -1            # bundle the resources came from
     submitted_at: float = 0.0
     started_at: float = 0.0
     finished_at: float = 0.0
@@ -104,6 +124,8 @@ class ActorRecord:
     max_concurrency: int
     worker: "WorkerHandle | None" = None
     state: str = "PENDING"         # PENDING/ALIVE/RESTARTING/DEAD
+    node_id: str = ""
+    pg_bundle: int = -1
     restart_count: int = 0
     in_flight: dict[TaskID, tuple] = field(default_factory=dict)
     ready_event: threading.Event = field(default_factory=threading.Event)
@@ -122,11 +144,23 @@ class PGRecord:
     pg_id: PlacementGroupID
     bundles: list[dict[str, float]]
     strategy: str
-    # Resources still unclaimed inside the reservation; tasks/actors
-    # scheduled into the PG draw from here, not the node pool.
-    avail: dict[str, float] = field(default_factory=dict)
+    # Per-bundle unclaimed reservations + the node each bundle landed
+    # on (reference: bundles own their reserved resources,
+    # placement_group_resource_manager.cc; 2-phase placement
+    # gcs_placement_group_scheduler.cc).
+    bundle_avail: list[dict[str, float]] = field(default_factory=list)
+    bundle_nodes: list[str] = field(default_factory=list)
     ready: threading.Event = field(default_factory=threading.Event)
     created: bool = False
+
+
+class WorkerDiedBeforeConnectError(RuntimeError):
+    """The worker process exited before its exec channel attached."""
+
+
+class PlacementError(RuntimeError):
+    """The placement request can never be satisfied (bad bundle index,
+    hard affinity to a dead node, ...) — fail the task, don't wait."""
 
 
 class WorkerHandle:
@@ -144,9 +178,10 @@ class WorkerHandle:
     BOOT_TIMEOUT_S = 120.0
 
     def __init__(self, runtime: "DriverRuntime", env_key: str,
-                 env_vars: dict[str, str]):
+                 env_vars: dict[str, str], node_id: str = ""):
         self.index = next(self._counter)
         self.env_key = env_key
+        self.node_id = node_id
         self.busy = False
         self.is_actor = False
         self.actor_id: ActorID | None = None
@@ -164,6 +199,7 @@ class WorkerHandle:
         env = dict(os.environ)
         env.update(env_vars)
         env["RAY_TPU_WORKER"] = "1"
+        env["RAY_TPU_NODE_ID"] = node_id
         # Propagate the driver's import path so workers resolve the same
         # modules (incl. a repo added to sys.path by the driver script).
         env["PYTHONPATH"] = os.pathsep.join(
@@ -187,10 +223,23 @@ class WorkerHandle:
         self.reader.start()
 
     def send(self, msg: tuple) -> None:
-        if not self._conn_ready.wait(self.BOOT_TIMEOUT_S):
-            raise RuntimeError(
-                f"worker {self.index} failed to connect within "
-                f"{self.BOOT_TIMEOUT_S}s (pid={self.proc.pid})")
+        # Wait in slices so a worker killed pre-handshake (e.g. its
+        # node was removed) surfaces immediately instead of after the
+        # full boot timeout — there is no reader-thread EOF to notice
+        # it for us until the connection exists.
+        deadline = time.monotonic() + self.BOOT_TIMEOUT_S
+        while not self._conn_ready.wait(0.25):
+            if self.proc.poll() is not None:
+                self.dead = True
+                self._runtime._forget_worker(self)
+                raise WorkerDiedBeforeConnectError(
+                    f"worker {self.index} process exited (pid="
+                    f"{self.proc.pid}, code={self.proc.returncode}) "
+                    f"before connecting")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"worker {self.index} failed to connect within "
+                    f"{self.BOOT_TIMEOUT_S}s (pid={self.proc.pid})")
         with self.send_lock:
             self.conn.send(msg)
 
@@ -244,13 +293,19 @@ class DriverRuntime:
 
         ncpu = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
         ntpu = num_tpus if num_tpus is not None else detect_tpu_chips()
-        self.total_resources: dict[str, float] = {"CPU": float(ncpu)}
+        head_res: dict[str, float] = {"CPU": float(ncpu)}
         if ntpu:
-            self.total_resources["TPU"] = float(ntpu)
+            head_res["TPU"] = float(ntpu)
         if resources:
-            self.total_resources.update(resources)
-        self.avail = dict(self.total_resources)
+            head_res.update(resources)
+        # Node table (GCS node-manager analog): the head node holds the
+        # init resources; Cluster.add_node adds more logical nodes.
         self._res_cv = threading.Condition()
+        self._nodes: dict[str, NodeRecord] = {}
+        self._node_seq = itertools.count()
+        self.head_node_id = self._add_node_locked_free(
+            head_res, is_head=True)
+        self._rr_counter = itertools.count()  # SPREAD round-robin
 
         # Object plane
         self.memory_store = MemoryStore()
@@ -302,7 +357,10 @@ class DriverRuntime:
         self._events: deque = deque(maxlen=config.task_event_buffer_size)
 
         # Client listener (worker -> driver API proxy + exec channels)
-        sock_dir = f"/tmp/ray_tpu/{os.getpid()}"
+        # NB not /tmp/ray_tpu: a directory named exactly like the
+        # package next to a user's script (or cwd=/tmp) would shadow
+        # the real ray_tpu module as an empty namespace package.
+        sock_dir = f"/tmp/ray_tpu_sessions/{os.getpid()}"
         os.makedirs(sock_dir, exist_ok=True)
         self.client_address = os.path.join(sock_dir, "runtime.sock")
         self._listener = mpc.Listener(self.client_address, family="AF_UNIX")
@@ -565,29 +623,55 @@ class DriverRuntime:
 
     def _dispatch_loop(self) -> None:
         while not self._shutdown:
-            with self._res_cv:
-                rec = self._next_schedulable_locked()
-                while rec is None and not self._shutdown:
-                    self._res_cv.wait(0.5)
-                    self._reap_idle_workers()
-                    rec = self._next_schedulable_locked()
-                if self._shutdown:
-                    return
-                if rec.state == "FAILED":
-                    # dependency error — already propagated to returns
-                    self._prune_task(rec)
-                    continue
-                self._acquire_locked(self._effective_resources(rec.options),
-                                     rec.options.placement_group)
             try:
-                self._dispatch(rec)
+                self._dispatch_loop_step()
             except Exception:  # noqa: BLE001
-                self._release(self._effective_resources(rec.options),
-                              rec.options.placement_group)
-                err = TaskError(rec.name, traceback.format_exc())
-                blob = ser.dumps(err)
-                for oid in rec.return_ids:
-                    self._store_error(oid, blob)
+                # The dispatcher must survive anything — a dead
+                # dispatcher strands every future task as PENDING.
+                traceback.print_exc()
+                time.sleep(0.1)
+
+    def _dispatch_loop_step(self) -> None:
+        """One blocking schedule-and-dispatch iteration."""
+        with self._res_cv:
+            rec = self._next_schedulable_locked()
+            while rec is None and not self._shutdown:
+                self._res_cv.wait(0.5)
+                self._reap_idle_workers()
+                rec = self._next_schedulable_locked()
+            if self._shutdown:
+                return
+            if rec.state == "FAILED":
+                # dependency/placement error — already propagated
+                self._prune_task(rec)
+                return
+            # _next_schedulable_locked already picked the node/bundle
+            # and acquired the resources.
+        try:
+            self._dispatch(rec)
+        except Exception:  # noqa: BLE001
+            self._release(self._effective_resources(rec.options),
+                          rec.options.placement_group,
+                          node_id=rec.node_id, bundle=rec.pg_bundle)
+            max_retries = (rec.options.max_retries
+                           if rec.options.max_retries >= 0
+                           else self.config.task_max_retries)
+            if rec.attempts <= max_retries:
+                # Dispatch failure (e.g. the worker died before its
+                # handshake) is retryable, same as a mid-task death.
+                rec.state = "PENDING"
+                rec.worker = None
+                with self._res_cv:
+                    self._pending.append(rec)
+                    self._res_cv.notify_all()
+                return
+            err = TaskError(rec.name, traceback.format_exc())
+            blob = ser.dumps(err)
+            for oid in rec.return_ids:
+                self._store_error(oid, blob)
+            rec.state = "FAILED"
+            self._event(rec, "FAILED")
+            self._prune_task(rec)
 
     def _effective_resources(self, options: TaskOptions) -> dict[str, float]:
         return options.resources or {"CPU": 1.0}
@@ -622,55 +706,213 @@ class DriverRuntime:
             if deps != "ready":
                 continue
             need = self._effective_resources(rec.options)
-            if self._fits_locked(need, rec.options.placement_group):
+            try:
+                placed = self._try_place_locked(need, rec.options)
+            except PlacementError as e:
+                # Infeasible forever: fail the task now instead of
+                # leaving it pending (and keep the dispatcher alive).
+                del self._pending[i]
+                blob = ser.dumps(TaskError(rec.name, str(e), e))
+                for oid in rec.return_ids:
+                    self._store_error(oid, blob)
+                rec.state = "FAILED"
+                return rec
+            if placed is not None:
+                rec.node_id, rec.pg_bundle = placed
                 del self._pending[i]
                 return rec
         return None
 
-    def _pool_for(self, pg) -> dict[str, float]:
-        """Resource pool a task draws from: the node pool, or its
-        placement group's reservation (reference: bundles own their
-        reserved resources; tasks in a PG consume from the bundle,
-        not the node — placement_group_resource_manager.cc)."""
-        if pg is not None:
-            pg_rec = self._pgs.get(pg.id)
-            if pg_rec is not None:
-                return pg_rec.avail
-        return self.avail
+    # -- node-aware placement (ClusterResourceScheduler analog,
+    #    cluster_resource_scheduler.cc:146 GetBestSchedulableNode) ------
 
-    def _fits_locked(self, need: dict[str, float], pg=None) -> bool:
-        pool = self._pool_for(pg)
+    def _fits_pool(self, pool: dict[str, float],
+                   need: dict[str, float]) -> bool:
+        return all(pool.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+    def _alive_nodes(self) -> list[NodeRecord]:
+        return [n for n in self._nodes.values() if n.alive]
+
+    def _try_place_locked(self, need: dict[str, float],
+                          options: TaskOptions) -> tuple[str, int] | None:
+        """Pick (node, pg_bundle) for the request and ACQUIRE the
+        resources, or return None if nothing fits right now.
+
+        Policies (reference: scheduling/policy/*.cc):
+        - placement group: draw from the assigned bundle on its node
+        - NODE_AFFINITY: the named node (soft -> fall back to DEFAULT)
+        - SPREAD: round-robin over fitting nodes (spread_scheduling)
+        - DEFAULT: hybrid pack-then-spread — prefer the head node until
+          its utilization crosses the threshold, then best-fit spill
+          (hybrid_scheduling_policy.cc)
+        """
+        pg = options.placement_group
         if pg is not None:
             pg_rec = self._pgs.get(pg.id)
             if pg_rec is None or not pg_rec.created:
-                return False
-        return all(pool.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+                return None
+            if (options.placement_group_bundle_index
+                    >= len(pg_rec.bundles)):
+                raise PlacementError(
+                    f"placement_group_bundle_index="
+                    f"{options.placement_group_bundle_index} out of "
+                    f"range for a {len(pg_rec.bundles)}-bundle group")
+            idxs = ([options.placement_group_bundle_index]
+                    if options.placement_group_bundle_index >= 0
+                    else range(len(pg_rec.bundle_avail)))
+            for bi in idxs:
+                node = self._nodes.get(pg_rec.bundle_nodes[bi])
+                if node is None or not node.alive:
+                    continue
+                if self._fits_pool(pg_rec.bundle_avail[bi], need):
+                    for k, v in need.items():
+                        pg_rec.bundle_avail[bi][k] = (
+                            pg_rec.bundle_avail[bi].get(k, 0.0) - v)
+                    return pg_rec.bundle_nodes[bi], bi
+            return None
 
-    def _acquire_locked(self, need: dict[str, float], pg=None) -> None:
-        pool = self._pool_for(pg)
+        strategy = options.scheduling_strategy or "DEFAULT"
+        if strategy == "NODE_AFFINITY" and options.node_id:
+            node = self._nodes.get(options.node_id)
+            if node is not None and node.alive and self._fits_pool(
+                    node.avail, need):
+                self._take_from_node(node, need)
+                return node.node_id, -1
+            if not options.soft:
+                if node is None or not node.alive:
+                    # Fail fast: a hard affinity to a missing/dead node
+                    # can never be satisfied (reference behavior:
+                    # NodeAffinity infeasible -> task error).
+                    raise PlacementError(
+                        f"node {options.node_id!r} is "
+                        f"{'dead' if node is not None else 'unknown'} "
+                        f"and scheduling is not soft")
+                return None
+            # soft: fall through to DEFAULT below
+
+        candidates = [n for n in self._alive_nodes()
+                      if self._fits_pool(n.avail, need)
+                      and self._fits_pool(n.resources, need)]
+        if not candidates:
+            return None
+        if strategy == "SPREAD":
+            pick = candidates[next(self._rr_counter) % len(candidates)]
+        else:
+            # hybrid: pack onto head (or first nodes) while utilization
+            # is below threshold, else pick the least-loaded candidate.
+            thr = self.config.scheduler_spread_threshold
+            pick = None
+            for n in candidates:
+                cpu_total = n.resources.get("CPU", 0.0) or 1.0
+                util = 1.0 - n.avail.get("CPU", 0.0) / cpu_total
+                if util < thr:
+                    pick = n
+                    break
+            if pick is None:
+                pick = max(candidates,
+                           key=lambda n: n.avail.get("CPU", 0.0))
+        self._take_from_node(pick, need)
+        return pick.node_id, -1
+
+    def _take_from_node(self, node: NodeRecord,
+                        need: dict[str, float]) -> None:
         for k, v in need.items():
-            pool[k] = pool.get(k, 0.0) - v
+            node.avail[k] = node.avail.get(k, 0.0) - v
 
-    def acquire_resources(self, need: dict[str, float],
-                          timeout: float | None = None,
-                          pg=None) -> bool:
+    def acquire_on_some_node(self, need: dict[str, float],
+                             options: TaskOptions,
+                             timeout: float | None = None,
+                             ) -> tuple[str, int] | None:
+        """Blocking placement for actors/PGs; returns (node_id, bundle)
+        or None on timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._res_cv:
-            while not self._fits_locked(need, pg):
+            while True:
+                placed = self._try_place_locked(need, options)
+                if placed is not None:
+                    return placed
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
-                    return False
+                    return None
                 self._res_cv.wait(remaining)
-            self._acquire_locked(need, pg)
-            return True
 
-    def _release(self, resources: dict[str, float], pg=None) -> None:
+    def _release(self, resources: dict[str, float], pg=None,
+                 node_id: str = "", bundle: int = -1) -> None:
         with self._res_cv:
-            pool = self._pool_for(pg)
-            for k, v in resources.items():
-                pool[k] = pool.get(k, 0.0) + v
+            if pg is not None and bundle >= 0:
+                pg_rec = self._pgs.get(pg.id)
+                if (pg_rec is not None and pg_rec.created
+                        and bundle < len(pg_rec.bundle_nodes)
+                        and pg_rec.bundle_nodes[bundle] == (
+                            node_id or self.head_node_id)):
+                    pool = pg_rec.bundle_avail[bundle]
+                    for k, v in resources.items():
+                        pool[k] = pool.get(k, 0.0) + v
+                    self._res_cv.notify_all()
+                    return
+                # PG removed, or the bundle was re-homed after its node
+                # died (remove_node resets the new bundle to full
+                # capacity — crediting this release too would
+                # over-subscribe it): fall through to the node pool,
+                # which drops the release if that node is dead.
+            node = self._nodes.get(node_id or self.head_node_id)
+            if node is not None and node.alive:
+                for k, v in resources.items():
+                    node.avail[k] = node.avail.get(k, 0.0) + v
             self._res_cv.notify_all()
+
+    # -- node management (GCS node manager analog) ----------------------
+
+    def _add_node_locked_free(self, resources: dict[str, float],
+                              labels: dict[str, str] | None = None,
+                              is_head: bool = False) -> str:
+        node_id = f"node_{next(self._node_seq):04d}_{os.urandom(4).hex()}"
+        self._nodes[node_id] = NodeRecord(
+            node_id=node_id, resources=dict(resources),
+            avail=dict(resources), labels=dict(labels or {}),
+            is_head=is_head)
+        return node_id
+
+    def add_node(self, resources: dict[str, float],
+                 labels: dict[str, str] | None = None) -> str:
+        with self._res_cv:
+            node_id = self._add_node_locked_free(resources, labels)
+            self._res_cv.notify_all()
+        return node_id
+
+    def remove_node(self, node_id: str) -> None:
+        """Simulated node failure: mark dead, kill its worker
+        processes (their exits drive task retry / actor restart —
+        GcsNodeManager::OnNodeFailure analog, gcs_node_manager.cc:408)."""
+        with self._res_cv:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            node.avail = {}
+            self._res_cv.notify_all()
+        with self._pool_lock:
+            victims = [w for w in self._workers if w.node_id == node_id]
+        for w in victims:
+            try:
+                w.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        # Re-home placement-group bundles that lived on the dead node.
+        with self._res_cv:
+            for pg_rec in self._pgs.values():
+                if not pg_rec.created:
+                    continue
+                for bi, nid in enumerate(pg_rec.bundle_nodes):
+                    if nid != node_id:
+                        continue
+                    placed = self._try_place_locked(
+                        pg_rec.bundles[bi], TaskOptions(resources={}))
+                    if placed is not None:
+                        pg_rec.bundle_nodes[bi] = placed[0]
+                        pg_rec.bundle_avail[bi] = dict(
+                            pg_rec.bundles[bi])
 
     def _env_for_options(self, options: TaskOptions) -> tuple[str, dict]:
         env_vars: dict[str, str] = {}
@@ -684,15 +926,17 @@ class DriverRuntime:
             ser.dumps(sorted(env_vars.items()))).hexdigest()[:12]
         return key, env_vars
 
-    def _take_worker(self, env_key: str, env_vars: dict) -> WorkerHandle:
+    def _take_worker(self, env_key: str, env_vars: dict,
+                     node_id: str = "") -> WorkerHandle:
+        node_id = node_id or self.head_node_id
         with self._pool_lock:
-            pool = self._idle.get(env_key, [])
+            pool = self._idle.get((node_id, env_key), [])
             while pool:
                 w = pool.pop()
                 if not w.dead:
                     w.busy = True
                     return w
-            w = WorkerHandle(self, env_key, env_vars)
+            w = WorkerHandle(self, env_key, env_vars, node_id=node_id)
             w.busy = True
             self._workers.append(w)
             return w
@@ -703,7 +947,7 @@ class DriverRuntime:
         with self._pool_lock:
             w.busy = False
             w.last_idle = time.monotonic()
-            self._idle.setdefault(w.env_key, []).append(w)
+            self._idle.setdefault((w.node_id, w.env_key), []).append(w)
 
     def _reap_idle_workers(self) -> None:
         ttl = self.config.idle_worker_ttl_s
@@ -722,7 +966,7 @@ class DriverRuntime:
 
     def _dispatch(self, rec: TaskRecord) -> None:
         env_key, env_vars = self._env_for_options(rec.options)
-        w = self._take_worker(env_key, env_vars)
+        w = self._take_worker(env_key, env_vars, rec.node_id)
         rec.worker = w
         rec.worker_index = w.index
         rec.state = "RUNNING"
@@ -791,9 +1035,21 @@ class DriverRuntime:
         rec.finished_at = time.time()
         self._event(rec, rec.state)
         self._release(self._effective_resources(rec.options),
-                      rec.options.placement_group)
+                      rec.options.placement_group,
+                      node_id=rec.node_id, bundle=rec.pg_bundle)
         self._return_worker(w)
         self._prune_task(rec)
+
+    def _forget_worker(self, w: WorkerHandle) -> None:
+        """Drop a worker from the pools without task-failure handling
+        (used when it died before ever connecting; the task outcome is
+        handled by the dispatch retry path)."""
+        with self._pool_lock:
+            if w in self._workers:
+                self._workers.remove(w)
+            for pool in self._idle.values():
+                if w in pool:
+                    pool.remove(w)
 
     def _on_worker_exit(self, w: WorkerHandle) -> None:
         if self._shutdown:
@@ -819,7 +1075,8 @@ class DriverRuntime:
         if victim is None:
             return
         self._release(self._effective_resources(victim.options),
-                      victim.options.placement_group)
+                      victim.options.placement_group,
+                      node_id=victim.node_id, bundle=victim.pg_bundle)
         if victim.state == "CANCELLED":
             # cancel(force=True): error already stored; never retry.
             self._prune_task(victim)
@@ -883,17 +1140,18 @@ class DriverRuntime:
     def _start_actor(self, rec: ActorRecord) -> None:
         try:
             need = self._effective_resources(rec.options)
-            ok = self.acquire_resources(
-                need, timeout=self.config.actor_creation_timeout_s,
-                pg=rec.options.placement_group)
-            if not ok:
+            placed = self.acquire_on_some_node(
+                need, rec.options,
+                timeout=self.config.actor_creation_timeout_s)
+            if placed is None:
                 raise TimeoutError(
                     f"could not acquire resources {need} for actor "
                     f"{rec.cls_name} within "
                     f"{self.config.actor_creation_timeout_s}s")
+            rec.node_id, rec.pg_bundle = placed
             env_key, env_vars = self._env_for_options(rec.options)
             w = WorkerHandle(self, f"actor_{rec.actor_id.hex()[:8]}",
-                             env_vars)
+                             env_vars, node_id=rec.node_id)
             w.is_actor = True
             w.actor_id = rec.actor_id
             w.busy = True
@@ -1000,7 +1258,8 @@ class DriverRuntime:
                 self._store_error(oid, blob)
         rec.in_flight.clear()
         self._release(self._effective_resources(rec.options),
-                      rec.options.placement_group)
+                      rec.options.placement_group,
+                      node_id=rec.node_id, bundle=rec.pg_bundle)
         if (was_alive and rec.restart_count < rec.max_restarts
                 and not self._shutdown):
             # GCS actor restart state machine analog
@@ -1012,7 +1271,10 @@ class DriverRuntime:
                              daemon=True).start()
         else:
             rec.state = "DEAD"
-            rec.creation_error = err
+            # Keep the real __init__ traceback if the RESULT_ERR handler
+            # already recorded one; only fall back to the generic death
+            # error for a clean-state exit.
+            rec.creation_error = rec.creation_error or err
             rec.ready_event.set()
             with self._actor_lock:
                 if rec.name and self._named_actors.get(rec.name) == actor_id:
@@ -1059,19 +1321,78 @@ class DriverRuntime:
             self._pgs[pg_id] = rec
 
         def reserve():
+            # All-or-nothing bundle placement across nodes per strategy
+            # (2-phase-commit analog: assignment is computed and
+            # committed atomically under the resource lock —
+            # gcs_placement_group_scheduler.cc).
+            with self._res_cv:
+                while not self._shutdown:
+                    assignment = self._place_bundles_locked(
+                        bundles, strategy)
+                    if assignment is not None:
+                        for bi, node_id in enumerate(assignment):
+                            self._take_from_node(
+                                self._nodes[node_id], bundles[bi])
+                        rec.bundle_nodes = assignment
+                        rec.bundle_avail = [dict(b) for b in bundles]
+                        rec.created = True
+                        self._res_cv.notify_all()
+                        break
+                    self._res_cv.wait(0.5)
+            rec.ready.set()
+
+        threading.Thread(target=reserve, daemon=True).start()
+        return pg_id
+
+    def _place_bundles_locked(self, bundles: list[dict[str, float]],
+                              strategy: str) -> list[str] | None:
+        """Map every bundle to a node (or None if impossible now).
+
+        PACK / STRICT_PACK: all bundles on one node (STRICT_PACK fails
+        otherwise; PACK falls back to spreading). SPREAD /
+        STRICT_SPREAD: round-robin distinct-ish nodes (STRICT_SPREAD
+        requires pairwise-distinct nodes). Reference: bundle strategies
+        in gcs_placement_group_scheduler.cc.
+        """
+        nodes = self._alive_nodes()
+        if not nodes:
+            return None
+
+        def node_fits_all(n: NodeRecord) -> bool:
             total: dict[str, float] = {}
             for b in bundles:
                 for k, v in b.items():
                     total[k] = total.get(k, 0.0) + v
-            if self.acquire_resources(total, timeout=None):
-                with self._res_cv:
-                    rec.avail = dict(total)
-                    rec.created = True
-                    self._res_cv.notify_all()
-                rec.ready.set()
+            return self._fits_pool(n.avail, total)
 
-        threading.Thread(target=reserve, daemon=True).start()
-        return pg_id
+        if strategy in ("PACK", "STRICT_PACK"):
+            for n in nodes:
+                if node_fits_all(n):
+                    return [n.node_id] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+        # spread (and PACK fallback): greedy first-fit over a rotating
+        # node order, tracking tentative consumption.
+        tentative = {n.node_id: dict(n.avail) for n in nodes}
+        assignment: list[str] = []
+        used_nodes: set[str] = set()
+        for bi, b in enumerate(bundles):
+            placed_on = None
+            order = nodes[bi % len(nodes):] + nodes[:bi % len(nodes)]
+            for n in order:
+                if strategy == "STRICT_SPREAD" and n.node_id in used_nodes:
+                    continue
+                if self._fits_pool(tentative[n.node_id], b):
+                    placed_on = n.node_id
+                    break
+            if placed_on is None:
+                return None
+            for k, v in b.items():
+                tentative[placed_on][k] = (
+                    tentative[placed_on].get(k, 0.0) - v)
+            used_nodes.add(placed_on)
+            assignment.append(placed_on)
+        return assignment
 
     def pg_ready(self, pg_id: PlacementGroupID,
                  timeout: float | None = None) -> bool:
@@ -1084,10 +1405,12 @@ class DriverRuntime:
         with self._pg_lock:
             rec = self._pgs.pop(pg_id, None)
         if rec and rec.created:
-            # Return only the unclaimed share; resources held by still-
-            # running PG tasks flow back to the node pool when they
-            # finish (after removal, _pool_for resolves to the node).
-            self._release(rec.avail)
+            # Return only the unclaimed share of each bundle to its
+            # node; resources held by still-running PG tasks flow back
+            # to the node pool when they finish (after removal,
+            # _release falls through to the node).
+            for bi, pool in enumerate(rec.bundle_avail):
+                self._release(pool, node_id=rec.bundle_nodes[bi])
 
     # ---------------- cancellation ----------------
 
@@ -1118,19 +1441,37 @@ class DriverRuntime:
     # ---------------- introspection ----------------
 
     def available_resources(self) -> dict[str, float]:
+        out: dict[str, float] = {}
         with self._res_cv:
-            return dict(self.avail)
+            for n in self._alive_nodes():
+                for k, v in n.avail.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
 
     def cluster_resources(self) -> dict[str, float]:
-        return dict(self.total_resources)
+        out: dict[str, float] = {}
+        with self._res_cv:
+            for n in self._alive_nodes():
+                for k, v in n.resources.items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
 
     def nodes(self) -> list[dict]:
+        with self._res_cv:
+            recs = list(self._nodes.values())
+        with self._pool_lock:
+            per_node = {}
+            for w in self._workers:
+                per_node[w.node_id] = per_node.get(w.node_id, 0) + 1
         return [{
-            "NodeID": "local",
-            "Alive": True,
-            "Resources": dict(self.total_resources),
-            "alive_workers": len(self._workers),
-        }]
+            "NodeID": n.node_id,
+            "Alive": n.alive,
+            "IsHead": n.is_head,
+            "Resources": dict(n.resources),
+            "Available": dict(n.avail),
+            "Labels": dict(n.labels),
+            "alive_workers": per_node.get(n.node_id, 0),
+        } for n in recs]
 
     def _event(self, rec: TaskRecord, state: str) -> None:
         self._events.append({
@@ -1282,6 +1623,21 @@ class DriverRuntime:
             return None
         if op == P.OP_RESOURCES:
             return (self.available_resources(), self.cluster_resources())
+        if op == P.OP_STATE:
+            kind, filters = payload
+            from ray_tpu.util import state as state_api
+            fns = {
+                "tasks": state_api.list_tasks,
+                "actors": state_api.list_actors,
+                "objects": state_api.list_objects,
+                "nodes": state_api.list_nodes,
+                "placement_groups": state_api.list_placement_groups,
+            }
+            if kind == "summary":
+                return state_api.summarize_tasks()
+            if kind == "timeline":
+                return self.timeline()
+            return fns[kind](filters)
         if op == P.OP_PG_CREATE:
             bundles, strategy = payload
             return self.create_placement_group(bundles, strategy).binary()

@@ -1,0 +1,23 @@
+"""Lazy task DAGs + compiled execution (reference: python/ray/dag/)."""
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled_dag import CompiledDAG
+
+__all__ = [
+    "DAGNode",
+    "InputNode",
+    "InputAttributeNode",
+    "FunctionNode",
+    "ClassNode",
+    "ClassMethodNode",
+    "MultiOutputNode",
+    "CompiledDAG",
+]

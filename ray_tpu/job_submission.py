@@ -1,0 +1,185 @@
+"""Job submission: run shell entrypoints supervised by an actor.
+
+Reference: JobSubmissionClient (python/ray/dashboard/modules/job/
+sdk.py:35), the driver run by a JobSupervisor actor
+(job_supervisor.py:53) managed by JobManager (job_manager.py:58). Same
+shape here: ``submit_job`` creates a detached zero-CPU supervisor actor
+that forks the entrypoint, tails its output to a log buffer, and
+reports terminal status.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str
+    start_time: float
+    end_time: float | None = None
+    return_code: int | None = None
+    metadata: dict = field(default_factory=dict)
+
+
+class _JobSupervisor:
+    """Runs IN an actor process; forks the entrypoint and tails it."""
+
+    def __init__(self, entrypoint: str, env_vars: dict | None,
+                 working_dir: str | None):
+        import os
+        import subprocess
+        import threading
+        self.entrypoint = entrypoint
+        self.start_time = time.time()
+        self.end_time = None
+        self.return_code = None
+        self._stopped = False
+        self._log_chunks: list[str] = []
+        self._log_lock = threading.Lock()
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, env=env, cwd=working_dir,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        self._tail = threading.Thread(target=self._tail_loop,
+                                      daemon=True)
+        self._tail.start()
+
+    def _tail_loop(self):
+        for line in self._proc.stdout:
+            with self._log_lock:
+                self._log_chunks.append(line)
+        self._proc.wait()
+        self.return_code = self._proc.returncode
+        self.end_time = time.time()
+
+    def status(self) -> str:
+        if self._stopped:
+            return JobStatus.STOPPED
+        rc = self._proc.poll()
+        if rc is None:
+            return JobStatus.RUNNING
+        # let the tail thread publish return_code
+        return JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+
+    def info(self) -> dict:
+        return {
+            "status": self.status(),
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "return_code": self._proc.poll(),
+        }
+
+    def logs(self) -> str:
+        with self._log_lock:
+            return "".join(self._log_chunks)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(5)
+            except Exception:  # noqa: BLE001
+                self._proc.kill()
+
+
+class JobSubmissionClient:
+    """Submit/inspect/stop jobs against the local runtime."""
+
+    def __init__(self, address: str | None = None):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self._ray = ray_tpu
+        self._jobs: dict[str, tuple] = {}  # id -> (handle, JobInfo)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: str | None = None,
+                   runtime_env: dict | None = None,
+                   metadata: dict | None = None) -> str:
+        import ray_tpu
+        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if sid in self._jobs:
+            raise ValueError(f"submission_id {sid!r} already exists")
+        env_vars = (runtime_env or {}).get("env_vars")
+        working_dir = (runtime_env or {}).get("working_dir")
+        supervisor_cls = ray_tpu.remote(num_cpus=0)(_JobSupervisor)
+        handle = supervisor_cls.options(
+            name=f"_job_supervisor_{sid}").remote(
+                entrypoint, env_vars, working_dir)
+        info = JobInfo(submission_id=sid, entrypoint=entrypoint,
+                       status=JobStatus.PENDING,
+                       start_time=time.time(),
+                       metadata=dict(metadata or {}))
+        self._jobs[sid] = (handle, info)
+        return sid
+
+    def _handle(self, sid: str):
+        if sid not in self._jobs:
+            raise ValueError(f"unknown job {sid!r}")
+        return self._jobs[sid][0]
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._ray.get(
+            self._handle(submission_id).status.remote(), timeout=60)
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        handle, info = self._jobs[submission_id]
+        d = self._ray.get(handle.info.remote(), timeout=60)
+        info.status = d["status"]
+        info.end_time = d["end_time"]
+        info.return_code = d["return_code"]
+        return info
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._ray.get(
+            self._handle(submission_id).logs.remote(), timeout=60)
+
+    def stop_job(self, submission_id: str) -> bool:
+        self._ray.get(self._handle(submission_id).stop.remote(),
+                      timeout=60)
+        return True
+
+    def list_jobs(self) -> list[JobInfo]:
+        return [self.get_job_info(sid) for sid in list(self._jobs)]
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 600,
+                            poll_s: float = 0.5) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = self.get_job_status(submission_id)
+            if st in JobStatus.TERMINAL:
+                return st
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"job {submission_id} not finished after {timeout}s")
+
+    def delete_job(self, submission_id: str) -> bool:
+        handle, _ = self._jobs.pop(submission_id, (None, None))
+        if handle is not None:
+            try:
+                self._ray.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+
+__all__ = ["JobSubmissionClient", "JobStatus", "JobInfo"]

@@ -1,0 +1,199 @@
+"""``ray-tpu`` command line interface.
+
+Reference: python/ray/scripts/scripts.py (``ray status|list|job``...).
+The runtime is driver-embedded, so cluster commands attach to a live
+session's unix socket (``/tmp/ray_tpu_sessions/<pid>/runtime.sock``) using the
+same client protocol worker processes use; ``job submit`` starts a
+session and supervises the entrypoint.
+
+Usage:
+    python -m ray_tpu.scripts.cli status [--address PATH]
+    python -m ray_tpu.scripts.cli list {tasks,actors,nodes,objects,pgs}
+    python -m ray_tpu.scripts.cli summary
+    python -m ray_tpu.scripts.cli timeline --output trace.json
+    python -m ray_tpu.scripts.cli metrics
+    python -m ray_tpu.scripts.cli doctor
+    python -m ray_tpu.scripts.cli job submit -- python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import itertools
+import json
+import os
+import sys
+import threading
+from multiprocessing import connection as mpc
+
+
+def _discover_address(explicit: str | None) -> str:
+    if explicit:
+        return explicit
+    candidates = sorted(glob.glob("/tmp/ray_tpu_sessions/*/runtime.sock"),
+                        key=os.path.getmtime, reverse=True)
+    for path in candidates:
+        if os.path.exists(path):
+            return path
+    raise SystemExit(
+        "no live ray_tpu session found under /tmp/ray_tpu_sessions; pass "
+        "--address /path/to/runtime.sock")
+
+
+class _Client:
+    """Minimal state client over the worker client protocol."""
+
+    def __init__(self, address: str):
+        self._conn = mpc.Client(address, family="AF_UNIX")
+        self._conn.send(("hello", "client", ""))
+        self._req = itertools.count()
+        self._lock = threading.Lock()
+
+    def call(self, op: str, payload):
+        from ray_tpu.core import protocol as P
+        from ray_tpu.core import serialization as ser
+        req_id = next(self._req)
+        with self._lock:
+            self._conn.send((req_id, op, payload))
+            rid, status, result = self._conn.recv()
+        if status == P.ST_ERR:
+            raise ser.loads(result)
+        return result
+
+    def state(self, kind: str, filters=None):
+        from ray_tpu.core import protocol as P
+        return self.call(P.OP_STATE, (kind, filters))
+
+
+def _cmd_status(args) -> int:
+    from ray_tpu.core import protocol as P
+    c = _Client(_discover_address(args.address))
+    avail, total = c.call(P.OP_RESOURCES, None)
+    nodes = c.state("nodes")
+    print("== ray_tpu cluster status ==")
+    alive = [n for n in nodes if n["state"] == "ALIVE"]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    return 0
+
+
+def _cmd_list(args) -> int:
+    kind = {"pgs": "placement_groups"}.get(args.kind, args.kind)
+    c = _Client(_discover_address(args.address))
+    rows = c.state(kind)
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def _cmd_summary(args) -> int:
+    c = _Client(_discover_address(args.address))
+    print(json.dumps(c.state("summary"), indent=2, default=str))
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    c = _Client(_discover_address(args.address))
+    events = c.state("timeline")
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output} "
+          f"(chrome://tracing format)")
+    return 0
+
+
+def _cmd_metrics(args) -> int:
+    from ray_tpu.util.metrics import prometheus_text
+    sys.stdout.write(prometheus_text())
+    return 0
+
+
+def _cmd_doctor(args) -> int:
+    print("== ray_tpu doctor ==")
+    import ray_tpu
+    print(f"ray_tpu {ray_tpu.__version__}")
+    try:
+        import jax
+        print(f"jax {jax.__version__}; devices: "
+              f"{[str(d) for d in jax.devices()]}")
+    except Exception as e:  # noqa: BLE001
+        print(f"jax unavailable: {e}")
+    from ray_tpu.native.store import native_store_available
+    print(f"native C++ store: "
+          f"{'ok' if native_store_available() else 'UNAVAILABLE'}")
+    from ray_tpu.core.accelerator import detect_tpu_chips
+    print(f"tpu chips detected: {detect_tpu_chips()}")
+    return 0
+
+
+def _cmd_job_submit(args) -> int:
+    import ray_tpu
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    ray_tpu.init(ignore_reinit_error=True)
+    client = JobSubmissionClient()
+    entrypoint = " ".join(args.entrypoint)
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    sid = client.submit_job(entrypoint=entrypoint,
+                            runtime_env=runtime_env or None)
+    print(f"submitted job {sid}: {entrypoint!r}")
+    if args.no_wait:
+        return 0
+    status = client.wait_until_finished(sid, timeout=args.timeout)
+    sys.stdout.write(client.get_job_logs(sid))
+    print(f"job {sid} finished: {status}")
+    return 0 if status == JobStatus.SUCCEEDED else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("status", help="cluster resources + nodes")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=["tasks", "actors", "nodes",
+                                    "objects", "placement_groups",
+                                    "pgs"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_list)
+
+    p = sub.add_parser("summary", help="task summary by name/state")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_summary)
+
+    p = sub.add_parser("timeline", help="dump chrome trace")
+    p.add_argument("--output", "-o", default="timeline.json")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_timeline)
+
+    p = sub.add_parser("metrics", help="prometheus metrics dump")
+    p.set_defaults(fn=_cmd_metrics)
+
+    p = sub.add_parser("doctor", help="environment checks")
+    p.set_defaults(fn=_cmd_doctor)
+
+    pjob = sub.add_parser("job", help="job submission")
+    jsub = pjob.add_subparsers(dest="jobcmd", required=True)
+    p = jsub.add_parser("submit")
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--no-wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command after --")
+    p.set_defaults(fn=_cmd_job_submit)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "entrypoint", None):
+        # strip a leading "--" separator
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,7 +44,7 @@ class CheckpointConfig:
 @dataclass
 class RunConfig:
     name: str = ""
-    storage_path: str = "/tmp/ray_tpu/experiments"
+    storage_path: str = "/tmp/ray_tpu_sessions/experiments"
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)

@@ -1,0 +1,1 @@
+"""Utility APIs (reference: python/ray/util/)."""

@@ -1,0 +1,170 @@
+"""User-facing metrics API.
+
+Reference: ray.util.metrics Counter/Gauge/Histogram
+(python/ray/util/metrics.py:137,187,262) flowing into the per-node
+metrics agent and a Prometheus exporter (SURVEY.md §5.5). Here the
+registry is process-local and aggregated by the driver on scrape; the
+text exposition format is Prometheus-compatible so the same dashboards
+work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "Metric"] = {}
+
+
+def _tag_key(tags: dict[str, str] | None) -> tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base: named, tagged, thread-safe."""
+
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple = ()):
+        if not name or not name.replace("_", "").isalnum():
+            raise ValueError(f"invalid metric name: {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict[str, str] = {}
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+        with _registry_lock:
+            prev = _registry.get(name)
+            if prev is not None and prev.TYPE != self.TYPE:
+                raise ValueError(
+                    f"metric {name!r} already registered with type "
+                    f"{prev.TYPE}")
+            _registry[name] = self
+
+    def set_default_tags(self, tags: dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: dict[str, str] | None) -> dict[str, str]:
+        out = dict(self._default_tags)
+        if tags:
+            out.update(tags)
+        return out
+
+    def collect(self) -> list[tuple[dict[str, str], float]]:
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: dict[str, str] | None = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] += value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float,
+            tags: dict[str, str] | None = None) -> None:
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: list[float] | None = None,
+                 tag_keys: tuple = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100])
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float,
+                tags: dict[str, str] | None = None) -> None:
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            buckets = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def collect(self) -> list[tuple[dict[str, str], float]]:
+        with self._lock:
+            return [(dict(k), self._sums[k]) for k in self._counts]
+
+    def collect_histogram(self):
+        with self._lock:
+            return {k: (list(v), self._sums[k], self._totals[k])
+                    for k, v in self._counts.items()}
+
+
+def collect_all() -> dict[str, "Metric"]:
+    with _registry_lock:
+        return dict(_registry)
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format of every registered metric
+    (reference: prometheus_exporter.py)."""
+    lines: list[str] = []
+    for name, m in sorted(collect_all().items()):
+        if m.description:
+            lines.append(f"# HELP {name} {m.description}")
+        lines.append(f"# TYPE {name} {m.TYPE}")
+        if isinstance(m, Histogram):
+            for key, (buckets, total_sum, n) in (
+                    m.collect_histogram().items()):
+                base = dict(key)
+                cum = 0
+                for i, b in enumerate(m.boundaries):
+                    cum += buckets[i]
+                    tag_str = _fmt_tags({**base, "le": str(b)})
+                    lines.append(f"{name}_bucket{tag_str} {cum}")
+                cum += buckets[-1]
+                tag_str = _fmt_tags({**base, "le": "+Inf"})
+                lines.append(f"{name}_bucket{tag_str} {cum}")
+                lines.append(f"{name}_sum{_fmt_tags(base)} {total_sum}")
+                lines.append(f"{name}_count{_fmt_tags(base)} {n}")
+        else:
+            for tags, v in m.collect():
+                lines.append(f"{name}{_fmt_tags(tags)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_tags(tags: dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def reset_registry() -> None:
+    """Test hook."""
+    with _registry_lock:
+        _registry.clear()
+
+
+__all__ = ["Counter", "Gauge", "Histogram", "prometheus_text",
+           "collect_all", "reset_registry"]

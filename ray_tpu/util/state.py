@@ -1,0 +1,155 @@
+"""Observability / state API.
+
+Reference: ``ray.util.state`` (python/ray/util/state/api.py) — the
+``ray list tasks|actors|nodes|objects|placement-groups`` surface,
+backed by GCS tables + per-worker task events (SURVEY.md §5.5). Here
+the driver runtime IS the control plane, so listing reads its tables
+directly; the dict schemas mirror the reference's state objects.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _rt():
+    from ray_tpu.core.api import get_runtime
+    return get_runtime()
+
+
+def _match(row: dict, filters) -> bool:
+    for f in filters or ():
+        key, op, want = f
+        have = row.get(key)
+        if op in ("=", "=="):
+            if str(have) != str(want):
+                return False
+        elif op == "!=":
+            if str(have) == str(want):
+                return False
+        else:
+            raise ValueError(f"unsupported filter op: {op}")
+    return True
+
+
+def list_tasks(filters=None, limit: int = 10_000) -> list[dict]:
+    rt = _rt()
+    with rt._task_lock:
+        recs = list(rt._done_tasks) + list(rt._tasks.values())
+    out = []
+    for rec in recs:
+        row = {
+            "task_id": rec.task_id.hex(),
+            "name": rec.name,
+            "state": rec.state,
+            "node_id": rec.node_id,
+            "attempts": rec.attempts,
+            "worker_index": rec.worker_index,
+            "submitted_at": rec.submitted_at,
+            "started_at": rec.started_at,
+            "finished_at": rec.finished_at,
+            "required_resources": dict(rec.options.resources or {}),
+        }
+        if _match(row, filters):
+            out.append(row)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_actors(filters=None, limit: int = 10_000) -> list[dict]:
+    rt = _rt()
+    with rt._actor_lock:
+        recs = list(rt._actors.values())
+    out = []
+    for rec in recs:
+        row = {
+            "actor_id": rec.actor_id.hex(),
+            "class_name": rec.cls_name,
+            "name": rec.name,
+            "state": rec.state,
+            "node_id": rec.node_id,
+            "restart_count": rec.restart_count,
+            "max_restarts": rec.max_restarts,
+            "pid": (rec.worker.proc.pid
+                    if rec.worker is not None else None),
+        }
+        if _match(row, filters):
+            out.append(row)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_objects(filters=None, limit: int = 10_000) -> list[dict]:
+    rt = _rt()
+    with rt._obj_cv:
+        locs = dict(rt._obj_locations)
+    out = []
+    for oid, loc in locs.items():
+        row = {
+            "object_id": oid.hex(),
+            "location": loc,            # mem | shm | err
+            "reference_count": rt._refcounts.get(oid, 0),
+        }
+        if _match(row, filters):
+            out.append(row)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_nodes(filters=None, limit: int = 10_000) -> list[dict]:
+    import ray_tpu
+    out = []
+    for n in ray_tpu.nodes():
+        row = {
+            "node_id": n["NodeID"],
+            "state": "ALIVE" if n["Alive"] else "DEAD",
+            "is_head_node": n.get("IsHead", False),
+            "resources_total": n["Resources"],
+            "labels": n.get("Labels", {}),
+        }
+        if _match(row, filters):
+            out.append(row)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def list_placement_groups(filters=None, limit: int = 10_000
+                          ) -> list[dict]:
+    rt = _rt()
+    with rt._pg_lock:
+        recs = list(rt._pgs.values())
+    out = []
+    for rec in recs:
+        row = {
+            "placement_group_id": rec.pg_id.hex(),
+            "state": "CREATED" if rec.created else "PENDING",
+            "strategy": rec.strategy,
+            "bundles": [dict(b) for b in rec.bundles],
+            "bundle_nodes": list(rec.bundle_nodes),
+        }
+        if _match(row, filters):
+            out.append(row)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def summarize_tasks() -> dict[str, Any]:
+    """Counts by (name, state) — reference: ray summary tasks."""
+    summary: dict[str, dict[str, int]] = {}
+    for row in list_tasks():
+        by_state = summary.setdefault(
+            row["name"], {"FINISHED": 0, "FAILED": 0, "RUNNING": 0,
+                          "PENDING": 0, "CANCELLED": 0})
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return {"node_count": len(list_nodes()), "tasks": summary}
+
+
+__all__ = [
+    "list_tasks", "list_actors", "list_objects", "list_nodes",
+    "list_placement_groups", "summarize_tasks",
+]

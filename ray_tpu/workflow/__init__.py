@@ -1,0 +1,19 @@
+"""Durable workflows (reference: python/ray/workflow/)."""
+
+from ray_tpu.workflow.api import (
+    cancel,
+    get_metadata,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+from ray_tpu.workflow.common import WorkflowStatus
+
+__all__ = [
+    "init", "run", "run_async", "resume", "get_output", "get_status",
+    "get_metadata", "list_all", "cancel", "WorkflowStatus",
+]

@@ -1,0 +1,261 @@
+"""Workflow execution API.
+
+Reference: python/ray/workflow/api.py + workflow_executor.py — a DAG
+(built with the same ``.bind()`` API as ray_tpu.dag) is executed with
+**step-level durable logging**: every step's result is persisted before
+the workflow advances, so a crashed/failed run resumes from the last
+completed step (``resume``). The DAG itself is pickled into workflow
+metadata so ``resume(workflow_id)`` needs nothing but the id.
+
+Steps run as regular ray_tpu tasks, so independent branches execute in
+parallel; persistence happens as results arrive (fan-in barrier per
+step, not per workflow).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any
+
+from ray_tpu.core import serialization as ser
+from ray_tpu.dag.dag_node import (
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+    _DAGInputData,
+)
+from ray_tpu.workflow import storage as wf_storage
+from ray_tpu.workflow.common import WorkflowStatus
+
+_running: dict[str, threading.Thread] = {}
+_results: dict[str, Any] = {}
+_cancel_flags: dict[str, threading.Event] = {}
+_lock = threading.Lock()
+
+
+def init(storage: str | None = None) -> None:
+    """Set the durable storage root (reference: workflow.init)."""
+    if storage:
+        wf_storage.set_root(storage)
+
+
+def _step_keys(order: list[DAGNode]) -> dict[int, str]:
+    keys: dict[int, str] = {}
+    for i, n in enumerate(order):
+        if isinstance(n, FunctionNode):
+            name = n._remote_fn.underlying_function.__name__
+        else:
+            name = type(n).__name__
+        keys[id(n)] = f"{i:04d}_{name}"
+    return keys
+
+
+def _validate(order: list[DAGNode]) -> None:
+    for n in order:
+        if not isinstance(n, (FunctionNode, InputNode,
+                              InputAttributeNode, MultiOutputNode)):
+            raise TypeError(
+                f"workflows support function DAGs only; got "
+                f"{type(n).__name__} (actor steps are not durable)")
+
+
+def _execute(dag: DAGNode, store: wf_storage.WorkflowStorage,
+             input_val: Any, cancel: threading.Event) -> Any:
+    import ray_tpu
+    from ray_tpu.core.object_ref import ObjectRef
+    order = dag.topological_order()
+    _validate(order)
+    keys = _step_keys(order)
+    # node id -> concrete value OR pending ObjectRef. Independent
+    # branches run in parallel: fresh steps are submitted as tasks
+    # with upstream ObjectRefs as args (the runtime resolves them),
+    # then a second pass persists each result as it completes.
+    vals: dict[int, Any] = {}
+
+    def resolve_nested(obj):
+        """Resolve a nested container arg to concrete values (nested
+        refs would reach the task unresolved, so block on them)."""
+        if isinstance(obj, DAGNode):
+            v = vals[id(obj)]
+            return ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(resolve_nested(v) for v in obj)
+        if isinstance(obj, dict):
+            return {k: resolve_nested(v) for k, v in obj.items()}
+        return obj
+
+    def resolve_top(obj):
+        if isinstance(obj, DAGNode):
+            return vals[id(obj)]       # value or ref; both fine as args
+        return resolve_nested(obj)
+
+    # Pass 1: submit every non-cached step (refs flow as task args).
+    for n in order:
+        if cancel.is_set():
+            raise _Canceled()
+        if isinstance(n, InputNode):
+            vals[id(n)] = input_val
+        elif isinstance(n, InputAttributeNode):
+            base = vals[id(n._bound_args[0])]
+            if isinstance(base, _DAGInputData):
+                vals[id(n)] = base.pick(n._key)
+            elif isinstance(n._key, int):
+                vals[id(n)] = base[n._key]
+            else:
+                vals[id(n)] = (base[n._key] if isinstance(base, dict)
+                               else getattr(base, n._key))
+        elif isinstance(n, MultiOutputNode):
+            pass  # resolved in pass 2
+        elif store.has_step(keys[id(n)]):
+            vals[id(n)] = store.load_step(keys[id(n)])
+        else:
+            args = tuple(resolve_top(a) for a in n._bound_args)
+            kwargs = {k: resolve_top(v)
+                      for k, v in n._bound_kwargs.items()}
+            vals[id(n)] = n._remote_fn.remote(*args, **kwargs)
+
+    # Pass 2: persist results in topo order — every step completed
+    # before a failure is durably logged, so resume() skips it.
+    for n in order:
+        if cancel.is_set():
+            raise _Canceled()
+        if isinstance(n, MultiOutputNode):
+            vals[id(n)] = [
+                ray_tpu.get(vals[id(c)])
+                if isinstance(vals[id(c)], ObjectRef) else vals[id(c)]
+                for c in n._bound_args]
+        elif isinstance(vals.get(id(n)), ObjectRef):
+            value = ray_tpu.get(vals[id(n)])
+            store.save_step(keys[id(n)], value)
+            vals[id(n)] = value
+    return vals[id(order[-1])]
+
+
+class _Canceled(Exception):
+    pass
+
+
+def _run_thread(workflow_id: str, dag: DAGNode, input_val: Any) -> None:
+    store = wf_storage.WorkflowStorage(workflow_id)
+    cancel = _cancel_flags[workflow_id]
+    meta = store.load_meta() or {}
+    try:
+        result = _execute(dag, store, input_val, cancel)
+        with _lock:
+            _results[workflow_id] = ("ok", result)
+        meta["status"] = WorkflowStatus.SUCCESSFUL
+        meta["end_time"] = time.time()
+        store.save_meta(meta)
+    except _Canceled:
+        with _lock:
+            _results[workflow_id] = ("canceled", None)
+        meta["status"] = WorkflowStatus.CANCELED
+        store.save_meta(meta)
+    except BaseException as e:  # noqa: BLE001
+        with _lock:
+            _results[workflow_id] = ("err", e)
+        meta["status"] = WorkflowStatus.FAILED
+        meta["error"] = repr(e)
+        store.save_meta(meta)
+
+
+def run_async(dag: DAGNode, *, workflow_id: str | None = None,
+              args: Any = None) -> str:
+    """Start a workflow; returns its id immediately."""
+    workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
+    store = wf_storage.WorkflowStorage(workflow_id)
+    store.save_meta({
+        "workflow_id": workflow_id,
+        "status": WorkflowStatus.RUNNING,
+        "start_time": time.time(),
+        "dag_blob": ser.dumps((dag, args)).hex(),
+    })
+    with _lock:
+        _cancel_flags[workflow_id] = threading.Event()
+        t = threading.Thread(target=_run_thread,
+                             args=(workflow_id, dag, args),
+                             daemon=True,
+                             name=f"workflow_{workflow_id[:16]}")
+        _running[workflow_id] = t
+    t.start()
+    return workflow_id
+
+
+def run(dag: DAGNode, *, workflow_id: str | None = None,
+        args: Any = None, timeout: float | None = None) -> Any:
+    wid = run_async(dag, workflow_id=workflow_id, args=args)
+    return get_output(wid, timeout=timeout)
+
+
+def get_output(workflow_id: str, timeout: float | None = None) -> Any:
+    t = _running.get(workflow_id)
+    if t is None:
+        raise ValueError(f"workflow {workflow_id!r} is not running "
+                         f"in this process; use resume()")
+    t.join(timeout)
+    if t.is_alive():
+        raise TimeoutError(f"workflow {workflow_id} still running")
+    kind, payload = _results[workflow_id]
+    if kind == "ok":
+        return payload
+    if kind == "canceled":
+        raise RuntimeError(f"workflow {workflow_id} was canceled")
+    raise payload
+
+
+def resume(workflow_id: str, timeout: float | None = None) -> Any:
+    """Re-run from durable state: completed steps load from storage,
+    the rest re-execute (reference: workflow.resume)."""
+    store = wf_storage.WorkflowStorage(workflow_id)
+    meta = store.load_meta()
+    if meta is None:
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    dag, args = ser.loads(bytes.fromhex(meta["dag_blob"]))
+    meta["status"] = WorkflowStatus.RUNNING
+    store.save_meta(meta)
+    with _lock:
+        _cancel_flags[workflow_id] = threading.Event()
+        t = threading.Thread(target=_run_thread,
+                             args=(workflow_id, dag, args),
+                             daemon=True)
+        _running[workflow_id] = t
+    t.start()
+    return get_output(workflow_id, timeout=timeout)
+
+
+def get_status(workflow_id: str) -> str:
+    meta = wf_storage.WorkflowStorage(workflow_id).load_meta()
+    if meta is None:
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    return meta["status"]
+
+
+def get_metadata(workflow_id: str) -> dict:
+    meta = wf_storage.WorkflowStorage(workflow_id).load_meta()
+    if meta is None:
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    return {k: v for k, v in meta.items() if k != "dag_blob"}
+
+
+def list_all() -> list[tuple[str, str]]:
+    out = []
+    for wid in wf_storage.list_workflows():
+        meta = wf_storage.WorkflowStorage(wid).load_meta()
+        if meta:
+            out.append((wid, meta.get("status", "UNKNOWN")))
+    return out
+
+
+def cancel(workflow_id: str) -> None:
+    flag = _cancel_flags.get(workflow_id)
+    if flag is not None:
+        flag.set()
+    store = wf_storage.WorkflowStorage(workflow_id)
+    meta = store.load_meta()
+    if meta is not None:
+        meta["status"] = WorkflowStatus.CANCELED
+        store.save_meta(meta)

@@ -1,0 +1,12 @@
+"""Workflow shared types (reference: python/ray/workflow/common.py)."""
+
+from __future__ import annotations
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+    CANCELED = "CANCELED"
+    PENDING = "PENDING"

@@ -1,0 +1,186 @@
+"""Multi-node cluster simulation tests.
+
+Reference analogs: python/ray/tests/test_multi_node*.py,
+test_scheduling.py, test_chaos.py — all runnable on one host because a
+"node" is a logical resource pool with its own worker processes
+(SURVEY.md §4.2).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def _node_of_task():
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+    return where
+
+
+def test_add_node_grows_cluster_resources(cluster):
+    base = ray_tpu.cluster_resources()["CPU"]
+    cluster.add_node(num_cpus=3)
+    assert ray_tpu.cluster_resources()["CPU"] == base + 3
+
+
+def test_spillback_to_second_node(cluster):
+    """Tasks exceeding the head's capacity spill to the added node."""
+    n2 = cluster.add_node(num_cpus=2)
+    where = _node_of_task()
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold_and_where(t):
+        time.sleep(t)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    refs = [hold_and_where.remote(1.0) for _ in range(4)]
+    homes = set(ray_tpu.get(refs, timeout=120))
+    assert n2.node_id in homes  # at least one spilled
+    assert len(homes) == 2
+
+
+def test_node_affinity_strict(cluster):
+    n2 = cluster.add_node(num_cpus=2)
+    where = _node_of_task()
+    ref = where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id)).remote()
+    assert ray_tpu.get(ref, timeout=60) == n2.node_id
+
+
+def test_node_affinity_soft_falls_back(cluster):
+    where = _node_of_task()
+    ref = where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            "node_does_not_exist", soft=True)).remote()
+    # Falls back to any live node instead of hanging.
+    assert ray_tpu.get(ref, timeout=60)
+
+
+def test_spread_strategy_uses_both_nodes(cluster):
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def where_slow():
+        time.sleep(0.5)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    homes = set(ray_tpu.get([where_slow.remote() for _ in range(4)],
+                            timeout=120))
+    assert len(homes) == 2
+
+
+def test_custom_resource_on_added_node(cluster):
+    cluster.add_node(num_cpus=1, resources={"accel": 2})
+
+    @ray_tpu.remote(num_cpus=1, resources={"accel": 1})
+    def needs_accel():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    assert ray_tpu.get(needs_accel.remote(), timeout=60)
+
+
+def test_node_failure_retries_task_elsewhere(cluster):
+    """Kill the node mid-task: the task retries on a surviving node
+    (lineage-style re-execution, task_manager.cc retries)."""
+    n2 = cluster.add_node(num_cpus=2)
+    where = _node_of_task()
+    # Pin a long task to n2, then kill n2.
+    started = ray_tpu.put(0)  # noqa: F841 (keep store warm)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def slow_where():
+        time.sleep(2.0)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    ref = slow_where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=True)).remote()
+    time.sleep(0.8)  # let it start on n2
+    cluster.remove_node(n2)
+    # Retry lands on the head node.
+    out = ray_tpu.get(ref, timeout=120)
+    assert out == cluster.head_node.node_id
+
+
+def test_actor_restarts_on_surviving_node(cluster):
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1, max_restarts=2)
+    class Pinger:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Pinger.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=True)).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == n2.node_id
+    cluster.remove_node(n2)
+    deadline = time.time() + 60
+    home = None
+    while time.time() < deadline:
+        try:
+            home = ray_tpu.get(a.node.remote(), timeout=30)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(0.5)
+    assert home == cluster.head_node.node_id
+
+
+def test_dead_node_not_in_available_resources(cluster):
+    n2 = cluster.add_node(num_cpus=8)
+    assert ray_tpu.cluster_resources()["CPU"] >= 10
+    cluster.remove_node(n2)
+    assert ray_tpu.cluster_resources()["CPU"] == 2
+    node_table = {n["NodeID"]: n for n in ray_tpu.nodes()}
+    assert not node_table[n2.node_id]["Alive"]
+
+
+def test_strict_spread_pg_across_nodes(cluster):
+    cluster.add_node(num_cpus=2)
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    homes = ray_tpu.get([
+        where.options(placement_group=pg,
+                      placement_group_bundle_index=i).remote()
+        for i in range(2)], timeout=120)
+    assert homes[0] != homes[1]
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_strict_pack_pg_single_node(cluster):
+    cluster.add_node(num_cpus=2)
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=60)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    homes = ray_tpu.get([
+        where.options(placement_group=pg,
+                      placement_group_bundle_index=i).remote()
+        for i in range(2)], timeout=120)
+    assert homes[0] == homes[1]
+    ray_tpu.remove_placement_group(pg)

@@ -1,0 +1,162 @@
+"""State API / metrics / job submission / CLI tests.
+
+Reference analogs: python/ray/tests/test_state_api.py,
+test_metrics_agent.py, dashboard/modules/job/tests.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state as state_api
+from ray_tpu.util.metrics import (
+    Counter, Gauge, Histogram, prometheus_text, reset_registry,
+)
+
+
+# ---------------- state API ----------------
+
+def test_list_tasks_and_summary(rt):
+    @ray_tpu.remote
+    def work(x):
+        return x
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    rows = state_api.list_tasks()
+    finished = [r for r in rows if r["state"] == "FINISHED"]
+    assert len(finished) >= 3
+    assert all(r["name"] == "work" for r in finished)
+
+    s = state_api.summarize_tasks()
+    assert s["tasks"]["work"]["FINISHED"] >= 3
+    assert s["node_count"] == 1
+
+
+def test_list_actors_filters(rt):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    rows = state_api.list_actors(filters=[("state", "=", "ALIVE")])
+    assert any(r["class_name"] == "A" for r in rows)
+    assert all(r["state"] == "ALIVE" for r in rows)
+
+
+def test_list_nodes_and_objects(rt):
+    ref = ray_tpu.put(list(range(100)))
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    objs = state_api.list_objects()
+    assert any(o["object_id"] == ref.id.hex() for o in objs)
+
+
+# ---------------- metrics ----------------
+
+def test_counter_gauge_histogram():
+    reset_registry()
+    c = Counter("requests_total", "total requests", ("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("queue_depth", "depth")
+    g.set(7)
+    h = Histogram("latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = prometheus_text()
+    assert 'requests_total{route="/a"} 3' in text
+    assert "queue_depth 7" in text
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    assert "latency_s_count 3" in text
+    reset_registry()
+
+
+def test_counter_rejects_negative():
+    reset_registry()
+    c = Counter("neg_test", "")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    reset_registry()
+
+
+# ---------------- job submission ----------------
+
+def test_job_submit_success(rt):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job ran ok')\"")
+    status = client.wait_until_finished(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(sid)
+
+
+def test_job_submit_failure_status(rt):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
+    status = client.wait_until_finished(sid, timeout=120)
+    assert status == JobStatus.FAILED
+    assert client.get_job_info(sid).return_code == 3
+
+
+def test_job_stop(rt):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    deadline = time.time() + 60
+    while (client.get_job_status(sid) != JobStatus.RUNNING
+           and time.time() < deadline):
+        time.sleep(0.2)
+    client.stop_job(sid)
+    status = client.wait_until_finished(sid, timeout=60)
+    assert status == JobStatus.STOPPED
+
+
+# ---------------- CLI ----------------
+
+def test_cli_status_and_list_against_live_session(rt):
+    @ray_tpu.remote
+    def touch():
+        return 1
+
+    ray_tpu.get(touch.remote())
+    rt_obj = ray_tpu.core.api.get_runtime()
+    addr = rt_obj.client_address
+    env = {"PYTHONPATH": ":".join(sys.path), "PATH": "/usr/bin:/bin",
+           "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "status",
+         "--address", addr],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "nodes: 1 alive" in out.stdout
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "list", "tasks",
+         "--address", addr],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    assert any(r["name"] == "touch" for r in rows)
+
+
+def test_cli_doctor_runs():
+    env = {"PYTHONPATH": ":".join(sys.path), "PATH": "/usr/bin:/bin",
+           "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "doctor"],
+        capture_output=True, text=True, env=env, timeout=180)
+    assert out.returncode == 0, out.stderr
+    assert "ray_tpu" in out.stdout

@@ -1,0 +1,105 @@
+"""Workflow tests (reference analog: python/ray/workflow/tests/)."""
+
+import os
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+from ray_tpu.workflow.common import WorkflowStatus
+
+
+@pytest.fixture
+def wf_store(tmp_path):
+    workflow.init(str(tmp_path))
+    yield str(tmp_path)
+
+
+def test_workflow_run_simple(rt, wf_store):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 1)
+
+    assert workflow.run(dag, args=5, timeout=120) == 11
+
+
+def test_workflow_status_and_metadata(rt, wf_store):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    wid = "wf_status_test"
+    assert workflow.run(one.bind(), workflow_id=wid, timeout=120) == 1
+    assert workflow.get_status(wid) == WorkflowStatus.SUCCESSFUL
+    meta = workflow.get_metadata(wid)
+    assert meta["workflow_id"] == wid
+    assert "dag_blob" not in meta
+    assert (wid, WorkflowStatus.SUCCESSFUL) in workflow.list_all()
+
+
+def test_workflow_failure_then_resume_skips_done_steps(rt, wf_store):
+    """A failing step marks the workflow FAILED; resume() re-runs only
+    the missing steps — completed ones load from durable storage."""
+    marker_dir = tempfile.mkdtemp()
+    count_a = os.path.join(marker_dir, "a_runs")
+    gate = os.path.join(marker_dir, "gate")
+
+    @ray_tpu.remote
+    def step_a():
+        with open(count_a, "a") as f:
+            f.write("x")
+        return 10
+
+    @ray_tpu.remote
+    def step_b(x):
+        if not os.path.exists(gate):
+            raise RuntimeError("transient failure")
+        return x + 5
+
+    dag = step_b.bind(step_a.bind())
+    wid = "wf_resume_test"
+    with pytest.raises(ray_tpu.TaskError, match="transient failure"):
+        workflow.run(dag, workflow_id=wid, timeout=120)
+    assert workflow.get_status(wid) == WorkflowStatus.FAILED
+    with open(count_a) as f:
+        assert f.read() == "x"  # step_a ran once
+
+    open(gate, "w").close()   # heal the failure
+    assert workflow.resume(wid, timeout=120) == 15
+    assert workflow.get_status(wid) == WorkflowStatus.SUCCESSFUL
+    with open(count_a) as f:
+        assert f.read() == "x"  # step_a did NOT re-run
+
+
+def test_workflow_parallel_branches(rt, wf_store):
+    @ray_tpu.remote
+    def leaf(x):
+        return x * x
+
+    @ray_tpu.remote
+    def gather(*xs):
+        return sum(xs)
+
+    dag = gather.bind(*[leaf.bind(i) for i in range(4)])
+    assert workflow.run(dag, timeout=120) == 0 + 1 + 4 + 9
+
+
+def test_workflow_rejects_actor_steps(rt, wf_store):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    dag = a.m.bind()
+    with pytest.raises(TypeError, match="function DAGs only"):
+        workflow.run(dag, timeout=60)
