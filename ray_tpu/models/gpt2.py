@@ -6,9 +6,10 @@ tokens/sec/chip). TPU-first choices:
 - bfloat16 compute / float32 params (MXU-native).
 - param names line up with ``parallel.sharding.DEFAULT_PARAM_PATTERNS``
   so dp/fsdp/tp sharding is a table lookup, no per-model plumbing.
-- attention is pluggable: dense (``jax.nn.dot_product_attention`` — XLA
-  fuses to the TPU attention kernel) or ring attention over an ``sp``
-  mesh axis for long context (SURVEY.md §5.7 — capability the
+- attention is pluggable: dense (Pallas flash kernel on single-device
+  TPU, shard_map-wrapped per-device flash on a mesh, XLA
+  ``dot_product_attention`` elsewhere) or ring attention over an
+  ``sp`` mesh axis for long context (SURVEY.md §5.7 — capability the
   reference lacks natively).
 - activations carry logical sharding constraints ("batch", "seq") so
   pjit propagates the intended layout instead of guessing.
@@ -143,16 +144,18 @@ class GPT2(nn.Module):
 
     def _attn_fn(self) -> Callable:
         cfg = self.config
-        if cfg.attn_impl == "ring" and self.mesh is not None \
-                and self.mesh.shape.get(cfg.sp_axis, 1) > 1:
+        if self.mesh is not None and any(
+                self.mesh.shape.get(a, 1) > 1
+                for a in ("dp", "fsdp", "tp", cfg.sp_axis)):
+            # Mesh-sharded activations: shard_map-wrapped attention
+            # (ring over sp when that axis is real, else per-device
+            # local blocks — required for the Pallas kernel, which has
+            # no SPMD partitioning rule of its own).
             from ray_tpu.ops.attention import (
                 make_sharded_causal_attention,
             )
             return make_sharded_causal_attention(
                 self.mesh, seq_axis=cfg.sp_axis)
-        if cfg.attn_impl == "ring":
-            # single sp shard degenerates to dense
-            return causal_attention
         return causal_attention
 
     def _constrain(self, x):
@@ -188,11 +191,14 @@ class GPT2(nn.Module):
             x = self._constrain(x)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f", dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype)(x)
-        # Tied LM head: logits in float32 for a stable softmax (explicit
-        # einsum — wte.attend would cast back to the module's bf16).
+        # Tied LM head: bf16 operands into the MXU, f32 accumulation
+        # and f32 logits out. Operands are rounded to bf16 (small
+        # precision trade, ~2^-8 relative) — accepted for full MXU
+        # rate; only the accumulation is fp32.
         logits = jnp.einsum(
-            "bte,ve->btv", x.astype(jnp.float32),
-            wte.embedding.astype(jnp.float32))
+            "bte,ve->btv", x.astype(self.config.dtype),
+            wte.embedding.astype(self.config.dtype),
+            preferred_element_type=jnp.float32)
         return logits
 
     def init_params(self, rng, batch_size: int = 2):

@@ -1,7 +1,9 @@
 """Attention ops.
 
-- ``causal_attention``: dense causal attention; delegates to
-  ``jax.nn.dot_product_attention`` so XLA picks the fused TPU path.
+- ``causal_attention``: dense causal attention. On single-device TPU
+  with flash-blockable shapes it dispatches to the Pallas flash kernel
+  (ops/pallas/flash_attention.py); otherwise
+  ``jax.nn.dot_product_attention`` (XLA fused path).
 - ``ring_attention``: sequence-parallel causal attention over an ICI
   ring. The reference has NO sequence parallelism in-tree (SURVEY.md
   §5.7); here it is first-class: K/V blocks rotate around the ``sp``
@@ -24,9 +26,31 @@ from jax import lax
 _NEG_INF = -1e30
 
 
+def _flash_ok(q, k, v) -> bool:
+    from ray_tpu.ops.pallas.flash_attention import (
+        flash_attention_shapes_ok,
+    )
+    return (jax.default_backend() == "tpu"
+            and q.shape == k.shape == v.shape
+            and flash_attention_shapes_ok(q.shape[1], q.shape[-1]))
+
+
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                     scale: float | None = None) -> jax.Array:
-    """Dense causal attention [B, T, H, D] -> [B, T, H, D]."""
+                     scale: float | None = None,
+                     force_flash: bool = False) -> jax.Array:
+    """Causal attention [B, T, H, D] -> [B, T, H, D].
+
+    Single-device TPU with cleanly-blocking shapes runs the Pallas
+    flash kernel (ops/pallas/flash_attention.py — measured ~25% faster
+    fwd and ~35% faster fwd+bwd than the XLA fused path on v5e).
+    Multi-device programs must NOT hit the bare kernel (pallas_call has
+    no SPMD partitioning rule): use make_sharded_causal_attention,
+    which shard_maps over the mesh and sets ``force_flash`` for the
+    per-device local block. Everything else takes the XLA path.
+    """
+    if _flash_ok(q, k, v) and (force_flash or jax.device_count() == 1):
+        from ray_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True, scale=scale)
     return jax.nn.dot_product_attention(q, k, v, scale=scale,
                                         is_causal=True)
 
@@ -114,9 +138,36 @@ def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
 
     sp = mesh.shape.get(seq_axis, 1)
     if sp <= 1:
-        def dense(q, k, v):
-            return causal_attention(q, k, v)
-        return dense
+        batch = tuple(a for a in batch_axes
+                      if mesh.shape.get(a, 1) > 1)
+        heads = (head_axis if mesh.shape.get(head_axis, 1) > 1
+                 else None)
+        if not batch and heads is None:
+            # Unsharded attention operands: plain local dispatch.
+            def dense(q, k, v):
+                return causal_attention(q, k, v)
+            return dense
+        # Batch/head-sharded, sequence-replicated: shard_map so each
+        # device runs the local block — this is what lets the Pallas
+        # flash kernel (no SPMD rule of its own) serve the multi-chip
+        # dense path.
+        spec = P(batch if batch else None, None, heads, None)
+        local = functools.partial(causal_attention, force_flash=True)
+        sharded = jax.shard_map(local, mesh=mesh,
+                                in_specs=(spec, spec, spec),
+                                out_specs=spec, check_vma=False)
+        n_batch = 1
+        for a in batch:
+            n_batch *= mesh.shape[a]
+        n_heads = mesh.shape[head_axis] if heads else 1
+
+        def dispatch(q, k, v):
+            # Shapes that don't divide the mesh (e.g. the tiny batch
+            # used by init tracing) take the plain XLA path.
+            if q.shape[0] % n_batch or q.shape[2] % n_heads:
+                return causal_attention(q, k, v)
+            return sharded(q, k, v)
+        return dispatch
 
     batch = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
     spec = P(batch if batch else None, seq_axis,

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops."""
+
+from ray_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    flash_attention_available,
+)
+
+__all__ = ["flash_attention", "flash_attention_available"]

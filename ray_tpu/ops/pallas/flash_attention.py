@@ -1,0 +1,346 @@
+"""Causal flash attention as a Pallas TPU kernel (fwd + bwd).
+
+The streaming-softmax recipe: the [T, T] score matrix is never
+materialized in HBM; each q-block program walks k-blocks keeping a
+running (max, sum, accumulator) in VMEM scratch, and the backward pass
+recomputes probabilities from the saved log-sum-exp instead of storing
+them. MXU-friendly: all matmuls are block-sized with fp32
+accumulation (``preferred_element_type``); bf16 inputs stay bf16 into
+the MXU.
+
+The reference framework has no attention kernels at all (it hosts
+frameworks that bring their own); this is part of the TPU-native
+compute path (SURVEY.md §5.7). API shape follows jax convention
+[batch, seq, heads, head_dim].
+
+Grid layout (both passes): (batch*heads, outer_block, inner_block)
+with the innermost grid dimension "arbitrary" (sequential on TPU), so
+VMEM scratch carries state across inner steps of one outer block.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def flash_attention_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _pick_block(t: int, target: int = 512) -> int:
+    """Largest divisor of t that is <= target and a multiple of 8."""
+    best = 0
+    for b in range(8, min(t, target) + 1, 8):
+        if t % b == 0:
+            best = b
+    return best
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, bq, bk, nk, causal):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: skip blocks strictly above the diagonal.
+    diag_ok = (not causal) or (ik * bk <= iq * bq + bq - 1)
+
+    @pl.when(diag_ok)
+    def _attend():
+        q = q_ref[0]                       # [bq, d]
+        k = k_ref[0]                       # [bk, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            cols = ik * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+
+        m_prev = m_ref[...]                # [bq, 128] (replicated)
+        block_max = jnp.max(s, axis=-1, keepdims=True)     # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(
+            block_max, m_prev.shape))
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])       # [bq, 1]
+        p = jnp.exp(s - m_new[:, :1])                      # [bq, bk]
+        l_ref[...] = l_ref[...] * corr + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, d]
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(
+            jnp.maximum(l_ref[...], 1e-30)))[:, :1].astype(lse_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret):
+    bh, t, d = q.shape
+    nq, nk = t // bq, t // bk
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, bq=bq, bk=bk, nk=nk, causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((bq, d)),     # acc
+            _vmem((bq, 128)),   # running max (replicated lanes)
+            _vmem((bq, 128)),   # running sum (replicated lanes)
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except TypeError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, scale, bq, bk, nk, causal):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    diag_ok = (not causal) or (ik * bk <= iq * bq + bq - 1)
+
+    @pl.when(diag_ok)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                   # [bq, 1]
+        delta = delta_ref[0]               # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            cols = ik * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk]
+        dov = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dov - delta) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, bq, bk, nq, causal):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    diag_ok = (not causal) or (ik * bk <= iq * bq + bq - 1)
+
+    @pl.when(diag_ok)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                   # [bq, 1]
+        delta = delta_ref[0]               # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            cols = ik * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                # [bq, bk]
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bk, d]
+        dov = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dov - delta) * scale                      # [bq, bk]
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bk, d]
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, bq, bk, interpret):
+    q, k, v, out, lse = res
+    bh, t, d = q.shape
+    nq, nk = t // bq, t // bk
+    do = g
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [bh, t, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk,
+                          nk=nk, causal=causal),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[_vmem((bq, d))],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk,
+                          nq=nq, causal=causal),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, d)), _vmem((bk, d))],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, causal, bq, bk, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, scale, causal, bq, bk, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(scale, causal, bq, bk, interpret, res, g):
+    return _flash_bwd(res, g, scale, causal, bq, bk, interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    *, causal: bool = True,
+                    scale: float | None = None,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
+                    interpret: bool = False) -> jax.Array:
+    """Flash attention on [B, T, H, D]; differentiable (custom VJP).
+
+    Falls back to the caller's dense path when shapes don't block
+    cleanly — check with ``flash_attention_shapes_ok`` or catch
+    ValueError.
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    bq = block_q or _pick_block(t)
+    bk = block_k or _pick_block(t)
+    if bq == 0 or bk == 0 or t % bq or t % bk:
+        raise ValueError(
+            f"seq len {t} not divisible into flash blocks")
+    # [B, T, H, D] -> [B*H, T, D]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = _flash_core(fold(q), fold(k), fold(v), float(scale), causal,
+                      bq, bk, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_shapes_ok(t: int, d: int) -> bool:
+    return _pick_block(t) >= 128 and d % 8 == 0
