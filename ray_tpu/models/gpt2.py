@@ -40,7 +40,7 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16        # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False
-    attn_impl: str = "dense"         # "dense" | "ring"
+    attn_impl: str = "auto"          # "auto" | "dense" | "ring"
     sp_axis: str = "sp"
 
     @staticmethod
@@ -155,7 +155,7 @@ class GPT2(nn.Module):
                 make_sharded_causal_attention,
             )
             return make_sharded_causal_attention(
-                self.mesh, seq_axis=cfg.sp_axis)
+                self.mesh, seq_axis=cfg.sp_axis, impl=cfg.attn_impl)
         return causal_attention
 
     def _constrain(self, x):

@@ -129,14 +129,27 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
-                                  seq_axis="sp", head_axis="tp"):
+                                  seq_axis="sp", head_axis="tp",
+                                  impl="auto"):
     """Build an attention fn for activations sharded
     [batch->dp/fsdp, seq->sp, heads->tp]: shard_map-wrapped ring
     attention when the mesh has a real sp axis, dense attention
-    otherwise."""
+    otherwise. ``impl`` forces a path: "dense" is incompatible with a
+    real sp axis (activations are sequence-sharded, so each device
+    only holds a slice of K/V) and raises rather than silently
+    running ring."""
     from jax.sharding import PartitionSpec as P
 
+    if impl not in ("auto", "dense", "ring"):
+        raise ValueError(f"unknown attn impl {impl!r}; "
+                         "expected 'auto', 'dense' or 'ring'")
     sp = mesh.shape.get(seq_axis, 1)
+    if impl == "dense" and sp > 1:
+        raise ValueError(
+            f"attn_impl='dense' cannot run on a mesh with "
+            f"{seq_axis}={sp}: activations are sequence-sharded, so "
+            f"attention must be 'ring' (or 'auto') — or build the "
+            f"mesh without a {seq_axis} axis")
     if sp <= 1:
         batch = tuple(a for a in batch_axes
                       if mesh.shape.get(a, 1) > 1)

@@ -42,6 +42,22 @@ def _pick_block(t: int, target: int = 512) -> int:
     return best
 
 
+def _masked_scores(q, k, iq, ik, *, scale, bq, bk, causal):
+    """Scaled q·kᵀ for one (q-block, k-block) pair with the causal
+    mask applied in absolute coordinates — shared by the fwd and both
+    bwd kernels so the mask can never diverge between passes."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [bq, bk]
+    if causal:
+        rows = iq * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        cols = ik * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -65,15 +81,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0]                       # [bq, d]
         k = k_ref[0]                       # [bk, d]
         v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
-        if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            cols = ik * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+        s = _masked_scores(q, k, iq, ik, scale=scale, bq=bq, bk=bk,
+                           causal=causal)
 
         m_prev = m_ref[...]                # [bq, 128] (replicated)
         block_max = jnp.max(s, axis=-1, keepdims=True)     # [bq, 1]
@@ -167,15 +176,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]                   # [bq, 1]
         delta = delta_ref[0]               # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            cols = ik * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+        s = _masked_scores(q, k, iq, ik, scale=scale, bq=bq, bk=bk,
+                           causal=causal)
         p = jnp.exp(s - lse)                               # [bq, bk]
         dov = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -211,15 +213,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]                   # [bq, 1]
         delta = delta_ref[0]               # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            cols = ik * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+        s = _masked_scores(q, k, iq, ik, scale=scale, bq=bq, bk=bk,
+                           causal=causal)
         p = jnp.exp(s - lse)                                # [bq, bk]
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),

@@ -45,7 +45,9 @@ class _TrainSession:
     def __init__(self, context: TrainContext):
         self.context = context
         self.results: "queue.Queue[ReportedResult]" = queue.Queue()
-        self._index = 0
+        # Seed past the restored checkpoint so checkpoint directory
+        # names stay monotonic across slice restarts.
+        self._index = checkpoint_index(context.restored_checkpoint_dir) + 1
         self._lock = threading.Lock()
 
     def report(self, metrics: dict[str, Any],
@@ -124,4 +126,22 @@ class Checkpoint:
             if rank else dest
         if os.path.abspath(self.path) != os.path.abspath(rank_dest):
             shutil.copytree(self.path, rank_dest, dirs_exist_ok=True)
+        # Completion marker: lets the driver trust on-disk checkpoints
+        # for recovery even when the worker died before its report was
+        # polled (the poll stream is lossy across actor death; disk is
+        # the durable record, as in the reference's StorageContext).
+        with open(os.path.join(dest, f".complete_rank_{rank}"), "w"):
+            pass
         return dest
+
+
+def checkpoint_index(ckpt_dir: str | None) -> int:
+    """Parse the index out of a ``checkpoint_%06d`` directory name
+    (-1 when there is no checkpoint)."""
+    if not ckpt_dir:
+        return -1
+    name = os.path.basename(os.path.normpath(ckpt_dir))
+    try:
+        return int(name.rsplit("_", 1)[1])
+    except (IndexError, ValueError):
+        return -1

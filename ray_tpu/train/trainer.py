@@ -71,16 +71,30 @@ class JaxTrainer:
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0
         restored: str | None = None
+        # Checkpoints already in the trial dir belong to a previous
+        # run reusing this name — never silently resume from them.
+        try:
+            preexisting = frozenset(os.listdir(trial_dir))
+        except OSError:
+            preexisting = frozenset()
         while True:
             try:
                 return self._fit_once(trial_dir, restored)
             except _WorkerGroupError as e:
                 attempt += 1
+                # Workers persist checkpoints to storage before the
+                # driver polls the matching report, so on actor death
+                # the on-disk record can be ahead of e.latest_ckpt —
+                # recover from whichever is newest.
+                latest = _latest_complete_checkpoint(
+                    trial_dir, e.latest_ckpt,
+                    world_size=self.scaling.num_workers,
+                    exclude=preexisting)
                 if max_failures >= 0 and attempt > max_failures:
-                    return Result(metrics={}, checkpoint_dir=e.latest_ckpt,
+                    return Result(metrics={}, checkpoint_dir=latest,
                                   path=trial_dir, error=e.error)
                 # Elastic slice restart from the latest checkpoint.
-                restored = e.latest_ckpt
+                restored = latest
 
     # -- internals --
 
@@ -131,6 +145,34 @@ class JaxTrainer:
             raise _WorkerGroupError(str(e), latest_ckpt) from e
         finally:
             group.shutdown()
+
+
+def _latest_complete_checkpoint(
+        trial_dir: str, polled: str | None, *,
+        world_size: int = 1,
+        exclude: frozenset[str] = frozenset()) -> str | None:
+    """Newest on-disk checkpoint with EVERY rank's completion marker
+    (a sharded save is unusable if any rank's shard is missing),
+    preferring disk over the lossy polled report stream. ``exclude``
+    filters out checkpoints from a previous run reusing the name."""
+    from ray_tpu.train.session import checkpoint_index
+
+    def complete(d: str) -> bool:
+        return all(os.path.exists(
+            os.path.join(trial_dir, d, f".complete_rank_{r}"))
+            for r in range(world_size))
+
+    best = polled
+    try:
+        names = sorted(
+            d for d in os.listdir(trial_dir)
+            if d.startswith("checkpoint_") and d not in exclude
+            and complete(d))
+    except OSError:
+        return best
+    if names and checkpoint_index(names[-1]) > checkpoint_index(best):
+        best = os.path.join(trial_dir, names[-1])
+    return best
 
 
 class _WorkerGroupError(Exception):

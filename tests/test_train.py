@@ -144,3 +144,36 @@ def test_checkpoint_pytree_roundtrip(tmp_path):
                                np.arange(8.0))
     np.testing.assert_allclose(np.asarray(out["b"]["x"]),
                                np.ones((2, 2)))
+
+
+def test_latest_complete_checkpoint_prefers_disk(tmp_path):
+    """Recovery must trust on-disk completed checkpoints over the
+    polled stream: a worker can persist + die before the driver polls
+    the matching report."""
+    from ray_tpu.train.trainer import _latest_complete_checkpoint
+
+    trial = str(tmp_path)
+    for idx, complete in [(0, True), (1, True), (2, False)]:
+        d = os.path.join(trial, f"checkpoint_{idx:06d}")
+        os.makedirs(d)
+        if complete:
+            open(os.path.join(d, ".complete_rank_0"), "w").close()
+
+    # Driver polled nothing: picks newest *complete* dir (idx 1).
+    got = _latest_complete_checkpoint(trial, None)
+    assert got is not None and got.endswith("checkpoint_000001")
+    # Polled state older than disk: disk wins.
+    got = _latest_complete_checkpoint(
+        trial, os.path.join(trial, "checkpoint_000000"))
+    assert got is not None and got.endswith("checkpoint_000001")
+    # Polled state newer than any completed dir: polled wins.
+    newer = os.path.join(trial, "checkpoint_000009")
+    assert _latest_complete_checkpoint(trial, newer) == newer
+
+
+def test_session_index_monotonic_after_restore():
+    from ray_tpu.train.session import checkpoint_index
+
+    assert checkpoint_index(None) == -1
+    assert checkpoint_index("/a/b/checkpoint_000004") == 4
+    assert checkpoint_index("/a/b/weird") == -1
