@@ -47,6 +47,7 @@ def init(num_cpus: int | None = None,
          resources: dict[str, float] | None = None,
          local_mode: bool = False,
          ignore_reinit_error: bool = False,
+         runtime_env: dict[str, Any] | None = None,
          _system_config: dict[str, Any] | None = None):
     """Start the single-node runtime in this process (driver).
 
@@ -65,9 +66,13 @@ def init(num_cpus: int | None = None,
         cfg = Config.from_env(_system_config)
         set_config(cfg)
         from ray_tpu.core.runtime import DriverRuntime
+        if runtime_env:
+            from ray_tpu.runtime_env import validate_runtime_env
+            validate_runtime_env(runtime_env)
         _runtime = DriverRuntime(
             cfg, num_cpus=num_cpus, num_tpus=num_tpus,
-            resources=resources, local_mode=local_mode)
+            resources=resources, local_mode=local_mode,
+            runtime_env=runtime_env)
         atexit.register(_shutdown_at_exit)
         return _runtime
 

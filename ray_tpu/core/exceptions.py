@@ -67,3 +67,8 @@ class RuntimeNotInitializedError(RayTpuError):
 
 class PlacementGroupError(RayTpuError):
     """Placement group could not be created/scheduled."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """A runtime_env could not be built for a task/actor/job
+    (reference: ray.exceptions.RuntimeEnvSetupError)."""

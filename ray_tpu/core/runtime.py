@@ -109,6 +109,10 @@ class TaskRecord:
     submitted_at: float = 0.0
     started_at: float = 0.0
     finished_at: float = 0.0
+    # Runtime env resolved ONCE at submission (runtime_env builds can
+    # stat whole staged trees — too costly per dispatch/retry).
+    env_key: str = ""
+    env_vars: dict[str, str] | None = None
 
 
 @dataclass
@@ -137,6 +141,9 @@ class ActorRecord:
     queue_cv: threading.Condition = field(
         default_factory=threading.Condition)
     pusher: "threading.Thread | None" = None
+    # Resolved once at creation; restarts reuse it.
+    env_key: str = ""
+    env_vars: dict[str, str] | None = None
 
 
 @dataclass
@@ -285,10 +292,12 @@ class DriverRuntime:
     def __init__(self, config: Config, num_cpus: int | None = None,
                  num_tpus: int | None = None,
                  resources: dict[str, float] | None = None,
-                 local_mode: bool = False):
+                 local_mode: bool = False,
+                 runtime_env: dict | None = None):
         self.config = config
         self.job_id = JobID.next()
         self.local_mode = local_mode
+        self.job_runtime_env = runtime_env or {}
         self._shutdown = False
 
         ncpu = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
@@ -544,6 +553,10 @@ class DriverRuntime:
                     options: TaskOptions) -> list[ObjectRef]:
         if fn_blob is not None:
             self._fn_cache.setdefault(fn_id, fn_blob)
+        # Resolve the runtime env now: a broken env (task- OR
+        # job-level) fails at .remote() with RuntimeEnvSetupError, and
+        # dispatch/retries reuse the resolved result.
+        env_key, env_vars = self._env_for_options(options)
         task_id = TaskID.for_normal_task(self.job_id)
         return_ids = [ObjectID.for_return(task_id, i)
                       for i in range(options.num_returns)]
@@ -551,7 +564,8 @@ class DriverRuntime:
         rec = TaskRecord(
             task_id=task_id, fn_id=fn_id, name=fn_name or "task",
             args_blob=args_blob, arg_refs=arg_refs, options=options,
-            return_ids=return_ids, submitted_at=time.time())
+            return_ids=return_ids, submitted_at=time.time(),
+            env_key=env_key, env_vars=env_vars)
         with self._task_lock:
             self._tasks[task_id] = rec
         self._event(rec, "PENDING")
@@ -915,13 +929,21 @@ class DriverRuntime:
                             pg_rec.bundles[bi])
 
     def _env_for_options(self, options: TaskOptions) -> tuple[str, dict]:
+        from ray_tpu.runtime_env import (
+            build_runtime_env, merge_runtime_envs,
+        )
         env_vars: dict[str, str] = {}
         need = self._effective_resources(options)
         if need.get("TPU", 0) <= 0:
             # CPU-only workers must not grab the TPU runtime.
             env_vars["JAX_PLATFORMS"] = "cpu"
-        if options.runtime_env and "env_vars" in options.runtime_env:
-            env_vars.update(options.runtime_env["env_vars"])
+        merged = merge_runtime_envs(self.job_runtime_env,
+                                    options.runtime_env)
+        # Plugin build happens driver-side (the per-node agent analog,
+        # reference runtime_env_agent.py:161); failures surface at
+        # submission as RuntimeEnvSetupError, not inside the worker.
+        ctx = build_runtime_env(merged)
+        env_vars.update(ctx.to_env_vars())
         key = hashlib.sha1(
             ser.dumps(sorted(env_vars.items()))).hexdigest()[:12]
         return key, env_vars
@@ -965,7 +987,10 @@ class DriverRuntime:
                 self._idle[key] = keep
 
     def _dispatch(self, rec: TaskRecord) -> None:
-        env_key, env_vars = self._env_for_options(rec.options)
+        if rec.env_vars is None:
+            rec.env_key, rec.env_vars = self._env_for_options(
+                rec.options)
+        env_key, env_vars = rec.env_key, rec.env_vars
         w = self._take_worker(env_key, env_vars, rec.node_id)
         rec.worker = w
         rec.worker_index = w.index
@@ -1121,12 +1146,16 @@ class DriverRuntime:
                      name: str = "", max_restarts: int = 0,
                      max_concurrency: int = 1) -> ActorID:
         actor_id = ActorID.of(self.job_id)
+        # Resolve eagerly: broken runtime_env raises here, at
+        # ``Cls.remote()``, not inside the async start thread.
+        env_key, env_vars = self._env_for_options(options)
         args_blob, arg_refs = self._pack_args(args, kwargs)
         rec = ActorRecord(
             actor_id=actor_id, name=name, cls_name=cls_name,
             cls_blob=cls_blob, init_args_blob=args_blob,
             init_arg_refs=arg_refs, options=options,
-            max_restarts=max_restarts, max_concurrency=max_concurrency)
+            max_restarts=max_restarts, max_concurrency=max_concurrency,
+            env_key=env_key, env_vars=env_vars)
         with self._actor_lock:
             if name:
                 if name in self._named_actors:
@@ -1149,9 +1178,11 @@ class DriverRuntime:
                     f"{rec.cls_name} within "
                     f"{self.config.actor_creation_timeout_s}s")
             rec.node_id, rec.pg_bundle = placed
-            env_key, env_vars = self._env_for_options(rec.options)
+            if rec.env_vars is None:
+                rec.env_key, rec.env_vars = self._env_for_options(
+                    rec.options)
             w = WorkerHandle(self, f"actor_{rec.actor_id.hex()[:8]}",
-                             env_vars, node_id=rec.node_id)
+                             rec.env_vars, node_id=rec.node_id)
             w.is_actor = True
             w.actor_id = rec.actor_id
             w.busy = True

@@ -30,6 +30,13 @@ def main() -> None:
         except Exception:  # noqa: BLE001 — older jax w/o the flag
             pass
 
+    # runtime_env working_dir: staged driver-side, applied here so
+    # user code sees it as cwd AND an import root (PYTHONPATH already
+    # carries it for module resolution).
+    wd = os.environ.get("RAY_TPU_WORKING_DIR")
+    if wd and os.path.isdir(wd):
+        os.chdir(wd)
+
     address, token = sys.argv[1], sys.argv[2]
     conn = mpc.Client(address, family="AF_UNIX")
     conn.send(("hello", "exec", token))

@@ -53,6 +53,14 @@ class _JobSupervisor:
         self._log_lock = threading.Lock()
         env = dict(os.environ)
         env.update(env_vars or {})
+        # A runtime_env PYTHONPATH (staged working_dir/py_modules)
+        # must extend — not replace — the inherited one, or the job
+        # loses modules resolvable in the driver's environment.
+        staged_pp = (env_vars or {}).get("PYTHONPATH")
+        inherited_pp = os.environ.get("PYTHONPATH")
+        if staged_pp and inherited_pp:
+            env["PYTHONPATH"] = os.pathsep.join(
+                [staged_pp, inherited_pp])
         self._proc = subprocess.Popen(
             entrypoint, shell=True, env=env, cwd=working_dir,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -118,8 +126,12 @@ class JobSubmissionClient:
         sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
         if sid in self._jobs:
             raise ValueError(f"submission_id {sid!r} already exists")
-        env_vars = (runtime_env or {}).get("env_vars")
-        working_dir = (runtime_env or {}).get("working_dir")
+        # Full runtime_env build (staging, plugins, pip gating) —
+        # failures surface here at submission time.
+        from ray_tpu.runtime_env import build_runtime_env
+        ctx = build_runtime_env(runtime_env)
+        env_vars = ctx.to_env_vars() or None
+        working_dir = ctx.working_dir
         supervisor_cls = ray_tpu.remote(num_cpus=0)(_JobSupervisor)
         handle = supervisor_cls.options(
             name=f"_job_supervisor_{sid}").remote(

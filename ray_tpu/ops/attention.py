@@ -150,6 +150,12 @@ def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
             f"{seq_axis}={sp}: activations are sequence-sharded, so "
             f"attention must be 'ring' (or 'auto') — or build the "
             f"mesh without a {seq_axis} axis")
+    if impl == "ring" and sp <= 1:
+        raise ValueError(
+            f"attn_impl='ring' requires a real {seq_axis} mesh axis "
+            f"(got {seq_axis}={sp}); the O(seq/sp) per-device K/V "
+            f"memory you asked for does not exist on this mesh — use "
+            f"'auto' or add a {seq_axis} axis")
     if sp <= 1:
         batch = tuple(a for a in batch_axes
                       if mesh.shape.get(a, 1) > 1)

@@ -1,0 +1,24 @@
+"""Runtime environments (reference: python/ray/runtime_env/ + the
+plugin architecture of python/ray/_private/runtime_env/)."""
+
+from ray_tpu.runtime_env.plugins import (
+    RuntimeEnvContext,
+    RuntimeEnvPlugin,
+    build_runtime_env,
+    register_plugin,
+)
+from ray_tpu.runtime_env.runtime_env import (
+    RuntimeEnv,
+    merge_runtime_envs,
+    validate_runtime_env,
+)
+
+__all__ = [
+    "RuntimeEnv",
+    "RuntimeEnvContext",
+    "RuntimeEnvPlugin",
+    "build_runtime_env",
+    "merge_runtime_envs",
+    "register_plugin",
+    "validate_runtime_env",
+]

@@ -1,0 +1,90 @@
+"""RuntimeEnv spec object.
+
+Reference analog: ``python/ray/runtime_env/runtime_env.py`` — a
+validated dict describing the environment a task/actor/job runs in.
+Fields map 1:1 to plugins (ray_tpu.runtime_env.plugins); unknown keys
+are allowed iff a plugin with that name is registered (the reference's
+plugin extension point, python/ray/_private/runtime_env/plugin.py:24).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+class RuntimeEnv(dict):
+    """A runtime environment description.
+
+    Built-in fields:
+      env_vars: dict[str, str] — extra environment variables;
+      working_dir: str — local directory (or .zip) staged per-env and
+        used as the worker's cwd + import root;
+      py_modules: list[str] — local module dirs/files staged onto the
+        worker import path;
+      pip / conda: gated in this deployment (no network egress) — the
+        pip plugin only *verifies* the named distributions are already
+        present and fails fast otherwise;
+      config: dict — setup options (e.g. setup_timeout_seconds).
+    """
+
+    KNOWN = ("env_vars", "working_dir", "py_modules", "pip", "conda",
+             "config")
+
+    def __init__(self, **kwargs: Any):
+        super().__init__()
+        for k, v in kwargs.items():
+            if v is not None:
+                self[k] = v
+        validate_runtime_env(self)
+
+    def to_dict(self) -> dict:
+        return dict(self)
+
+
+def validate_runtime_env(env: dict) -> None:
+    from ray_tpu.runtime_env.plugins import plugin_names
+
+    known = set(RuntimeEnv.KNOWN) | set(plugin_names())
+    for k in env:
+        if k not in known:
+            raise ValueError(
+                f"unknown runtime_env field {k!r}; known fields: "
+                f"{sorted(known)} (register a RuntimeEnvPlugin to "
+                f"extend)")
+    ev = env.get("env_vars")
+    if ev is not None:
+        if not isinstance(ev, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in ev.items()):
+            raise ValueError("env_vars must be dict[str, str]")
+    wd = env.get("working_dir")
+    if wd is not None:
+        if not isinstance(wd, str):
+            raise ValueError("working_dir must be a path string")
+        if not os.path.exists(wd):
+            raise ValueError(f"working_dir {wd!r} does not exist")
+    pm = env.get("py_modules")
+    if pm is not None:
+        if not isinstance(pm, (list, tuple)):
+            raise ValueError("py_modules must be a list of paths")
+        for p in pm:
+            if not isinstance(p, str) or not os.path.exists(p):
+                raise ValueError(f"py_modules entry {p!r} not found")
+
+
+def merge_runtime_envs(parent: dict | None,
+                       child: dict | None) -> dict:
+    """Child overrides parent field-by-field; env_vars are merged with
+    child winning per key (reference semantics for job→task)."""
+    parent = dict(parent or {})
+    child = dict(child or {})
+    out = dict(parent)
+    for k, v in child.items():
+        if k == "env_vars":
+            merged = dict(parent.get("env_vars", {}))
+            merged.update(v or {})
+            out[k] = merged
+        else:
+            out[k] = v
+    return out

@@ -87,9 +87,7 @@ class JaxTrainer:
                 # the on-disk record can be ahead of e.latest_ckpt —
                 # recover from whichever is newest.
                 latest = _latest_complete_checkpoint(
-                    trial_dir, e.latest_ckpt,
-                    world_size=self.scaling.num_workers,
-                    exclude=preexisting)
+                    trial_dir, e.latest_ckpt, exclude=preexisting)
                 if max_failures >= 0 and attempt > max_failures:
                     return Result(metrics={}, checkpoint_dir=latest,
                                   path=trial_dir, error=e.error)
@@ -149,18 +147,29 @@ class JaxTrainer:
 
 def _latest_complete_checkpoint(
         trial_dir: str, polled: str | None, *,
-        world_size: int = 1,
         exclude: frozenset[str] = frozenset()) -> str | None:
-    """Newest on-disk checkpoint with EVERY rank's completion marker
-    (a sharded save is unusable if any rank's shard is missing),
-    preferring disk over the lossy polled report stream. ``exclude``
-    filters out checkpoints from a previous run reusing the name."""
+    """Newest on-disk checkpoint that finished persisting, preferring
+    disk over the lossy polled report stream. Complete = rank 0's
+    marker exists AND every rank shard directory that was started
+    (``rank_N/``) has its matching marker — this accepts the
+    rank-0-only checkpoint pattern (replicated state) while rejecting
+    sharded saves interrupted mid-copy. ``exclude`` filters out
+    checkpoints from a previous run reusing the name."""
     from ray_tpu.train.session import checkpoint_index
 
     def complete(d: str) -> bool:
-        return all(os.path.exists(
-            os.path.join(trial_dir, d, f".complete_rank_{r}"))
-            for r in range(world_size))
+        path = os.path.join(trial_dir, d)
+        if not os.path.exists(os.path.join(path, ".complete_rank_0")):
+            return False
+        try:
+            entries = os.listdir(path)
+        except OSError:
+            return False
+        for e in entries:
+            if e.startswith("rank_") and e[5:].isdigit():
+                if f".complete_rank_{e[5:]}" not in entries:
+                    return False
+        return True
 
     best = polled
     try:

@@ -1,0 +1,137 @@
+"""Runtime-env plugin system tests (reference analog: the
+runtime_env suites under python/ray/tests/)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import RuntimeEnvSetupError
+from ray_tpu.runtime_env import (
+    RuntimeEnv, RuntimeEnvPlugin, build_runtime_env,
+    merge_runtime_envs, register_plugin, validate_runtime_env,
+)
+
+
+def test_validate_rejects_unknown_field():
+    with pytest.raises(ValueError, match="unknown runtime_env field"):
+        validate_runtime_env({"totally_bogus": 1})
+
+
+def test_validate_env_vars_types():
+    with pytest.raises(ValueError, match="env_vars"):
+        RuntimeEnv(env_vars={"A": 1})
+    RuntimeEnv(env_vars={"A": "1"})
+
+
+def test_merge_child_overrides_but_env_vars_merge():
+    parent = {"env_vars": {"A": "p", "B": "p"}, "working_dir": "/x"}
+    child = {"env_vars": {"B": "c"}}
+    out = merge_runtime_envs(parent, child)
+    assert out["env_vars"] == {"A": "p", "B": "c"}
+    assert out["working_dir"] == "/x"
+
+
+def test_pip_plugin_gated_missing_package():
+    with pytest.raises(RuntimeEnvSetupError, match="no network"):
+        build_runtime_env({"pip": ["definitely-not-a-real-pkg-xyz"]})
+
+
+def test_pip_plugin_passes_for_present_packages():
+    ctx = build_runtime_env({"pip": ["numpy", "jax>=0.4"]})
+    assert ctx.env_vars == {}
+
+
+def test_conda_plugin_gated():
+    with pytest.raises(RuntimeEnvSetupError, match="conda"):
+        build_runtime_env({"conda": {"dependencies": ["x"]}})
+
+
+def test_working_dir_staged_and_hash_changes_on_edit(tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("v1")
+    ctx1 = build_runtime_env({"working_dir": str(wd)})
+    assert ctx1.working_dir and os.path.isdir(ctx1.working_dir)
+    assert open(os.path.join(ctx1.working_dir, "data.txt")).read() == "v1"
+    # staged copy is decoupled from the source
+    (wd / "data.txt").write_text("v2")
+    os.utime(wd / "data.txt")
+    ctx2 = build_runtime_env({"working_dir": str(wd)})
+    assert open(os.path.join(ctx2.working_dir, "data.txt")).read() == "v2"
+    assert ctx1.working_dir != ctx2.working_dir
+
+
+def test_custom_plugin_registration(tmp_path):
+    class TokenPlugin(RuntimeEnvPlugin):
+        name = "token"
+
+        def build(self, value, ctx, cache_dir):
+            ctx.env_vars["MY_TOKEN"] = str(value)
+
+    register_plugin(TokenPlugin())
+    ctx = build_runtime_env({"token": "sekrit"})
+    assert ctx.env_vars["MY_TOKEN"] == "sekrit"
+
+
+def test_task_runtime_env_env_vars(rt):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("RT_ENV_PROBE")
+
+    ref = read_env.options(
+        runtime_env={"env_vars": {"RT_ENV_PROBE": "42"}}).remote()
+    assert ray_tpu.get(ref, timeout=60) == "42"
+    # and without the env, unset
+    assert ray_tpu.get(read_env.remote(), timeout=60) is None
+
+
+def test_task_runtime_env_working_dir(rt, tmp_path):
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "mymod_rt_env.py").write_text(
+        textwrap.dedent("""
+        VALUE = "from-working-dir"
+        """))
+    (wd / "asset.txt").write_text("asset!")
+
+    @ray_tpu.remote
+    def use_working_dir():
+        import mymod_rt_env
+        with open("asset.txt") as f:
+            return mymod_rt_env.VALUE, f.read()
+
+    ref = use_working_dir.options(
+        runtime_env={"working_dir": str(wd)}).remote()
+    val, asset = ray_tpu.get(ref, timeout=60)
+    assert val == "from-working-dir"
+    assert asset == "asset!"
+
+
+def test_actor_runtime_env_py_modules(rt, tmp_path):
+    pkg = tmp_path / "rtenvpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("WHO = 'pkg'")
+
+    @ray_tpu.remote
+    class Importer:
+        def who(self):
+            import rtenvpkg
+            return rtenvpkg.WHO
+
+    a = Importer.options(
+        runtime_env={"py_modules": [str(pkg)]}).remote()
+    assert ray_tpu.get(a.who.remote(), timeout=60) == "pkg"
+    ray_tpu.kill(a)
+
+
+def test_runtime_env_setup_error_at_submission(rt):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    with pytest.raises(RuntimeEnvSetupError):
+        noop.options(
+            runtime_env={"pip": ["nope-not-installed-xyz"]}).remote()
