@@ -6,13 +6,15 @@ backpressure; ``streaming_split`` feeds trainer gangs and
 mesh (SURVEY.md §2.3/§2.4).
 """
 
-from ray_tpu.data.dataset import DataIterator, Dataset
+from ray_tpu.data.dataset import DataIterator, Dataset, GroupedData
 from ray_tpu.data.io import (
     from_items,
     from_numpy,
     from_pandas,
     range as range_,  # noqa: A001 — re-exported as .range below
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_parquet,
 )
@@ -21,6 +23,7 @@ from ray_tpu.data.io import (
 range = range_  # noqa: A001
 
 __all__ = [
-    "Dataset", "DataIterator", "range", "from_items", "from_numpy",
-    "from_pandas", "read_parquet", "read_csv", "read_json",
+    "Dataset", "DataIterator", "GroupedData", "range", "from_items",
+    "from_numpy", "from_pandas", "read_parquet", "read_csv",
+    "read_json", "read_images", "read_binary_files",
 ]

@@ -37,7 +37,14 @@ def to_block(rows_or_batch) -> "pyarrow.Table":  # noqa: F821
 def _to_arrow_array(v):
     import pyarrow as pa
 
+    # bytes columns must not round-trip through numpy: np.asarray
+    # gives an |S dtype that silently truncates trailing NULs.
+    if isinstance(v, (list, tuple)) and v and \
+            isinstance(v[0], (bytes, bytearray)):
+        return pa.array([bytes(x) for x in v], type=pa.binary())
     arr = np.asarray(v)
+    if arr.dtype.kind == "S":
+        return pa.array([bytes(x) for x in v], type=pa.binary())
     if arr.ndim <= 1:
         return pa.array(arr.tolist() if arr.dtype == object else arr)
     # N-d columns -> FixedSizeList nesting (tensors per row).

@@ -78,6 +78,31 @@ class _Limit:
     n: int
 
 
+@dataclass
+class _Sort:
+    key: str
+    descending: bool = False
+
+
+@dataclass
+class _GroupBy:
+    key: str
+    # ("count", None) | ("sum"/"mean"/"min"/"max"/"std", col)
+    # | ("map_groups", fn)
+    agg: tuple
+    num_partitions: int | None = None
+
+
+@dataclass
+class _Zip:
+    other: "Dataset"
+
+
+@dataclass
+class _Union:
+    others: list
+
+
 _FUSABLE = (_MapBatches, _MapRows, _FlatMap, _Filter)
 
 
@@ -149,6 +174,87 @@ class Dataset:
     def limit(self, n: int) -> "Dataset":
         return self._append(_Limit(n))
 
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed sample-based range-partition sort (reference:
+        Dataset.sort — sample cutoffs, partition, per-partition sort)."""
+        return self._append(_Sort(key, descending))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of equal-length datasets (barrier)."""
+        return self._append(_Zip(other))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (streaming — no barrier)."""
+        return self._append(_Union(list(others)))
+
+    # -- column ops (sugar over map_batches, fused like the rest) --
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch):
+            batch[name] = np.asarray(fn(batch))
+            return batch
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in drop})
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        keep = list(cols)
+        return self.map_batches(
+            lambda b: {k: b[k] for k in keep})
+
+    def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()})
+
+    # -- scalar aggregates --
+
+    def sum(self, col: str):
+        return self._scalar_agg(col, np.sum, 0)
+
+    def min(self, col: str):
+        return self._scalar_agg(col, np.min, None)
+
+    def max(self, col: str):
+        return self._scalar_agg(col, np.max, None)
+
+    def mean(self, col: str):
+        total, count = 0.0, 0
+        for block in self.iter_blocks():
+            if block.num_rows:
+                v = block_to_batch(block)[col]
+                total += float(np.sum(v))
+                count += len(v)
+        return total / count if count else float("nan")
+
+    def std(self, col: str):
+        vals = [block_to_batch(b)[col] for b in self.iter_blocks()
+                if b.num_rows]
+        if not vals:
+            return float("nan")
+        return float(np.std(np.concatenate(vals), ddof=1))
+
+    def unique(self, col: str) -> list:
+        out = set()
+        for block in self.iter_blocks():
+            if block.num_rows:
+                out.update(np.asarray(
+                    block_to_batch(block)[col]).tolist())
+        return sorted(out)
+
+    def _scalar_agg(self, col: str, op, empty):
+        parts = [op(block_to_batch(b)[col])
+                 for b in self.iter_blocks() if b.num_rows]
+        if not parts:
+            return empty
+        val = op(np.asarray(parts))
+        return val.item() if hasattr(val, "item") else val
+
     # -- execution ---------------------------------------------------------
 
     def _stream_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
@@ -174,6 +280,16 @@ class Dataset:
                 refs = iter(_do_shuffle(list(refs), payload))
             elif kind == "limit":
                 refs = _do_limit(refs, payload)
+            elif kind == "sort":
+                refs = iter(_do_sort(list(refs), payload))
+            elif kind == "groupby":
+                refs = iter(_do_groupby(list(refs), payload))
+            elif kind == "zip":
+                refs = iter(_do_zip(list(refs), payload))
+            elif kind == "union":
+                refs = itertools.chain(
+                    refs, *(o._stream_blocks(max_in_flight)
+                            for o in payload.others))
         return refs
 
     def iter_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
@@ -258,6 +374,44 @@ class Dataset:
         os.makedirs(path, exist_ok=True)
         for i, block in enumerate(self.iter_blocks()):
             pq.write_table(block, f"{path}/part-{i:05d}.parquet")
+
+    def write_csv(self, path: str) -> None:
+        import os
+        import pyarrow.csv as pacsv
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            pacsv.write_csv(block, f"{path}/part-{i:05d}.csv")
+
+    def write_json(self, path: str) -> None:
+        import json as jsonlib
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            with open(f"{path}/part-{i:05d}.json", "w") as f:
+                for row in block_rows(block):
+                    f.write(jsonlib.dumps(
+                        {k: (v.tolist() if hasattr(v, "tolist")
+                             else v) for k, v in row.items()}) + "\n")
+
+    def iter_torch_batches(self, batch_size: int | None = None,
+                           drop_last: bool = False,
+                           device: str | None = None):
+        """Batches as torch tensors (reference:
+        Dataset.iter_torch_batches; non-numeric columns pass through)."""
+        import torch
+        for batch in self.iter_batches(batch_size, drop_last):
+            out = {}
+            for k, v in batch.items():
+                arr = np.asarray(v)
+                if arr.dtype == object:
+                    out[k] = v
+                    continue
+                arr = np.ascontiguousarray(arr)
+                if not arr.flags.writeable:
+                    arr = arr.copy()   # torch rejects read-only views
+                t = torch.from_numpy(arr)
+                out[k] = t.to(device) if device else t
+            yield out
 
     def __repr__(self):
         return f"Dataset(stages={len(self._plan)})"
@@ -344,6 +498,18 @@ def _split_stages(plan: list) -> list[tuple[str, Any]]:
         elif isinstance(op, _Limit):
             stages.append(("limit", op.n))
             i += 1
+        elif isinstance(op, _Sort):
+            stages.append(("sort", op))
+            i += 1
+        elif isinstance(op, _GroupBy):
+            stages.append(("groupby", op))
+            i += 1
+        elif isinstance(op, _Zip):
+            stages.append(("zip", op))
+            i += 1
+        elif isinstance(op, _Union):
+            stages.append(("union", op))
+            i += 1
         else:
             fused = []
             while i < len(plan) and isinstance(plan[i], _FUSABLE):
@@ -419,3 +585,209 @@ def _do_limit(refs, n: int):
         else:
             yield _slice_task.remote(ref, 0, n - taken)
             taken = n
+
+
+# -- distributed sort (sample → range partition → per-part sort) -----------
+
+@ray_tpu.remote
+def _sample_keys(block, key, k):
+    import numpy as np
+    vals = np.asarray(block_to_batch(block)[key]) if block.num_rows \
+        else np.asarray([])
+    if len(vals) <= k:
+        return vals
+    idx = np.random.default_rng(0).choice(len(vals), k, replace=False)
+    return vals[idx]
+
+
+@ray_tpu.remote
+def _range_partition(block, key, cutoffs):
+    """Split one block into len(cutoffs)+1 range partitions."""
+    import numpy as np
+    batch = block_to_batch(block)
+    vals = np.asarray(batch[key]) if block.num_rows else \
+        np.asarray([])
+    part_ids = np.searchsorted(np.asarray(cutoffs), vals,
+                               side="right")
+    parts = []
+    for p in range(len(cutoffs) + 1):
+        mask = part_ids == p
+        parts.append(to_block(
+            {k: np.asarray(v)[mask] for k, v in batch.items()}))
+    return tuple(parts)
+
+
+@ray_tpu.remote
+def _sort_partition(key, descending, idx, *part_tuples):
+    import pyarrow as pa
+    parts = [t[idx] for t in part_tuples]
+    merged = concat_blocks(parts) if parts else pa.table({})
+    if merged.num_rows == 0:
+        return merged
+    return merged.sort_by([(key, "descending" if descending
+                            else "ascending")])
+
+
+def _do_sort(refs: list, op: "_Sort") -> list:
+    import numpy as np
+    if not refs:
+        return refs
+    num_parts = len(refs)
+    samples = ray_tpu.get(
+        [_sample_keys.remote(r, op.key, 64) for r in refs])
+    allv = np.sort(np.concatenate([s for s in samples]))
+    if len(allv) == 0 or num_parts == 1:
+        return [_sort_partition.remote(
+            op.key, op.descending, 0,
+            _range_partition.remote(r, op.key, [])) for r in refs][:1] \
+            if num_parts == 1 else refs
+    cut_idx = [int(len(allv) * (i + 1) / num_parts)
+               for i in range(num_parts - 1)]
+    cutoffs = [allv[min(i, len(allv) - 1)] for i in cut_idx]
+    part_refs = [_range_partition.remote(r, op.key, cutoffs)
+                 for r in refs]
+    order = (range(num_parts) if not op.descending
+             else reversed(range(num_parts)))
+    return [_sort_partition.remote(op.key, op.descending, p,
+                                   *part_refs)
+            for p in order]
+
+
+# -- distributed group-by (hash partition → per-part aggregate) ------------
+
+@ray_tpu.remote
+def _hash_partition(block, key, num_parts):
+    import numpy as np
+    batch = block_to_batch(block)
+    if block.num_rows == 0:
+        empty = {k: np.asarray(v)[:0] for k, v in batch.items()}
+        return tuple(to_block(empty)
+                     for _ in range(num_parts))
+    vals = np.asarray(batch[key])
+    # stable content hash (python hash() is randomized across procs)
+    import zlib
+    ids = np.asarray([
+        zlib.crc32(repr(v).encode()) % num_parts for v in vals])
+    return tuple(to_block({k: np.asarray(v)[ids == p]
+                           for k, v in batch.items()})
+                 for p in range(num_parts))
+
+
+_ARROW_AGGS = {"sum": "sum", "mean": "mean", "min": "min",
+               "max": "max", "std": "stddev", "count": "count"}
+
+
+@ray_tpu.remote
+def _agg_partition(key, agg, idx, *part_tuples):
+    import pyarrow as pa
+    parts = [t[idx] for t in part_tuples]
+    merged = concat_blocks(parts) if parts else pa.table({})
+    if merged.num_rows == 0:
+        return pa.table({})
+    kind, col = agg
+    if kind == "map_groups":
+        out_rows = []
+        batch = block_to_batch(merged)
+        import numpy as np
+        keys = np.asarray(batch[key])
+        for kv in sorted(set(keys.tolist())):
+            mask = keys == kv
+            group = {c: np.asarray(v)[mask] for c, v in batch.items()}
+            res = col(group)
+            if isinstance(res, dict):
+                out_rows.append(res)
+            else:
+                out_rows.extend(res)
+        return to_block(out_rows)
+    if kind == "count":
+        tbl = merged.group_by(key).aggregate([(key, "count")])
+        return tbl.rename_columns([key, "count()"])
+    tbl = merged.group_by(key).aggregate([(col, _ARROW_AGGS[kind])])
+    out_name = f"{kind}({col})"
+    return tbl.rename_columns([key, out_name])
+
+
+def _do_groupby(refs: list, op: "_GroupBy") -> list:
+    if not refs:
+        return refs
+    num_parts = op.num_partitions or min(len(refs), 8)
+    part_refs = [_hash_partition.remote(r, op.key, num_parts)
+                 for r in refs]
+    return [_agg_partition.remote(op.key, op.agg, p, *part_refs)
+            for p in range(num_parts)]
+
+
+# -- zip -------------------------------------------------------------------
+
+@ray_tpu.remote
+def _zip_blocks(a, b):
+    import pyarrow as pa
+    names = set(a.column_names)
+    cols = {n: a.column(n) for n in a.column_names}
+    for n in b.column_names:
+        out = f"{n}_1" if n in names else n
+        cols[out] = b.column(n)
+    return pa.table(cols)
+
+
+@ray_tpu.remote
+def _num_rows_task(block):
+    return block.num_rows
+
+
+def _do_zip(refs: list, op: "_Zip") -> list:
+    a_ref = _concat_task.remote(*refs)
+    b_refs = list(op.other._stream_blocks())
+    b_ref = _concat_task.remote(*b_refs)
+    # Row counts via tiny tasks — the concatenated tables themselves
+    # never transit the driver.
+    na, nb = ray_tpu.get([_num_rows_task.remote(a_ref),
+                          _num_rows_task.remote(b_ref)])
+    if na != nb:
+        raise ValueError(
+            f"zip requires equal row counts ({na} vs {nb})")
+    zipped = _zip_blocks.remote(a_ref, b_ref)
+    num_blocks = max(1, len(refs))
+    per = (na + num_blocks - 1) // num_blocks
+    return [_slice_task.remote(zipped, s, min(na, s + per))
+            for s in range(0, na, per)]
+
+
+class GroupedData:
+    """Result of ``Dataset.groupby`` (reference:
+    ray.data.grouped_data.GroupedData): each aggregate runs as a
+    hash-shuffle (all-to-all) followed by per-partition arrow
+    group-by aggregation tasks."""
+
+    def __init__(self, ds: Dataset, key: str,
+                 num_partitions: int | None = None):
+        self._ds = ds
+        self._key = key
+        self._parts = num_partitions
+
+    def _agg(self, kind: str, col) -> Dataset:
+        return self._ds._append(
+            _GroupBy(self._key, (kind, col), self._parts))
+
+    def count(self) -> Dataset:
+        return self._agg("count", None)
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg("sum", col)
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg("mean", col)
+
+    def min(self, col: str) -> Dataset:
+        return self._agg("min", col)
+
+    def max(self, col: str) -> Dataset:
+        return self._agg("max", col)
+
+    def std(self, col: str) -> Dataset:
+        return self._agg("std", col)
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """fn(group_batch: dict[str, np.ndarray]) -> dict-row or
+        list of dict-rows."""
+        return self._agg("map_groups", fn)

@@ -128,3 +128,49 @@ def read_json(paths: str | list[str]) -> Dataset:
         return read
 
     return Dataset([_Source([make(f) for f in files])])
+
+
+def read_images(paths: str | list[str], *, size: tuple | None = None,
+                mode: str = "RGB") -> Dataset:
+    """Image files → blocks with an ``image`` tensor column and a
+    ``path`` column (reference: _internal/datasource/image_datasource).
+    One read task per file keeps decode distributed across CPU
+    workers."""
+    files: list[str] = []
+    for suffix in (".png", ".jpg", ".jpeg", ".bmp", ".gif"):
+        try:
+            files.extend(_expand(paths, suffix))
+        except FileNotFoundError:
+            pass
+    files = sorted(set(files))
+    if not files:
+        raise FileNotFoundError(f"no image files match {paths}")
+
+    def make(f):
+        def read():
+            from PIL import Image
+            img = Image.open(f).convert(mode)
+            if size is not None:
+                img = img.resize(size)
+            arr = np.asarray(img)
+            return to_block({"image": arr[None], "path": [f]})
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
+
+
+def read_binary_files(paths: str | list[str],
+                      include_paths: bool = True) -> Dataset:
+    files = _expand(paths, "")
+
+    def make(f):
+        def read():
+            with open(f, "rb") as fh:
+                data = fh.read()
+            row = {"bytes": [data]}
+            if include_paths:
+                row["path"] = [f]
+            return to_block(row)
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])

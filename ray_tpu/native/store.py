@@ -85,6 +85,8 @@ class NativeStore:
 
     def put(self, object_id: bytes, data: bytes) -> bool:
         """False when the arena is full (caller should spill)."""
+        if self._closed:
+            return False
         rc = self._lib.rts_put(self._h, self._check_id(object_id),
                                bytes(data), len(data))
         if rc == -2:
@@ -93,6 +95,8 @@ class NativeStore:
 
     def get(self, object_id: bytes) -> memoryview | None:
         """Zero-copy view over the mapped bytes (valid until delete)."""
+        if self._closed:
+            return None
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         found = self._lib.rts_get(self._h, self._check_id(object_id),
@@ -105,6 +109,8 @@ class NativeStore:
         return memoryview(buf).cast("B")
 
     def contains(self, object_id: bytes) -> bool:
+        if self._closed:
+            return False
         off = ctypes.c_uint64()
         size = ctypes.c_uint64()
         return bool(self._lib.rts_get(
@@ -112,16 +118,27 @@ class NativeStore:
             ctypes.byref(off), ctypes.byref(size)))
 
     def delete(self, object_id: bytes) -> bool:
+        # Guard against finalizer-ordered calls after close(): GC can
+        # run ObjectRef release callbacks after runtime shutdown, and
+        # rts_delete on a munmapped arena is a segfault.
+        if self._closed:
+            return False
         return bool(self._lib.rts_delete(self._h,
                                          self._check_id(object_id)))
 
     def used_bytes(self) -> int:
+        if self._closed:
+            return 0
         return self._lib.rts_used_bytes(self._h)
 
     def capacity(self) -> int:
+        if self._closed:
+            return 0
         return self._lib.rts_capacity(self._h)
 
     def num_objects(self) -> int:
+        if self._closed:
+            return 0
         return self._lib.rts_num_objects(self._h)
 
     def close(self) -> None:

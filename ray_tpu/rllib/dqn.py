@@ -1,0 +1,222 @@
+"""DQN: off-policy Q-learning with replay + target network.
+
+Reference analog: rllib/algorithms/dqn/ (new API stack: EnvRunners
+sample with epsilon-greedy, a Learner does TD updates from a replay
+buffer, target net synced periodically). TPU-first shape: the TD
+minibatch update is ONE jitted program (double-Q target, Huber loss,
+Adam); the replay buffer is host-side numpy — only minibatches move to
+the device.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.models import ActorCriticConfig, QNetwork
+
+
+@dataclass
+class DQNHyperparams:
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_size: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    num_gradient_steps: int = 8      # per train() call
+    target_update_freq: int = 4      # in train() calls
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_iters: int = 20
+    double_q: bool = True
+
+
+class ReplayBuffer:
+    """Circular numpy transition store (host RAM — the reference's
+    EpisodeReplayBuffer analog)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self._i = 0
+        self.size = 0
+
+    def add_episodes(self, episodes) -> int:
+        n = 0
+        for ep in episodes:
+            obs_seq = ep.obs + [ep.final_obs]
+            for t in range(ep.length):
+                done = float(ep.terminated and t == ep.length - 1)
+                self._add(obs_seq[t], ep.actions[t], ep.rewards[t],
+                          obs_seq[t + 1], done)
+                n += 1
+        return n
+
+    def _add(self, o, a, r, o2, d) -> None:
+        i = self._i
+        self.obs[i], self.actions[i] = o, a
+        self.rewards[i], self.next_obs[i], self.dones[i] = r, o2, d
+        self._i = (i + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch_size: int, rng) -> dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, batch_size)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "next_obs": self.next_obs[idx],
+                "dones": self.dones[idx]}
+
+
+class DQNLearner:
+    def __init__(self, policy_config: dict, hp: DQNHyperparams,
+                 seed: int = 0):
+        self.hp = hp
+        self.model = QNetwork(ActorCriticConfig(**policy_config))
+        self.params = self.model.init_params(jax.random.key(seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt = optax.adam(hp.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._update = jax.jit(self._update_fn, donate_argnums=(0, 1))
+
+    def _update_fn(self, params, opt_state, target_params, batch):
+        hp = self.hp
+
+        def loss_fn(p):
+            q = self.model.apply({"params": p}, batch["obs"])
+            q_sa = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=-1)[:, 0]
+            q_next_t = self.model.apply({"params": target_params},
+                                        batch["next_obs"])
+            if hp.double_q:
+                # online net picks the argmax, target net evaluates it
+                q_next_o = self.model.apply({"params": p},
+                                            batch["next_obs"])
+                a_star = jnp.argmax(q_next_o, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], axis=-1)[:, 0]
+            else:
+                q_next = q_next_t.max(axis=-1)
+            target = batch["rewards"] + hp.gamma * \
+                (1.0 - batch["dones"]) * jax.lax.stop_gradient(q_next)
+            td = q_sa - target
+            loss = jnp.mean(optax.huber_loss(td))
+            return loss, jnp.mean(jnp.abs(td))
+
+        (loss, mean_td), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"td_error": mean_td, "loss": loss}
+
+    def update(self, batch: dict[str, np.ndarray]) -> dict:
+        mb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, self.target_params, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self) -> None:
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+@dataclass
+class DQNConfig:
+    env: Any = None
+    policy_config: dict = field(default_factory=dict)
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 128
+    hparams: DQNHyperparams = field(default_factory=DQNHyperparams)
+    seed: int = 0
+
+    def environment(self, env, *, obs_dim: int, num_actions: int,
+                    hidden: tuple = (64, 64)) -> "DQNConfig":
+        return replace(self, env=env, policy_config={
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hidden": hidden})
+
+    def env_runners(self, num_env_runners: int) -> "DQNConfig":
+        return replace(self, num_env_runners=num_env_runners)
+
+    def training(self, **hp_overrides) -> "DQNConfig":
+        return replace(self, hparams=replace(self.hparams,
+                                             **hp_overrides))
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        assert config.env is not None
+        self.config = config
+        hp = config.hparams
+        self.learner = DQNLearner(config.policy_config, hp,
+                                  seed=config.seed)
+        self.runners = EnvRunnerGroup(
+            config.env, config.policy_config,
+            num_runners=config.num_env_runners, seed=config.seed,
+            policy="epsilon_greedy")
+        self.buffer = ReplayBuffer(hp.buffer_size,
+                                   config.policy_config["obs_dim"])
+        self.rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+        self.runners.set_weights(self.learner.get_weights())
+
+    def _epsilon(self) -> float:
+        hp = self.config.hparams
+        frac = min(1.0, self.iteration / max(1, hp.epsilon_decay_iters))
+        return hp.epsilon_initial + frac * (hp.epsilon_final
+                                            - hp.epsilon_initial)
+
+    def train(self) -> dict:
+        hp = self.config.hparams
+        t0 = time.time()
+        self.runners.set_epsilon(self._epsilon())
+        episodes = self.runners.sample(
+            self.config.rollout_fragment_length)
+        added = self.buffer.add_episodes(episodes)
+        sample_time = time.time() - t0
+
+        metrics: dict = {}
+        t1 = time.time()
+        if self.buffer.size >= hp.learning_starts:
+            for _ in range(hp.num_gradient_steps):
+                batch = self.buffer.sample(hp.train_batch_size,
+                                           self.rng)
+                metrics = self.learner.update(batch)
+            if (self.iteration + 1) % hp.target_update_freq == 0:
+                self.learner.sync_target()
+            self.runners.set_weights(self.learner.get_weights())
+        learn_time = time.time() - t1
+
+        self.iteration += 1
+        finished = [e for e in episodes if e.terminated or e.truncated]
+        mean_reward = (sum(e.total_reward for e in finished)
+                       / len(finished)) if finished else float("nan")
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_reward,
+            "episodes_this_iter": len(finished),
+            "num_env_steps_sampled": added,
+            "buffer_size": self.buffer.size,
+            "epsilon": round(self._epsilon(), 4),
+            "time_sample_s": round(sample_time, 3),
+            "time_learn_s": round(learn_time, 3),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        self.runners.shutdown()

@@ -27,6 +27,7 @@ class Episode:
     terminated: bool = False
     truncated: bool = False
     last_value: float = 0.0
+    final_obs: Any = None     # obs after the last step (off-policy)
 
     @property
     def length(self) -> int:
@@ -42,10 +43,8 @@ class EnvRunner:
     """One sampling actor: vectorized-ish env loop with a host policy."""
 
     def __init__(self, env_maker_or_name, policy_config: dict,
-                 seed: int = 0):
+                 seed: int = 0, policy: str = "categorical"):
         import jax
-
-        from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
 
         if isinstance(env_maker_or_name, str):
             import gymnasium
@@ -53,7 +52,27 @@ class EnvRunner:
         else:
             self.env = env_maker_or_name()
         self.rng = np.random.default_rng(seed)
-        self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        self.policy = policy
+        self.epsilon = 1.0          # epsilon_greedy only
+        self._key = jax.random.key(seed)
+        if policy == "categorical":
+            from ray_tpu.rllib.models import (
+                ActorCritic, ActorCriticConfig,
+            )
+            self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        elif policy == "epsilon_greedy":
+            from ray_tpu.rllib.models import (
+                ActorCriticConfig, QNetwork,
+            )
+            self.model = QNetwork(ActorCriticConfig(**policy_config))
+        elif policy == "gaussian":
+            from ray_tpu.rllib.models import (
+                ContinuousConfig, SquashedGaussianActor,
+            )
+            self.model = SquashedGaussianActor(
+                ContinuousConfig(**policy_config))
+        else:
+            raise ValueError(f"unknown policy {policy!r}")
         self.params = self.model.init_params(jax.random.key(seed))
         self._fwd = jax.jit(
             lambda p, o: self.model.apply({"params": p}, o))
@@ -63,33 +82,65 @@ class EnvRunner:
         self.params = params
         return True
 
-    def sample(self, num_steps: int) -> list:
-        """Collect ~num_steps of experience as Episode chunks."""
+    def set_epsilon(self, epsilon: float) -> bool:
+        self.epsilon = float(epsilon)
+        return True
+
+    def _act(self, obs):
+        """Policy-dependent action selection on host.
+        Returns (env_action, stored_action, logp, value)."""
+        import jax
         import jax.nn as jnn
 
-        episodes: list[Episode] = []
-        ep = Episode()
-        for _ in range(num_steps):
-            logits, value = self._fwd(self.params, self._obs[None])
+        if self.policy == "categorical":
+            logits, value = self._fwd(self.params, obs[None])
             probs = np.asarray(jnn.softmax(logits[0]))
             action = int(self.rng.choice(len(probs), p=probs))
             logp = float(np.log(probs[action] + 1e-9))
-            next_obs, reward, term, trunc, _ = self.env.step(action)
+            return action, action, logp, float(value[0])
+        if self.policy == "epsilon_greedy":
+            q = np.asarray(self._fwd(self.params, obs[None])[0])
+            if self.rng.random() < self.epsilon:
+                action = int(self.rng.integers(len(q)))
+            else:
+                action = int(np.argmax(q))
+            return action, action, 0.0, float(q[action])
+        # gaussian (SAC)
+        from ray_tpu.rllib.models import SquashedGaussianActor
+        mu, log_std = self._fwd(self.params, obs[None])
+        self._key, sub = jax.random.split(self._key)
+        a, logp = SquashedGaussianActor.sample(mu, log_std, sub)
+        a = np.asarray(a[0], dtype=np.float32)
+        return a, a, float(logp[0]), 0.0
+
+    def sample(self, num_steps: int) -> list:
+        """Collect ~num_steps of experience as Episode chunks."""
+        episodes: list[Episode] = []
+        ep = Episode()
+        for _ in range(num_steps):
+            env_action, action, logp, value = self._act(
+                np.asarray(self._obs, dtype=np.float32))
+            next_obs, reward, term, trunc, _ = self.env.step(env_action)
             ep.obs.append(np.asarray(self._obs, dtype=np.float32))
             ep.actions.append(action)
             ep.rewards.append(float(reward))
             ep.logps.append(logp)
-            ep.values.append(float(value[0]))
+            ep.values.append(value)
             self._obs = next_obs
             if term or trunc:
                 ep.terminated, ep.truncated = term, trunc
                 ep.last_value = 0.0
+                ep.final_obs = np.asarray(next_obs, dtype=np.float32)
                 episodes.append(ep)
                 ep = Episode()
                 self._obs, _ = self.env.reset()
         if ep.length:
-            _, last_v = self._fwd(self.params, self._obs[None])
-            ep.last_value = float(last_v[0])
+            if self.policy == "categorical":
+                _, last_v = self._fwd(
+                    self.params,
+                    np.asarray(self._obs, np.float32)[None])
+                ep.last_value = float(last_v[0])
+            ep.final_obs = np.asarray(self._obs, dtype=np.float32)
             episodes.append(ep)
         return episodes
 
@@ -102,12 +153,15 @@ class EnvRunnerGroup:
     (reference: EnvRunnerGroup probe-and-restore)."""
 
     def __init__(self, env_maker_or_name, policy_config: dict,
-                 num_runners: int = 2, seed: int = 0):
+                 num_runners: int = 2, seed: int = 0,
+                 policy: str = "categorical"):
         self._maker = env_maker_or_name
         self._policy_config = policy_config
         self._seed = seed
+        self._policy = policy
         self.runners = [
-            EnvRunner.remote(env_maker_or_name, policy_config, seed + i)
+            EnvRunner.remote(env_maker_or_name, policy_config,
+                             seed + i, policy)
             for i in range(num_runners)
         ]
 
@@ -120,13 +174,17 @@ class EnvRunnerGroup:
             except Exception:  # noqa: BLE001 — respawn lost runner
                 self.runners[i] = EnvRunner.remote(
                     self._maker, self._policy_config,
-                    self._seed + i + 1000)
+                    self._seed + i + 1000, self._policy)
         return episodes
 
     def set_weights(self, params) -> None:
         ref = ray_tpu.put(params)   # broadcast via object store
         ray_tpu.get([r.set_weights.remote(ref) for r in self.runners],
                     timeout=120)
+
+    def set_epsilon(self, epsilon: float) -> None:
+        ray_tpu.get([r.set_epsilon.remote(epsilon)
+                     for r in self.runners], timeout=120)
 
     def shutdown(self) -> None:
         for r in self.runners:

@@ -41,3 +41,94 @@ class ActorCritic(nn.Module):
     def init_params(self, rng):
         obs = jnp.zeros((1, self.config.obs_dim))
         return self.init(rng, obs)["params"]
+
+
+class QNetwork(nn.Module):
+    """State-action value net for DQN (reference:
+    rllib/algorithms/dqn — the RLModule's Q head)."""
+
+    config: ActorCriticConfig
+
+    @nn.compact
+    def __call__(self, obs):
+        cfg = self.config
+        x = obs.astype(cfg.dtype)
+        for i, h in enumerate(cfg.hidden):
+            x = nn.relu(nn.Dense(h, name=f"fc{i}", dtype=cfg.dtype)(x))
+        return nn.Dense(cfg.num_actions, name="q",
+                        dtype=cfg.dtype)(x)
+
+    def init_params(self, rng):
+        obs = jnp.zeros((1, self.config.obs_dim))
+        return self.init(rng, obs)["params"]
+
+
+@dataclass(frozen=True)
+class ContinuousConfig:
+    obs_dim: int
+    action_dim: int
+    hidden: tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+class SquashedGaussianActor(nn.Module):
+    """Tanh-squashed gaussian policy (SAC actor)."""
+
+    config: ContinuousConfig
+    LOG_STD_MIN: float = -10.0
+    LOG_STD_MAX: float = 2.0
+
+    @nn.compact
+    def __call__(self, obs):
+        cfg = self.config
+        x = obs.astype(cfg.dtype)
+        for i, h in enumerate(cfg.hidden):
+            x = nn.relu(nn.Dense(h, name=f"fc{i}", dtype=cfg.dtype)(x))
+        mu = nn.Dense(cfg.action_dim, name="mu", dtype=cfg.dtype)(x)
+        log_std = nn.Dense(cfg.action_dim, name="log_std",
+                           dtype=cfg.dtype)(x)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mu, log_std
+
+    def init_params(self, rng):
+        obs = jnp.zeros((1, self.config.obs_dim))
+        return self.init(rng, obs)["params"]
+
+    @staticmethod
+    def sample(mu, log_std, key):
+        """Reparameterized tanh-gaussian sample with log-prob."""
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        a = jnp.tanh(pre)
+        logp = (-0.5 * (eps ** 2 + 2 * log_std
+                        + jnp.log(2 * jnp.pi))).sum(-1)
+        # tanh change-of-variables correction
+        logp -= jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+        return a, logp
+
+
+class TwinQ(nn.Module):
+    """Two independent Q(s, a) critics (SAC's clipped double-Q)."""
+
+    config: ContinuousConfig
+
+    @nn.compact
+    def __call__(self, obs, action):
+        cfg = self.config
+        x = jnp.concatenate(
+            [obs.astype(cfg.dtype), action.astype(cfg.dtype)], axis=-1)
+        outs = []
+        for head in ("q1", "q2"):
+            h = x
+            for i, width in enumerate(cfg.hidden):
+                h = nn.relu(nn.Dense(width, name=f"{head}_fc{i}",
+                                     dtype=cfg.dtype)(h))
+            outs.append(nn.Dense(1, name=head,
+                                 dtype=cfg.dtype)(h)[..., 0])
+        return outs[0], outs[1]
+
+    def init_params(self, rng):
+        obs = jnp.zeros((1, self.config.obs_dim))
+        act = jnp.zeros((1, self.config.action_dim))
+        return self.init(rng, obs, act)["params"]

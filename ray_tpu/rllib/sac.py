@@ -1,0 +1,260 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference analog: rllib/algorithms/sac/ — off-policy maximum-entropy
+RL: tanh-gaussian actor, twin Q critics with clipped double-Q targets,
+polyak-averaged target critics, and automatic entropy-temperature
+tuning against a target entropy of -|A|. TPU-first shape: actor,
+critic, and alpha updates are ONE jitted program per minibatch; the
+replay buffer stays host-side numpy.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.models import (
+    ContinuousConfig, SquashedGaussianActor, TwinQ,
+)
+
+
+@dataclass
+class SACHyperparams:
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005              # polyak target rate
+    buffer_size: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    num_gradient_steps: int = 8
+    init_alpha: float = 0.1
+
+
+class ContinuousReplayBuffer:
+    def __init__(self, capacity: int, obs_dim: int, action_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity, action_dim), np.float32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self._i = 0
+        self.size = 0
+
+    def add_episodes(self, episodes) -> int:
+        n = 0
+        for ep in episodes:
+            obs_seq = ep.obs + [ep.final_obs]
+            for t in range(ep.length):
+                done = float(ep.terminated and t == ep.length - 1)
+                i = self._i
+                self.obs[i] = obs_seq[t]
+                self.actions[i] = ep.actions[t]
+                self.rewards[i] = ep.rewards[t]
+                self.next_obs[i] = obs_seq[t + 1]
+                self.dones[i] = done
+                self._i = (i + 1) % self.capacity
+                self.size = min(self.size + 1, self.capacity)
+                n += 1
+        return n
+
+    def sample(self, batch_size: int, rng) -> dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, batch_size)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "next_obs": self.next_obs[idx],
+                "dones": self.dones[idx]}
+
+
+class SACLearner:
+    def __init__(self, policy_config: dict, hp: SACHyperparams,
+                 seed: int = 0):
+        self.hp = hp
+        cfg = ContinuousConfig(**policy_config)
+        self.actor = SquashedGaussianActor(cfg)
+        self.critic = TwinQ(cfg)
+        k = jax.random.key(seed)
+        ka, kc = jax.random.split(k)
+        self.actor_params = self.actor.init_params(ka)
+        self.critic_params = self.critic.init_params(kc)
+        self.target_critic_params = jax.tree.map(
+            jnp.copy, self.critic_params)
+        self.log_alpha = jnp.log(jnp.asarray(hp.init_alpha))
+        self.target_entropy = -float(cfg.action_dim)
+        self.actor_opt = optax.adam(hp.actor_lr)
+        self.critic_opt = optax.adam(hp.critic_lr)
+        self.alpha_opt = optax.adam(hp.alpha_lr)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.critic_opt_state = self.critic_opt.init(self.critic_params)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self._step = jax.jit(self._step_fn)
+
+    def _step_fn(self, actor_p, critic_p, target_p, log_alpha,
+                 actor_os, critic_os, alpha_os, batch, key):
+        hp = self.hp
+        alpha = jnp.exp(log_alpha)
+        k1, k2 = jax.random.split(key)
+
+        # -- critic update: clipped double-Q soft target --
+        mu_n, lstd_n = self.actor.apply({"params": actor_p},
+                                        batch["next_obs"])
+        a_next, logp_next = SquashedGaussianActor.sample(mu_n, lstd_n,
+                                                         k1)
+        q1_t, q2_t = self.critic.apply({"params": target_p},
+                                       batch["next_obs"], a_next)
+        q_target = jnp.minimum(q1_t, q2_t) - alpha * logp_next
+        y = batch["rewards"] + hp.gamma * (1 - batch["dones"]) * \
+            jax.lax.stop_gradient(q_target)
+
+        def critic_loss_fn(p):
+            q1, q2 = self.critic.apply({"params": p}, batch["obs"],
+                                       batch["actions"])
+            return ((q1 - y) ** 2 + (q2 - y) ** 2).mean()
+
+        c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(critic_p)
+        c_updates, critic_os = self.critic_opt.update(
+            c_grads, critic_os, critic_p)
+        critic_p = optax.apply_updates(critic_p, c_updates)
+
+        # -- actor update: maximize soft value --
+        def actor_loss_fn(p):
+            mu, lstd = self.actor.apply({"params": p}, batch["obs"])
+            a, logp = SquashedGaussianActor.sample(mu, lstd, k2)
+            q1, q2 = self.critic.apply({"params": critic_p},
+                                       batch["obs"], a)
+            q = jnp.minimum(q1, q2)
+            return (alpha * logp - q).mean(), logp.mean()
+
+        (a_loss, mean_logp), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True)(actor_p)
+        a_updates, actor_os = self.actor_opt.update(
+            a_grads, actor_os, actor_p)
+        actor_p = optax.apply_updates(actor_p, a_updates)
+
+        # -- temperature update toward target entropy --
+        def alpha_loss_fn(la):
+            return -(jnp.exp(la) * jax.lax.stop_gradient(
+                mean_logp + self.target_entropy))
+
+        al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+        al_updates, alpha_os = self.alpha_opt.update(
+            al_grad, alpha_os, log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, al_updates)
+
+        # -- polyak target --
+        target_p = jax.tree.map(
+            lambda t, o: (1 - hp.tau) * t + hp.tau * o,
+            target_p, critic_p)
+
+        metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha),
+                   "entropy": -mean_logp}
+        return (actor_p, critic_p, target_p, log_alpha,
+                actor_os, critic_os, alpha_os, metrics)
+
+    def update(self, batch: dict[str, np.ndarray], key) -> dict:
+        mb = {k: jnp.asarray(v) for k, v in batch.items()}
+        (self.actor_params, self.critic_params,
+         self.target_critic_params, self.log_alpha,
+         self.actor_opt_state, self.critic_opt_state,
+         self.alpha_opt_state, metrics) = self._step(
+            self.actor_params, self.critic_params,
+            self.target_critic_params, self.log_alpha,
+            self.actor_opt_state, self.critic_opt_state,
+            self.alpha_opt_state, mb, key)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.actor_params)
+
+
+@dataclass
+class SACConfig:
+    env: Any = None
+    policy_config: dict = field(default_factory=dict)
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 64
+    hparams: SACHyperparams = field(default_factory=SACHyperparams)
+    seed: int = 0
+
+    def environment(self, env, *, obs_dim: int, action_dim: int,
+                    hidden: tuple = (64, 64)) -> "SACConfig":
+        return replace(self, env=env, policy_config={
+            "obs_dim": obs_dim, "action_dim": action_dim,
+            "hidden": hidden})
+
+    def env_runners(self, num_env_runners: int) -> "SACConfig":
+        return replace(self, num_env_runners=num_env_runners)
+
+    def training(self, **hp_overrides) -> "SACConfig":
+        return replace(self, hparams=replace(self.hparams,
+                                             **hp_overrides))
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        assert config.env is not None
+        self.config = config
+        hp = config.hparams
+        self.learner = SACLearner(config.policy_config, hp,
+                                  seed=config.seed)
+        self.runners = EnvRunnerGroup(
+            config.env, config.policy_config,
+            num_runners=config.num_env_runners, seed=config.seed,
+            policy="gaussian")
+        self.buffer = ContinuousReplayBuffer(
+            hp.buffer_size, config.policy_config["obs_dim"],
+            config.policy_config["action_dim"])
+        self.rng = np.random.default_rng(config.seed)
+        self._key = jax.random.key(config.seed + 1)
+        self.iteration = 0
+        self.runners.set_weights(self.learner.get_weights())
+
+    def train(self) -> dict:
+        hp = self.config.hparams
+        t0 = time.time()
+        episodes = self.runners.sample(
+            self.config.rollout_fragment_length)
+        added = self.buffer.add_episodes(episodes)
+        sample_time = time.time() - t0
+
+        metrics: dict = {}
+        t1 = time.time()
+        if self.buffer.size >= hp.learning_starts:
+            for _ in range(hp.num_gradient_steps):
+                self._key, sub = jax.random.split(self._key)
+                batch = self.buffer.sample(hp.train_batch_size,
+                                           self.rng)
+                metrics = self.learner.update(batch, sub)
+            self.runners.set_weights(self.learner.get_weights())
+        learn_time = time.time() - t1
+
+        self.iteration += 1
+        finished = [e for e in episodes if e.terminated or e.truncated]
+        mean_reward = (sum(e.total_reward for e in finished)
+                       / len(finished)) if finished else float("nan")
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_reward,
+            "episodes_this_iter": len(finished),
+            "num_env_steps_sampled": added,
+            "buffer_size": self.buffer.size,
+            "time_sample_s": round(sample_time, 3),
+            "time_learn_s": round(learn_time, 3),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        self.runners.shutdown()

@@ -19,8 +19,14 @@ from ray_tpu.serve.api import (
     Deployment,
     DeploymentHandle,
 )
+from ray_tpu.serve.autoscaling import AutoscalingConfig
+from ray_tpu.serve.multiplex import (
+    get_multiplexed_model_id,
+    multiplexed,
+)
 
 __all__ = [
     "deployment", "run", "shutdown", "get_deployment_handle", "batch",
     "Application", "Deployment", "DeploymentHandle",
+    "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]

@@ -30,20 +30,24 @@ class Deployment:
     num_replicas: int = 1
     ray_actor_options: dict = field(default_factory=dict)
     user_config: Any = None
+    autoscaling_config: dict | None = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
 
     def options(self, *, num_replicas: int | None = None,
                 name: str | None = None,
-                ray_actor_options: dict | None = None) -> "Deployment":
+                ray_actor_options: dict | None = None,
+                autoscaling_config: dict | None = None) -> "Deployment":
         return Deployment(
             cls=self.cls,
             name=name or self.name,
             num_replicas=num_replicas or self.num_replicas,
             ray_actor_options=ray_actor_options
             or self.ray_actor_options,
-            user_config=self.user_config)
+            user_config=self.user_config,
+            autoscaling_config=autoscaling_config
+            or self.autoscaling_config)
 
 
 @dataclass
@@ -58,14 +62,24 @@ class DeploymentHandle:
     handle.py:710). ``handle.remote(...)`` and
     ``handle.method.remote(...)`` return ObjectRefs."""
 
-    def __init__(self, deployment_name: str, controller=None):
+    def __init__(self, deployment_name: str, controller=None,
+                 multiplexed_model_id: str = ""):
         self._name = deployment_name
         self._controller = controller or ray_tpu.get_actor(
             CONTROLLER_NAME)
         self._router = Router(self._controller, deployment_name)
+        self._model_id = multiplexed_model_id
+
+    def options(self, *, multiplexed_model_id: str = ""
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(self._name, self._controller,
+                             multiplexed_model_id=multiplexed_model_id)
+        h._router = self._router     # share replica cache
+        return h
 
     def remote(self, *args, **kwargs):
-        return self._router.assign("__call__", args, kwargs)
+        return self._router.assign("__call__", args, kwargs,
+                                   multiplexed_model_id=self._model_id)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -77,24 +91,27 @@ class DeploymentHandle:
                 self._name = name
 
             def remote(self, *args, **kwargs):
-                return self._outer._router.assign(self._name, args,
-                                                  kwargs)
+                return self._outer._router.assign(
+                    self._name, args, kwargs,
+                    multiplexed_model_id=self._outer._model_id)
 
         return _Method(self, method)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name,))
+        return (DeploymentHandle, (self._name, None, self._model_id))
 
 
 def deployment(cls: type | None = None, *, name: str | None = None,
                num_replicas: int = 1,
-               ray_actor_options: dict | None = None):
+               ray_actor_options: dict | None = None,
+               autoscaling_config: dict | None = None):
     """Decorator turning a class (or function) into a Deployment."""
     def wrap(target):
         return Deployment(
             cls=target, name=name or target.__name__,
             num_replicas=num_replicas,
-            ray_actor_options=ray_actor_options or {})
+            ray_actor_options=ray_actor_options or {},
+            autoscaling_config=autoscaling_config)
     if cls is not None:
         return wrap(cls)
     return wrap
@@ -128,7 +145,7 @@ def _deploy_tree(app: Application, controller) -> str:
         resources["TPU"] = d.ray_actor_options["num_tpus"]
     ray_tpu.get(controller.deploy.remote(
         d.name, ser.dumps(d.cls), args, kwargs, d.num_replicas,
-        resources), timeout=120)
+        resources, d.autoscaling_config), timeout=120)
     return d.name
 
 

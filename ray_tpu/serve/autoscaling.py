@@ -1,0 +1,68 @@
+"""Deployment autoscaling policy.
+
+Reference analog: python/ray/serve/_private/{autoscaling_state,
+autoscaling_policy}.py — replicas report ongoing requests; desired
+replicas = ceil(total_ongoing / target_ongoing_requests), clamped to
+[min_replicas, max_replicas], smoothed by upscale/downscale delays so
+transient spikes don't thrash the replica set.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 2.0
+    look_back_period_s: float = 5.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AutoscalingConfig":
+        return cls(**{k: v for k, v in d.items()
+                      if k in cls.__dataclass_fields__})
+
+
+@dataclass
+class AutoscalingState:
+    config: AutoscalingConfig
+    window: list = field(default_factory=list)   # (ts, total_ongoing)
+    _pending_since: float | None = None
+    _pending_target: int | None = None
+
+    def record(self, total_ongoing: float) -> None:
+        now = time.monotonic()
+        self.window.append((now, total_ongoing))
+        cutoff = now - self.config.look_back_period_s
+        self.window = [(t, v) for (t, v) in self.window if t >= cutoff]
+
+    def decide(self, current_replicas: int) -> int:
+        """Return the replica count the deployment should have now."""
+        cfg = self.config
+        if not self.window:
+            return max(cfg.min_replicas,
+                       min(current_replicas, cfg.max_replicas))
+        avg = sum(v for _, v in self.window) / len(self.window)
+        raw = math.ceil(avg / max(cfg.target_ongoing_requests, 1e-9))
+        target = max(cfg.min_replicas, min(cfg.max_replicas, raw))
+        if target == current_replicas:
+            self._pending_since = None
+            self._pending_target = None
+            return current_replicas
+        delay = (cfg.upscale_delay_s if target > current_replicas
+                 else cfg.downscale_delay_s)
+        now = time.monotonic()
+        if self._pending_target != target:
+            self._pending_target = target
+            self._pending_since = now
+        if now - (self._pending_since or now) >= delay:
+            self._pending_since = None
+            self._pending_target = None
+            return target
+        return current_replicas

@@ -4,7 +4,9 @@ Reference analog: ServeController (controller.py:86) + DeploymentState
 reconcile (deployment_state.py:1232): desired state (deployments map)
 vs live state (replica actors); a background loop starts/stops
 replicas to converge, respawns dead ones, and bumps a version so
-routers refresh their replica sets.
+routers refresh their replica sets. Deployment autoscaling
+(autoscaling_state.py) runs inside the same loop: replica queue
+lengths recorded each pass drive the ceil(ongoing/target) policy.
 """
 
 from __future__ import annotations
@@ -13,6 +15,7 @@ import threading
 import time
 
 import ray_tpu
+from ray_tpu.serve.autoscaling import AutoscalingConfig, AutoscalingState
 from ray_tpu.serve.replica import Replica
 
 CONTROLLER_NAME = "ray_tpu_serve_controller"
@@ -25,6 +28,12 @@ class ServeController:
         self.desired: dict[str, dict] = {}
         self.replicas: dict[str, list] = {}
         self.versions: dict[str, int] = {}
+        self.autoscaling: dict[str, AutoscalingState] = {}
+        # name -> {model_id -> [replica indices]} from last probe
+        self.model_map: dict[str, dict[str, list[int]]] = {}
+        # scale-down victims draining in-flight requests before kill:
+        # name -> [(replica, deadline)]
+        self.draining: dict[str, list] = {}
         self._stop = False
         self._rec_lock = threading.Lock()
         self._thread = threading.Thread(target=self._reconcile_loop,
@@ -34,7 +43,8 @@ class ServeController:
     # -- desired state --
 
     def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
-               num_replicas: int, resources: dict) -> bool:
+               num_replicas: int, resources: dict,
+               autoscaling_config: dict | None = None) -> bool:
         from ray_tpu.core import serialization as ser
         self.desired[name] = {
             "cls": ser.loads(cls_blob),
@@ -42,6 +52,12 @@ class ServeController:
             "num_replicas": num_replicas,
             "resources": resources or {},
         }
+        if autoscaling_config:
+            cfg = AutoscalingConfig.from_dict(autoscaling_config)
+            self.autoscaling[name] = AutoscalingState(config=cfg)
+            self.desired[name]["num_replicas"] = cfg.min_replicas
+        else:
+            self.autoscaling.pop(name, None)
         self.versions.setdefault(name, 0)
         self._reconcile_once()
         return True
@@ -59,6 +75,14 @@ class ServeController:
     def get_replicas(self, name: str):
         return self.versions.get(name, 0), list(
             self.replicas.get(name, []))
+
+    def get_model_replicas(self, name: str, model_id: str):
+        """Replicas that had ``model_id`` resident at the last probe —
+        the router's model-locality hint (reference: multiplex-aware
+        pow-2 scheduling)."""
+        idxs = self.model_map.get(name, {}).get(model_id, [])
+        live = self.replicas.get(name, [])
+        return [live[i] for i in idxs if i < len(live)]
 
     def list_deployments(self) -> dict:
         return {name: {"num_replicas": len(self.replicas.get(name, [])),
@@ -91,16 +115,28 @@ class ServeController:
                 self.versions[name] = self.versions.get(name, 0) + 1
         for name, spec in self.desired.items():
             live = self.replicas.setdefault(name, [])
-            # drop dead replicas (health probe)
-            alive = []
+            # probe replicas: liveness + stats (queue lens, models)
+            alive, stats = [], []
             changed = False
             for r in live:
                 try:
-                    ray_tpu.get(r.queue_len.remote(), timeout=5)
+                    s = ray_tpu.get(r.stats.remote(), timeout=5)
                     alive.append(r)
+                    stats.append(s)
                 except Exception:  # noqa: BLE001
                     changed = True
             live = alive
+            # autoscaling decision from observed load
+            auto = self.autoscaling.get(name)
+            if auto is not None:
+                auto.record(sum(s["inflight"] for s in stats))
+                spec["num_replicas"] = auto.decide(spec["num_replicas"])
+            # model-locality map for the router
+            mmap: dict[str, list[int]] = {}
+            for i, s in enumerate(stats):
+                for mid in s.get("model_ids", []):
+                    mmap.setdefault(mid, []).append(i)
+            self.model_map[name] = mmap
             while len(live) < spec["num_replicas"]:
                 tag = f"{name}#{len(live)}_{int(time.time()*1e3)%100000}"
                 resources = dict(spec["resources"])
@@ -112,15 +148,40 @@ class ServeController:
                 ).remote(spec["cls"], spec["args"], spec["kwargs"], tag))
                 changed = True
             while len(live) > spec["num_replicas"]:
+                # Graceful scale-down: stop routing to the victim (it
+                # leaves the replica set now, version bump below) but
+                # only kill it once its in-flight requests drain —
+                # killing a busy replica fails user requests.
                 victim = live.pop()
+                self.draining.setdefault(name, []).append(
+                    (victim, time.time() + 30.0))
+                changed = True
+            self.replicas[name] = live
+            self._reap_draining(name)
+            if changed:
+                self.versions[name] = self.versions.get(name, 0) + 1
+
+    def _reap_draining(self, name: str) -> None:
+        still = []
+        for victim, deadline in self.draining.get(name, []):
+            done = time.time() > deadline
+            if not done:
+                try:
+                    done = ray_tpu.get(victim.queue_len.remote(),
+                                       timeout=5) == 0
+                except Exception:  # noqa: BLE001 — already dead
+                    done = True
+            if done:
                 try:
                     ray_tpu.kill(victim)
                 except Exception:  # noqa: BLE001
                     pass
-                changed = True
-            self.replicas[name] = live
-            if changed:
-                self.versions[name] = self.versions.get(name, 0) + 1
+            else:
+                still.append((victim, deadline))
+        if still:
+            self.draining[name] = still
+        else:
+            self.draining.pop(name, None)
 
     def graceful_shutdown(self) -> bool:
         self._stop = True

@@ -1,0 +1,106 @@
+"""Model multiplexing: many models time-share one replica pool.
+
+Reference analog: python/ray/serve/multiplex.py +
+model-multiplex-aware routing in pow_2_scheduler.py — a replica holds
+an LRU cache of loaded models (``@serve.multiplexed``); requests carry
+a ``multiplexed_model_id`` and the router prefers replicas that
+already have that model resident (on TPU: model weights already on
+the chip — avoiding a reload is the difference between µs and
+seconds).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+
+_current_model_id = threading.local()
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request being handled (valid inside a
+    replica's request path)."""
+    return getattr(_current_model_id, "value", "")
+
+
+def _set_current_model_id(model_id: str) -> None:
+    _current_model_id.value = model_id
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorate a replica method ``load_model(self, model_id)`` so
+    repeated calls hit a per-instance LRU cache; evicted models call
+    ``model.__del__`` naturally (or an ``unload()`` if defined)."""
+
+    def wrap(fn):
+        attr = f"__serve_mux_cache_{fn.__name__}"
+        lock_attr = f"__serve_mux_lock_{fn.__name__}"
+        loading_attr = f"__serve_mux_loading_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def inner(self, model_id: str):
+            lock = getattr(self, lock_attr, None)
+            if lock is None:
+                lock = threading.Lock()
+                setattr(self, lock_attr, lock)
+            while True:
+                with lock:
+                    cache: OrderedDict = getattr(self, attr, None)
+                    if cache is None:
+                        cache = OrderedDict()
+                        setattr(self, attr, cache)
+                    loading: dict = getattr(self, loading_attr, None)
+                    if loading is None:
+                        loading = {}
+                        setattr(self, loading_attr, loading)
+                    if model_id in cache:
+                        cache.move_to_end(model_id)
+                        return cache[model_id]
+                    ev = loading.get(model_id)
+                    if ev is None:
+                        loading[model_id] = threading.Event()
+                        break   # we are the loader for this model id
+                # Another request is mid-load for the same model: wait
+                # instead of loading a duplicate copy (a second
+                # multi-GB weight load onto the same chip).
+                ev.wait(timeout=600)
+            try:
+                model = fn(self, model_id)
+            except BaseException:
+                with lock:
+                    loading.pop(model_id).set()
+                raise
+            with lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    _, evicted = cache.popitem(last=False)
+                    unload = getattr(evicted, "unload", None)
+                    if callable(unload):
+                        try:
+                            unload()
+                        except Exception:  # noqa: BLE001
+                            pass
+                loading.pop(model_id).set()
+            return model
+
+        inner.__serve_is_multiplexed__ = True
+        return inner
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+def resident_model_ids(obj) -> list[str]:
+    """All model ids currently cached by any @multiplexed method of
+    the replica's user object (reported to the controller so the
+    router can do model-locality-aware picks)."""
+    out: list[str] = []
+    for name in dir(obj):
+        if name.startswith("__serve_mux_cache_"):
+            cache = getattr(obj, name)
+            if isinstance(cache, OrderedDict):
+                out.extend(cache.keys())
+    return out

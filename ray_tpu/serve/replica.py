@@ -26,10 +26,13 @@ class Replica:
         else:
             self.callable = cls_or_fn
 
-    def handle_request(self, method_name: str, args, kwargs):
+    def handle_request(self, method_name: str, args, kwargs,
+                       multiplexed_model_id: str = ""):
+        from ray_tpu.serve.multiplex import _set_current_model_id
         with self._lock:
             self._inflight += 1
             self._total += 1
+        _set_current_model_id(multiplexed_model_id)
         try:
             target = (self.callable if method_name == "__call__"
                       and not isinstance(self.callable, object.__class__)
@@ -51,8 +54,10 @@ class Replica:
         return self._inflight
 
     def stats(self) -> dict:
+        from ray_tpu.serve.multiplex import resident_model_ids
         return {"tag": self.tag, "inflight": self._inflight,
-                "total": self._total}
+                "total": self._total,
+                "model_ids": resident_model_ids(self.callable)}
 
     def reconfigure(self, user_config) -> bool:
         if hasattr(self.callable, "reconfigure"):

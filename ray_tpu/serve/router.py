@@ -28,7 +28,7 @@ class Router:
         self._version = version
         self._replicas = replicas
 
-    def pick_replica(self):
+    def pick_replica(self, multiplexed_model_id: str = ""):
         version = ray_tpu.get(
             self._controller.get_version.remote(self._name))
         if version != self._version or not self._replicas:
@@ -36,9 +36,18 @@ class Router:
         if not self._replicas:
             raise RuntimeError(
                 f"deployment {self._name!r} has no replicas")
-        if len(self._replicas) == 1:
-            return self._replicas[0]
-        a, b = self._rng.sample(self._replicas, 2)
+        pool = self._replicas
+        if multiplexed_model_id:
+            # Model-locality-aware pick (reference: multiplex-aware
+            # pow-2): prefer replicas with the model already resident.
+            with_model = ray_tpu.get(
+                self._controller.get_model_replicas.remote(
+                    self._name, multiplexed_model_id))
+            if with_model:
+                pool = with_model
+        if len(pool) == 1:
+            return pool[0]
+        a, b = self._rng.sample(pool, 2)
         try:
             qa, qb = ray_tpu.get(
                 [a.queue_len.remote(), b.queue_len.remote()],
@@ -48,6 +57,9 @@ class Router:
             return a
         return a if qa <= qb else b
 
-    def assign(self, method_name: str, args, kwargs):
-        replica = self.pick_replica()
-        return replica.handle_request.remote(method_name, args, kwargs)
+    def assign(self, method_name: str, args, kwargs,
+               multiplexed_model_id: str = ""):
+        replica = self.pick_replica(multiplexed_model_id)
+        return replica.handle_request.remote(
+            method_name, args, kwargs,
+            multiplexed_model_id=multiplexed_model_id)

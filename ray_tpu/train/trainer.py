@@ -87,7 +87,8 @@ class JaxTrainer:
                 # the on-disk record can be ahead of e.latest_ckpt —
                 # recover from whichever is newest.
                 latest = _latest_complete_checkpoint(
-                    trial_dir, e.latest_ckpt, exclude=preexisting)
+                    trial_dir, e.latest_ckpt, exclude=preexisting,
+                    world_size=self.scaling.num_workers)
                 if max_failures >= 0 and attempt > max_failures:
                     return Result(metrics={}, checkpoint_dir=latest,
                                   path=trial_dir, error=e.error)
@@ -147,14 +148,16 @@ class JaxTrainer:
 
 def _latest_complete_checkpoint(
         trial_dir: str, polled: str | None, *,
-        exclude: frozenset[str] = frozenset()) -> str | None:
+        exclude: frozenset[str] = frozenset(),
+        world_size: int = 1) -> str | None:
     """Newest on-disk checkpoint that finished persisting, preferring
     disk over the lossy polled report stream. Complete = rank 0's
-    marker exists AND every rank shard directory that was started
-    (``rank_N/``) has its matching marker — this accepts the
-    rank-0-only checkpoint pattern (replicated state) while rejecting
-    sharded saves interrupted mid-copy. ``exclude`` filters out
-    checkpoints from a previous run reusing the name."""
+    marker exists AND, when the save is sharded (any ``rank_N/``
+    present), ALL ``world_size`` ranks have their markers — a rank
+    that died before even creating its shard directory must not make
+    the checkpoint look complete. Rank-0-only checkpoints (replicated
+    state) have no rank dirs and stay accepted. ``exclude`` filters
+    out checkpoints from a previous run reusing the name."""
     from ray_tpu.train.session import checkpoint_index
 
     def complete(d: str) -> bool:
@@ -165,11 +168,12 @@ def _latest_complete_checkpoint(
             entries = os.listdir(path)
         except OSError:
             return False
-        for e in entries:
-            if e.startswith("rank_") and e[5:].isdigit():
-                if f".complete_rank_{e[5:]}" not in entries:
-                    return False
-        return True
+        sharded = any(e.startswith("rank_") and e[5:].isdigit()
+                      for e in entries)
+        if not sharded:
+            return True
+        return all(f".complete_rank_{r}" in entries
+                   for r in range(world_size))
 
     best = polled
     try:

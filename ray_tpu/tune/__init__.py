@@ -7,15 +7,22 @@ SURVEY.md §2.4 "one pod slice per trial").
 
 from ray_tpu.tune.search import (
     grid_search, choice, uniform, loguniform, randint,
-    BasicVariantGenerator,
+    BasicVariantGenerator, RandomSearcher, TPESearcher,
+    ConcurrencyLimiter, Searcher,
 )
-from ray_tpu.tune.schedulers import FIFOScheduler, ASHAScheduler
+from ray_tpu.tune.schedulers import (
+    FIFOScheduler, ASHAScheduler, HyperBandScheduler,
+    MedianStoppingRule, PopulationBasedTraining,
+)
 from ray_tpu.tune.tune import (
     Tuner, TuneConfig, Trial, ResultGrid, TrialResult,
 )
 
 __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "BasicVariantGenerator", "FIFOScheduler", "ASHAScheduler",
+    "BasicVariantGenerator", "RandomSearcher", "TPESearcher",
+    "ConcurrencyLimiter", "Searcher",
+    "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
     "Tuner", "TuneConfig", "Trial", "ResultGrid", "TrialResult",
 ]

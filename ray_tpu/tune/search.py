@@ -78,10 +78,21 @@ def _sample(spec, rng: random.Random):
 
 
 class Searcher:
-    """Suggestion interface (reference: tune.search.Searcher)."""
+    """Suggestion interface (reference: tune.search.Searcher).
+
+    ``suggest`` returning None means either *exhausted* (when
+    ``is_finished()`` is True) or *not ready yet* (a concurrency
+    limiter holding back suggestions) — the controller re-polls in the
+    latter case. Custom subclasses that don't override
+    ``is_finished`` are treated as exhausted once ``suggest`` returns
+    None with no trials in flight (the controller's fallback).
+    """
 
     def suggest(self, trial_id: str) -> dict | None:
         raise NotImplementedError
+
+    def is_finished(self) -> bool:
+        return False
 
     def on_trial_complete(self, trial_id: str, result: dict | None,
                           error: bool = False) -> None:
@@ -126,3 +137,175 @@ class BasicVariantGenerator(Searcher):
         cfg = self._variants[self._i]
         self._i += 1
         return cfg
+
+    def is_finished(self) -> bool:
+        return self._i >= len(self._variants)
+
+
+class RandomSearcher(Searcher):
+    """Pure random sampling from the space, ``num_samples`` trials."""
+
+    def __init__(self, param_space: dict, num_samples: int = 10,
+                 seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._n = 0
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._n >= self.num_samples:
+            return None
+        self._n += 1
+        return {k: _sample(v, self.rng)
+                for k, v in self.param_space.items()}
+
+    def is_finished(self) -> bool:
+        return self._n >= self.num_samples
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (the algorithm behind the
+    reference's OptunaSearch/HyperOptSearch default samplers,
+    python/ray/tune/search/{optuna,hyperopt}/).
+
+    After ``n_startup`` random trials, observations are split at the
+    ``gamma`` quantile into good/bad sets per dimension; candidates
+    are drawn from a Parzen (gaussian-kernel) density over the good
+    set and ranked by the likelihood ratio l_good/l_bad. Categorical
+    dims use smoothed count ratios. Pure numpy — no external deps.
+    """
+
+    def __init__(self, param_space: dict, metric: str = "loss",
+                 mode: str = "min", num_samples: int = 32,
+                 n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int | None = None):
+        self.param_space = param_space
+        self.metric, self.mode = metric, mode
+        self.num_samples = num_samples
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._n = 0
+        self._obs: list[tuple[dict, float]] = []   # (config, score↓)
+        self._pending: dict[str, dict] = {}
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._n >= self.num_samples:
+            return None
+        self._n += 1
+        if len(self._obs) < self.n_startup:
+            cfg = {k: _sample(v, self.rng)
+                   for k, v in self.param_space.items()}
+        else:
+            cfg = self._tpe_suggest()
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def is_finished(self) -> bool:
+        return self._n >= self.num_samples
+
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or error or not result or \
+                self.metric not in result:
+            return
+        v = float(result[self.metric])
+        score = -v if self.mode == "max" else v
+        self._obs.append((cfg, score))
+
+    # -- TPE internals --
+
+    def _tpe_suggest(self) -> dict:
+        import math
+        obs = sorted(self._obs, key=lambda cv: cv[1])
+        n_good = max(1, int(len(obs) * self.gamma))
+        good, bad = obs[:n_good], obs[n_good:]
+        out = {}
+        for key, spec in self.param_space.items():
+            gvals = [c[key] for c, _ in good if key in c]
+            bvals = [c[key] for c, _ in bad if key in c]
+            if isinstance(spec, (_Choice, _GridSearch)):
+                out[key] = self._categorical(spec, gvals, bvals)
+                continue
+            if not isinstance(spec, (_Uniform, _LogUniform, _RandInt)):
+                out[key] = _sample(spec, self.rng)
+                continue
+            logspace = isinstance(spec, _LogUniform)
+            xform = math.log if logspace else (lambda x: x)
+            inv = math.exp if logspace else (lambda x: x)
+            lo, hi = xform(spec.low), xform(spec.high)
+            g = [xform(v) for v in gvals] or [(lo + hi) / 2]
+            b = [xform(v) for v in bvals]
+            bw = max((hi - lo) / 8,
+                     _std(g) if len(g) > 1 else (hi - lo) / 8)
+            best_x, best_ratio = None, -math.inf
+            for _ in range(self.n_candidates):
+                mu = self.rng.choice(g)
+                x = min(hi, max(lo, self.rng.gauss(mu, bw)))
+                ratio = _kde(x, g, bw) / max(_kde(x, b, bw), 1e-12)
+                if ratio > best_ratio:
+                    best_x, best_ratio = x, ratio
+            val = inv(best_x)
+            if isinstance(spec, _RandInt):
+                val = min(spec.high - 1, max(spec.low, round(val)))
+            out[key] = val
+        return out
+
+    def _categorical(self, spec, gvals, bvals):
+        values = list(spec.values)
+        gc = {v: 1.0 for v in values}
+        bc = {v: 1.0 for v in values}
+        for v in gvals:
+            gc[v] = gc.get(v, 1.0) + 1
+        for v in bvals:
+            bc[v] = bc.get(v, 1.0) + 1
+        weights = [gc[v] / bc[v] for v in values]
+        total = sum(weights)
+        r = self.rng.uniform(0, total)
+        acc = 0.0
+        for v, w in zip(values, weights):
+            acc += w
+            if r <= acc:
+                return v
+        return values[-1]
+
+
+def _std(xs: list[float]) -> float:
+    m = sum(xs) / len(xs)
+    return (sum((x - m) ** 2 for x in xs) / len(xs)) ** 0.5
+
+
+def _kde(x: float, xs: list[float], bw: float) -> float:
+    import math
+    if not xs:
+        return 1e-12
+    s = sum(math.exp(-0.5 * ((x - m) / bw) ** 2) for m in xs)
+    return s / (len(xs) * bw * math.sqrt(2 * math.pi))
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions from the wrapped searcher
+    (reference: python/ray/tune/search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def is_finished(self) -> bool:
+        return self.searcher.is_finished()
+
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error=error)

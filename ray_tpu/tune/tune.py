@@ -1,15 +1,21 @@
 """Tuner + trial controller.
 
 Reference call stack being re-based (SURVEY.md §3.4 / §2.3 Tune):
-``Tuner.fit`` → controller event loop managing trials as actors.
-A trial is one TrainWorker-style actor (function trainables), or a
-whole JaxTrainer (its gang nests through the core runtime — actors
-creating actors). The ASHA scheduler prunes at rung boundaries by
-killing the trial actor; FailureConfig-style retry is per-trial.
+``Tuner.fit`` → controller event loop managing trials as actors
+(python/ray/tune/execution/tune_controller.py:68). A trial is one
+TrainWorker-style actor (function trainables), or a whole JaxTrainer
+(its gang nests through the core runtime — actors creating actors).
+Schedulers act at result boundaries: ASHA/HyperBand/median-stop kill
+the trial actor; PBT restarts it from a donor's checkpoint with a
+mutated config (EXPLOIT). Experiment state is journaled to
+``<exp_dir>/experiment_state.json`` after every controller step so
+``Tuner.restore`` can resume an interrupted run (reference:
+python/ray/tune/execution/experiment_state.py).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import time
 import uuid
@@ -19,7 +25,9 @@ from typing import Any, Callable
 import ray_tpu
 from ray_tpu.train.config import RunConfig
 from ray_tpu.train.worker_group import TrainWorker
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import (
+    CONTINUE, EXPLOIT, STOP, FIFOScheduler,
+)
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 
 
@@ -29,7 +37,7 @@ class TuneConfig:
     max_concurrent_trials: int = 0      # 0 = resource-bound
     metric: str | None = None
     mode: str = "min"
-    scheduler: Any = None               # FIFOScheduler | ASHAScheduler
+    scheduler: Any = None               # FIFO/ASHA/HyperBand/PBT/...
     search_alg: Searcher | None = None
     resources_per_trial: dict[str, float] = field(
         default_factory=lambda: {"CPU": 1.0})
@@ -58,6 +66,8 @@ class Trial:
     history: list = field(default_factory=list)
     checkpoint_dir: str | None = None
     error: str | None = None
+    restore_from: str | None = None     # PBT exploit checkpoint
+    perturbations: int = 0
 
 
 class ResultGrid:
@@ -91,16 +101,44 @@ class Tuner:
                  *,
                  param_space: dict | None = None,
                  tune_config: TuneConfig | None = None,
-                 run_config: RunConfig | None = None):
+                 run_config: RunConfig | None = None,
+                 _restore_trials: list[Trial] | None = None):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_trials = _restore_trials
+
+    @classmethod
+    def restore(cls, exp_dir: str, trainable: Callable | Any,
+                *, tune_config: TuneConfig | None = None) -> "Tuner":
+        """Resume an interrupted experiment from its journaled state:
+        completed trials keep their results; pending/running/errored
+        trials are re-run (from their latest checkpoint when the
+        trainable consumes ``restored_checkpoint_dir``)."""
+        state_file = os.path.join(exp_dir, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        trials = []
+        for row in state["trials"]:
+            t = Trial(trial_id=row["trial_id"], config=row["config"],
+                      state=row["state"], metrics=row["metrics"],
+                      history=row["history"],
+                      checkpoint_dir=row["checkpoint_dir"],
+                      error=row["error"])
+            if t.state != "COMPLETED":
+                t.state = "PENDING"
+                t.restore_from = t.checkpoint_dir
+                t.metrics, t.history, t.error = {}, [], None
+            trials.append(t)
+        run_config = RunConfig(
+            name=os.path.basename(exp_dir.rstrip("/")),
+            storage_path=os.path.dirname(exp_dir.rstrip("/")))
+        return cls(trainable, tune_config=tune_config,
+                   run_config=run_config, _restore_trials=trials)
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
-        searcher = tc.search_alg or BasicVariantGenerator(
-            self.param_space, tc.num_samples, seed=tc.seed)
         scheduler = tc.scheduler or FIFOScheduler()
 
         exp_name = self.run_config.name or f"tune_{int(time.time())}"
@@ -108,32 +146,61 @@ class Tuner:
         os.makedirs(exp_dir, exist_ok=True)
 
         fn = _as_function_trainable(self.trainable)
-
-        # Materialize trials up front from the searcher.
-        trials: list[Trial] = []
-        while True:
-            tid = f"trial_{len(trials):05d}_{uuid.uuid4().hex[:6]}"
-            cfg = searcher.suggest(tid)
-            if cfg is None:
-                break
-            trials.append(Trial(trial_id=tid, config=cfg))
-
         max_conc = tc.max_concurrent_trials or self._resource_bound(tc)
-        pending = list(trials)
-        running: list[Trial] = []
 
-        while pending or running:
+        trials: list[Trial] = []
+        pending: list[Trial] = []
+        if self._restore_trials is not None:
+            trials = self._restore_trials
+            pending = [t for t in trials if t.state == "PENDING"]
+            searcher: Searcher | None = None
+        else:
+            searcher = tc.search_alg or BasicVariantGenerator(
+                self.param_space, tc.num_samples, seed=tc.seed)
+
+        running: list[Trial] = []
+        exhausted = False   # fallback for searchers that never
+        #                     override is_finished()
+
+        def searcher_drained() -> bool:
+            return (searcher is None or exhausted
+                    or searcher.is_finished())
+
+        while True:
+            # Admit: restored pending trials first, then fresh
+            # suggestions — lazily, so ConcurrencyLimiter-style
+            # searchers see live trial counts.
             while pending and len(running) < max_conc:
                 t = pending.pop(0)
-                self._start_trial(t, fn, exp_dir, tc)
+                self._start_trial(t, fn, exp_dir, tc, scheduler)
                 running.append(t)
+            while (searcher is not None and not searcher_drained()
+                   and len(running) < max_conc):
+                tid = f"trial_{len(trials):05d}_{uuid.uuid4().hex[:6]}"
+                cfg = searcher.suggest(tid)
+                if cfg is None:
+                    # Limiter holding back (re-poll later) — unless
+                    # nothing is in flight, in which case no progress
+                    # is possible and the searcher is exhausted.
+                    if not running and not pending:
+                        exhausted = True
+                    break
+                t = Trial(trial_id=tid, config=cfg)
+                trials.append(t)
+                self._start_trial(t, fn, exp_dir, tc, scheduler)
+                running.append(t)
+            if not running and not pending and searcher_drained():
+                break
             time.sleep(0.05)
             still = []
             for t in running:
-                if self._poll_trial(t, scheduler, searcher):
+                if self._poll_trial(t, fn, exp_dir, tc, scheduler,
+                                    searcher):
                     still.append(t)
             running = still
+            self._save_state(exp_dir, trials)
 
+        self._save_state(exp_dir, trials)
         results = [TrialResult(
             trial_id=t.trial_id, config=t.config, metrics=t.metrics,
             metrics_history=t.history, checkpoint_dir=t.checkpoint_dir,
@@ -147,10 +214,27 @@ class Tuner:
         per = tc.resources_per_trial.get("CPU", 1.0) or 1.0
         return max(1, int(total.get("CPU", 1.0) // per))
 
+    def _save_state(self, exp_dir: str, trials: list[Trial]) -> None:
+        state = {"trials": [
+            {"trial_id": t.trial_id, "config": t.config,
+             "state": t.state, "metrics": t.metrics,
+             "history": t.history, "checkpoint_dir": t.checkpoint_dir,
+             "error": t.error} for t in trials]}
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f, default=str)
+            os.replace(tmp,
+                       os.path.join(exp_dir, "experiment_state.json"))
+        except (OSError, TypeError):
+            pass   # non-serializable config — resume unsupported
+
     def _start_trial(self, t: Trial, fn, exp_dir: str,
-                     tc: TuneConfig) -> None:
+                     tc: TuneConfig, scheduler) -> None:
         trial_dir = os.path.join(exp_dir, t.trial_id)
         os.makedirs(trial_dir, exist_ok=True)
+        if hasattr(scheduler, "on_trial_add"):
+            scheduler.on_trial_add(t.trial_id, t.config)
         t.actor = TrainWorker.options(
             num_cpus=tc.resources_per_trial.get("CPU", 1.0),
             resources={k: v for k, v in tc.resources_per_trial.items()
@@ -160,19 +244,21 @@ class Tuner:
             "experiment_name": os.path.basename(exp_dir),
             "storage_path": self.run_config.storage_path,
             "trial_dir": trial_dir,
-            "restored_checkpoint_dir": None,
+            "restored_checkpoint_dir": t.restore_from,
         }
         t.state = "RUNNING"
         t.actor.start_loop.remote((fn, t.config), ctx_kwargs)
 
-    def _poll_trial(self, t: Trial, scheduler, searcher) -> bool:
+    def _poll_trial(self, t: Trial, fn, exp_dir: str, tc: TuneConfig,
+                    scheduler, searcher) -> bool:
         """Poll one trial; True if still running."""
         try:
             p = ray_tpu.get(t.actor.poll.remote(), timeout=60)
         except Exception as e:  # noqa: BLE001 — actor died
             t.state = "ERROR"
             t.error = str(e)
-            searcher.on_trial_complete(t.trial_id, None, error=True)
+            if searcher:
+                searcher.on_trial_complete(t.trial_id, None, error=True)
             return False
         decision = CONTINUE
         for r in p["results"]:
@@ -183,21 +269,36 @@ class Tuner:
             t.history.append(m)
             if r["checkpoint_dir"]:
                 t.checkpoint_dir = r["checkpoint_dir"]
+                if hasattr(scheduler, "on_checkpoint"):
+                    scheduler.on_checkpoint(t.trial_id,
+                                            r["checkpoint_dir"])
             decision = scheduler.on_result(t.trial_id, m)
-            if decision == STOP:
+            if decision in (STOP, EXPLOIT):
                 break
+        if decision == EXPLOIT and not p["done"]:
+            # PBT: restart this trial from a donor's checkpoint with a
+            # mutated config. Counts as the same trial (same id).
+            new_config, donor_ckpt = scheduler.exploit(t.trial_id)
+            ray_tpu.kill(t.actor)
+            t.config = new_config
+            t.restore_from = donor_ckpt
+            t.perturbations += 1
+            self._start_trial(t, fn, exp_dir, tc, scheduler)
+            return True
         if decision == STOP and not p["done"]:
             t.state = "STOPPED"
             ray_tpu.kill(t.actor)
             scheduler.on_trial_complete(t.trial_id)
-            searcher.on_trial_complete(t.trial_id, t.metrics)
+            if searcher:
+                searcher.on_trial_complete(t.trial_id, t.metrics)
             return False
         if p["done"]:
             t.state = "ERROR" if p["error"] else "COMPLETED"
             t.error = p["error"]
             scheduler.on_trial_complete(t.trial_id)
-            searcher.on_trial_complete(t.trial_id, t.metrics,
-                                       error=bool(p["error"]))
+            if searcher:
+                searcher.on_trial_complete(t.trial_id, t.metrics,
+                                           error=bool(p["error"]))
             ray_tpu.kill(t.actor)
             return False
         return True
@@ -209,7 +310,6 @@ def _as_function_trainable(trainable) -> Callable:
     if isinstance(trainable, JaxTrainer):
         def run_trainer(config):
             from ray_tpu.train import report
-            import copy
             trainer = JaxTrainer(
                 trainable.train_loop,
                 train_loop_config={**trainable.loop_config, **config},

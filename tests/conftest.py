@@ -28,6 +28,11 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running learning/e2e test")
+
+
 @pytest.fixture
 def rt():
     """A fresh multiprocess runtime per test."""

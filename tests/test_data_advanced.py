@@ -1,0 +1,139 @@
+"""Data: sort, groupby/aggregate, zip/union, column ops, new IO.
+
+Reference analogs: ray.data Dataset.sort (sample-based range
+partition), GroupedData aggregates (hash shuffle), zip/union,
+image/binary datasources, iter_torch_batches.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rdata
+
+
+def test_sort_distributed(rt):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(200)
+    ds = rdata.from_numpy({"x": vals}, parallelism=8).sort("x")
+    out = [r["x"] for r in ds.take_all()]
+    assert out == sorted(vals.tolist())
+
+    out_desc = [r["x"] for r in
+                rdata.from_numpy({"x": vals}, parallelism=4)
+                .sort("x", descending=True).take_all()]
+    assert out_desc == sorted(vals.tolist(), reverse=True)
+
+
+def test_groupby_aggregates(rt):
+    n = 60
+    ds = rdata.range(n, parallelism=6).add_column(
+        "g", lambda b: b["id"] % 3)
+    counts = {r["g"]: r["count()"]
+              for r in ds.groupby("g").count().take_all()}
+    assert counts == {0: 20, 1: 20, 2: 20}
+
+    sums = {r["g"]: r["sum(id)"]
+            for r in ds.groupby("g").sum("id").take_all()}
+    expect = {g: sum(i for i in range(n) if i % 3 == g)
+              for g in range(3)}
+    assert sums == expect
+
+    means = {r["g"]: r["mean(id)"]
+             for r in ds.groupby("g").mean("id").take_all()}
+    assert means[0] == pytest.approx(expect[0] / 20)
+
+    mins = {r["g"]: r["min(id)"]
+            for r in ds.groupby("g").min("id").take_all()}
+    assert mins == {0: 0, 1: 1, 2: 2}
+
+
+def test_groupby_map_groups(rt):
+    ds = rdata.from_items(
+        [{"k": i % 2, "v": float(i)} for i in range(10)])
+    out = ds.groupby("k").map_groups(
+        lambda g: {"k": int(g["k"][0]),
+                   "spread": float(g["v"].max() - g["v"].min())})
+    rows = {r["k"]: r["spread"] for r in out.take_all()}
+    assert rows == {0: 8.0, 1: 8.0}
+
+
+def test_zip_and_union(rt):
+    a = rdata.from_numpy({"x": np.arange(10)}, parallelism=3)
+    b = rdata.from_numpy({"y": np.arange(10) * 2}, parallelism=2)
+    z = a.zip(b)
+    rows = z.take_all()
+    assert len(rows) == 10
+    assert all(r["y"] == 2 * r["x"] for r in rows)
+
+    u = a.union(rdata.from_numpy({"x": np.arange(10, 15)}))
+    assert sorted(r["x"] for r in u.take_all()) == list(range(15))
+
+
+def test_zip_mismatch_raises(rt):
+    a = rdata.range(4)
+    b = rdata.range(5)
+    with pytest.raises((ValueError, Exception)):
+        a.zip(b).take_all()
+
+
+def test_column_ops_and_scalar_aggs(rt):
+    ds = rdata.range(10, parallelism=2).add_column(
+        "sq", lambda b: b["id"] ** 2)
+    rows = ds.select_columns(["sq"]).take_all()
+    assert [r["sq"] for r in rows] == [i * i for i in range(10)]
+    renamed = ds.rename_columns({"sq": "square"}).take(1)[0]
+    assert "square" in renamed and "sq" not in renamed
+    dropped = ds.drop_columns(["sq"]).take(1)[0]
+    assert set(dropped) == {"id"}
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0 and ds.max("id") == 9
+    assert ds.mean("id") == pytest.approx(4.5)
+    assert ds.unique("sq") == [i * i for i in range(10)]
+
+
+def test_write_read_csv_json(rt):
+    with tempfile.TemporaryDirectory() as tmp:
+        ds = rdata.range(20, parallelism=2)
+        ds.write_csv(f"{tmp}/csv")
+        back = rdata.read_csv(f"{tmp}/csv")
+        assert sorted(r["id"] for r in back.take_all()) == \
+            list(range(20))
+        ds.write_json(f"{tmp}/json")
+        files = os.listdir(f"{tmp}/json")
+        assert files and all(f.endswith(".json") for f in files)
+
+
+def test_read_images(rt):
+    from PIL import Image
+    with tempfile.TemporaryDirectory() as tmp:
+        for i in range(3):
+            arr = np.full((8, 8, 3), i * 10, np.uint8)
+            Image.fromarray(arr).save(f"{tmp}/img{i}.png")
+        ds = rdata.read_images(tmp, size=(4, 4))
+        batches = list(ds.iter_batches())
+        imgs = np.concatenate([b["image"] for b in batches])
+        assert imgs.shape == (3, 4, 4, 3)
+        assert sorted(int(im[0, 0, 0]) for im in imgs) == [0, 10, 20]
+
+
+def test_read_binary_files(rt):
+    with tempfile.TemporaryDirectory() as tmp:
+        for i in range(2):
+            with open(f"{tmp}/f{i}.bin", "wb") as f:
+                f.write(bytes([i] * 4))
+        ds = rdata.read_binary_files(f"{tmp}/*.bin")
+        rows = sorted(ds.take_all(), key=lambda r: r["path"])
+        assert rows[0]["bytes"] == bytes([0] * 4)
+        assert rows[1]["bytes"] == bytes([1] * 4)
+
+
+def test_iter_torch_batches(rt):
+    import torch
+    ds = rdata.range(16, parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=8))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], torch.Tensor)
+    assert batches[0]["id"].shape == (8,)
