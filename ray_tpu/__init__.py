@@ -35,7 +35,7 @@ from ray_tpu.core.api import (
     method,
     get_runtime_context,
 )
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.actor import ActorHandle
 from ray_tpu.core.exceptions import (
     RayTpuError,
@@ -70,6 +70,7 @@ __all__ = [
     "timeline",
     "get_runtime_context",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "RayTpuError",
     "TaskError",

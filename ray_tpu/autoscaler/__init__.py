@@ -1,0 +1,24 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference analog (SURVEY.md §2.2): autoscaler v2's reconciler design
+(python/ray/autoscaler/v2/: read demand from the control plane,
+bin-pack onto node types, drive a NodeProvider) rather than v1's
+imperative StandardAutoscaler. TPU angle: a node type is a whole pod
+slice (atomic resource bundle, e.g. ``{"TPU": 8, "TPU-v5e-8-head": 1}``)
+— the provider launches/terminates slices, never fractions of one.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    NodeTypeConfig,
+)
+from ray_tpu.autoscaler.node_provider import (
+    LocalNodeProvider,
+    NodeProvider,
+)
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "NodeTypeConfig",
+    "NodeProvider", "LocalNodeProvider",
+]

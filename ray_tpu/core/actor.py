@@ -21,10 +21,21 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         from ray_tpu.core.api import get_runtime
+        from ray_tpu.util.tracing import get_tracer
         rt = get_runtime()
-        refs = rt.submit_actor_task(
-            self._handle._actor_id, self._name, args, kwargs,
-            self._num_returns)
+        tracer = get_tracer()
+        if tracer.enabled:
+            with tracer.span(f"submit::{self._name}"):
+                refs = rt.submit_actor_task(
+                    self._handle._actor_id, self._name, args, kwargs,
+                    self._num_returns,
+                    trace_ctx=tracer.current_context())
+        else:
+            refs = rt.submit_actor_task(
+                self._handle._actor_id, self._name, args, kwargs,
+                self._num_returns)
+        if self._num_returns == "streaming":
+            return refs            # ObjectRefGenerator
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -68,6 +68,14 @@ class Config:
     # (reference: GcsHealthCheckManager thresholds, ray_config_def.h:847).
     health_check_failure_threshold: int = 5
 
+    # --- memory monitor / OOM killer (reference: MemoryMonitor
+    # memory_monitor.h:52 + worker_killing_policy_retriable_fifo) ---
+    # Kill a retriable task when system memory usage crosses this
+    # fraction (0 disables the monitor).
+    memory_usage_threshold: float = 0.95
+    # Seconds between memory polls.
+    memory_monitor_refresh_s: float = 1.0
+
     # --- timeouts ---
     get_timeout_default_s: float = 0.0  # 0 = no timeout
     actor_creation_timeout_s: float = 120.0

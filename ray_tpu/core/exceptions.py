@@ -72,3 +72,9 @@ class PlacementGroupError(RayTpuError):
 class RuntimeEnvSetupError(RayTpuError):
     """A runtime_env could not be built for a task/actor/job
     (reference: ray.exceptions.RuntimeEnvSetupError)."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """A task was killed by the memory monitor (reference: raylet OOM
+    killer, worker_killing_policy*.cc) more times than its retry
+    budget allowed."""

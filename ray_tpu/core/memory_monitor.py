@@ -1,0 +1,81 @@
+"""Memory monitor + OOM killer.
+
+Reference analog (SURVEY.md §2.1 N26): the raylet polls cgroup/system
+memory (``MemoryMonitor`` src/ray/common/memory_monitor.h:52) and,
+above a usage threshold, kills a *retriable* task instead of letting
+the OS OOM-killer take down the whole node — policy here is
+retriable-FIFO (worker_killing_policy_retriable_fifo.h): newest
+retriable running task dies first (it has made the least progress),
+and its normal worker-death retry path re-runs it when memory frees
+up. Tasks killed this way more times than their retry budget fail
+with ``OutOfMemoryError``.
+
+The memory source is injectable for tests (fake pressure without
+actually exhausting RAM).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+
+def system_memory() -> tuple[int, int]:
+    """(used_bytes, total_bytes), preferring the cgroup v2 limit when
+    this process runs inside a container."""
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            limit_s = f.read().strip()
+        if limit_s != "max":
+            limit = int(limit_s)
+            with open("/sys/fs/cgroup/memory.current") as f:
+                used = int(f.read().strip())
+            return used, limit
+    except (OSError, ValueError):
+        pass
+    total = avail = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+    except OSError:
+        return 0, 1
+    return max(0, total - avail), max(1, total)
+
+
+class MemoryMonitor:
+    """Polls memory usage; above threshold asks the runtime to kill
+    the newest retriable running task (retriable-FIFO policy)."""
+
+    def __init__(self, runtime, threshold: float,
+                 refresh_s: float = 1.0,
+                 source: Callable[[], tuple[int, int]] | None = None):
+        self._runtime = runtime
+        self._threshold = threshold
+        self._refresh = refresh_s
+        self._source = source or system_memory
+        self._stop = threading.Event()
+        self.kills = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="memory_monitor")
+        self._thread.start()
+
+    def usage_fraction(self) -> float:
+        used, total = self._source()
+        return used / max(1, total)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._refresh):
+            try:
+                if self.usage_fraction() >= self._threshold:
+                    if self._runtime.oom_kill_one():
+                        self.kills += 1
+            except Exception:  # noqa: BLE001 — monitor must survive
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()

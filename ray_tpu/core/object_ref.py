@@ -71,6 +71,62 @@ class ObjectRef:
         return get_runtime().as_future(self)
 
 
+class ObjectRefGenerator:
+    """Stream of ObjectRefs from a task declared
+    ``num_returns="streaming"`` (reference: generator/streaming
+    returns, ``ReportGeneratorItemReturns`` core_worker.proto:460).
+
+    Iterating yields ObjectRefs as the executing worker produces them
+    — items stream back one by one instead of waiting for the whole
+    task. Picklable: rebinds to the local runtime on deserialization,
+    so a generator handle can be passed to other tasks/actors.
+    """
+
+    def __init__(self, task_id_bytes: bytes, _owner: bool = False):
+        self._task_id_bytes = task_id_bytes
+        self._exhausted = False
+        # Only the originating handle drops the stream on GC; pickled
+        # copies passed to other processes must not tear it down.
+        self._owner = _owner
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next_sync(timeout=None)
+
+    def _next_sync(self, timeout: float | None) -> ObjectRef:
+        if self._exhausted:
+            raise StopIteration
+        from ray_tpu.core.api import get_runtime
+        nxt = get_runtime().stream_next(self._task_id_bytes, timeout)
+        if nxt is None:
+            self._exhausted = True
+            raise StopIteration
+        return nxt
+
+    def next_ready(self, timeout: float | None = None) -> ObjectRef:
+        """Blocking next with a timeout (TimeoutError on expiry)."""
+        return self._next_sync(timeout)
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id_bytes,))
+
+    def __del__(self):
+        if self._exhausted or not self._owner:
+            return
+        try:
+            from ray_tpu.core.api import get_runtime_or_none
+            rt = get_runtime_or_none()
+            if rt is not None:
+                rt.drop_stream(self._task_id_bytes)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id_bytes.hex()})"
+
+
 def _rehydrate_ref(id_bytes: bytes, owner_hint):
     ref = ObjectRef(ObjectID(id_bytes), owner_hint)
     # Register the deserializing process as a borrower so the owner keeps

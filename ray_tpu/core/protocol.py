@@ -28,6 +28,9 @@ EXEC_SHUTDOWN = "shutdown"    # (EXEC_SHUTDOWN,)
 RESULT_OK = "ok"              # (RESULT_OK, task_id_bytes, results_blob_list)
 RESULT_ERR = "err"            # (RESULT_ERR, task_id_bytes, err_blob)
 RESULT_READY = "ready"        # worker finished booting / actor __init__ done
+RESULT_STREAM = "stream"      # (RESULT_STREAM, task_id_bytes, index,
+                              #  (data, buffers)) — one yielded item
+RESULT_STREAM_END = "stream_end"  # (RESULT_STREAM_END, task_id_bytes, count)
 
 # client channel, worker -> driver: (req_id, op, payload...)
 OP_SUBMIT = "submit"
@@ -44,6 +47,10 @@ OP_RESOURCES = "resources"
 OP_STATE = "state"            # (kind, filters) -> list[dict] | dict
 OP_PG_CREATE = "pg_create"
 OP_PG_REMOVE = "pg_remove"
+OP_STREAM_NEXT = "stream_next"  # (task_id_bytes, timeout) ->
+                                #   ("item", oid_bytes) | ("done",)
+OP_STREAM_DROP = "stream_drop"  # task_id_bytes
+OP_SPANS = "spans"              # list of finished span dicts (tracing)
 
 # client channel, driver -> worker: (req_id, status, payload)
 ST_OK = "ok"

@@ -85,8 +85,23 @@ class RemoteFunction:
         options = make_task_options(**self._default_opts)
         if not self._default_opts.get("name"):
             options.name = self._fn.__name__
-        refs = rt.submit_task(self._fn_id, self._fn_blob,
-                              self._fn.__name__, args, kwargs, options)
+        from ray_tpu.util.tracing import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            # Reference: _tracing_task_invocation wraps .remote() and
+            # serializes the span context into the task
+            # (tracing_helper.py:293).
+            with tracer.span(f"submit::{options.name}"):
+                options.trace_ctx = tracer.current_context()
+                refs = rt.submit_task(
+                    self._fn_id, self._fn_blob, self._fn.__name__,
+                    args, kwargs, options)
+        else:
+            refs = rt.submit_task(self._fn_id, self._fn_blob,
+                                  self._fn.__name__, args, kwargs,
+                                  options)
+        if options.num_returns == "streaming":
+            return refs            # ObjectRefGenerator
         return refs[0] if options.num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):

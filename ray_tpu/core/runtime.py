@@ -47,7 +47,7 @@ from ray_tpu.core.exceptions import (
     TaskError,
 )
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
-from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.object_store import (
     MemoryStore,
     SharedMemoryStore,
@@ -73,6 +73,18 @@ class TaskOptions:
     scheduling_strategy: str = "DEFAULT"  # DEFAULT|SPREAD|NODE_AFFINITY
     node_id: str = ""              # NODE_AFFINITY target
     soft: bool = False             # NODE_AFFINITY soft fallback
+    trace_ctx: tuple | None = None  # (trace_id, span_id) propagation
+
+
+@dataclass
+class _StreamState:
+    """Driver-side state of one streaming-returns task."""
+    cv: threading.Condition
+    ready: deque = field(default_factory=deque)   # ObjectRefs not yet taken
+    produced: int = 0
+    consumed: int = 0
+    done: bool = False
+    err_blob: bytes | None = None
 
 
 @dataclass
@@ -113,6 +125,7 @@ class TaskRecord:
     # stat whole staged trees — too costly per dispatch/retry).
     env_key: str = ""
     env_vars: dict[str, str] | None = None
+    oom_killed: bool = False       # memory monitor chose this victim
 
 
 @dataclass
@@ -347,6 +360,11 @@ class DriverRuntime:
         self._task_lock = threading.Lock()
         self._fn_cache: dict[str, bytes] = {}
 
+        # Streaming generator returns (reference: generator returns,
+        # ReportGeneratorItemReturns): task_id -> stream state
+        self._streams: dict[TaskID, _StreamState] = {}
+        self._stream_lock = threading.Lock()
+
         # Worker pool
         self._workers: list[WorkerHandle] = []
         self._idle: dict[str, list[WorkerHandle]] = {}
@@ -384,6 +402,14 @@ class DriverRuntime:
             self._dispatch_thread = threading.Thread(
                 target=self._dispatch_loop, daemon=True, name="dispatcher")
             self._dispatch_thread.start()
+
+        # Memory monitor / OOM killer (reference: MemoryMonitor N26)
+        self.memory_monitor = None
+        if not local_mode and config.memory_usage_threshold > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+            self.memory_monitor = MemoryMonitor(
+                self, config.memory_usage_threshold,
+                config.memory_monitor_refresh_s)
 
     # ---------------- object plane ----------------
 
@@ -558,8 +584,10 @@ class DriverRuntime:
         # dispatch/retries reuse the resolved result.
         env_key, env_vars = self._env_for_options(options)
         task_id = TaskID.for_normal_task(self.job_id)
-        return_ids = [ObjectID.for_return(task_id, i)
-                      for i in range(options.num_returns)]
+        streaming = options.num_returns == "streaming"
+        return_ids = [] if streaming else [
+            ObjectID.for_return(task_id, i)
+            for i in range(options.num_returns)]
         args_blob, arg_refs = self._pack_args(args, kwargs)
         rec = TaskRecord(
             task_id=task_id, fn_id=fn_id, name=fn_name or "task",
@@ -568,6 +596,10 @@ class DriverRuntime:
             env_key=env_key, env_vars=env_vars)
         with self._task_lock:
             self._tasks[task_id] = rec
+        if streaming:
+            with self._stream_lock:
+                self._streams[task_id] = _StreamState(
+                    cv=threading.Condition())
         self._event(rec, "PENDING")
 
         if self.local_mode:
@@ -576,6 +608,8 @@ class DriverRuntime:
             with self._res_cv:
                 self._pending.append(rec)
                 self._res_cv.notify_all()
+        if streaming:
+            return ObjectRefGenerator(task_id.binary(), _owner=True)
         return [self.register_ref(ObjectRef(oid)) for oid in return_ids]
 
     def _pack_args(self, args: tuple, kwargs: dict):
@@ -606,7 +640,13 @@ class DriverRuntime:
         rec.started_at = time.time()
         try:
             result = fn(*args, **kwargs)
-            self._store_returns(rec, result)
+            if rec.options.num_returns == "streaming":
+                for i, item in enumerate(result):
+                    self._stream_item(rec.task_id, i,
+                                      ser.serialize(item))
+                self._finish_stream(rec.task_id)
+            else:
+                self._store_returns(rec, result)
             rec.state = "FINISHED"
         except Exception as e:  # noqa: BLE001
             tb = traceback.format_exc()
@@ -614,6 +654,7 @@ class DriverRuntime:
             blob = ser.dumps(err)
             for oid in rec.return_ids:
                 self._store_error(oid, blob)
+            self._finish_stream(rec.task_id, blob)
             rec.state = "FAILED"
         rec.finished_at = time.time()
         self._event(rec, rec.state)
@@ -632,6 +673,111 @@ class DriverRuntime:
         for oid, v in zip(rec.return_ids, values):
             self._store_value(oid, v if isinstance(v, SerializedObject)
                               else ser.serialize(v))
+
+    # ---------------- memory pressure (OOM killer) ----------------
+
+    def oom_kill_one(self) -> bool:
+        """Retriable-FIFO worker-killing policy (reference:
+        worker_killing_policy_retriable_fifo.h): kill the NEWEST
+        running retriable normal task — it has made the least
+        progress and will be retried by the worker-death path; fall
+        back to the newest running task when none are retriable."""
+        with self._task_lock:
+            running = [r for r in self._tasks.values()
+                       if r.state == "RUNNING" and r.worker is not None
+                       and not r.worker.is_actor]
+            if not running:
+                return False
+
+            def retriable(r: TaskRecord) -> bool:
+                mr = (r.options.max_retries
+                      if r.options.max_retries >= 0
+                      else self.config.task_max_retries)
+                return r.attempts <= mr
+
+            pool = [r for r in running if retriable(r)] or running
+            victim = max(pool, key=lambda r: r.started_at)
+            victim.oom_killed = True
+        try:
+            victim.worker.proc.terminate()
+        except Exception:  # noqa: BLE001
+            return False
+        return True
+
+    # ---------------- streaming returns ----------------
+
+    def _stream_item(self, task_id: TaskID, index: int,
+                     obj: SerializedObject) -> None:
+        oid = ObjectID.for_return(task_id, index)
+        self._store_value(oid, obj)
+        with self._stream_lock:
+            st = self._streams.get(task_id)
+        if st is None:
+            # Stream was dropped: free the stored item everywhere it
+            # may live (large items land in shm, not memory_store).
+            self.memory_store.delete(oid)
+            self.shm_store.delete(oid)
+            with self._obj_cv:
+                self._obj_locations.pop(oid, None)
+            return
+        ref = self.register_ref(ObjectRef(oid))
+        with st.cv:
+            st.ready.append(ref)
+            st.produced += 1
+            st.cv.notify_all()
+
+    def _finish_stream(self, task_id: TaskID,
+                       err_blob: bytes | None = None) -> None:
+        with self._stream_lock:
+            st = self._streams.get(task_id)
+        if st is None:
+            return
+        with st.cv:
+            st.done = True
+            if err_blob is not None:
+                st.err_blob = err_blob
+            st.cv.notify_all()
+
+    def stream_next(self, task_id_bytes: bytes,
+                    timeout: float | None = None) -> ObjectRef | None:
+        """Next ObjectRef of a streaming task; None = exhausted."""
+        task_id = TaskID(task_id_bytes)
+        with self._stream_lock:
+            st = self._streams.get(task_id)
+        if st is None:
+            return None
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with st.cv:
+            while True:
+                if st.ready:
+                    st.consumed += 1
+                    return st.ready.popleft()
+                if st.err_blob is not None:
+                    raise ser.loads(st.err_blob)
+                if st.done:
+                    with self._stream_lock:
+                        self._streams.pop(task_id, None)
+                    return None
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("stream_next timed out")
+                st.cv.wait(remaining)
+
+    def drop_stream(self, task_id_bytes: bytes) -> None:
+        """Consumer abandoned the generator: delete unconsumed items."""
+        task_id = TaskID(task_id_bytes)
+        with self._stream_lock:
+            st = self._streams.pop(task_id, None)
+        if st is None:
+            return
+        with st.cv:
+            # Unconsumed ObjectRefs die with this deque; their
+            # weakref finalizers (register_ref) free the stored values.
+            st.ready.clear()
+            st.done = True
+            st.cv.notify_all()
 
     # ---------------- dispatch loop (raylet analog) ----------------
 
@@ -675,14 +821,26 @@ class DriverRuntime:
                 # handshake) is retryable, same as a mid-task death.
                 rec.state = "PENDING"
                 rec.worker = None
+                rec.oom_killed = False
                 with self._res_cv:
                     self._pending.append(rec)
                     self._res_cv.notify_all()
                 return
-            err = TaskError(rec.name, traceback.format_exc())
+            if rec.oom_killed:
+                # The memory monitor terminated the worker while it
+                # was still booting (the task was already RUNNING from
+                # the scheduler's view) — surface OOM, not a generic
+                # dispatch failure.
+                from ray_tpu.core.exceptions import OutOfMemoryError
+                err: Exception = OutOfMemoryError(
+                    f"task {rec.name} was killed by the memory "
+                    f"monitor after {rec.attempts} attempts")
+            else:
+                err = TaskError(rec.name, traceback.format_exc())
             blob = ser.dumps(err)
             for oid in rec.return_ids:
                 self._store_error(oid, blob)
+            self._finish_stream(rec.task_id, blob)
             rec.state = "FAILED"
             self._event(rec, "FAILED")
             self._prune_task(rec)
@@ -975,11 +1133,22 @@ class DriverRuntime:
         ttl = self.config.idle_worker_ttl_s
         now = time.monotonic()
         with self._pool_lock:
+            # Keep ONE warm worker, on the head node only — a warm
+            # worker pinned to an autoscaled node would keep that node
+            # "busy" forever and block scale-down.
+            head_workers = sum(
+                1 for w in self._workers
+                if w.node_id == self.head_node_id)
             for key, pool in self._idle.items():
+                node_id = key[0] if isinstance(key, tuple) else ""
                 keep = []
                 for w in pool:
-                    if now - w.last_idle > ttl and len(self._workers) > 1:
+                    expendable = (node_id != self.head_node_id
+                                  or head_workers > 1)
+                    if now - w.last_idle > ttl and expendable:
                         self._workers.remove(w)
+                        if node_id == self.head_node_id:
+                            head_workers -= 1
                         threading.Thread(target=w.shutdown,
                                          daemon=True).start()
                     else:
@@ -1003,7 +1172,8 @@ class DriverRuntime:
             w.sent_fn_ids.add(rec.fn_id)
         resolved = self._resolve_args_payload(rec.args_blob, rec.arg_refs)
         w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id, fn_blob,
-                rec.args_blob, resolved, rec.options.num_returns))
+                rec.args_blob, resolved, rec.options.num_returns,
+                getattr(rec.options, "trace_ctx", None)))
         self._event(rec, "RUNNING")
 
     # ---------------- worker message handling ----------------
@@ -1034,6 +1204,19 @@ class DriverRuntime:
                 self._finish_actor_task(w, task_id, None, err_blob)
             else:
                 self._finish_task(w, task_id, None, err_blob)
+        elif kind == P.RESULT_STREAM:
+            _, task_id_bytes, index, (data, buffers) = msg
+            self._stream_item(
+                TaskID(task_id_bytes), index,
+                SerializedObject(data=data, buffers=list(buffers)))
+        elif kind == P.RESULT_STREAM_END:
+            _, task_id_bytes, _count = msg
+            task_id = TaskID(task_id_bytes)
+            self._finish_stream(task_id)
+            if w.is_actor:
+                self._finish_actor_task(w, task_id, [], None)
+            else:
+                self._finish_task(w, task_id, [], None)
         elif kind == P.RESULT_READY:
             if w.is_actor and w.actor_id is not None:
                 rec = self._actors.get(w.actor_id)
@@ -1056,6 +1239,7 @@ class DriverRuntime:
         else:
             for oid in rec.return_ids:
                 self._store_error(oid, err_blob)
+            self._finish_stream(rec.task_id, err_blob)
             rec.state = "FAILED"
         rec.finished_at = time.time()
         self._event(rec, rec.state)
@@ -1109,21 +1293,41 @@ class DriverRuntime:
         max_retries = (victim.options.max_retries
                        if victim.options.max_retries >= 0
                        else self.config.task_max_retries)
-        if victim.attempts <= max_retries:
+        # A streaming task that already yielded items cannot be
+        # transparently retried (the consumer may have observed a
+        # prefix); only retry when nothing was produced yet.
+        streaming = victim.options.num_returns == "streaming"
+        produced = 0
+        if streaming:
+            with self._stream_lock:
+                st = self._streams.get(victim.task_id)
+            produced = st.produced if st is not None else 0
+        if victim.attempts <= max_retries and (not streaming
+                                               or produced == 0):
             victim.state = "PENDING"
             victim.worker = None
+            # A fresh attempt gets a clean slate: a later unrelated
+            # crash must not be misreported as OOM.
+            victim.oom_killed = False
             with self._res_cv:
                 self._pending.append(victim)
                 self._res_cv.notify_all()
         else:
-            err = TaskError(
-                victim.name,
-                f"worker process died (pid={w.proc.pid}, "
-                f"exitcode={w.proc.returncode}) after "
-                f"{victim.attempts} attempts")
+            if victim.oom_killed:
+                from ray_tpu.core.exceptions import OutOfMemoryError
+                err: Exception = OutOfMemoryError(
+                    f"task {victim.name} was killed by the memory "
+                    f"monitor after {victim.attempts} attempts")
+            else:
+                err = TaskError(
+                    victim.name,
+                    f"worker process died (pid={w.proc.pid}, "
+                    f"exitcode={w.proc.returncode}) after "
+                    f"{victim.attempts} attempts")
             blob = ser.dumps(err)
             for oid in victim.return_ids:
                 self._store_error(oid, blob)
+            self._finish_stream(victim.task_id, blob)
             victim.state = "FAILED"
             self._event(victim, "FAILED")
             self._prune_task(victim)
@@ -1201,21 +1405,27 @@ class DriverRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, method: str,
                           args: tuple, kwargs: dict,
-                          num_returns: int = 1) -> list[ObjectRef]:
+                          num_returns: int = 1, trace_ctx=None):
         rec = self._actors.get(actor_id)
         if rec is None:
             raise ActorDiedError(actor_id.hex(), "unknown actor")
         task_id = TaskID.for_actor_task(actor_id)
-        return_ids = [ObjectID.for_return(task_id, i)
-                      for i in range(num_returns)]
+        streaming = num_returns == "streaming"
+        return_ids = [] if streaming else [
+            ObjectID.for_return(task_id, i)
+            for i in range(num_returns)]
         args_blob, arg_refs = self._pack_args(args, kwargs)
         refs = [self.register_ref(ObjectRef(oid)) for oid in return_ids]
+        if streaming:
+            with self._stream_lock:
+                self._streams[task_id] = _StreamState(
+                    cv=threading.Condition())
         with rec.queue_cv:
             if rec.submit_queue is None:
                 rec.submit_queue = deque()
             rec.submit_queue.append(
                 (task_id, return_ids, method, args_blob, arg_refs,
-                 num_returns))
+                 num_returns, trace_ctx))
             if rec.pusher is None:
                 rec.pusher = threading.Thread(
                     target=self._actor_push_loop, args=(rec,),
@@ -1223,6 +1433,8 @@ class DriverRuntime:
                     name=f"actor_push_{rec.actor_id.hex()[:8]}")
                 rec.pusher.start()
             rec.queue_cv.notify_all()
+        if streaming:
+            return ObjectRefGenerator(task_id.binary(), _owner=True)
         return refs
 
     def _actor_push_loop(self, rec: ActorRecord) -> None:
@@ -1237,7 +1449,7 @@ class DriverRuntime:
                         return
                 item = rec.submit_queue.popleft()
             (task_id, return_ids, method, args_blob, arg_refs,
-             num_returns) = item
+             num_returns, trace_ctx) = item
             try:
                 if not rec.ready_event.wait(
                         self.config.actor_creation_timeout_s):
@@ -1249,7 +1461,8 @@ class DriverRuntime:
                 resolved = self._resolve_args_payload(args_blob, arg_refs)
                 rec.in_flight[task_id] = (return_ids, method)
                 rec.worker.send((P.EXEC_ACTOR_CALL, task_id.binary(),
-                                 method, args_blob, resolved, num_returns))
+                                 method, args_blob, resolved,
+                                 num_returns, trace_ctx))
             except Exception as e:  # noqa: BLE001
                 rec.in_flight.pop(task_id, None)
                 blob = ser.dumps(e if isinstance(e, ActorDiedError) else
@@ -1257,6 +1470,7 @@ class DriverRuntime:
                                            e))
                 for oid in return_ids:
                     self._store_error(oid, blob)
+                self._finish_stream(task_id, blob)
 
     def _finish_actor_task(self, w: WorkerHandle, task_id: TaskID,
                            results, err_blob) -> None:
@@ -1275,6 +1489,7 @@ class DriverRuntime:
         else:
             for oid in return_ids:
                 self._store_error(oid, err_blob)
+            self._finish_stream(task_id, err_blob)
 
     def _on_actor_death(self, actor_id: ActorID) -> None:
         rec = self._actors.get(actor_id)
@@ -1284,9 +1499,10 @@ class DriverRuntime:
         # Fail all in-flight calls.
         err = ActorDiedError(actor_id.hex(), "actor process exited")
         blob = ser.dumps(err)
-        for return_ids, _m in rec.in_flight.values():
+        for task_id, (return_ids, _m) in rec.in_flight.items():
             for oid in return_ids:
                 self._store_error(oid, blob)
+            self._finish_stream(task_id, blob)
         rec.in_flight.clear()
         self._release(self._effective_resources(rec.options),
                       rec.options.placement_group,
@@ -1471,6 +1687,26 @@ class DriverRuntime:
 
     # ---------------- introspection ----------------
 
+    def resource_demand(self) -> list[dict[str, float]]:
+        """Unmet resource requests (autoscaler input — reference:
+        resource demand in autoscaler.proto / GcsAutoscalerStateManager):
+        one dict per pending task, pending actor, and unplaced PG
+        bundle."""
+        out: list[dict[str, float]] = []
+        with self._res_cv:
+            for rec in self._pending:
+                out.append(dict(self._effective_resources(rec.options)))
+        with self._actor_lock:
+            for arec in self._actors.values():
+                if arec.state == "PENDING" and not arec.node_id:
+                    out.append(dict(
+                        self._effective_resources(arec.options)))
+        with self._pg_lock:
+            for pg in self._pgs.values():
+                if not pg.created:
+                    out.extend(dict(b) for b in pg.bundles)
+        return out
+
     def available_resources(self) -> dict[str, float]:
         out: dict[str, float] = {}
         with self._res_cv:
@@ -1600,6 +1836,8 @@ class DriverRuntime:
             options = ser.loads(opts_blob)
             refs = self.submit_task(fn_id, fn_blob, fn_name, args,
                                     kwargs, options)
+            if isinstance(refs, ObjectRefGenerator):
+                return ("stream", refs._task_id_bytes)
             # The only holder of these refs is the remote worker: pin
             # them so driver-side GC of the transient ObjectRef objects
             # doesn't delete the results out from under it.
@@ -1631,13 +1869,31 @@ class DriverRuntime:
                 max_restarts, max_concurrency)
             return actor_id.binary()
         if op == P.OP_SUBMIT_ACTOR:
-            actor_id_bytes, method, args_kwargs_blob, num_returns = payload
+            (actor_id_bytes, method, args_kwargs_blob, num_returns,
+             trace_ctx) = payload
             args, kwargs = ser.loads(args_kwargs_blob)
             refs = self.submit_actor_task(
-                ActorID(actor_id_bytes), method, args, kwargs, num_returns)
+                ActorID(actor_id_bytes), method, args, kwargs,
+                num_returns, trace_ctx)
+            if isinstance(refs, ObjectRefGenerator):
+                return ("stream", refs._task_id_bytes)
             for r in refs:
                 self.on_ref_escaped(r.id)
             return [r.id.binary() for r in refs]
+        if op == P.OP_STREAM_NEXT:
+            task_id_bytes, timeout = payload
+            ref = self.stream_next(task_id_bytes, timeout)
+            if ref is None:
+                return ("done",)
+            self.on_ref_escaped(ref.id)
+            return ("item", ref.id.binary())
+        if op == P.OP_STREAM_DROP:
+            self.drop_stream(payload)
+            return None
+        if op == P.OP_SPANS:
+            from ray_tpu.util.tracing import get_tracer
+            get_tracer().add_spans(payload)
+            return None
         if op == P.OP_GET_ACTOR:
             name = payload
             return self.get_named_actor(name).binary()
@@ -1683,6 +1939,8 @@ class DriverRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         with self._res_cv:
             self._res_cv.notify_all()
         with self._pool_lock:

@@ -132,11 +132,25 @@ class ClientRuntime:
     # -- task / actor API --
 
     def submit_task(self, fn_id: str, fn_blob: bytes | None, fn_name: str,
-                    args: tuple, kwargs: dict, options) -> list[ObjectRef]:
+                    args: tuple, kwargs: dict, options):
         ref_bytes = self._call(P.OP_SUBMIT, (
             fn_id, fn_blob, fn_name, ser.dumps((args, kwargs)),
             ser.dumps(options)))
+        if isinstance(ref_bytes, tuple) and ref_bytes[0] == "stream":
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(ref_bytes[1], _owner=True)
         return [ObjectRef(ObjectID(b)) for b in ref_bytes]
+
+    def stream_next(self, task_id_bytes: bytes,
+                    timeout: float | None = None):
+        out = self._call(P.OP_STREAM_NEXT, (task_id_bytes, timeout),
+                         timeout=None)
+        if out[0] == "done":
+            return None
+        return ObjectRef(ObjectID(out[1]))
+
+    def drop_stream(self, task_id_bytes: bytes) -> None:
+        self._call(P.OP_STREAM_DROP, task_id_bytes)
 
     def register_function(self, fn):
         import hashlib
@@ -154,10 +168,13 @@ class ClientRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, method: str,
                           args: tuple, kwargs: dict,
-                          num_returns: int = 1) -> list[ObjectRef]:
+                          num_returns: int = 1, trace_ctx=None):
         ref_bytes = self._call(P.OP_SUBMIT_ACTOR, (
             actor_id.binary(), method, ser.dumps((args, kwargs)),
-            num_returns))
+            num_returns, trace_ctx))
+        if isinstance(ref_bytes, tuple) and ref_bytes[0] == "stream":
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(ref_bytes[1], _owner=True)
         return [ObjectRef(ObjectID(b)) for b in ref_bytes]
 
     def get_named_actor(self, name: str) -> ActorID:
@@ -286,14 +303,51 @@ def worker_main(conn, client_address: str) -> None:
         with send_lock:
             conn.send(msg)
 
+    def stream_out(task_id_bytes, result):
+        """Iterate a generator result, shipping each item as its own
+        streamed return (reference: generator returns /
+        ReportGeneratorItemReturns)."""
+        count = 0
+        for item in result:
+            obj = ser.serialize(item)
+            send((P.RESULT_STREAM, task_id_bytes, count,
+                  (obj.data, obj.buffers)))
+            count += 1
+        send((P.RESULT_STREAM_END, task_id_bytes, count))
+
+    def _flush_spans():
+        from ray_tpu.util.tracing import get_tracer
+        tr = get_tracer()
+        if tr.enabled:
+            spans = tr.drain_dicts()
+            if spans:
+                try:
+                    client._call(P.OP_SPANS, spans)
+                except Exception:  # noqa: BLE001
+                    pass
+
     def exec_task(task_id_bytes, fn_id, fn_blob, args_blob, resolved,
-                  num_returns):
+                  num_returns, trace_ctx=None):
+        from ray_tpu.util.tracing import get_tracer
+        tr = get_tracer()
+        # Tracing follows the incoming task: an untraced task on a
+        # pooled worker must not keep recording (and later flush)
+        # spans left enabled by an earlier traced task.
+        if trace_ctx is not None:
+            tr.enable()
+        else:
+            tr.disable()
         try:
             if fn_id not in fn_cache:
                 fn_cache[fn_id] = ser.loads(fn_blob)
             fn = fn_cache[fn_id]
             args, kwargs = _materialize_args(args_blob, resolved)
-            result = _run_maybe_async(fn, args, kwargs)
+            with tr.remote_parent(trace_ctx), \
+                    tr.span(f"task::{getattr(fn, '__name__', 'task')}"):
+                result = _run_maybe_async(fn, args, kwargs)
+                if num_returns == "streaming":
+                    stream_out(task_id_bytes, result)
+                    return
             send((P.RESULT_OK, task_id_bytes,
                   _serialize_returns(result, num_returns)))
         except BaseException as e:  # noqa: BLE001
@@ -301,24 +355,52 @@ def worker_main(conn, client_address: str) -> None:
             err = TaskError(name, traceback.format_exc(), None) \
                 if not isinstance(e, TaskError) else e
             send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
+        finally:
+            if trace_ctx is not None:
+                _flush_spans()
 
     serialize_calls = True  # False when max_concurrency > 1
 
     def exec_actor_call(task_id_bytes, method, args_blob, resolved,
-                        num_returns):
+                        num_returns, trace_ctx=None):
+        from ray_tpu.util.tracing import get_tracer
+        tr = get_tracer()
+        if trace_ctx is not None:
+            tr.enable()
+        elif serialize_calls:
+            # Sequential actors mirror the pooled-worker rule; with
+            # max_concurrency > 1 a disable here would race a traced
+            # call on another thread, so concurrent actors only ever
+            # enable.
+            tr.disable()
         try:
             args, kwargs = _materialize_args(args_blob, resolved)
             bound = getattr(actor_instance, method)
-            if serialize_calls:
-                with actor_lock:
-                    result = _run_maybe_async(bound, args, kwargs)
-            else:
+
+            def run_and_maybe_stream():
                 result = _run_maybe_async(bound, args, kwargs)
+                if num_returns == "streaming":
+                    stream_out(task_id_bytes, result)
+                    return None
+                return result
+
+            with tr.remote_parent(trace_ctx), \
+                    tr.span(f"actor::{method}"):
+                if serialize_calls:
+                    with actor_lock:
+                        result = run_and_maybe_stream()
+                else:
+                    result = run_and_maybe_stream()
+                if num_returns == "streaming":
+                    return
             send((P.RESULT_OK, task_id_bytes,
                   _serialize_returns(result, num_returns)))
         except BaseException:  # noqa: BLE001
             err = ActorError(method, traceback.format_exc(), None)
             send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
+        finally:
+            if trace_ctx is not None:
+                _flush_spans()
 
     executor = None  # thread pool for max_concurrency > 1
 
@@ -330,9 +412,9 @@ def worker_main(conn, client_address: str) -> None:
                 break
             elif kind == P.EXEC_TASK:
                 (_, task_id_bytes, fn_id, fn_blob, args_blob, resolved,
-                 num_returns) = msg
+                 num_returns, trace_ctx) = msg
                 exec_task(task_id_bytes, fn_id, fn_blob, args_blob,
-                          resolved, num_returns)
+                          resolved, num_returns, trace_ctx)
             elif kind == P.EXEC_ACTOR_INIT:
                 (_, actor_id_bytes, cls_blob, args_blob, resolved,
                  max_concurrency) = msg
@@ -353,13 +435,14 @@ def worker_main(conn, client_address: str) -> None:
                     break
             elif kind == P.EXEC_ACTOR_CALL:
                 (_, task_id_bytes, method, args_blob, resolved,
-                 num_returns) = msg
+                 num_returns, trace_ctx) = msg
                 if executor is not None:
-                    executor.submit(exec_actor_call, task_id_bytes, method,
-                                    args_blob, resolved, num_returns)
+                    executor.submit(exec_actor_call, task_id_bytes,
+                                    method, args_blob, resolved,
+                                    num_returns, trace_ctx)
                 else:
                     exec_actor_call(task_id_bytes, method, args_blob,
-                                    resolved, num_returns)
+                                    resolved, num_returns, trace_ctx)
     except (EOFError, OSError, KeyboardInterrupt):
         pass
     finally:

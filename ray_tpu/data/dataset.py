@@ -685,6 +685,12 @@ def _agg_partition(key, agg, idx, *part_tuples):
     if merged.num_rows == 0:
         return pa.table({})
     kind, col = agg
+    if kind == "std":
+        # ddof=1 (sample std) to match Dataset.std and the reference.
+        import pyarrow.compute as pc
+        tbl = merged.group_by(key).aggregate(
+            [(col, "stddev", pc.VarianceOptions(ddof=1))])
+        return tbl.rename_columns([key, f"std({col})"])
     if kind == "map_groups":
         out_rows = []
         batch = block_to_batch(merged)

@@ -1,6 +1,13 @@
 """Model zoo: flax implementations annotated for mesh sharding."""
 
 from ray_tpu.models.gpt2 import GPT2, GPT2Config
+from ray_tpu.models.llama import Llama, LlamaConfig
+from ray_tpu.models.moe import MoEConfig, MoETransformer
 from ray_tpu.models.resnet import ResNet, ResNet50Config
+from ray_tpu.models.vit import ViT, ViTConfig
 
-__all__ = ["GPT2", "GPT2Config", "ResNet", "ResNet50Config"]
+__all__ = [
+    "GPT2", "GPT2Config", "Llama", "LlamaConfig",
+    "MoETransformer", "MoEConfig", "ResNet", "ResNet50Config",
+    "ViT", "ViTConfig",
+]

@@ -1,0 +1,149 @@
+"""Vision Transformer classifier in flax, mesh-shardable.
+
+Completes the vision side of the flagship set next to ResNet
+(models/resnet.py): patchify conv → encoder blocks (bidirectional
+attention — ``jax.nn.dot_product_attention``, no causal mask) → CLS
+head. bf16 compute / f32 params; activations carry batch/seq logical
+constraints like the LMs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @staticmethod
+    def base(**kw) -> "ViTConfig":
+        return ViTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("num_classes", 10)
+        kw.setdefault("n_layer", 2)
+        kw.setdefault("n_head", 4)
+        kw.setdefault("n_embd", 64)
+        return ViTConfig(**kw)
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+class EncoderBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, T, _ = x.shape
+        ln = partial(nn.LayerNorm, epsilon=1e-6, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        dense = partial(nn.Dense, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        kernel_init=nn.initializers.xavier_uniform())
+
+        h = ln(name="ln_1")(x)
+        q = dense(cfg.n_embd, name="q")(h)
+        k = dense(cfg.n_embd, name="k")(h)
+        v = dense(cfg.n_embd, name="v")(h)
+        q = q.reshape(B, T, cfg.n_head, cfg.head_dim)
+        k = k.reshape(B, T, cfg.n_head, cfg.head_dim)
+        v = v.reshape(B, T, cfg.n_head, cfg.head_dim)
+        y = jax.nn.dot_product_attention(q, k, v)   # bidirectional
+        y = dense(cfg.n_embd, name="proj")(
+            y.reshape(B, T, cfg.n_embd))
+        x = x + y
+
+        h = ln(name="ln_2")(x)
+        h = dense(cfg.mlp_ratio * cfg.n_embd, name="fc")(h)
+        h = nn.gelu(h)
+        x = x + dense(cfg.n_embd, name="mlp_proj")(h)
+        return x
+
+
+class ViT(nn.Module):
+    """``__call__(images [B,H,W,C]) -> logits [B, num_classes]``."""
+
+    config: ViTConfig
+    mesh: Any = None
+
+    def _constrain(self, x):
+        if self.mesh is None:
+            return x
+        from ray_tpu.parallel.sharding import constrain
+        return constrain(x, self.mesh, "batch", "seq", None)
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.config
+        B = images.shape[0]
+        x = nn.Conv(cfg.n_embd,
+                    kernel_size=(cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    name="patch_embed", dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype)(
+            images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.n_embd)            # [B, P, E]
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, cfg.n_embd), cfg.param_dtype)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype),
+                              (B, 1, cfg.n_embd)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.n_embd),
+                         cfg.param_dtype)
+        x = x + pos.astype(cfg.dtype)
+        x = self._constrain(x)
+        block_cls = EncoderBlock
+        if cfg.remat:
+            block_cls = nn.remat(EncoderBlock)
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x)
+            x = self._constrain(x)
+        x = nn.LayerNorm(epsilon=1e-6, name="ln_f", dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype)(x)
+        return nn.Dense(cfg.num_classes, name="head",
+                        dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype)(
+            x[:, 0].astype(jnp.float32))
+
+    def init_params(self, rng, batch_size: int = 2):
+        images = jnp.zeros((batch_size, self.config.image_size,
+                            self.config.image_size, 3), jnp.float32)
+        return self.init(rng, images)["params"]
+
+
+def vit_loss_fn(model: ViT):
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["images"])
+        labels = jax.nn.one_hot(batch["labels"],
+                                model.config.num_classes)
+        return -jnp.mean(jnp.sum(
+            labels * jax.nn.log_softmax(logits), axis=-1))
+
+    return loss_fn

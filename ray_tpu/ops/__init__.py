@@ -1,5 +1,12 @@
-"""TPU compute ops: attention (dense + ring), fused kernels (Pallas)."""
+"""TPU compute ops: attention (dense + ring + ulysses), fused kernels
+(Pallas)."""
 
-from ray_tpu.ops.attention import causal_attention, ring_attention
+from ray_tpu.ops.attention import (
+    causal_attention,
+    make_sharded_causal_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
-__all__ = ["causal_attention", "ring_attention"]
+__all__ = ["causal_attention", "ring_attention", "ulysses_attention",
+           "make_sharded_causal_attention"]

@@ -128,6 +128,37 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp",
+                      scale: float | None = None) -> jax.Array:
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all swaps
+    the sharded dimension from sequence to heads, each device runs
+    FULL-sequence attention on its head subset (flash-eligible), and
+    a second all-to-all swaps back. Call inside shard_map with the
+    sequence dim sharded on ``axis_name``; requires
+    num_heads % axis_size == 0.
+
+    vs ring attention: ulysses moves activations twice (2 all-to-alls,
+    O(B·T·H·D/sp) each) but runs ONE dense/flash kernel over the full
+    sequence; ring keeps activations put and rotates K/V around the
+    ICI ring in S steps. Ulysses wins when heads divide evenly and the
+    per-step latency of S rotations dominates; ring wins at very long
+    sequences where full-seq attention per device would not fit.
+    """
+    sp = lax.psum(1, axis_name)
+    # [B, Tl, H, D] -> [B, Tl*sp, H/sp, D]: scatter heads, gather seq
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    out = causal_attention(qh, kh, vh, scale=scale)
+    # inverse swap: scatter seq back, gather heads
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
 def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
                                   seq_axis="sp", head_axis="tp",
                                   impl="auto"):
@@ -140,9 +171,10 @@ def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
     running ring."""
     from jax.sharding import PartitionSpec as P
 
-    if impl not in ("auto", "dense", "ring"):
+    if impl not in ("auto", "dense", "ring", "ulysses"):
         raise ValueError(f"unknown attn impl {impl!r}; "
-                         "expected 'auto', 'dense' or 'ring'")
+                         "expected 'auto', 'dense', 'ring' or "
+                         "'ulysses'")
     sp = mesh.shape.get(seq_axis, 1)
     if impl == "dense" and sp > 1:
         raise ValueError(
@@ -150,9 +182,9 @@ def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
             f"{seq_axis}={sp}: activations are sequence-sharded, so "
             f"attention must be 'ring' (or 'auto') — or build the "
             f"mesh without a {seq_axis} axis")
-    if impl == "ring" and sp <= 1:
+    if impl in ("ring", "ulysses") and sp <= 1:
         raise ValueError(
-            f"attn_impl='ring' requires a real {seq_axis} mesh axis "
+            f"attn_impl={impl!r} requires a real {seq_axis} mesh axis "
             f"(got {seq_axis}={sp}); the O(seq/sp) per-device K/V "
             f"memory you asked for does not exist on this mesh — use "
             f"'auto' or add a {seq_axis} axis")
@@ -192,6 +224,8 @@ def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
     spec = P(batch if batch else None, seq_axis,
              head_axis if mesh.shape.get(head_axis, 1) > 1 else None,
              None)
-    ring = functools.partial(ring_attention, axis_name=seq_axis)
-    return jax.shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+    local_impl = (ulysses_attention if impl == "ulysses"
+                  else ring_attention)
+    fn = functools.partial(local_impl, axis_name=seq_axis)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)

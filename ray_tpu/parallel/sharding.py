@@ -119,8 +119,13 @@ DEFAULT_PARAM_PATTERNS: list[tuple[str, tuple[str | None, ...]]] = [
     # GPT-style transformer (see models/gpt2.py param naming).
     # Order matters: wpe before the generic embedding rule (its param
     # path also contains "embedding" but dim0 is positions, not vocab).
+    (r"pos_embed", (None, None, "embed")),       # ViT [1, P, E]
     (r"wpe|pos_emb", (None, "embed")),
     (r"wte|embedding", ("vocab", "embed")),
+    # MoE experts (models/moe.py): expert dim -> ep axis
+    (r"moe.*router", ("embed", None)),
+    (r"moe.*w_up", ("experts", "embed", "mlp")),
+    (r"moe.*w_down", ("experts", "mlp", "embed")),
     (r"(attn|attention).*(q|k|v|qkv).*kernel", ("embed", "heads")),
     (r"(attn|attention).*(out|proj).*kernel", ("heads", "embed")),
     (r"mlp.*(fc|up|gate).*kernel", ("embed", "mlp")),

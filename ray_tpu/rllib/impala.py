@@ -82,8 +82,10 @@ class ImpalaLearner:
             col = jnp.arange(T)[None, :]
             v_tp1 = jnp.where(col == batch["last_step"][:, None],
                               batch["bootstrap"][:, None], v_shift)
+            # mask kills padded-step deltas: V(zero-padded obs) is
+            # garbage and must not leak into the scan carry.
             deltas = rho_c * (batch["rewards"] + discounts * v_tp1
-                              - values)
+                              - values) * mask
 
             def backward(carry, xs):
                 delta_t, disc_t, c_t = xs

@@ -76,6 +76,13 @@ class ServeController:
         return self.versions.get(name, 0), list(
             self.replicas.get(name, []))
 
+    def get_routing_state(self, name: str):
+        """(version, replicas, model_map) in one call — the router's
+        refresh payload (long-poll snapshot analog)."""
+        return (self.versions.get(name, 0),
+                list(self.replicas.get(name, [])),
+                dict(self.model_map.get(name, {})))
+
     def get_model_replicas(self, name: str, model_id: str):
         """Replicas that had ``model_id`` resident at the last probe —
         the router's model-locality hint (reference: multiplex-aware
@@ -131,11 +138,14 @@ class ServeController:
             if auto is not None:
                 auto.record(sum(s["inflight"] for s in stats))
                 spec["num_replicas"] = auto.decide(spec["num_replicas"])
-            # model-locality map for the router
+            # model-locality map for the router; a residency change
+            # bumps the version so routers refresh their cached copy.
             mmap: dict[str, list[int]] = {}
             for i, s in enumerate(stats):
                 for mid in s.get("model_ids", []):
                     mmap.setdefault(mid, []).append(i)
+            if mmap != self.model_map.get(name):
+                changed = True
             self.model_map[name] = mmap
             while len(live) < spec["num_replicas"]:
                 tag = f"{name}#{len(live)}_{int(time.time()*1e3)%100000}"

@@ -19,14 +19,16 @@ class Router:
         self._controller = controller
         self._name = deployment_name
         self._replicas: list = []
+        self._model_map: dict[str, list[int]] = {}
         self._version = -1
         self._rng = random.Random()
 
     def _refresh(self) -> None:
-        version, replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self._name))
+        version, replicas, model_map = ray_tpu.get(
+            self._controller.get_routing_state.remote(self._name))
         self._version = version
         self._replicas = replicas
+        self._model_map = model_map
 
     def pick_replica(self, multiplexed_model_id: str = ""):
         version = ray_tpu.get(
@@ -39,10 +41,11 @@ class Router:
         pool = self._replicas
         if multiplexed_model_id:
             # Model-locality-aware pick (reference: multiplex-aware
-            # pow-2): prefer replicas with the model already resident.
-            with_model = ray_tpu.get(
-                self._controller.get_model_replicas.remote(
-                    self._name, multiplexed_model_id))
+            # pow-2): prefer replicas with the model resident, from
+            # the version-gated cached map — no extra hot-path RPC.
+            idxs = self._model_map.get(multiplexed_model_id, [])
+            with_model = [self._replicas[i] for i in idxs
+                          if i < len(self._replicas)]
             if with_model:
                 pool = with_model
         if len(pool) == 1:

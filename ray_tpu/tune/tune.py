@@ -193,12 +193,18 @@ class Tuner:
                 break
             time.sleep(0.05)
             still = []
+            changed = False
             for t in running:
-                if self._poll_trial(t, fn, exp_dir, tc, scheduler,
-                                    searcher):
+                alive, trial_changed = self._poll_trial(
+                    t, fn, exp_dir, tc, scheduler, searcher)
+                changed = changed or trial_changed
+                if alive:
                     still.append(t)
             running = still
-            self._save_state(exp_dir, trials)
+            # Journal only on actual progress — rewriting the full
+            # experiment state every 50 ms poll would thrash the disk.
+            if changed:
+                self._save_state(exp_dir, trials)
 
         self._save_state(exp_dir, trials)
         results = [TrialResult(
@@ -250,8 +256,8 @@ class Tuner:
         t.actor.start_loop.remote((fn, t.config), ctx_kwargs)
 
     def _poll_trial(self, t: Trial, fn, exp_dir: str, tc: TuneConfig,
-                    scheduler, searcher) -> bool:
-        """Poll one trial; True if still running."""
+                    scheduler, searcher) -> tuple[bool, bool]:
+        """Poll one trial; returns (still_running, state_changed)."""
         try:
             p = ray_tpu.get(t.actor.poll.remote(), timeout=60)
         except Exception as e:  # noqa: BLE001 — actor died
@@ -259,7 +265,7 @@ class Tuner:
             t.error = str(e)
             if searcher:
                 searcher.on_trial_complete(t.trial_id, None, error=True)
-            return False
+            return False, True
         decision = CONTINUE
         for r in p["results"]:
             t.iteration += 1
@@ -275,6 +281,7 @@ class Tuner:
             decision = scheduler.on_result(t.trial_id, m)
             if decision in (STOP, EXPLOIT):
                 break
+        changed = bool(p["results"])
         if decision == EXPLOIT and not p["done"]:
             # PBT: restart this trial from a donor's checkpoint with a
             # mutated config. Counts as the same trial (same id).
@@ -284,14 +291,14 @@ class Tuner:
             t.restore_from = donor_ckpt
             t.perturbations += 1
             self._start_trial(t, fn, exp_dir, tc, scheduler)
-            return True
+            return True, True
         if decision == STOP and not p["done"]:
             t.state = "STOPPED"
             ray_tpu.kill(t.actor)
             scheduler.on_trial_complete(t.trial_id)
             if searcher:
                 searcher.on_trial_complete(t.trial_id, t.metrics)
-            return False
+            return False, True
         if p["done"]:
             t.state = "ERROR" if p["error"] else "COMPLETED"
             t.error = p["error"]
@@ -300,8 +307,8 @@ class Tuner:
                 searcher.on_trial_complete(t.trial_id, t.metrics,
                                            error=bool(p["error"]))
             ray_tpu.kill(t.actor)
-            return False
-        return True
+            return False, True
+        return True, changed
 
 
 def _as_function_trainable(trainable) -> Callable:

@@ -1,0 +1,186 @@
+"""Distributed tracing: spans that follow tasks across processes.
+
+Reference analog (SURVEY.md §5.1): OpenTelemetry tracing wraps every
+``.remote()`` (tracing_helper.py:293) and serializes the span context
+into task metadata, re-hydrated in the executing worker; exporters are
+pluggable. Here: a process-local tracer with contextvar propagation;
+the driver injects (trace_id, parent_span_id) into the task wire
+message, the worker parents its spans under it and ships finished
+spans back over the client channel — so one trace spans driver and
+workers. Export as a span list or Chrome-trace JSON (the same
+``chrome://tracing`` surface as ``ray.timeline``).
+
+Device profiling: ``profile_device()`` wraps ``jax.profiler.trace``
+(the nsight-plugin analog for TPU — SURVEY.md §5.1 TPU mapping).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_current_span", default=None)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start: float
+    end: float = 0.0
+    attributes: dict = field(default_factory=dict)
+    process: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "trace_id": self.trace_id,
+            "span_id": self.span_id, "parent_id": self.parent_id,
+            "start": self.start, "end": self.end,
+            "attributes": dict(self.attributes),
+            "process": self.process,
+        }
+
+
+class Tracer:
+    def __init__(self):
+        self.enabled = False
+        self._spans: deque = deque(maxlen=100_000)
+        self._lock = threading.Lock()
+
+    # -- lifecycle --
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    # -- span API --
+
+    @contextlib.contextmanager
+    def span(self, name: str, attributes: dict | None = None):
+        if not self.enabled:
+            yield None
+            return
+        parent = _current.get()
+        s = Span(
+            name=name,
+            trace_id=(parent.trace_id if parent
+                      else uuid.uuid4().hex[:16]),
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            start=time.time(),
+            attributes=dict(attributes or {}),
+            process=f"pid:{os.getpid()}",
+        )
+        token = _current.set(s)
+        try:
+            yield s
+        finally:
+            _current.reset(token)
+            s.end = time.time()
+            with self._lock:
+                self._spans.append(s)
+
+    def current_context(self) -> tuple[str, str] | None:
+        """(trace_id, span_id) to inject into an outgoing task."""
+        s = _current.get()
+        return (s.trace_id, s.span_id) if s else None
+
+    @contextlib.contextmanager
+    def remote_parent(self, ctx: tuple[str, str] | None):
+        """Re-hydrate a propagated context in the executing worker."""
+        if ctx is None or not self.enabled:
+            yield
+            return
+        trace_id, span_id = ctx
+        fake = Span(name="<remote-parent>", trace_id=trace_id,
+                    span_id=span_id, parent_id=None, start=0.0)
+        token = _current.set(fake)
+        try:
+            yield
+        finally:
+            _current.reset(token)
+
+    # -- collection / export --
+
+    def add_spans(self, span_dicts: list[dict]) -> None:
+        with self._lock:
+            for d in span_dicts:
+                self._spans.append(Span(**d))
+
+    def drain_dicts(self) -> list[dict]:
+        """Take all finished spans (worker-side flush)."""
+        with self._lock:
+            out = [s.to_dict() for s in self._spans]
+            self._spans.clear()
+        return out
+
+    def get_spans(self, trace_id: str | None = None) -> list[Span]:
+        with self._lock:
+            spans = list(self._spans)
+        if trace_id is not None:
+            spans = [s for s in spans if s.trace_id == trace_id]
+        return spans
+
+    def chrome_trace(self) -> list[dict]:
+        out = []
+        for s in self.get_spans():
+            out.append({
+                "name": s.name, "ph": "X",
+                "pid": s.process or "driver", "tid": s.trace_id,
+                "ts": s.start * 1e6, "dur": (s.end - s.start) * 1e6,
+                "args": s.attributes,
+            })
+        return out
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def enable() -> None:
+    """Turn on tracing in this process (driver: call before submitting
+    work; propagation to workers is automatic)."""
+    _tracer.enable()
+
+
+def disable() -> None:
+    _tracer.disable()
+
+
+def span(name: str, attributes: dict | None = None):
+    return _tracer.span(name, attributes)
+
+
+def get_spans(trace_id: str | None = None):
+    return _tracer.get_spans(trace_id)
+
+
+def chrome_trace() -> list[dict]:
+    return _tracer.chrome_trace()
+
+
+@contextlib.contextmanager
+def profile_device(logdir: str = "/tmp/ray_tpu_profile"):
+    """Capture an XLA device profile around a code region
+    (TensorBoard-compatible; the TPU answer to the reference's nsight
+    runtime-env plugin)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()

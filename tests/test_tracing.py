@@ -1,0 +1,105 @@
+"""Distributed tracing tests (reference analog: OTel task tracing,
+tracing_helper.py — span context serialized into tasks, rehydrated in
+the worker)."""
+
+import time
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def setup_function(_fn):
+    tracing.get_tracer().disable()
+    tracing.get_tracer().drain_dicts()
+
+
+def test_local_span_nesting():
+    tr = tracing.get_tracer()
+    tr.enable()
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            pass
+    spans = {s.name: s for s in tracing.get_spans()}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["inner"].trace_id == spans["outer"].trace_id
+    assert spans["outer"].end >= spans["outer"].start
+    tr.disable()
+
+
+@ray_tpu.remote
+def traced_work(x):
+    from ray_tpu.util import tracing as t
+    with t.span("user_compute", {"x": x}):
+        time.sleep(0.01)
+    return x * 2
+
+
+def test_task_span_propagation(rt):
+    tracing.enable()
+    try:
+        with tracing.span("driver_root"):
+            ref = traced_work.remote(21)
+            assert ray_tpu.get(ref, timeout=60) == 42
+        # Worker spans flush on task completion; allow a beat.
+        deadline = time.monotonic() + 10
+        names = set()
+        while time.monotonic() < deadline:
+            names = {s.name for s in tracing.get_spans()}
+            if "user_compute" in names:
+                break
+            time.sleep(0.1)
+        assert "driver_root" in names
+        assert "submit::traced_work" in names
+        assert "task::traced_work" in names
+        assert "user_compute" in names
+        # One connected trace: every span shares the root's trace id.
+        by_name = {s.name: s for s in tracing.get_spans()}
+        root = by_name["driver_root"]
+        for n in ("submit::traced_work", "task::traced_work",
+                  "user_compute"):
+            assert by_name[n].trace_id == root.trace_id, n
+        # Parent chain crosses the process boundary.
+        assert by_name["task::traced_work"].parent_id == \
+            by_name["submit::traced_work"].span_id
+        assert by_name["user_compute"].parent_id == \
+            by_name["task::traced_work"].span_id
+        assert by_name["user_compute"].attributes == {"x": 21}
+    finally:
+        tracing.disable()
+
+
+@ray_tpu.remote
+class TracedActor:
+    def double(self, x):
+        return x * 2
+
+
+def test_actor_span_propagation(rt):
+    tracing.enable()
+    try:
+        a = TracedActor.remote()
+        with tracing.span("driver_root"):
+            assert ray_tpu.get(a.double.remote(5), timeout=60) == 10
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            names = {s.name for s in tracing.get_spans()}
+            if "actor::double" in names:
+                break
+            time.sleep(0.1)
+        by_name = {s.name: s for s in tracing.get_spans()}
+        assert by_name["actor::double"].trace_id == \
+            by_name["driver_root"].trace_id
+    finally:
+        tracing.disable()
+
+
+def test_chrome_trace_export():
+    tr = tracing.get_tracer()
+    tr.enable()
+    with tracing.span("x", {"k": "v"}):
+        pass
+    events = tracing.chrome_trace()
+    ev = [e for e in events if e["name"] == "x"][0]
+    assert ev["ph"] == "X" and ev["dur"] >= 0
+    assert ev["args"] == {"k": "v"}
+    tr.disable()
