@@ -295,10 +295,15 @@ class NativeSharedMemoryStore:
             return None
 
     def read_local(self, object_id: ObjectID) -> SerializedObject | None:
-        """Owner-process fast path."""
-        view = self._store.get(object_id.binary())
-        if view is not None:
-            return self.decode(view)
+        """Owner-process fast path — pinned zero-copy like remote
+        readers (deletes defer while the returned buffers live)."""
+        id_bytes = object_id.binary()
+        res = self._store.pin(id_bytes)
+        if res is not None:
+            kind, payload = res
+            if kind == "pinned":
+                return _decode_pinned(payload, self._store, id_bytes)
+            return self.decode(payload)
         path = self._spilled.get(object_id)
         if path is not None:
             with open(path, "rb") as f:
@@ -322,6 +327,9 @@ class NativeSharedMemoryStore:
 
     def used_bytes(self) -> int:
         return self._store.used_bytes()
+
+    def reap_dead_pins(self) -> int:
+        return self._store.reap_dead_pins()
 
     def shutdown(self) -> None:
         for path in self._spilled.values():
@@ -356,12 +364,91 @@ def make_shared_store(capacity: int, spill_dir: str, threshold: float):
     return SharedMemoryStore(capacity, spill_dir, threshold)
 
 
+class _Pin:
+    """One reader pin on one object (plasma Get). Released exactly
+    once, when the last PinnedBuffer referencing it is collected."""
+
+    def __init__(self, store, id_bytes: bytes):
+        self._store = store
+        self._id = id_bytes
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            try:
+                self._store.unpin(self._id)
+            except Exception:  # noqa: BLE001 — store already closed
+                pass
+
+    def __del__(self):
+        self.release()
+
+
+class PinnedBuffer:
+    """Zero-copy view into the shared arena. Consumers (numpy arrays
+    deserialized out-of-band) keep this exporter alive through the
+    buffer protocol; the shared ``_Pin`` holds the reader refcount
+    until every buffer of the object is garbage-collected — only then
+    may the owner's delete actually reclaim the pages."""
+
+    def __init__(self, view: memoryview, pin: _Pin):
+        self._view = view
+        self._pin = pin
+
+    def __buffer__(self, flags):
+        # Read-only: shared pages are immutable to readers (same rule
+        # as plasma-backed numpy arrays in the reference).
+        return memoryview(self._view).toreadonly()
+
+    def __release_buffer__(self, view):
+        view.release()
+
+    def __len__(self):
+        return len(self._view)
+
+
+def _decode_pinned(record: memoryview, store,
+                   id_bytes: bytes) -> SerializedObject:
+    """Parse the arena record like ``decode`` but return BUFFERS as
+    zero-copy PinnedBuffer views instead of bytes copies. The pickle
+    stream (small) is copied; one pin is shared by all buffers and
+    releases when they are all collected. Owns the pin's error path:
+    the caller must NOT unpin — a failed decode releases exactly once
+    here (a second unpin could steal a concurrent reader's pin)."""
+    pin = _Pin(store, id_bytes)
+    try:
+        mv = record
+        dlen = int.from_bytes(mv[:8], "little")
+        data = bytes(mv[8:8 + dlen])
+        pos = 8 + dlen
+        nbuf = int.from_bytes(mv[pos:pos + 4], "little")
+        pos += 4
+        lens = []
+        for _ in range(nbuf):
+            lens.append(int.from_bytes(mv[pos:pos + 8], "little"))
+            pos += 8
+        buffers: list = []
+        for ln in lens:
+            buffers.append(PinnedBuffer(mv[pos:pos + ln], pin))
+            pos += ln
+        if not buffers:
+            pin.release()
+        return SerializedObject(data=data, buffers=buffers)
+    except Exception:
+        pin.release()
+        raise
+
+
 def read_descriptor(desc) -> SerializedObject:
     """Materialize a SerializedObject from a store descriptor.
 
-    Buffers are copied out of shared memory here: a reader must not
-    hold pointers into pages the owner may free (the zero-copy pinned
-    path needs distributed refcounts on readers — later round).
+    Native-store reads are ZERO-COPY: the reader pins the object
+    (reader refcount in the C++ arena) and the returned buffers are
+    views straight into the mapped pages; the pin releases when the
+    consumers are garbage-collected. Deletes concurrent with a pinned
+    read defer reclamation (store.cpp zombie entries), so views never
+    dangle. Python-shm and spilled reads still copy.
     """
     if desc[0] == "nat":
         _tag, store_name, id_bytes, spilled_path = desc
@@ -371,10 +458,14 @@ def read_descriptor(desc) -> SerializedObject:
                     return NativeSharedMemoryStore.decode(f.read())
             except FileNotFoundError:
                 raise ObjectLostError(spilled_path)
-        view = _attach(store_name).get(id_bytes)
-        if view is None:
+        store = _attach(store_name)
+        res = store.pin(id_bytes)
+        if res is None:
             raise ObjectLostError(id_bytes.hex())
-        return NativeSharedMemoryStore.decode(view)
+        kind, payload = res
+        if kind == "pinned":
+            return _decode_pinned(payload, store, id_bytes)
+        return NativeSharedMemoryStore.decode(payload)
 
     data, names, sizes, spilled_path = desc
     if spilled_path is not None:

@@ -51,6 +51,7 @@ OP_STREAM_NEXT = "stream_next"  # (task_id_bytes, timeout) ->
                                 #   ("item", oid_bytes) | ("done",)
 OP_STREAM_DROP = "stream_drop"  # task_id_bytes
 OP_SPANS = "spans"              # list of finished span dicts (tracing)
+OP_KV = "kv"                    # (action, key, value, namespace)
 
 # client channel, driver -> worker: (req_id, status, payload)
 ST_OK = "ok"

@@ -380,6 +380,11 @@ class DriverRuntime:
         self._pgs: dict[PlacementGroupID, PGRecord] = {}
         self._pg_lock = threading.Lock()
 
+        # Internal KV (GCS InternalKV analog, gcs_kv_manager.cc):
+        # namespaced small-metadata store for libraries.
+        self._kv: dict[tuple[str, bytes], bytes] = {}
+        self._kv_lock = threading.Lock()
+
         # Events / timeline
         self._events: deque = deque(maxlen=config.task_event_buffer_size)
 
@@ -500,9 +505,10 @@ class DriverRuntime:
                             [o for o in oids if o not in ready_set])
                 self._obj_cv.wait(remaining)
 
-    def get_serialized(self, oid: ObjectID,
-                       timeout: float | None = None) -> SerializedObject:
-        deadline = None if timeout is None else time.monotonic() + timeout
+    def _wait_location(self, oid: ObjectID,
+                       deadline: float | None) -> str:
+        """Block until the object has a location; raises the stored
+        error or GetTimeoutError. Returns "mem" | "shm"."""
         with self._obj_cv:
             while oid not in self._obj_locations:
                 remaining = (None if deadline is None
@@ -513,6 +519,12 @@ class DriverRuntime:
             loc = self._obj_locations[oid]
             if loc == "err":
                 raise ser.loads(self._errors[oid])
+            return loc
+
+    def get_serialized(self, oid: ObjectID,
+                       timeout: float | None = None) -> SerializedObject:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        loc = self._wait_location(oid, deadline)
         if loc == "mem":
             obj = self.memory_store.try_get(oid)
             if obj is not None:
@@ -530,6 +542,24 @@ class DriverRuntime:
                 raise ObjectLostError(oid.hex())
             return obj
         return read_descriptor(desc)
+
+    def get_serialized_or_desc(self, oid: ObjectID,
+                               timeout: float | None = None):
+        """("desc", descriptor) for shm-resident objects — the caller
+        (a worker on this node) maps and reads the arena zero-copy —
+        else ("obj", SerializedObject) shipped inline. The timeout
+        covers the whole call (the inline fallback gets the remaining
+        budget, not a fresh one)."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        loc = self._wait_location(oid, deadline)
+        if loc == "shm":
+            desc = self.shm_store.get_descriptor(oid)
+            if desc is not None:
+                return ("desc", desc)
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+        return ("obj", self.get_serialized(oid, remaining))
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -622,11 +652,18 @@ class DriverRuntime:
 
     def _resolve_args_payload(self, rec_args_blob: bytes,
                               arg_refs: list[ObjectRef]):
-        # Ship resolved (serialized) values of top-level refs alongside.
+        # Ship resolved values of top-level refs alongside: small
+        # objects inline; shm-resident objects as descriptors the
+        # worker reads zero-copy from the mapped arena (plasma arg
+        # fetch — the bytes never transit the exec socket).
         resolved = {}
         for r in arg_refs:
-            obj = self.get_serialized(r.id)
-            resolved[r.id.binary()] = (obj.data, obj.buffers)
+            kind, val = self.get_serialized_or_desc(r.id)
+            if kind == "desc":
+                resolved[r.id.binary()] = ("desc", val)
+            else:
+                resolved[r.id.binary()] = ("inline", val.data,
+                                           val.buffers)
         return resolved
 
     def _execute_local(self, rec: TaskRecord) -> None:
@@ -1130,6 +1167,14 @@ class DriverRuntime:
             self._idle.setdefault((w.node_id, w.env_key), []).append(w)
 
     def _reap_idle_workers(self) -> None:
+        # Also reclaim reader pins left by SIGKILLed processes
+        # (plasma's client-disconnect release analog).
+        reap = getattr(self.shm_store, "reap_dead_pins", None)
+        if reap is not None:
+            try:
+                reap()
+            except Exception:  # noqa: BLE001
+                pass
         ttl = self.config.idle_worker_ttl_s
         now = time.monotonic()
         with self._pool_lock:
@@ -1270,7 +1315,7 @@ class DriverRuntime:
                 if w in pool:
                     pool.remove(w)
         if w.is_actor and w.actor_id is not None:
-            self._on_actor_death(w.actor_id)
+            self._on_actor_death(w.actor_id, worker=w)
             return
         # A pooled worker died mid-task: retry or fail the task
         # (reference: owner-side TaskManager retries, task_manager.cc).
@@ -1371,8 +1416,10 @@ class DriverRuntime:
         return actor_id
 
     def _start_actor(self, rec: ActorRecord) -> None:
+        placed = None
+        w = None
+        need = self._effective_resources(rec.options)
         try:
-            need = self._effective_resources(rec.options)
             placed = self.acquire_on_some_node(
                 need, rec.options,
                 timeout=self.config.actor_creation_timeout_s)
@@ -1399,6 +1446,35 @@ class DriverRuntime:
                     rec.cls_blob, rec.init_args_blob, resolved,
                     rec.max_concurrency))
         except Exception as e:  # noqa: BLE001
+            if w is not None and w.conn is not None:
+                # The worker attached before dying: its reader thread
+                # owns death handling (_on_worker_exit ->
+                # _on_actor_death releases resources and decides the
+                # restart) — doing it here too would double-release
+                # and double-boot.
+                return
+            if w is not None:
+                # Worker created but never attached: no reader thread
+                # exists, so clean it up here.
+                with self._pool_lock:
+                    if w in self._workers:
+                        self._workers.remove(w)
+                try:
+                    w.proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+                rec.worker = None
+            if placed is not None:
+                self._release(need, rec.options.placement_group,
+                              node_id=rec.node_id, bundle=rec.pg_bundle)
+            if (rec.restart_count < rec.max_restarts
+                    and not self._shutdown):
+                rec.restart_count += 1
+                rec.state = "RESTARTING"
+                rec.ready_event.clear()
+                time.sleep(0.1)
+                self._start_actor(rec)
+                return
             rec.creation_error = e
             rec.state = "DEAD"
             rec.ready_event.set()
@@ -1491,11 +1567,21 @@ class DriverRuntime:
                 self._store_error(oid, err_blob)
             self._finish_stream(task_id, err_blob)
 
-    def _on_actor_death(self, actor_id: ActorID) -> None:
+    def _on_actor_death(self, actor_id: ActorID,
+                        worker=None) -> None:
         rec = self._actors.get(actor_id)
         if rec is None:
             return
-        was_alive = rec.state == "ALIVE"
+        if worker is not None and rec.worker is not worker:
+            # A stale incarnation's delayed exit: the current worker
+            # is someone else — releasing resources or restarting on
+            # its behalf would double-count.
+            return
+        # A kill landing mid-restart must keep consuming restart
+        # budget, not permanently kill the actor (reference: the GCS
+        # actor FSM keeps retrying RESTARTING actors,
+        # gcs_actor_manager.cc:1358).
+        was_alive = rec.state in ("ALIVE", "RESTARTING")
         # Fail all in-flight calls.
         err = ActorDiedError(actor_id.hex(), "actor process exited")
         blob = ser.dumps(err)
@@ -1687,6 +1773,32 @@ class DriverRuntime:
 
     # ---------------- introspection ----------------
 
+    # ---------------- internal KV (GCS KV analog) ----------------
+
+    def kv_put(self, key: bytes, value: bytes,
+               namespace: str = "") -> None:
+        with self._kv_lock:
+            self._kv[(namespace, bytes(key))] = bytes(value)
+
+    def kv_get(self, key: bytes, namespace: str = "") -> bytes | None:
+        with self._kv_lock:
+            return self._kv.get((namespace, bytes(key)))
+
+    def kv_del(self, key: bytes, namespace: str = "") -> bool:
+        with self._kv_lock:
+            return self._kv.pop((namespace, bytes(key)), None) \
+                is not None
+
+    def kv_exists(self, key: bytes, namespace: str = "") -> bool:
+        with self._kv_lock:
+            return (namespace, bytes(key)) in self._kv
+
+    def kv_keys(self, prefix: bytes = b"",
+                namespace: str = "") -> list[bytes]:
+        with self._kv_lock:
+            return [k for (ns, k) in self._kv
+                    if ns == namespace and k.startswith(prefix)]
+
     def resource_demand(self) -> list[dict[str, float]]:
         """Unmet resource requests (autoscaler input — reference:
         resource demand in autoscaler.proto / GcsAutoscalerStateManager):
@@ -1852,8 +1964,11 @@ class DriverRuntime:
             return ref.id.binary()
         if op == P.OP_GET:
             oid_bytes, timeout = payload
-            obj = self.get_serialized(ObjectID(oid_bytes), timeout)
-            return (obj.data, obj.buffers)
+            kind, val = self.get_serialized_or_desc(
+                ObjectID(oid_bytes), timeout)
+            if kind == "desc":
+                return ("desc", val)
+            return ("inline", val.data, val.buffers)
         if op == P.OP_WAIT:
             oid_bytes_list, num_returns, timeout = payload
             done, rest = self.wait_available(
@@ -1894,6 +2009,20 @@ class DriverRuntime:
             from ray_tpu.util.tracing import get_tracer
             get_tracer().add_spans(payload)
             return None
+        if op == P.OP_KV:
+            action, key, value, namespace = payload
+            if action == "put":
+                self.kv_put(key, value, namespace)
+                return None
+            if action == "get":
+                return self.kv_get(key, namespace)
+            if action == "del":
+                return self.kv_del(key, namespace)
+            if action == "exists":
+                return self.kv_exists(key, namespace)
+            if action == "keys":
+                return self.kv_keys(key, namespace)
+            raise ValueError(f"unknown kv action {action!r}")
         if op == P.OP_GET_ACTOR:
             name = payload
             return self.get_named_actor(name).binary()

@@ -92,8 +92,8 @@ class ClientRuntime:
 
     def get_serialized(self, oid: ObjectID,
                        timeout: float | None = None) -> SerializedObject:
-        data, buffers = self._call(P.OP_GET, (oid.binary(), timeout))
-        return SerializedObject(data=data, buffers=list(buffers))
+        out = self._call(P.OP_GET, (oid.binary(), timeout))
+        return _resolved_to_serialized(out)
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -151,6 +151,26 @@ class ClientRuntime:
 
     def drop_stream(self, task_id_bytes: bytes) -> None:
         self._call(P.OP_STREAM_DROP, task_id_bytes)
+
+    # -- internal KV --
+
+    def kv_put(self, key, value, namespace=""):
+        self._call(P.OP_KV, ("put", bytes(key), bytes(value),
+                             namespace))
+
+    def kv_get(self, key, namespace=""):
+        return self._call(P.OP_KV, ("get", bytes(key), b"", namespace))
+
+    def kv_del(self, key, namespace=""):
+        return self._call(P.OP_KV, ("del", bytes(key), b"", namespace))
+
+    def kv_exists(self, key, namespace=""):
+        return self._call(P.OP_KV, ("exists", bytes(key), b"",
+                                    namespace))
+
+    def kv_keys(self, prefix=b"", namespace=""):
+        return self._call(P.OP_KV, ("keys", bytes(prefix), b"",
+                                    namespace))
 
     def register_function(self, fn):
         import hashlib
@@ -237,6 +257,29 @@ def PlacementGroupIDFromBytes(b):
 # Execution helpers
 # --------------------------------------------------------------------------
 
+def _resolved_to_serialized(entry) -> SerializedObject:
+    """A resolved value is ("inline", data, buffers) or
+    ("desc", descriptor) — the latter reads the shared arena in place
+    (zero-copy, pinned until the deserialized consumers die). A
+    descriptor can race the owner's spiller (object evicted to disk
+    between resolve and read): re-request through the driver once,
+    which hands back a spill-file descriptor."""
+    if entry[0] == "desc":
+        from ray_tpu.core.exceptions import ObjectLostError
+        from ray_tpu.core.object_store import read_descriptor
+        try:
+            return read_descriptor(entry[1])
+        except ObjectLostError:
+            desc = entry[1]
+            if desc[0] == "nat":
+                from ray_tpu.core.api import get_runtime
+                return get_runtime().get_serialized(
+                    ObjectID(desc[2]), timeout=30)
+            raise
+    _tag, data, buffers = entry
+    return SerializedObject(data=data, buffers=list(buffers))
+
+
 def _materialize_args(args_blob: bytes, resolved: dict):
     """Deserialize (args, kwargs), substituting driver-resolved values
     for top-level ObjectRefs (reference: plasma arg fetch before
@@ -249,9 +292,8 @@ def _materialize_args(args_blob: bytes, resolved: dict):
             key = v.id.binary()
             if key in resolved:
                 if key not in cache:
-                    data, buffers = resolved[key]
                     cache[key] = ser.deserialize(
-                        SerializedObject(data=data, buffers=list(buffers)))
+                        _resolved_to_serialized(resolved[key]))
                 return cache[key]
         return v
 

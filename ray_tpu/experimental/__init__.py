@@ -1,0 +1,5 @@
+"""ray_tpu.experimental — unstable APIs (internal KV, head state)."""
+
+from ray_tpu.experimental import internal_kv
+
+__all__ = ["internal_kv"]

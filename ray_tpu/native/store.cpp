@@ -36,13 +36,31 @@ constexpr uint32_t kIdSize = 28;         // ObjectID size (ids.py)
 constexpr uint32_t kMaxObjects = 16384;
 constexpr uint32_t kMaxFreeBlocks = 16384;
 
+constexpr uint32_t kMaxPinPids = 4;
+
+struct PinSlot {
+  int32_t pid;         // 0 = empty
+  uint32_t count;
+};
+
 struct Entry {
   uint8_t id[kIdSize];
   uint8_t used;
-  uint8_t padding[3];
+  uint8_t zombie;      // deleted while pinned: space freed on unpin
+  uint16_t pins;       // zero-copy reader count (plasma Get/Release)
   uint64_t offset;
   uint64_t size;
+  // Which processes hold the pins: lets the owner reap pins left by
+  // SIGKILLed readers (plasma's client-disconnect release analog).
+  PinSlot pin_pids[kMaxPinPids];
 };
+
+bool pid_alive(int32_t pid) {
+  char path[64];
+  std::snprintf(path, sizeof(path), "/proc/%d", pid);
+  struct stat st;
+  return ::stat(path, &st) == 0;
+}
 
 struct FreeBlock {
   uint64_t offset;
@@ -91,6 +109,22 @@ class Locker {
 
 Entry* find_entry(Header* h, const uint8_t* id) {
   // Linear probe from a hash start (open addressing over fixed slots).
+  // Zombie entries (deleted-while-pinned) are invisible here; only
+  // rts_unpin looks them up (find_entry_any).
+  uint64_t hash = 1469598103934665603ull;
+  for (uint32_t i = 0; i < kIdSize; ++i) {
+    hash = (hash ^ id[i]) * 1099511628211ull;
+  }
+  uint32_t start = static_cast<uint32_t>(hash % kMaxObjects);
+  for (uint32_t probe = 0; probe < kMaxObjects; ++probe) {
+    Entry* e = &h->entries[(start + probe) % kMaxObjects];
+    if (e->used && !e->zombie &&
+        std::memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return nullptr;
+}
+
+Entry* find_entry_any(Header* h, const uint8_t* id) {
   uint64_t hash = 1469598103934665603ull;
   for (uint32_t i = 0; i < kIdSize; ++i) {
     hash = (hash ^ id[i]) * 1099511628211ull;
@@ -195,7 +229,7 @@ void* rts_create(const char* name, uint64_t capacity) {
   Header* h = static_cast<Header*>(mem);
   std::memset(h, 0, sizeof(Header));
   h->magic = kMagic;
-  h->version = 1;
+  h->version = 2;
   h->capacity = capacity;
   h->used = 0;
   h->data_start = sizeof(Header);
@@ -304,10 +338,101 @@ int rts_delete(void* handle, const uint8_t* id) {
   Locker lock(h);
   Entry* e = find_entry(h, id);
   if (e == nullptr) return 0;
+  if (e->pins > 0) {
+    // Readers hold zero-copy views into the arena: logically delete
+    // now (invisible to get/put), reclaim on last unpin — the plasma
+    // deferred-deletion model.
+    e->zombie = 1;
+    h->num_entries--;
+    return 1;
+  }
   arena_free(h, e->offset, e->size);
   e->used = 0;
   h->num_entries--;
   return 1;
+}
+
+// Pin for a zero-copy read: like rts_get but increments the reader
+// count so the bytes stay mapped until rts_unpin (plasma Get).
+// Returns 1 on success, 0 if missing, 2 if the per-object pid table
+// is full (caller should fall back to a copying read, unpinned).
+int rts_pin(void* handle, const uint8_t* id, uint64_t* offset,
+            uint64_t* size) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  int32_t me = static_cast<int32_t>(getpid());
+  Locker lock(h);
+  Entry* e = find_entry(h, id);
+  if (e == nullptr) return 0;
+  if (e->pins == UINT16_MAX) return 0;
+  PinSlot* slot = nullptr;
+  for (uint32_t i = 0; i < kMaxPinPids; ++i) {
+    if (e->pin_pids[i].pid == me) { slot = &e->pin_pids[i]; break; }
+    if (slot == nullptr && e->pin_pids[i].pid == 0) {
+      slot = &e->pin_pids[i];
+    }
+  }
+  if (slot == nullptr) return 2;   // pid table full: copy instead
+  slot->pid = me;
+  slot->count++;
+  e->pins++;
+  *offset = e->offset;
+  *size = e->size;
+  return 1;
+}
+
+void entry_unpin_one(Header* h, Entry* e, PinSlot* slot) {
+  slot->count--;
+  if (slot->count == 0) slot->pid = 0;
+  e->pins--;
+  if (e->pins == 0 && e->zombie) {
+    arena_free(h, e->offset, e->size);
+    e->used = 0;
+    e->zombie = 0;
+  }
+}
+
+// Release a zero-copy read (plasma Release). Frees a zombie's space
+// on the last unpin. Returns remaining pins, or -1 if unknown id.
+int rts_unpin(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  int32_t me = static_cast<int32_t>(getpid());
+  Locker lock(h);
+  Entry* e = find_entry_any(h, id);
+  if (e == nullptr || e->pins == 0) return -1;
+  for (uint32_t i = 0; i < kMaxPinPids; ++i) {
+    if (e->pin_pids[i].pid == me && e->pin_pids[i].count > 0) {
+      entry_unpin_one(h, e, &e->pin_pids[i]);
+      return e->used ? e->pins : 0;
+    }
+  }
+  return -1;
+}
+
+// Reap pins held by dead processes (the owner calls this
+// periodically — plasma's client-disconnect release analog). Returns
+// the number of pins reclaimed.
+int rts_reap_dead_pins(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  int reaped = 0;
+  Locker lock(h);
+  for (uint32_t i = 0; i < kMaxObjects; ++i) {
+    Entry* e = &h->entries[i];
+    if (!e->used || e->pins == 0) continue;
+    for (uint32_t j = 0; j < kMaxPinPids; ++j) {
+      PinSlot* slot = &e->pin_pids[j];
+      while (slot->pid != 0 && slot->count > 0 &&
+             !pid_alive(slot->pid)) {
+        entry_unpin_one(h, e, slot);
+        reaped++;
+        if (!e->used) break;           // zombie reclaimed
+      }
+      if (!e->used) break;
+    }
+  }
+  return reaped;
 }
 
 uint8_t* rts_data_ptr(void* handle) {

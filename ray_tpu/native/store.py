@@ -42,6 +42,14 @@ def _load():
                                 ctypes.POINTER(ctypes.c_uint64)]
         lib.rts_delete.restype = ctypes.c_int
         lib.rts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_pin.restype = ctypes.c_int
+        lib.rts_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.rts_unpin.restype = ctypes.c_int
+        lib.rts_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_reap_dead_pins.restype = ctypes.c_int
+        lib.rts_reap_dead_pins.argtypes = [ctypes.c_void_p]
         lib.rts_data_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.rts_data_ptr.argtypes = [ctypes.c_void_p]
         lib.rts_used_bytes.restype = ctypes.c_uint64
@@ -116,6 +124,43 @@ class NativeStore:
         return bool(self._lib.rts_get(
             self._h, self._check_id(object_id),
             ctypes.byref(off), ctypes.byref(size)))
+
+    def pin(self, object_id: bytes):
+        """Zero-copy read with a reader refcount (plasma Get).
+
+        Returns ("pinned", memoryview) — valid, even across delete,
+        until ``unpin`` — or ("copy", bytes) when the per-object pid
+        table is full (no pin held; data copied out under the lock
+        window), or None when the object is missing."""
+        if self._closed:
+            return None
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rts_pin(self._h, self._check_id(object_id),
+                               ctypes.byref(off), ctypes.byref(size))
+        if rc == 0:
+            return None
+        if rc == 2:
+            view = self.get(object_id)
+            return None if view is None else ("copy", bytes(view))
+        base = self._lib.rts_data_ptr(self._h)
+        addr = ctypes.addressof(base.contents) + off.value
+        buf = (ctypes.c_uint8 * size.value).from_address(addr)
+        return ("pinned", memoryview(buf).cast("B"))
+
+    def reap_dead_pins(self) -> int:
+        """Release pins held by processes that no longer exist (the
+        plasma client-disconnect analog; owner calls periodically)."""
+        if self._closed:
+            return 0
+        return self._lib.rts_reap_dead_pins(self._h)
+
+    def unpin(self, object_id: bytes) -> int:
+        """Release a pinned read (plasma Release)."""
+        if self._closed:
+            return -1
+        return self._lib.rts_unpin(self._h,
+                                   self._check_id(object_id))
 
     def delete(self, object_id: bytes) -> bool:
         # Guard against finalizer-ordered calls after close(): GC can

@@ -1,0 +1,90 @@
+"""Fault-injection helpers for tests and chaos runs.
+
+Reference analog (SURVEY.md §4.1(4)): ``ResourceKillerActor`` /
+``WorkerKillerActor`` / ``kill_raylet`` in
+python/ray/_private/test_utils.py — kill workers/actors/nodes on an
+interval while a workload runs, asserting the system heals (task
+retries, actor restarts, PG re-homing).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class ResourceKiller:
+    """Periodically kills a random target while running.
+
+    kind: "worker"  — SIGKILL a busy task worker process
+          "actor"   — SIGKILL a random actor's worker process
+          "node"    — remove a random non-head node (simulated node
+                      failure; reference NodeKillerBase)
+    """
+
+    def __init__(self, kind: str = "worker",
+                 interval_s: float = 0.5,
+                 max_kills: int | None = None,
+                 seed: int | None = None, runtime=None):
+        if runtime is None:
+            from ray_tpu.core.api import get_runtime
+            runtime = get_runtime()
+        if kind not in ("worker", "actor", "node"):
+            raise ValueError(f"unknown kill target {kind!r}")
+        self.kind = kind
+        self.interval = interval_s
+        self.max_kills = max_kills
+        self.runtime = runtime
+        self.kills = 0
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ResourceKiller":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"chaos_{self.kind}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> int:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        return self.kills
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            if self.max_kills is not None and \
+                    self.kills >= self.max_kills:
+                return
+            try:
+                if self._kill_one():
+                    self.kills += 1
+            except Exception:  # noqa: BLE001 — chaos must not crash
+                pass
+
+    def _kill_one(self) -> bool:
+        rt = self.runtime
+        if self.kind == "node":
+            nodes = [n for n in rt.nodes()
+                     if n["Alive"] and not n["IsHead"]]
+            if not nodes:
+                return False
+            rt.remove_node(self._rng.choice(nodes)["NodeID"])
+            return True
+        with rt._pool_lock:
+            if self.kind == "worker":
+                targets = [w for w in rt._workers
+                           if not w.is_actor and w.busy and not w.dead]
+            else:
+                targets = [w for w in rt._workers
+                           if w.is_actor and not w.dead]
+        if not targets:
+            return False
+        victim = self._rng.choice(targets)
+        try:
+            victim.proc.kill()
+        except Exception:  # noqa: BLE001
+            return False
+        return True

@@ -1,0 +1,121 @@
+"""Head (control-plane) state snapshot & recovery.
+
+Reference analog (SURVEY.md §5.3 GCS failure/HA): with Redis
+persistence the GCS journals its tables (actors, placement groups,
+KV, jobs) and a restarted GCS replays them — named/detached actors
+are restarted fresh and placement groups re-reserved
+(``NotifyGCSRestart``). Here the control plane is the driver runtime,
+so HA = snapshot the control-plane tables to disk and replay them
+into a new runtime after a head restart:
+
+    ray_tpu.util.ha.save_head_state(path)        # old head
+    ...head dies, new process...
+    ray_tpu.init(); ray_tpu.util.ha.restore_head_state(path)
+
+Restored: internal KV, NAMED actors (restarted fresh — same semantics
+as a GCS-driven actor restart: state is lost, identity and
+reachability survive), and placement-group specs (re-reserved).
+Anonymous actors/objects die with the head, as their handles did.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Any
+
+
+def _rt():
+    from ray_tpu.core.api import get_runtime
+    return get_runtime()
+
+
+def _e(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _d(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def save_head_state(path: str) -> dict:
+    """Snapshot KV + named-actor specs + PG specs to ``path``
+    (atomic). Returns the counts written."""
+    from ray_tpu.core import serialization as ser
+    rt = _rt()
+
+    kv_rows = []
+    with rt._kv_lock:
+        for (ns, k), v in rt._kv.items():
+            kv_rows.append({"ns": ns, "k": _e(k), "v": _e(v)})
+
+    actor_rows = []
+    with rt._actor_lock:
+        named = dict(rt._named_actors)
+    for name, actor_id in named.items():
+        rec = rt._actors.get(actor_id)
+        if rec is None or rec.state == "DEAD":
+            continue
+        actor_rows.append({
+            "name": name,
+            "cls_name": rec.cls_name,
+            "cls_blob": _e(rec.cls_blob),
+            "init_args_blob": _e(rec.init_args_blob),
+            "options_blob": _e(ser.dumps(rec.options)),
+            "max_restarts": rec.max_restarts,
+            "max_concurrency": rec.max_concurrency,
+        })
+
+    pg_rows = []
+    with rt._pg_lock:
+        for pg in rt._pgs.values():
+            if pg.created:
+                pg_rows.append({"bundles": pg.bundles,
+                                "strategy": pg.strategy})
+
+    state = {"kv": kv_rows, "named_actors": actor_rows, "pgs": pg_rows}
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+    return {"kv": len(kv_rows), "named_actors": len(actor_rows),
+            "pgs": len(pg_rows)}
+
+
+def restore_head_state(path: str) -> dict:
+    """Replay a head snapshot into the CURRENT runtime: KV entries
+    restored verbatim, named actors recreated (fresh state), PGs
+    re-reserved. Returns what was restored; actors whose name is
+    already taken are skipped (idempotent replay)."""
+    from ray_tpu.core import serialization as ser
+    rt = _rt()
+    with open(path) as f:
+        state = json.load(f)
+
+    for row in state["kv"]:
+        rt.kv_put(_d(row["k"]), _d(row["v"]), row["ns"])
+
+    restored_actors = []
+    for row in state["named_actors"]:
+        try:
+            rt.get_named_actor(row["name"])
+            continue                      # name already live
+        except ValueError:
+            pass
+        options = ser.loads(_d(row["options_blob"]))
+        args, kwargs = ser.loads(_d(row["init_args_blob"]))
+        rt.create_actor(
+            _d(row["cls_blob"]), row["cls_name"], args, kwargs,
+            options, row["name"], row["max_restarts"],
+            row["max_concurrency"])
+        restored_actors.append(row["name"])
+
+    pgs = []
+    for row in state["pgs"]:
+        pgs.append(rt.create_placement_group(
+            [dict(b) for b in row["bundles"]], row["strategy"]))
+
+    return {"kv": len(state["kv"]), "named_actors": restored_actors,
+            "pgs": len(pgs)}
