@@ -34,6 +34,7 @@ from ray_tpu.core.api import (
     timeline,
     method,
     get_runtime_context,
+    client_address,
 )
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.actor import ActorHandle
@@ -69,6 +70,7 @@ __all__ = [
     "nodes",
     "timeline",
     "get_runtime_context",
+    "client_address",
     "ObjectRef",
     "ObjectRefGenerator",
     "ActorHandle",

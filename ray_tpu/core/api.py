@@ -48,8 +48,20 @@ def init(num_cpus: int | None = None,
          local_mode: bool = False,
          ignore_reinit_error: bool = False,
          runtime_env: dict[str, Any] | None = None,
+         address: str | None = None,
+         log_to_driver: bool = True,
          _system_config: dict[str, Any] | None = None):
-    """Start the single-node runtime in this process (driver).
+    """Start the single-node runtime in this process (driver), or —
+    with ``address`` — connect this process as a CLIENT of a running
+    head (the Ray Client analog, ``ray.init("ray://...")``,
+    python/ray/util/client/): the full API proxies over the head's
+    unix socket, so a separate script can submit tasks, create
+    actors, and read objects on a live cluster.
+
+    ``address`` is the head's ``runtime.sock`` path (printed by
+    ``ray_tpu.client_address()`` on the head / discoverable under
+    /tmp/ray_tpu_sessions/<pid>/), or "auto" to pick the newest live
+    session on this host.
 
     Reference analog: ``ray.init`` (python/ray/_private/worker.py:1240).
     ``_system_config`` injects config overrides for the whole session —
@@ -63,6 +75,24 @@ def init(num_cpus: int | None = None,
             raise RuntimeError(
                 "ray_tpu.init() called twice; pass "
                 "ignore_reinit_error=True to allow")
+        if address is not None:
+            bad = {"num_cpus": num_cpus, "num_tpus": num_tpus,
+                   "resources": resources,
+                   "runtime_env": runtime_env,
+                   "_system_config": _system_config}
+            passed = [k for k, v in bad.items() if v]
+            if local_mode:
+                passed.append("local_mode")
+            if passed:
+                raise ValueError(
+                    f"init(address=...) connects to an existing head; "
+                    f"{', '.join(passed)} configure a NEW cluster and "
+                    f"would be silently ignored — remove them or drop "
+                    f"address")
+            from ray_tpu.core.worker import ClientRuntime
+            _runtime = ClientRuntime(_resolve_address(address))
+            atexit.register(_shutdown_at_exit)
+            return _runtime
         cfg = Config.from_env(_system_config)
         set_config(cfg)
         from ray_tpu.core.runtime import DriverRuntime
@@ -72,9 +102,32 @@ def init(num_cpus: int | None = None,
         _runtime = DriverRuntime(
             cfg, num_cpus=num_cpus, num_tpus=num_tpus,
             resources=resources, local_mode=local_mode,
-            runtime_env=runtime_env)
+            runtime_env=runtime_env, log_to_driver=log_to_driver)
         atexit.register(_shutdown_at_exit)
         return _runtime
+
+
+def _resolve_address(address: str) -> str:
+    if address != "auto":
+        return address
+    import glob
+    import os
+    candidates = sorted(
+        glob.glob("/tmp/ray_tpu_sessions/*/runtime.sock"),
+        key=os.path.getmtime, reverse=True)
+    for sock in candidates:
+        # Liveness: the session dir is named by the head's pid.
+        pid = os.path.basename(os.path.dirname(sock))
+        if pid.isdigit() and os.path.exists(f"/proc/{pid}"):
+            return sock
+    raise ConnectionError(
+        "address='auto': no live ray_tpu session found on this host")
+
+
+def client_address() -> str:
+    """The unix-socket address remote clients connect to
+    (``init(address=...)``)."""
+    return get_runtime().client_address
 
 
 def _shutdown_at_exit():

@@ -1,0 +1,70 @@
+"""Log monitor: republish worker stdout/stderr on the driver.
+
+Reference analog (SURVEY.md §5.5): a per-node LogMonitor
+(python/ray/_private/log_monitor.py:103) tails worker log files and
+publishes records so drivers see remote prints. Here each worker
+writes to ``<session>/logs/worker-N.log``; a driver thread tails every
+file and reprints new lines prefixed with the worker identity.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+
+class LogMonitor:
+    def __init__(self, log_dir: str, interval_s: float = 0.3,
+                 out=None):
+        self.log_dir = log_dir
+        self.interval = interval_s
+        self.out = out or sys.stdout
+        self._offsets: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="log_monitor")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — monitor must survive
+                pass
+
+    def poll_once(self) -> int:
+        """Tail every log file once; returns lines published."""
+        published = 0
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except OSError:
+            return 0
+        for name in names:
+            if not name.endswith(".log"):
+                continue
+            path = os.path.join(self.log_dir, name)
+            offset = self._offsets.get(name, 0)
+            try:
+                size = os.path.getsize(path)
+                if size <= offset:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+            except OSError:
+                continue
+            # Only publish complete lines; carry partials.
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[name] = offset + last_nl + 1
+            tag = name[:-4]   # worker-N
+            text = chunk[:last_nl].decode(errors="replace")
+            for line in text.splitlines():
+                print(f"({tag}) {line}", file=self.out)
+                published += 1
+        return published
+
+    def stop(self) -> None:
+        self._stop.set()

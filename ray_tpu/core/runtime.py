@@ -225,12 +225,26 @@ class WorkerHandle:
         env["PYTHONPATH"] = os.pathsep.join(
             [p for p in sys.path if p] +
             [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        # Worker stdout/stderr go to a per-worker log file; the
+        # driver's LogMonitor tails it back to the driver's stdout
+        # (reference: log_monitor.py publishing remote prints).
+        stdout_target = None
+        self.log_path = None
+        if runtime.log_dir is not None:
+            env["PYTHONUNBUFFERED"] = "1"   # lines appear promptly
+            self.log_path = os.path.join(
+                runtime.log_dir, f"worker-{self.index}.log")
+            stdout_target = open(self.log_path, "ab", buffering=0)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_entry",
              runtime.client_address, self.token],
             env=env,
             cwd=os.getcwd(),
+            stdout=stdout_target,
+            stderr=stdout_target,
         )
+        if stdout_target is not None:
+            stdout_target.close()   # child holds its own fd
         runtime._register_pending_worker(self)
 
     def attach_conn(self, conn) -> None:
@@ -306,7 +320,8 @@ class DriverRuntime:
                  num_tpus: int | None = None,
                  resources: dict[str, float] | None = None,
                  local_mode: bool = False,
-                 runtime_env: dict | None = None):
+                 runtime_env: dict | None = None,
+                 log_to_driver: bool = True):
         self.config = config
         self.job_id = JobID.next()
         self.local_mode = local_mode
@@ -395,6 +410,15 @@ class DriverRuntime:
         sock_dir = f"/tmp/ray_tpu_sessions/{os.getpid()}"
         os.makedirs(sock_dir, exist_ok=True)
         self.client_address = os.path.join(sock_dir, "runtime.sock")
+        # Per-worker log capture + driver-side republish (reference:
+        # log_monitor.py). log_dir=None disables capture.
+        self.log_dir: str | None = None
+        self.log_monitor = None
+        if log_to_driver:
+            self.log_dir = os.path.join(sock_dir, "logs")
+            os.makedirs(self.log_dir, exist_ok=True)
+            from ray_tpu.core.log_monitor import LogMonitor
+            self.log_monitor = LogMonitor(self.log_dir)
         self._listener = mpc.Listener(self.client_address, family="AF_UNIX")
         self._pending_workers: dict[str, WorkerHandle] = {}
         self._pending_workers_lock = threading.Lock()
@@ -1446,7 +1470,8 @@ class DriverRuntime:
                     rec.cls_blob, rec.init_args_blob, resolved,
                     rec.max_concurrency))
         except Exception as e:  # noqa: BLE001
-            if w is not None and w.conn is not None:
+            worker_died = w is not None and w.proc.poll() is not None
+            if worker_died and w.conn is not None:
                 # The worker attached before dying: its reader thread
                 # owns death handling (_on_worker_exit ->
                 # _on_actor_death releases resources and decides the
@@ -1454,8 +1479,12 @@ class DriverRuntime:
                 # and double-boot.
                 return
             if w is not None:
-                # Worker created but never attached: no reader thread
-                # exists, so clean it up here.
+                # Pre-attach death, or a non-death failure (e.g. an
+                # init arg's error) with a healthy worker: clean up
+                # here. rec.worker is detached FIRST so the reader
+                # thread's eventual _on_actor_death is a no-op (stale
+                # worker check).
+                rec.worker = None
                 with self._pool_lock:
                     if w in self._workers:
                         self._workers.remove(w)
@@ -1463,11 +1492,14 @@ class DriverRuntime:
                     w.proc.terminate()
                 except Exception:  # noqa: BLE001
                     pass
-                rec.worker = None
             if placed is not None:
                 self._release(need, rec.options.placement_group,
                               node_id=rec.node_id, bundle=rec.pg_bundle)
-            if (rec.restart_count < rec.max_restarts
+            # Only worker deaths consume restart budget; logic errors
+            # (bad init args, infeasible placement) would fail every
+            # retry identically — surface them immediately.
+            if (worker_died
+                    and rec.restart_count < rec.max_restarts
                     and not self._shutdown):
                 rec.restart_count += 1
                 rec.state = "RESTARTING"
@@ -1776,9 +1808,15 @@ class DriverRuntime:
     # ---------------- internal KV (GCS KV analog) ----------------
 
     def kv_put(self, key: bytes, value: bytes,
-               namespace: str = "") -> None:
+               namespace: str = "", overwrite: bool = True) -> bool:
+        """Atomic put; with overwrite=False this is the GCS KV's
+        PutIfAbsent (exactly one concurrent caller wins)."""
         with self._kv_lock:
-            self._kv[(namespace, bytes(key))] = bytes(value)
+            k = (namespace, bytes(key))
+            if not overwrite and k in self._kv:
+                return False
+            self._kv[k] = bytes(value)
+            return True
 
     def kv_get(self, key: bytes, namespace: str = "") -> bytes | None:
         with self._kv_lock:
@@ -2012,8 +2050,10 @@ class DriverRuntime:
         if op == P.OP_KV:
             action, key, value, namespace = payload
             if action == "put":
-                self.kv_put(key, value, namespace)
-                return None
+                return self.kv_put(key, value, namespace)
+            if action == "put_if_absent":
+                return self.kv_put(key, value, namespace,
+                                   overwrite=False)
             if action == "get":
                 return self.kv_get(key, namespace)
             if action == "del":
@@ -2070,6 +2110,14 @@ class DriverRuntime:
         self._shutdown = True
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
+        if self.log_monitor is not None:
+            # Final drain so prints from short-lived workers are not
+            # lost between the last poll and shutdown.
+            try:
+                self.log_monitor.poll_once()
+            except Exception:  # noqa: BLE001
+                pass
+            self.log_monitor.stop()
         with self._res_cv:
             self._res_cv.notify_all()
         with self._pool_lock:

@@ -154,9 +154,10 @@ class ClientRuntime:
 
     # -- internal KV --
 
-    def kv_put(self, key, value, namespace=""):
-        self._call(P.OP_KV, ("put", bytes(key), bytes(value),
-                             namespace))
+    def kv_put(self, key, value, namespace="", overwrite=True):
+        return self._call(
+            P.OP_KV, ("put" if overwrite else "put_if_absent",
+                      bytes(key), bytes(value), namespace))
 
     def kv_get(self, key, namespace=""):
         return self._call(P.OP_KV, ("get", bytes(key), b"", namespace))

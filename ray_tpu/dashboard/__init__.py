@@ -1,0 +1,12 @@
+"""ray_tpu.dashboard — HTTP observability head.
+
+Reference analog (SURVEY.md §2.2 Dashboard): the dashboard head
+aggregates cluster state and serves it over HTTP with pluggable
+modules (nodes/tasks/actors/jobs/metrics). Here: a stdlib HTTP server
+in a thread exposing the state API as JSON, a Prometheus /metrics
+endpoint, the chrome-trace timeline, and a minimal HTML overview.
+"""
+
+from ray_tpu.dashboard.head import Dashboard, start_dashboard
+
+__all__ = ["Dashboard", "start_dashboard"]

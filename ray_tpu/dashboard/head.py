@@ -1,0 +1,145 @@
+"""Dashboard head: HTTP server over the state API.
+
+Endpoints (reference: dashboard modules python/ray/dashboard/modules/):
+  GET /                       minimal HTML overview
+  GET /api/cluster            {resources, available, nodes}
+  GET /api/nodes|tasks|actors|objects|placement_groups   state rows
+  GET /api/summary            task-state counts
+  GET /api/timeline           chrome-trace JSON (ray.timeline analog)
+  GET /api/spans              tracing spans (util.tracing)
+  GET /metrics                Prometheus exposition (util.metrics)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _json_default(o):
+    return str(o)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    runtime = None   # set by Dashboard
+
+    def log_message(self, *a):       # silence request logging
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, payload) -> None:
+        self._send(200, json.dumps(
+            payload, default=_json_default).encode())
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        from ray_tpu.util import state as state_api
+        rt = self.runtime
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._send(200, self._index(), "text/html")
+            elif path == "/api/cluster":
+                self._send_json({
+                    "resources": rt.cluster_resources(),
+                    "available": rt.available_resources(),
+                    "nodes": rt.nodes(),
+                })
+            elif path == "/api/nodes":
+                self._send_json(state_api.list_nodes())
+            elif path == "/api/tasks":
+                self._send_json(state_api.list_tasks())
+            elif path == "/api/actors":
+                self._send_json(state_api.list_actors())
+            elif path == "/api/objects":
+                self._send_json(state_api.list_objects())
+            elif path == "/api/placement_groups":
+                self._send_json(state_api.list_placement_groups())
+            elif path == "/api/summary":
+                self._send_json(state_api.summarize_tasks())
+            elif path == "/api/timeline":
+                self._send_json(rt.timeline())
+            elif path == "/api/spans":
+                from ray_tpu.util.tracing import get_tracer
+                self._send_json(
+                    [s.to_dict() for s in get_tracer().get_spans()])
+            elif path == "/metrics":
+                from ray_tpu.util.metrics import prometheus_text
+                self._send(200, prometheus_text().encode(),
+                           "text/plain; version=0.0.4")
+            else:
+                self._send(404, b'{"error": "not found"}')
+        except Exception as e:  # noqa: BLE001
+            self._send(500, json.dumps({"error": str(e)}).encode())
+
+    def _index(self) -> bytes:
+        from ray_tpu.util import state as state_api
+        rt = self.runtime
+        summary = state_api.summarize_tasks()
+        res = rt.cluster_resources()
+        avail = rt.available_resources()
+        rows = "".join(
+            f"<tr><td>{k}</td><td>{avail.get(k, 0):g} / {v:g}</td></tr>"
+            for k, v in sorted(res.items()))
+        agg: dict = {}
+        for states in summary.get("tasks", {}).values():
+            for st, n in states.items():
+                agg[st] = agg.get(st, 0) + n
+        counts = "".join(
+            f"<tr><td>{k}</td><td>{v}</td></tr>"
+            for k, v in sorted(agg.items()))
+        html = f"""<!doctype html><html><head>
+<title>ray_tpu dashboard</title>
+<style>body{{font-family:monospace;margin:2em}}
+table{{border-collapse:collapse}}td,th{{border:1px solid #999;
+padding:4px 10px}}</style></head><body>
+<h2>ray_tpu</h2>
+<h3>Resources (available / total)</h3><table>{rows}</table>
+<h3>Task states</h3><table>{counts}</table>
+<p>APIs: <a href="/api/cluster">cluster</a>
+<a href="/api/nodes">nodes</a> <a href="/api/tasks">tasks</a>
+<a href="/api/actors">actors</a> <a href="/api/objects">objects</a>
+<a href="/api/placement_groups">placement_groups</a>
+<a href="/api/summary">summary</a>
+<a href="/api/timeline">timeline</a> <a href="/api/spans">spans</a>
+<a href="/metrics">metrics</a></p>
+</body></html>"""
+        return html.encode()
+
+
+class Dashboard:
+    def __init__(self, port: int = 8265, host: str = "127.0.0.1",
+                 runtime=None):
+        if runtime is None:
+            from ray_tpu.core.api import get_runtime
+            runtime = get_runtime()
+        handler = type("BoundHandler", (_Handler,),
+                       {"runtime": runtime})
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dashboard")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1",
+                    runtime=None) -> Dashboard:
+    """Start the dashboard head; ``port=0`` picks a free port."""
+    return Dashboard(port=port, host=host, runtime=runtime)

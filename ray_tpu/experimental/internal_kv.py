@@ -22,11 +22,11 @@ def _b(x) -> bytes:
 
 def _kv_put(key, value, overwrite: bool = True,
             namespace: str = "") -> bool:
-    rt = _rt()
-    if not overwrite and rt.kv_exists(_b(key), namespace):
-        return False
-    rt.kv_put(_b(key), _b(value), namespace)
-    return True
+    # One atomic control-plane op — a check-then-act here would let
+    # two concurrent putters both "win" (reference: GCS PutIfAbsent
+    # is atomic server-side).
+    return _rt().kv_put(_b(key), _b(value), namespace,
+                        overwrite=overwrite)
 
 
 def _kv_get(key, namespace: str = "") -> bytes | None:

@@ -107,10 +107,11 @@ class Locker {
   Header* h_;
 };
 
-Entry* find_entry(Header* h, const uint8_t* id) {
-  // Linear probe from a hash start (open addressing over fixed slots).
-  // Zombie entries (deleted-while-pinned) are invisible here; only
-  // rts_unpin looks them up (find_entry_any).
+Entry* find_entry_impl(Header* h, const uint8_t* id,
+                       bool include_zombies) {
+  // Linear probe from a hash start (open addressing over fixed
+  // slots). Zombie entries (deleted-while-pinned) are skipped for
+  // get/put/delete; rts_unpin includes them.
   uint64_t hash = 1469598103934665603ull;
   for (uint32_t i = 0; i < kIdSize; ++i) {
     hash = (hash ^ id[i]) * 1099511628211ull;
@@ -118,23 +119,18 @@ Entry* find_entry(Header* h, const uint8_t* id) {
   uint32_t start = static_cast<uint32_t>(hash % kMaxObjects);
   for (uint32_t probe = 0; probe < kMaxObjects; ++probe) {
     Entry* e = &h->entries[(start + probe) % kMaxObjects];
-    if (e->used && !e->zombie &&
+    if (e->used && (include_zombies || !e->zombie) &&
         std::memcmp(e->id, id, kIdSize) == 0) return e;
   }
   return nullptr;
 }
 
+Entry* find_entry(Header* h, const uint8_t* id) {
+  return find_entry_impl(h, id, false);
+}
+
 Entry* find_entry_any(Header* h, const uint8_t* id) {
-  uint64_t hash = 1469598103934665603ull;
-  for (uint32_t i = 0; i < kIdSize; ++i) {
-    hash = (hash ^ id[i]) * 1099511628211ull;
-  }
-  uint32_t start = static_cast<uint32_t>(hash % kMaxObjects);
-  for (uint32_t probe = 0; probe < kMaxObjects; ++probe) {
-    Entry* e = &h->entries[(start + probe) % kMaxObjects];
-    if (e->used && std::memcmp(e->id, id, kIdSize) == 0) return e;
-  }
-  return nullptr;
+  return find_entry_impl(h, id, true);
 }
 
 Entry* find_slot(Header* h, const uint8_t* id) {
@@ -456,12 +452,44 @@ uint32_t rts_num_objects(void* handle) {
   return s->header->num_entries;
 }
 
+// Pins held by THIS process across all objects (used to decide
+// whether close may safely munmap).
+uint32_t rts_self_pin_count(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  Header* h = s->header;
+  int32_t me = static_cast<int32_t>(getpid());
+  uint32_t total = 0;
+  Locker lock(h);
+  for (uint32_t i = 0; i < kMaxObjects; ++i) {
+    Entry* e = &h->entries[i];
+    if (!e->used || e->pins == 0) continue;
+    for (uint32_t j = 0; j < kMaxPinPids; ++j) {
+      if (e->pin_pids[j].pid == me) total += e->pin_pids[j].count;
+    }
+  }
+  return total;
+}
+
 void rts_close(void* handle) {
   Store* s = static_cast<Store*>(handle);
   bool owner = s->owner;
   char name[256];
   std::snprintf(name, sizeof(name), "%s", s->name);
   munmap(s->base, s->map_size);
+  close(s->fd);
+  delete s;
+  if (owner) shm_unlink(name);
+}
+
+// Close WITHOUT unmapping: zero-copy consumers in this process still
+// hold views into the arena, so the mapping must outlive the store
+// handle (pages are freed by the kernel when the process exits —
+// the shm name is still unlinked so no new attachments form).
+void rts_close_keep_map(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  bool owner = s->owner;
+  char name[256];
+  std::snprintf(name, sizeof(name), "%s", s->name);
   close(s->fd);
   delete s;
   if (owner) shm_unlink(name);

@@ -50,6 +50,9 @@ def _load():
         lib.rts_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rts_reap_dead_pins.restype = ctypes.c_int
         lib.rts_reap_dead_pins.argtypes = [ctypes.c_void_p]
+        lib.rts_self_pin_count.restype = ctypes.c_uint32
+        lib.rts_self_pin_count.argtypes = [ctypes.c_void_p]
+        lib.rts_close_keep_map.argtypes = [ctypes.c_void_p]
         lib.rts_data_ptr.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.rts_data_ptr.argtypes = [ctypes.c_void_p]
         lib.rts_used_bytes.restype = ctypes.c_uint64
@@ -189,7 +192,14 @@ class NativeStore:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self._lib.rts_close(self._h)
+            # If this process still holds pinned zero-copy views
+            # (numpy arrays alive after shutdown), munmap would turn
+            # their next access into a segfault — keep the mapping and
+            # let the kernel reclaim it at process exit.
+            if self._lib.rts_self_pin_count(self._h) > 0:
+                self._lib.rts_close_keep_map(self._h)
+            else:
+                self._lib.rts_close(self._h)
 
     def __del__(self):
         try:

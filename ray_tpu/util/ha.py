@@ -57,21 +57,24 @@ def save_head_state(path: str) -> dict:
         rec = rt._actors.get(actor_id)
         if rec is None or rec.state == "DEAD":
             continue
+        pg = rec.options.placement_group
         actor_rows.append({
             "name": name,
             "cls_name": rec.cls_name,
             "cls_blob": _e(rec.cls_blob),
             "init_args_blob": _e(rec.init_args_blob),
             "options_blob": _e(ser.dumps(rec.options)),
+            "pg_id": pg.id.hex() if pg is not None else None,
             "max_restarts": rec.max_restarts,
             "max_concurrency": rec.max_concurrency,
         })
 
     pg_rows = []
     with rt._pg_lock:
-        for pg in rt._pgs.values():
+        for pg_id, pg in rt._pgs.items():
             if pg.created:
-                pg_rows.append({"bundles": pg.bundles,
+                pg_rows.append({"id": pg_id.hex(),
+                                "bundles": pg.bundles,
                                 "strategy": pg.strategy})
 
     state = {"kv": kv_rows, "named_actors": actor_rows, "pgs": pg_rows}
@@ -97,6 +100,16 @@ def restore_head_state(path: str) -> dict:
     for row in state["kv"]:
         rt.kv_put(_d(row["k"]), _d(row["v"]), row["ns"])
 
+    # Re-reserve placement groups FIRST, mapping old ids -> new PGs so
+    # restored actors that lived in a PG land in its replacement.
+    from ray_tpu.core.placement_group import PlacementGroup
+    pg_map: dict[str, PlacementGroup] = {}
+    for row in state["pgs"]:
+        bundles = [dict(b) for b in row["bundles"]]
+        new_id = rt.create_placement_group(bundles, row["strategy"])
+        pg_map[row.get("id", "")] = PlacementGroup(
+            new_id, bundles, row["strategy"])
+
     restored_actors = []
     for row in state["named_actors"]:
         try:
@@ -105,6 +118,14 @@ def restore_head_state(path: str) -> dict:
         except ValueError:
             pass
         options = ser.loads(_d(row["options_blob"]))
+        if row.get("pg_id") is not None:
+            # The snapshotted options carry the OLD runtime's PG id —
+            # relink to the re-reserved group (or drop to plain
+            # resource placement if it wasn't restorable).
+            options.placement_group = pg_map.get(row["pg_id"])
+            if options.placement_group is None:
+                options.placement_group_bundle_index = -1
+                options.scheduling_strategy = "DEFAULT"
         args, kwargs = ser.loads(_d(row["init_args_blob"]))
         rt.create_actor(
             _d(row["cls_blob"]), row["cls_name"], args, kwargs,
@@ -112,10 +133,5 @@ def restore_head_state(path: str) -> dict:
             row["max_concurrency"])
         restored_actors.append(row["name"])
 
-    pgs = []
-    for row in state["pgs"]:
-        pgs.append(rt.create_placement_group(
-            [dict(b) for b in row["bundles"]], row["strategy"]))
-
     return {"kv": len(state["kv"]), "named_actors": restored_actors,
-            "pgs": len(pgs)}
+            "pgs": len(pg_map)}

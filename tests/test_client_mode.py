@@ -1,0 +1,84 @@
+"""Client mode: a separate process connects to a live head with
+``init(address=...)`` and uses the full API (Ray Client analog,
+python/ray/util/client/)."""
+
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import sys
+    import ray_tpu
+
+    ray_tpu.init(address=sys.argv[1])
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    assert ray_tpu.get(square.remote(7), timeout=120) == 49
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=120) == 1
+    assert ray_tpu.get(c.incr.remote(), timeout=120) == 2
+
+    # objects put by the client are readable on the cluster
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+    # resources visible
+    assert ray_tpu.cluster_resources().get("CPU", 0) >= 1
+    print("CLIENT_OK")
+""")
+
+
+def _run_client(address: str) -> str:
+    out = subprocess.run(
+        [sys.executable, "-c", CLIENT_SCRIPT, address],
+        capture_output=True, text=True, timeout=300,
+        cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    return out.stdout
+
+
+def test_client_connects_by_address(rt):
+    addr = ray_tpu.client_address()
+    assert "CLIENT_OK" in _run_client(addr)
+
+
+def test_client_connects_auto(rt):
+    assert "CLIENT_OK" in _run_client("auto")
+
+
+def test_client_sees_named_actor(rt):
+    @ray_tpu.remote
+    class Svc:
+        def val(self):
+            return 41
+
+    Svc.options(name="shared_svc").remote()
+    script = textwrap.dedent("""
+        import sys
+        import ray_tpu
+        ray_tpu.init(address=sys.argv[1])
+        h = ray_tpu.get_actor("shared_svc")
+        assert ray_tpu.get(h.val.remote(), timeout=120) == 41
+        print("NAMED_OK")
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", script, ray_tpu.client_address()],
+        capture_output=True, text=True, timeout=300,
+        cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "NAMED_OK" in out.stdout

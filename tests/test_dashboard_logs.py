@@ -1,0 +1,88 @@
+"""Dashboard HTTP head + worker log capture/republish.
+
+Reference analogs: python/ray/dashboard/ (HTTP modules over cluster
+state) and python/ray/_private/log_monitor.py (worker stdout reaches
+the driver).
+"""
+
+import io
+import json
+import os
+import time
+import urllib.request
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_dashboard_endpoints(rt):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+    dash = start_dashboard(port=0)
+    try:
+        status, body = _get(dash.url + "/api/cluster")
+        assert status == 200
+        cluster = json.loads(body)
+        assert cluster["resources"].get("CPU", 0) >= 1
+        assert cluster["nodes"]
+
+        status, body = _get(dash.url + "/api/tasks")
+        rows = json.loads(body)
+        assert any(r.get("name") == "f" for r in rows)
+
+        status, body = _get(dash.url + "/api/summary")
+        summary = json.loads(body)
+        assert summary["tasks"]["f"]["FINISHED"] >= 1
+
+        status, body = _get(dash.url + "/metrics")
+        assert status == 200
+
+        status, body = _get(dash.url + "/")
+        assert status == 200 and b"ray_tpu" in body
+
+        status, _ = _get(dash.url + "/api/timeline")
+        assert status == 200
+    finally:
+        dash.stop()
+
+
+def test_worker_logs_reach_driver(rt):
+    from ray_tpu.core.api import get_runtime
+    runtime = get_runtime()
+    assert runtime.log_dir is not None
+
+    @ray_tpu.remote
+    def noisy():
+        print("hello from the worker side")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    # The log file contains the print...
+    deadline = time.monotonic() + 15
+    found = False
+    while time.monotonic() < deadline and not found:
+        for name in os.listdir(runtime.log_dir):
+            path = os.path.join(runtime.log_dir, name)
+            with open(path, "rb") as f:
+                if b"hello from the worker side" in f.read():
+                    found = True
+                    break
+        time.sleep(0.2)
+    assert found, "worker print never reached its log file"
+
+    # ...and the monitor republishes it with the worker tag.
+    out = io.StringIO()
+    runtime.log_monitor.out = out
+    runtime.log_monitor._offsets.clear()
+    runtime.log_monitor.poll_once()
+    text = out.getvalue()
+    assert "hello from the worker side" in text
+    assert "(worker-" in text

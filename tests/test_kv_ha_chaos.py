@@ -127,3 +127,56 @@ def test_chaos_actor_killer_restarts(rt):
             time.sleep(0.5)
     else:
         pytest.fail("actor never came back after chaos kills")
+
+
+def test_head_restore_relinks_placement_group():
+    """A named actor living in a placement group must land in the
+    RE-RESERVED group after head recovery (old PG ids are dead)."""
+    import tempfile
+    snap = os.path.join(tempfile.mkdtemp(), "head2.json")
+    ray_tpu.init(num_cpus=4)
+    try:
+        from ray_tpu.core.placement_group import (
+            PlacementGroupSchedulingStrategy,
+        )
+        pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+        pg.ready(timeout=30)
+        NamedCounter.options(
+            name="pg_actor",
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg),
+        ).remote(0)
+        c = ray_tpu.get_actor("pg_actor")
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+        ha.save_head_state(snap)
+    finally:
+        ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        restored = ha.restore_head_state(snap)
+        assert restored["named_actors"] == ["pg_actor"]
+        assert restored["pgs"] == 1
+        c2 = ray_tpu.get_actor("pg_actor")
+        # Placeable (bound to the new PG) and fresh.
+        assert ray_tpu.get(c2.incr.remote(), timeout=60) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_kv_put_if_absent_is_atomic(rt):
+    import threading
+    wins = []
+
+    def racer(i):
+        if internal_kv.kv_put("leader", str(i).encode(),
+                              overwrite=False):
+            wins.append(i)
+
+    threads = [threading.Thread(target=racer, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert internal_kv.kv_get("leader") == str(wins[0]).encode()

@@ -153,3 +153,16 @@ def make_big():
 def test_worker_large_return_roundtrip(rt):
     out = ray_tpu.get(make_big.remote(), timeout=120)
     assert out.shape == (250_000,) and float(out[0]) == 7.0
+
+
+def test_arrays_survive_runtime_shutdown():
+    """Zero-copy arrays held by the user must stay valid after
+    shutdown: the store keeps the mapping alive when this process
+    still holds pins (munmap would make `a.sum()` a segfault)."""
+    ray_tpu.init(num_cpus=2)
+    arr = np.arange(150_000, dtype=np.float64)
+    out = ray_tpu.get(ray_tpu.put(arr))
+    ray_tpu.shutdown()
+    np.testing.assert_array_equal(out, arr)    # no segfault, no junk
+    del out
+    gc.collect()
