@@ -1470,7 +1470,14 @@ class DriverRuntime:
                     rec.cls_blob, rec.init_args_blob, resolved,
                     rec.max_concurrency))
         except Exception as e:  # noqa: BLE001
-            worker_died = w is not None and w.proc.poll() is not None
+            # Death detection must not rely on poll() alone: a worker
+            # mid-teardown raises Broken/closed-pipe errors from
+            # send() milliseconds before the process reaps.
+            worker_died = w is not None and (
+                w.proc.poll() is not None
+                or isinstance(e, (WorkerDiedBeforeConnectError,
+                                  BrokenPipeError, ConnectionError,
+                                  EOFError, OSError)))
             if worker_died and w.conn is not None:
                 # The worker attached before dying: its reader thread
                 # owns death handling (_on_worker_exit ->

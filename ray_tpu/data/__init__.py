@@ -6,6 +6,7 @@ backpressure; ``streaming_split`` feeds trainer gangs and
 mesh (SURVEY.md §2.3/§2.4).
 """
 
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import DataIterator, Dataset, GroupedData
 from ray_tpu.data.io import (
     from_items,
@@ -23,7 +24,8 @@ from ray_tpu.data.io import (
 range = range_  # noqa: A001
 
 __all__ = [
-    "Dataset", "DataIterator", "GroupedData", "range", "from_items",
+    "DataContext", "Dataset", "DataIterator", "GroupedData", "range",
+    "from_items",
     "from_numpy", "from_pandas", "read_parquet", "read_csv",
     "read_json", "read_images", "read_binary_files",
 ]

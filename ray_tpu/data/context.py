@@ -1,0 +1,34 @@
+"""DataContext — per-process execution knobs for Datasets.
+
+Reference analog: ray.data.DataContext / ExecutionOptions
+(python/ray/data/context.py): a get_current() singleton whose fields
+tune the streaming executor. Fields here map to the knobs our
+executor actually honors.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+
+@dataclass
+class DataContext:
+    # Max block tasks in flight per stage (streaming backpressure).
+    max_in_flight: int = 16
+    # Default parallelism for range/from_* sources.
+    default_parallelism: int = 8
+    # Hash-shuffle partition cap for groupby.
+    groupby_num_partitions: int = 8
+    # Device-prefetch depth for iter_device_batches.
+    prefetch_batches: int = 2
+
+    _current = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = cls()
+            return cls._current

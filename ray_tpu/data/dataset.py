@@ -32,9 +32,6 @@ from ray_tpu.data.block import (
     slice_block, to_block,
 )
 
-DEFAULT_MAX_IN_FLIGHT = 16
-
-
 # -- logical ops -----------------------------------------------------------
 
 @dataclass
@@ -257,10 +254,14 @@ class Dataset:
 
     # -- execution ---------------------------------------------------------
 
-    def _stream_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+    def _stream_blocks(self, max_in_flight: int | None = None
                        ) -> Iterator[ray_tpu.ObjectRef]:
         """The streaming executor: yields block refs in order with at
-        most max_in_flight tasks outstanding."""
+        most max_in_flight tasks outstanding (default: the
+        DataContext knob)."""
+        if max_in_flight is None:
+            from ray_tpu.data.context import DataContext
+            max_in_flight = DataContext.get_current().max_in_flight
         stages = _split_stages(self._plan)
         refs = None
         for kind, payload in stages:
@@ -292,13 +293,13 @@ class Dataset:
                             for o in payload.others))
         return refs
 
-    def iter_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+    def iter_blocks(self, max_in_flight: int | None = None):
         for ref in self._stream_blocks(max_in_flight):
             yield ray_tpu.get(ref)
 
     def iter_batches(self, batch_size: int | None = None,
                      drop_last: bool = False,
-                     max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                     max_in_flight: int | None = None
                      ) -> Iterator[dict[str, np.ndarray]]:
         carry = None
         for block in self.iter_blocks(max_in_flight):
@@ -456,12 +457,16 @@ class DataIterator:
             yield block_to_batch(carry)
 
     def iter_device_batches(self, batch_size: int, mesh=None,
-                            seq_sharded: bool = False, prefetch: int = 2):
+                            seq_sharded: bool = False,
+                            prefetch: int | None = None):
         """Double-buffered device feed: host batches are device_put
         ahead of consumption (the multi-host device-prefetch path,
         SURVEY.md §2.4 data-pipeline row)."""
         from ray_tpu.train.step import shard_batch
         import collections
+        if prefetch is None:
+            from ray_tpu.data.context import DataContext
+            prefetch = DataContext.get_current().prefetch_batches
         buf = collections.deque()
         it = self.iter_batches(batch_size, drop_last=True)
         for batch in it:
@@ -716,7 +721,9 @@ def _agg_partition(key, agg, idx, *part_tuples):
 def _do_groupby(refs: list, op: "_GroupBy") -> list:
     if not refs:
         return refs
-    num_parts = op.num_partitions or min(len(refs), 8)
+    from ray_tpu.data.context import DataContext
+    cap = DataContext.get_current().groupby_num_partitions
+    num_parts = op.num_partitions or min(len(refs), cap)
     part_refs = [_hash_partition.remote(r, op.key, num_parts)
                  for r in refs]
     return [_agg_partition.remote(op.key, op.agg, p, *part_refs)

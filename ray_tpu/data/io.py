@@ -16,7 +16,15 @@ from ray_tpu.data.block import to_block
 from ray_tpu.data.dataset import Dataset, _Source
 
 
-def range(n: int, *, parallelism: int = 8) -> Dataset:
+def _default_parallelism(parallelism):
+    if parallelism is not None:
+        return parallelism
+    from ray_tpu.data.context import DataContext
+    return DataContext.get_current().default_parallelism
+
+
+def range(n: int, *, parallelism: int | None = None) -> Dataset:
+    parallelism = _default_parallelism(parallelism)
     parallelism = max(1, min(parallelism, n or 1))
     per = (n + parallelism - 1) // parallelism
     fns = []
@@ -29,8 +37,10 @@ def range(n: int, *, parallelism: int = 8) -> Dataset:
     return Dataset([_Source(fns)])
 
 
-def from_items(items: list, *, parallelism: int = 8) -> Dataset:
+def from_items(items: list, *, parallelism: int | None = None
+               ) -> Dataset:
     items = list(items)
+    parallelism = _default_parallelism(parallelism)
     parallelism = max(1, min(parallelism, len(items) or 1))
     per = (len(items) + parallelism - 1) // parallelism
     fns = []
@@ -44,10 +54,11 @@ def from_items(items: list, *, parallelism: int = 8) -> Dataset:
 
 
 def from_numpy(arrays: dict[str, np.ndarray] | np.ndarray,
-               *, parallelism: int = 8) -> Dataset:
+               *, parallelism: int | None = None) -> Dataset:
     if not isinstance(arrays, dict):
         arrays = {"data": arrays}
     n = len(next(iter(arrays.values())))
+    parallelism = _default_parallelism(parallelism)
     parallelism = max(1, min(parallelism, n or 1))
     per = (n + parallelism - 1) // parallelism
     fns = []
@@ -60,10 +71,11 @@ def from_numpy(arrays: dict[str, np.ndarray] | np.ndarray,
     return Dataset([_Source(fns)])
 
 
-def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+def from_pandas(df, *, parallelism: int | None = None) -> Dataset:
     import pyarrow as pa
     table = pa.Table.from_pandas(df)
     n = table.num_rows
+    parallelism = _default_parallelism(parallelism)
     parallelism = max(1, min(parallelism, n or 1))
     per = (n + parallelism - 1) // parallelism
     fns = []

@@ -10,6 +10,7 @@ propagation.
 """
 
 from ray_tpu.rllib.algorithm import AlgorithmConfig, PPO, PPOConfig
+from ray_tpu.rllib.bc import BC, BCConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup, Episode
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
@@ -18,7 +19,7 @@ from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "AlgorithmConfig", "PPO", "PPOConfig",
-    "DQN", "DQNConfig", "ReplayBuffer",
+    "BC", "BCConfig", "DQN", "DQNConfig", "ReplayBuffer",
     "Impala", "ImpalaConfig", "SAC", "SACConfig",
     "EnvRunner", "EnvRunnerGroup", "Episode", "JaxLearner",
 ]

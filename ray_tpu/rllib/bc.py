@@ -1,0 +1,150 @@
+"""BC — behavior cloning from offline data.
+
+Reference analog: rllib/algorithms/bc/ (offline RL entry point:
+train a policy by supervised learning on logged (obs, action) pairs
+read through the data layer). Offline data flows through
+ray_tpu.data — a Dataset with "obs" and "action" columns streams
+minibatches into ONE jitted cross-entropy update per step.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+
+
+@dataclass
+class BCHyperparams:
+    lr: float = 1e-3
+    train_batch_size: int = 256
+    num_gradient_steps: int = 16    # per train() call
+
+
+class BCLearner:
+    def __init__(self, policy_config: dict, hp: BCHyperparams,
+                 seed: int = 0):
+        self.hp = hp
+        self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        self.params = self.model.init_params(jax.random.key(seed))
+        self.opt = optax.adam(hp.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._update = jax.jit(self._update_fn, donate_argnums=(0, 1))
+
+    def _update_fn(self, params, opt_state, batch):
+        def loss_fn(p):
+            logits, _ = self.model.apply({"params": p}, batch["obs"])
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, batch["action"][:, None], axis=-1)[:, 0]
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == batch["action"])
+                .astype(jnp.float32))
+            return nll.mean(), acc
+
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "accuracy": acc}
+
+    def update(self, batch: dict[str, np.ndarray]) -> dict:
+        mb = {"obs": jnp.asarray(batch["obs"], jnp.float32),
+              "action": jnp.asarray(batch["action"], jnp.int32)}
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+@dataclass
+class BCConfig:
+    dataset: Any = None             # ray_tpu.data.Dataset
+    policy_config: dict = field(default_factory=dict)
+    hparams: BCHyperparams = field(default_factory=BCHyperparams)
+    seed: int = 0
+
+    def environment(self, *, obs_dim: int, num_actions: int,
+                    hidden: tuple = (64, 64)) -> "BCConfig":
+        return replace(self, policy_config={
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hidden": hidden})
+
+    def offline_data(self, dataset) -> "BCConfig":
+        """A Dataset with "obs" (float [D] rows) and "action" (int)
+        columns (reference: AlgorithmConfig.offline_data)."""
+        return replace(self, dataset=dataset)
+
+    def training(self, **hp_overrides) -> "BCConfig":
+        return replace(self, hparams=replace(self.hparams,
+                                             **hp_overrides))
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    def __init__(self, config: BCConfig):
+        assert config.dataset is not None, "call .offline_data(ds)"
+        assert config.policy_config, "call .environment(...)"
+        self.config = config
+        self.learner = BCLearner(config.policy_config, config.hparams,
+                                 seed=config.seed)
+        self.rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+        # Materialize the offline dataset once (epochs reshuffle it).
+        batches = list(config.dataset.iter_batches())
+        self._obs = np.concatenate(
+            [np.asarray(b["obs"], np.float32) for b in batches])
+        self._act = np.concatenate(
+            [np.asarray(b["action"], np.int64) for b in batches])
+
+    def train(self) -> dict:
+        hp = self.config.hparams
+        t0 = time.time()
+        metrics: dict = {}
+        n = len(self._obs)
+        for _ in range(hp.num_gradient_steps):
+            idx = self.rng.integers(0, n, hp.train_batch_size)
+            metrics = self.learner.update(
+                {"obs": self._obs[idx], "action": self._act[idx]})
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "num_samples": n,
+                "time_learn_s": round(time.time() - t0, 3),
+                **metrics}
+
+    def evaluate(self, env_maker, num_episodes: int = 5) -> dict:
+        """Roll out the greedy policy in a live env."""
+        rewards = []
+        params = self.learner.params
+        fwd = jax.jit(lambda p, o: self.model_apply(p, o))
+        for ep in range(num_episodes):
+            env = env_maker()
+            obs, _ = env.reset(seed=ep)
+            total, done = 0.0, False
+            while not done:
+                logits = fwd(params,
+                             np.asarray(obs, np.float32)[None])
+                action = int(np.argmax(np.asarray(logits[0])))
+                obs, r, term, trunc, _ = env.step(action)
+                total += float(r)
+                done = term or trunc
+            rewards.append(total)
+        return {"episode_reward_mean": float(np.mean(rewards))}
+
+    def model_apply(self, params, obs):
+        logits, _ = self.learner.model.apply({"params": params}, obs)
+        return logits
+
+    def stop(self) -> None:
+        pass

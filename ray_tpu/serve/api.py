@@ -63,23 +63,32 @@ class DeploymentHandle:
     ``handle.method.remote(...)`` return ObjectRefs."""
 
     def __init__(self, deployment_name: str, controller=None,
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self._name = deployment_name
         self._controller = controller or ray_tpu.get_actor(
             CONTROLLER_NAME)
         self._router = Router(self._controller, deployment_name)
         self._model_id = multiplexed_model_id
+        self._stream = stream
 
-    def options(self, *, multiplexed_model_id: str = ""
-                ) -> "DeploymentHandle":
-        h = DeploymentHandle(self._name, self._controller,
-                             multiplexed_model_id=multiplexed_model_id)
+    def options(self, *, multiplexed_model_id: str | None = None,
+                stream: bool | None = None) -> "DeploymentHandle":
+        """Unspecified options inherit from THIS handle, so
+        .options(multiplexed_model_id=...).options(stream=True)
+        composes instead of resetting."""
+        h = DeploymentHandle(
+            self._name, self._controller,
+            multiplexed_model_id=(self._model_id
+                                  if multiplexed_model_id is None
+                                  else multiplexed_model_id),
+            stream=self._stream if stream is None else stream)
         h._router = self._router     # share replica cache
         return h
 
     def remote(self, *args, **kwargs):
         return self._router.assign("__call__", args, kwargs,
-                                   multiplexed_model_id=self._model_id)
+                                   multiplexed_model_id=self._model_id,
+                                   stream=self._stream)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -93,12 +102,14 @@ class DeploymentHandle:
             def remote(self, *args, **kwargs):
                 return self._outer._router.assign(
                     self._name, args, kwargs,
-                    multiplexed_model_id=self._outer._model_id)
+                    multiplexed_model_id=self._outer._model_id,
+                    stream=self._outer._stream)
 
         return _Method(self, method)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, None, self._model_id))
+        return (DeploymentHandle,
+                (self._name, None, self._model_id, self._stream))
 
 
 def deployment(cls: type | None = None, *, name: str | None = None,

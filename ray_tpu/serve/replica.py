@@ -26,13 +26,28 @@ class Replica:
         else:
             self.callable = cls_or_fn
 
+    def _stream_wrapper(self, gen, multiplexed_model_id: str):
+        """Owns the inflight count for a streaming response: the
+        request is busy until the generator body finishes, not until
+        handle_request returns the (unstarted) generator."""
+        from ray_tpu.serve.multiplex import _set_current_model_id
+        try:
+            _set_current_model_id(multiplexed_model_id)
+            yield from gen
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
     def handle_request(self, method_name: str, args, kwargs,
                        multiplexed_model_id: str = ""):
+        import inspect
+
         from ray_tpu.serve.multiplex import _set_current_model_id
         with self._lock:
             self._inflight += 1
             self._total += 1
         _set_current_model_id(multiplexed_model_id)
+        streaming = False
         try:
             target = (self.callable if method_name == "__call__"
                       and not isinstance(self.callable, object.__class__)
@@ -41,14 +56,18 @@ class Replica:
                   if hasattr(self.callable, method_name)
                   else self.callable)
             result = fn(*args, **kwargs)
-            import inspect
+            if inspect.isgenerator(result):
+                streaming = True    # wrapper owns the decrement
+                return self._stream_wrapper(result,
+                                            multiplexed_model_id)
             if inspect.iscoroutine(result):
                 import asyncio
                 result = asyncio.run(result)
             return result
         finally:
-            with self._lock:
-                self._inflight -= 1
+            if not streaming:
+                with self._lock:
+                    self._inflight -= 1
 
     def queue_len(self) -> int:
         return self._inflight

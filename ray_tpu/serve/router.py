@@ -61,8 +61,14 @@ class Router:
         return a if qa <= qb else b
 
     def assign(self, method_name: str, args, kwargs,
-               multiplexed_model_id: str = ""):
+               multiplexed_model_id: str = "", stream: bool = False):
         replica = self.pick_replica(multiplexed_model_id)
-        return replica.handle_request.remote(
+        method = replica.handle_request
+        if stream:
+            # Streaming response (reference: serve generators /
+            # StreamingResponse): the user method returns a generator
+            # and items flow back as they are produced.
+            method = method.options(num_returns="streaming")
+        return method.remote(
             method_name, args, kwargs,
             multiplexed_model_id=multiplexed_model_id)

@@ -1,0 +1,53 @@
+"""TorchTrainer — torch-DDP training over the actor gang.
+
+Reference analog: ray.train.torch (TorchTrainer + TorchConfig,
+python/ray/train/torch/config.py:36,66,115): the framework supplies
+ranks and a rendezvous address, `dist.init_process_group` builds the
+collective group, and ``prepare_model``/``prepare_data_loader`` wrap
+the user's model/loader for DDP. Here the process group runs gloo
+(CPU) — on TPU fleets the JaxTrainer is the native path; TorchTrainer
+exists so torch workloads (and users migrating from the reference)
+run unchanged on CPU nodes of the same cluster.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.trainer import JaxTrainer
+
+
+class TorchTrainer(JaxTrainer):
+    """Same orchestration as JaxTrainer (WorkerGroup gang, session
+    reporting, checkpoint recovery); the backend hook initializes a
+    torch.distributed gloo process group on every worker — including
+    single-worker runs, so user loops can use dist.* unconditionally
+    (reference TorchConfig semantics)."""
+
+    _backend_setup = "setup_torch_distributed"
+    _setup_single_worker = True
+
+
+def prepare_model(model):
+    """Wrap a torch model for the current world: DDP when world > 1
+    (reference: train.torch.prepare_model)."""
+    import torch.distributed as dist
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Re-build a DataLoader with a DistributedSampler sharding by
+    rank (reference: train.torch.prepare_data_loader)."""
+    import torch.distributed as dist
+    if not dist.is_initialized() or dist.get_world_size() == 1:
+        return loader
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+    sampler = DistributedSampler(
+        loader.dataset, num_replicas=dist.get_world_size(),
+        rank=dist.get_rank())
+    return DataLoader(
+        loader.dataset, batch_size=loader.batch_size,
+        sampler=sampler, num_workers=0,
+        collate_fn=loader.collate_fn, drop_last=loader.drop_last)

@@ -50,6 +50,11 @@ class JaxTrainer:
     ``ray_tpu.train.report(metrics, checkpoint=...)`` to stream results.
     """
 
+    # Backend hook: which TrainWorker method builds the collective
+    # group (jax.distributed here; torch gloo in train.torch).
+    _backend_setup = "setup_distributed"
+    _setup_single_worker = False
+
     def __init__(self,
                  train_loop_per_worker: Callable,
                  *,
@@ -107,9 +112,10 @@ class JaxTrainer:
         history: list[dict] = []
         try:
             group.barrier()
-            if self.scaling.num_workers > 1:
+            if self.scaling.num_workers > 1 or self._setup_single_worker:
                 coordinator = f"127.0.0.1:{_free_port()}"
-                group.run("setup_distributed", coordinator, timeout=120)
+                group.run(self._backend_setup, coordinator,
+                          timeout=120)
             ctx_kwargs = {
                 "experiment_name": os.path.basename(trial_dir),
                 "storage_path": self.run_config.storage_path,

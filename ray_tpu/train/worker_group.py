@@ -45,6 +45,22 @@ class TrainWorker:
                 process_id=self.rank)
         return True
 
+    def setup_torch_distributed(self, coordinator: str) -> bool:
+        """torch.distributed gloo process group (reference:
+        _setup_torch_process_group, torch/config.py:115)."""
+        import os
+
+        import torch.distributed as dist
+        addr, port = coordinator.rsplit(":", 1)
+        os.environ["MASTER_ADDR"] = addr
+        os.environ["MASTER_PORT"] = port
+        os.environ.setdefault("RANK", str(self.rank))
+        os.environ.setdefault("WORLD_SIZE", str(self.world_size))
+        if not dist.is_initialized():
+            dist.init_process_group(
+                "gloo", rank=self.rank, world_size=self.world_size)
+        return True
+
     def start_loop(self, fn_and_config: tuple, context_kwargs: dict) -> bool:
         from ray_tpu.train.session import (
             TrainContext, init_session,

@@ -2,11 +2,14 @@
 ``init(address=...)`` and uses the full API (Ray Client analog,
 python/ray/util/client/)."""
 
+import os
 import subprocess
 import sys
 import textwrap
 
 import ray_tpu
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 CLIENT_SCRIPT = textwrap.dedent("""
@@ -47,7 +50,7 @@ def _run_client(address: str) -> str:
     out = subprocess.run(
         [sys.executable, "-c", CLIENT_SCRIPT, address],
         capture_output=True, text=True, timeout=300,
-        cwd="/root/repo")
+        cwd=REPO_ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     return out.stdout
 
@@ -79,6 +82,6 @@ def test_client_sees_named_actor(rt):
     out = subprocess.run(
         [sys.executable, "-c", script, ray_tpu.client_address()],
         capture_output=True, text=True, timeout=300,
-        cwd="/root/repo")
+        cwd=REPO_ROOT)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "NAMED_OK" in out.stdout

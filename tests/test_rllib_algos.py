@@ -201,3 +201,30 @@ def test_sac_learns_point1d(rt):
         assert late > -3.0, f"SAC final reward too low: {rewards}"
     finally:
         algo.stop()
+
+
+def test_bc_learns_from_offline_data(rt):
+    """BC from a ray_tpu.data Dataset of expert (obs, action) pairs:
+    accuracy on the expert policy rises (offline RL entry point,
+    reference: rllib/algorithms/bc)."""
+    from ray_tpu import data as rdata
+    from ray_tpu.rllib import BCConfig
+
+    rng = np.random.default_rng(0)
+    obs = rng.standard_normal((512, 4)).astype(np.float32)
+    # Expert: action = argmax of a fixed linear policy.
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    actions = np.argmax(obs @ w, axis=1).astype(np.int64)
+    ds = rdata.from_numpy({"obs": obs, "action": actions},
+                          parallelism=4)
+
+    algo = (BCConfig()
+            .environment(obs_dim=4, num_actions=3, hidden=(32, 32))
+            .offline_data(ds)
+            .training(lr=3e-3, num_gradient_steps=32)
+            .build())
+    first = algo.train()["accuracy"]
+    for _ in range(6):
+        m = algo.train()
+    assert m["accuracy"] > max(0.9, first), m
+    assert m["num_samples"] == 512
