@@ -1442,6 +1442,7 @@ class DriverRuntime:
     def _start_actor(self, rec: ActorRecord) -> None:
         placed = None
         w = None
+        send_failed = False
         need = self._effective_resources(rec.options)
         try:
             placed = self.acquire_on_some_node(
@@ -1466,18 +1467,28 @@ class DriverRuntime:
                 self._workers.append(w)
             resolved = self._resolve_args_payload(
                 rec.init_args_blob, rec.init_arg_refs)
-            w.send((P.EXEC_ACTOR_INIT, rec.actor_id.binary(),
-                    rec.cls_blob, rec.init_args_blob, resolved,
-                    rec.max_concurrency))
+            try:
+                w.send((P.EXEC_ACTOR_INIT, rec.actor_id.binary(),
+                        rec.cls_blob, rec.init_args_blob, resolved,
+                        rec.max_concurrency))
+            except Exception:
+                send_failed = True
+                raise
         except Exception as e:  # noqa: BLE001
             # Death detection must not rely on poll() alone: a worker
             # mid-teardown raises Broken/closed-pipe errors from
-            # send() milliseconds before the process reaps.
+            # send() milliseconds before the process reaps. But ONLY
+            # send-path errors count — an OSError from, say, resolving
+            # init args with a live worker is a logic error that must
+            # surface, not park the actor waiting for a death that
+            # never comes.
             worker_died = w is not None and (
                 w.proc.poll() is not None
-                or isinstance(e, (WorkerDiedBeforeConnectError,
-                                  BrokenPipeError, ConnectionError,
-                                  EOFError, OSError)))
+                or (send_failed
+                    and isinstance(e, (WorkerDiedBeforeConnectError,
+                                       BrokenPipeError,
+                                       ConnectionError, EOFError,
+                                       OSError))))
             if worker_died and w.conn is not None:
                 # The worker attached before dying: its reader thread
                 # owns death handling (_on_worker_exit ->

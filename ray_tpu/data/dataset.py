@@ -559,22 +559,59 @@ def _slice_task(block, start, end):
 
 
 @ray_tpu.remote
-def _local_shuffle(block, seed):
+def _random_partition(block, num_parts, seed):
+    """Scatter rows uniformly into num_parts sub-blocks. Called with
+    options(num_returns=num_parts): each partition becomes its OWN
+    object, so a downstream reducer fetches only its column — every
+    byte moves once, not once per reducer."""
     import numpy as np
     batch = block_to_batch(block)
     n = block.num_rows
-    perm = np.random.default_rng(seed).permutation(n)
+    ids = (np.random.default_rng(seed).integers(0, num_parts, n)
+           if n else np.zeros(0, np.int64))
+    parts = tuple(to_block({k: np.asarray(v)[ids == p]
+                            for k, v in batch.items()})
+                  for p in range(num_parts))
+    return parts if num_parts > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _merge_shuffle(seed, *parts):
+    """Concat one partition's pieces from every mapper and permute."""
+    import numpy as np
+    merged = concat_blocks(list(parts))
+    if merged.num_rows == 0:
+        return merged
+    batch = block_to_batch(merged)
+    perm = np.random.default_rng(seed).permutation(merged.num_rows)
     return to_block({k: np.asarray(v)[perm] for k, v in batch.items()})
 
 
 def _do_shuffle(refs: list, seed: int | None) -> list:
-    """Blockwise shuffle: permute block order + permute within blocks
-    (the reference's push-based full shuffle is a later round)."""
-    import random
-    order = list(range(len(refs)))
-    random.Random(seed).shuffle(order)
-    return [_local_shuffle.remote(refs[i], (seed or 0) + i)
-            for i in order]
+    """True all-to-all shuffle (reference: push-based full shuffle):
+    every input block scatters its rows uniformly across P output
+    partitions; each output concatenates its pieces from every input
+    and permutes — any row can land anywhere, unlike a blockwise
+    permute. Unseeded shuffles draw fresh entropy (a fixed default
+    would silently repeat the same "shuffle" every epoch)."""
+    if not refs:
+        return refs
+    num_parts = len(refs)
+    if seed is None:
+        import os as _os
+        base = int.from_bytes(_os.urandom(4), "little")
+    else:
+        base = seed
+    # cols[i] = list of num_parts refs from mapper i
+    cols = [_random_partition.options(num_returns=num_parts).remote(
+                r, num_parts, base + i)
+            for i, r in enumerate(refs)]
+    if num_parts == 1:
+        cols = [[c] for c in cols]
+    return [_merge_shuffle.remote(base + 7919 * (p + 1),
+                                  *[cols[i][p]
+                                    for i in range(len(refs))])
+            for p in range(num_parts)]
 
 
 def _do_limit(refs, n: int):
@@ -607,7 +644,8 @@ def _sample_keys(block, key, k):
 
 @ray_tpu.remote
 def _range_partition(block, key, cutoffs):
-    """Split one block into len(cutoffs)+1 range partitions."""
+    """Split one block into len(cutoffs)+1 range partitions (one
+    return object per partition — see _random_partition)."""
     import numpy as np
     batch = block_to_batch(block)
     vals = np.asarray(batch[key]) if block.num_rows else \
@@ -619,14 +657,13 @@ def _range_partition(block, key, cutoffs):
         mask = part_ids == p
         parts.append(to_block(
             {k: np.asarray(v)[mask] for k, v in batch.items()}))
-    return tuple(parts)
+    return tuple(parts) if len(parts) > 1 else parts[0]
 
 
 @ray_tpu.remote
-def _sort_partition(key, descending, idx, *part_tuples):
+def _sort_partition(key, descending, *parts):
     import pyarrow as pa
-    parts = [t[idx] for t in part_tuples]
-    merged = concat_blocks(parts) if parts else pa.table({})
+    merged = concat_blocks(list(parts)) if parts else pa.table({})
     if merged.num_rows == 0:
         return merged
     return merged.sort_by([(key, "descending" if descending
@@ -649,12 +686,16 @@ def _do_sort(refs: list, op: "_Sort") -> list:
     cut_idx = [int(len(allv) * (i + 1) / num_parts)
                for i in range(num_parts - 1)]
     cutoffs = [allv[min(i, len(allv) - 1)] for i in cut_idx]
-    part_refs = [_range_partition.remote(r, op.key, cutoffs)
-                 for r in refs]
+    cols = [_range_partition.options(num_returns=num_parts).remote(
+                r, op.key, cutoffs)
+            for r in refs]
+    if num_parts == 1:
+        cols = [[c] for c in cols]
     order = (range(num_parts) if not op.descending
              else reversed(range(num_parts)))
-    return [_sort_partition.remote(op.key, op.descending, p,
-                                   *part_refs)
+    return [_sort_partition.remote(op.key, op.descending,
+                                   *[cols[i][p]
+                                     for i in range(len(refs))])
             for p in order]
 
 
@@ -662,20 +703,23 @@ def _do_sort(refs: list, op: "_Sort") -> list:
 
 @ray_tpu.remote
 def _hash_partition(block, key, num_parts):
+    """Called with options(num_returns=num_parts): one object per
+    partition (see _random_partition)."""
     import numpy as np
     batch = block_to_batch(block)
     if block.num_rows == 0:
         empty = {k: np.asarray(v)[:0] for k, v in batch.items()}
-        return tuple(to_block(empty)
-                     for _ in range(num_parts))
-    vals = np.asarray(batch[key])
-    # stable content hash (python hash() is randomized across procs)
-    import zlib
-    ids = np.asarray([
-        zlib.crc32(repr(v).encode()) % num_parts for v in vals])
-    return tuple(to_block({k: np.asarray(v)[ids == p]
-                           for k, v in batch.items()})
-                 for p in range(num_parts))
+        parts = tuple(to_block(empty) for _ in range(num_parts))
+    else:
+        vals = np.asarray(batch[key])
+        # stable content hash (python hash() is randomized per proc)
+        import zlib
+        ids = np.asarray([
+            zlib.crc32(repr(v).encode()) % num_parts for v in vals])
+        parts = tuple(to_block({k: np.asarray(v)[ids == p]
+                                for k, v in batch.items()})
+                      for p in range(num_parts))
+    return parts if num_parts > 1 else parts[0]
 
 
 _ARROW_AGGS = {"sum": "sum", "mean": "mean", "min": "min",
@@ -683,10 +727,9 @@ _ARROW_AGGS = {"sum": "sum", "mean": "mean", "min": "min",
 
 
 @ray_tpu.remote
-def _agg_partition(key, agg, idx, *part_tuples):
+def _agg_partition(key, agg, *parts):
     import pyarrow as pa
-    parts = [t[idx] for t in part_tuples]
-    merged = concat_blocks(parts) if parts else pa.table({})
+    merged = concat_blocks(list(parts)) if parts else pa.table({})
     if merged.num_rows == 0:
         return pa.table({})
     kind, col = agg
@@ -724,9 +767,14 @@ def _do_groupby(refs: list, op: "_GroupBy") -> list:
     from ray_tpu.data.context import DataContext
     cap = DataContext.get_current().groupby_num_partitions
     num_parts = op.num_partitions or min(len(refs), cap)
-    part_refs = [_hash_partition.remote(r, op.key, num_parts)
-                 for r in refs]
-    return [_agg_partition.remote(op.key, op.agg, p, *part_refs)
+    cols = [_hash_partition.options(num_returns=num_parts).remote(
+                r, op.key, num_parts)
+            for r in refs]
+    if num_parts == 1:
+        cols = [[c] for c in cols]
+    return [_agg_partition.remote(op.key, op.agg,
+                                  *[cols[i][p]
+                                    for i in range(len(refs))])
             for p in range(num_parts)]
 
 

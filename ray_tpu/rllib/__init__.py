@@ -15,6 +15,9 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup, Episode
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnvRunner, MultiAgentPPO, MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
@@ -22,4 +25,5 @@ __all__ = [
     "BC", "BCConfig", "DQN", "DQNConfig", "ReplayBuffer",
     "Impala", "ImpalaConfig", "SAC", "SACConfig",
     "EnvRunner", "EnvRunnerGroup", "Episode", "JaxLearner",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnvRunner",
 ]

@@ -1,0 +1,215 @@
+"""Multi-agent RL: shared or per-policy training over a MultiAgentEnv.
+
+Reference analog: rllib/env/multi_agent_env.py + the multi-agent new
+API stack — envs step a DICT of agents; a ``policy_mapping_fn`` maps
+agent ids to policy ids; each policy trains on the episodes its
+agents produced (independent PPO, the reference's default
+multi-agent treatment).
+
+MultiAgentEnv protocol (gymnasium-style dict spaces):
+    reset(seed) -> (obs: {agent: obs}, info)
+    step(actions: {agent: act})
+        -> (obs, rewards, terminateds, truncateds, info)
+  ``terminateds["__all__"]`` ends the episode for everyone.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import Episode
+from ray_tpu.rllib.learner import JaxLearner, PPOHyperparams
+
+
+@ray_tpu.remote
+class MultiAgentEnvRunner:
+    """Steps one MultiAgentEnv; keeps a host copy of every policy."""
+
+    def __init__(self, env_maker, policy_configs: dict[str, dict],
+                 policy_mapping: Callable[[str], str], seed: int = 0):
+        import jax
+
+        from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+
+        self.env = env_maker()
+        self.mapping = policy_mapping
+        self.rng = np.random.default_rng(seed)
+        self.models = {
+            pid: ActorCritic(ActorCriticConfig(**cfg))
+            for pid, cfg in policy_configs.items()}
+        self.params = {
+            pid: m.init_params(jax.random.key(seed + i))
+            for i, (pid, m) in enumerate(self.models.items())}
+        self._fwd = {
+            pid: jax.jit(lambda p, o, m=m: m.apply({"params": p}, o))
+            for pid, m in self.models.items()}
+        self._obs, _ = self.env.reset(seed=seed)
+
+    def set_weights(self, params_by_policy: dict) -> bool:
+        self.params.update(params_by_policy)
+        return True
+
+    def sample(self, num_steps: int) -> dict[str, list]:
+        """~num_steps env steps; returns {policy_id: [Episode, ...]}
+        (per-agent trajectories grouped by the policy that acted)."""
+        import jax.nn as jnn
+
+        episodes: dict[str, list[Episode]] = {}
+        open_eps: dict[str, Episode] = {}       # agent -> episode
+
+        def close(agent, terminated):
+            ep = open_eps.pop(agent, None)
+            if ep is None or not ep.length:
+                return
+            ep.terminated = terminated
+            ep.truncated = not terminated
+            if terminated:
+                ep.last_value = 0.0
+            else:
+                pid = self.mapping(agent)
+                _, v = self._fwd[pid](
+                    self.params[pid],
+                    np.asarray(self._obs[agent], np.float32)[None])
+                ep.last_value = float(v[0])
+            episodes.setdefault(self.mapping(agent), []).append(ep)
+
+        for _ in range(num_steps):
+            actions = {}
+            step_info = {}
+            for agent, obs in self._obs.items():
+                pid = self.mapping(agent)
+                logits, value = self._fwd[pid](
+                    self.params[pid],
+                    np.asarray(obs, np.float32)[None])
+                probs = np.asarray(jnn.softmax(logits[0]))
+                action = int(self.rng.choice(len(probs), p=probs))
+                actions[agent] = action
+                step_info[agent] = (
+                    np.asarray(obs, np.float32), action,
+                    float(np.log(probs[action] + 1e-9)),
+                    float(value[0]))
+            next_obs, rewards, terms, truncs, _ = self.env.step(actions)
+            for agent, (obs, action, logp, value) in step_info.items():
+                ep = open_eps.setdefault(agent, Episode())
+                ep.obs.append(obs)
+                ep.actions.append(action)
+                ep.rewards.append(float(rewards.get(agent, 0.0)))
+                ep.logps.append(logp)
+                ep.values.append(value)
+            done_all = terms.get("__all__", False) or \
+                truncs.get("__all__", False)
+            self._obs = next_obs
+            if done_all:
+                for agent in list(open_eps):
+                    close(agent, terms.get(agent,
+                                           terms.get("__all__", False)))
+                self._obs, _ = self.env.reset()
+        for agent in list(open_eps):
+            close(agent, False)
+        return episodes
+
+    def ping(self) -> str:
+        return "ok"
+
+
+@dataclass
+class MultiAgentPPOConfig:
+    env: Any = None
+    policies: dict[str, dict] = field(default_factory=dict)
+    policy_mapping_fn: Callable[[str], str] | None = None
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 128
+    hparams: PPOHyperparams = field(default_factory=PPOHyperparams)
+    seed: int = 0
+
+    def environment(self, env) -> "MultiAgentPPOConfig":
+        return replace(self, env=env)
+
+    def multi_agent(self, *, policies: dict[str, dict],
+                    policy_mapping_fn: Callable[[str], str]
+                    ) -> "MultiAgentPPOConfig":
+        """policies: {policy_id: {obs_dim, num_actions, hidden}}."""
+        return replace(self, policies=dict(policies),
+                       policy_mapping_fn=policy_mapping_fn)
+
+    def env_runners(self, n: int) -> "MultiAgentPPOConfig":
+        return replace(self, num_env_runners=n)
+
+    def training(self, **hp) -> "MultiAgentPPOConfig":
+        return replace(self, hparams=replace(self.hparams, **hp))
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """Independent PPO per policy: each policy id owns a JaxLearner
+    updated from its agents' episodes."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        assert config.env is not None and config.policies
+        assert config.policy_mapping_fn is not None
+        self.config = config
+        self.learners = {
+            pid: JaxLearner(cfg, config.hparams,
+                            seed=config.seed + i)
+            for i, (pid, cfg) in enumerate(config.policies.items())}
+        self.runners = [
+            MultiAgentEnvRunner.remote(
+                config.env, config.policies,
+                config.policy_mapping_fn, config.seed + i)
+            for i in range(config.num_env_runners)]
+        self.iteration = 0
+        self._broadcast()
+
+    def _broadcast(self) -> None:
+        weights = {pid: ln.get_weights()
+                   for pid, ln in self.learners.items()}
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners],
+                    timeout=300)
+
+    def train(self) -> dict:
+        t0 = time.time()
+        per = max(1, self.config.rollout_fragment_length)
+        results = ray_tpu.get(
+            [r.sample.remote(per) for r in self.runners], timeout=600)
+        by_policy: dict[str, list[Episode]] = {}
+        for r in results:
+            for pid, eps in r.items():
+                by_policy.setdefault(pid, []).extend(eps)
+        sample_time = time.time() - t0
+
+        metrics: dict[str, Any] = {}
+        t1 = time.time()
+        for pid, eps in by_policy.items():
+            if eps:
+                m = self.learners[pid].update_from_episodes(eps)
+                metrics.update({f"{pid}/{k}": v for k, v in m.items()})
+        self._broadcast()
+        self.iteration += 1
+
+        finished = [e for eps in by_policy.values() for e in eps
+                    if e.terminated or e.truncated]
+        mean_r = (sum(e.total_reward for e in finished) / len(finished)
+                  if finished else float("nan"))
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_r,
+            "episodes_this_iter": len(finished),
+            "time_sample_s": round(sample_time, 3),
+            "time_learn_s": round(time.time() - t1, 3),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

@@ -39,7 +39,8 @@ class Replica:
                 self._inflight -= 1
 
     def handle_request(self, method_name: str, args, kwargs,
-                       multiplexed_model_id: str = ""):
+                       multiplexed_model_id: str = "",
+                       stream: bool = False):
         import inspect
 
         from ray_tpu.serve.multiplex import _set_current_model_id
@@ -57,9 +58,17 @@ class Replica:
                   else self.callable)
             result = fn(*args, **kwargs)
             if inspect.isgenerator(result):
+                if not stream:
+                    raise TypeError(
+                        f"{method_name} returned a generator; call it "
+                        f"through handle.options(stream=True)")
                 streaming = True    # wrapper owns the decrement
                 return self._stream_wrapper(result,
                                             multiplexed_model_id)
+            if stream:
+                raise TypeError(
+                    f"stream=True but {method_name} returned "
+                    f"{type(result).__name__}, not a generator")
             if inspect.iscoroutine(result):
                 import asyncio
                 result = asyncio.run(result)

@@ -71,4 +71,5 @@ class Router:
             method = method.options(num_returns="streaming")
         return method.remote(
             method_name, args, kwargs,
-            multiplexed_model_id=multiplexed_model_id)
+            multiplexed_model_id=multiplexed_model_id,
+            stream=stream)

@@ -36,18 +36,48 @@ def prepare_model(model):
     return model
 
 
+class _EpochDataLoader:
+    """Wraps a DDP DataLoader so each ``__iter__`` advances the
+    DistributedSampler epoch — without set_epoch every epoch would
+    replay the identical shuffle order (reference:
+    prepare_data_loader's epoch plumbing)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self.sampler = sampler
+        self._epoch = -1
+
+    def __iter__(self):
+        self._epoch += 1
+        self.sampler.set_epoch(self._epoch)
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
 def prepare_data_loader(loader):
     """Re-build a DataLoader with a DistributedSampler sharding by
-    rank (reference: train.torch.prepare_data_loader)."""
+    rank (reference: train.torch.prepare_data_loader). The original
+    loader's shuffle intent (RandomSampler vs sequential) is
+    preserved; pin_memory / collate / workers carry over; iteration
+    advances the sampler epoch so shuffles differ per epoch."""
     import torch.distributed as dist
     if not dist.is_initialized() or dist.get_world_size() == 1:
         return loader
-    from torch.utils.data import DataLoader
+    from torch.utils.data import DataLoader, RandomSampler
     from torch.utils.data.distributed import DistributedSampler
+    shuffle = isinstance(getattr(loader, "sampler", None),
+                         RandomSampler)
     sampler = DistributedSampler(
         loader.dataset, num_replicas=dist.get_world_size(),
-        rank=dist.get_rank())
-    return DataLoader(
+        rank=dist.get_rank(), shuffle=shuffle)
+    new_loader = DataLoader(
         loader.dataset, batch_size=loader.batch_size,
-        sampler=sampler, num_workers=0,
-        collate_fn=loader.collate_fn, drop_last=loader.drop_last)
+        sampler=sampler, num_workers=loader.num_workers,
+        collate_fn=loader.collate_fn, drop_last=loader.drop_last,
+        pin_memory=loader.pin_memory)
+    return _EpochDataLoader(new_loader, sampler)

@@ -1,0 +1,101 @@
+"""Distributed FIFO queue backed by an actor.
+
+Reference analog: ray.util.queue.Queue (python/ray/util/queue.py) —
+an asyncio-queue actor shared by producers/consumers across
+processes.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.items: deque = deque()
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def get(self):
+        if not self.items:
+            return False, None
+        return True, self.items.popleft()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+
+class Queue:
+    """Cross-process FIFO; handles are picklable, so any worker/actor
+    can produce or consume."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: dict
+                 | None = None):
+        opts = {"num_cpus": 0, "max_concurrency": 8,
+                **(actor_options or {})}
+        self._actor = _QueueActor.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True,
+            timeout: float | None = None) -> None:
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item), timeout=60):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full()
+            time.sleep(0.02)
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote(),
+                                   timeout=60)
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty()
+            time.sleep(0.02)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote(), timeout=60)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self._actor,))
+
+
+def _rebuild_queue(actor):
+    q = object.__new__(Queue)
+    q._actor = actor
+    return q

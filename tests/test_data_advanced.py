@@ -137,3 +137,28 @@ def test_iter_torch_batches(rt):
     assert len(batches) == 2
     assert isinstance(batches[0]["id"], torch.Tensor)
     assert batches[0]["id"].shape == (8,)
+
+
+def test_random_shuffle_is_all_to_all(rt):
+    """Rows must cross block boundaries (a blockwise permute keeps
+    each block's row SET intact; the true shuffle does not)."""
+    n, blocks = 200, 8
+    ds = rdata.range(n, parallelism=blocks)
+    shuffled = ds.random_shuffle(seed=3)
+    out_blocks = [set(np.asarray(
+        __import__("ray_tpu.data.block", fromlist=["block_to_batch"])
+        .block_to_batch(b)["id"]).tolist())
+        for b in shuffled.iter_blocks()]
+    # Same multiset of rows overall...
+    all_rows = sorted(x for s in out_blocks for x in s)
+    assert all_rows == list(range(n))
+    # ...but at least one output block mixes rows from >1 input block
+    # (input block i held [i*25, (i+1)*25)).
+    mixed = sum(
+        1 for s in out_blocks
+        if len({x // (n // blocks) for x in s}) > 1)
+    assert mixed >= 1, out_blocks
+    # Deterministic under the same seed.
+    again = [r["id"] for r in ds.random_shuffle(seed=3).take_all()]
+    first = [r["id"] for r in shuffled.take_all()]
+    assert again == first

@@ -1,0 +1,92 @@
+"""Multi-agent PPO over a MultiAgentEnv (reference:
+rllib/env/multi_agent_env.py + independent multi-agent training)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import MultiAgentPPOConfig
+
+
+class TwoAgentChain:
+    """Cooperative: both agents walk right on their own 6-chain; both
+    get +1 only when BOTH reach the end; -0.01 per step each."""
+
+    N = 6
+
+    def __init__(self):
+        self.pos = {"a0": 0, "a1": 0}
+        self.t = 0
+
+    def _obs(self):
+        out = {}
+        for agent, p in self.pos.items():
+            o = np.zeros(self.N, np.float32)
+            o[p] = 1.0
+            out[agent] = o
+        return out
+
+    def reset(self, seed=None):
+        self.pos = {"a0": 0, "a1": 0}
+        self.t = 0
+        return self._obs(), {}
+
+    def step(self, actions):
+        self.t += 1
+        for agent, a in actions.items():
+            self.pos[agent] = max(0, min(
+                self.N - 1, self.pos[agent] + (1 if a == 1 else -1)))
+        done = all(p == self.N - 1 for p in self.pos.values())
+        rewards = {a: (1.0 if done else -0.01) for a in self.pos}
+        terms = {a: done for a in self.pos}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.pos}
+        truncs["__all__"] = self.t >= 24 and not done
+        return self._obs(), rewards, terms, truncs, {}
+
+
+def test_multi_agent_shared_policy_learns(rt):
+    algo = (MultiAgentPPOConfig()
+            .environment(TwoAgentChain)
+            .multi_agent(
+                policies={"shared": {"obs_dim": 6, "num_actions": 2,
+                                     "hidden": (32, 32)}},
+                policy_mapping_fn=lambda agent: "shared")
+            .env_runners(2)
+            .training(lr=3e-3, minibatch_size=64, num_epochs=4,
+                      entropy_coeff=0.005)
+            .build())
+    try:
+        rewards = []
+        for _ in range(30):
+            m = algo.train()
+            rewards.append(m["episode_reward_mean"])
+        late = np.nanmean(rewards[-5:])
+        # optimal per-agent ≈ 1 - 5*0.01; random wanders to truncation.
+        assert late > 0.5, f"multi-agent PPO failed: {rewards}"
+        assert "shared/total_loss" in m
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_per_policy_smoke(rt):
+    algo = (MultiAgentPPOConfig()
+            .environment(TwoAgentChain)
+            .multi_agent(
+                policies={
+                    "p0": {"obs_dim": 6, "num_actions": 2,
+                           "hidden": (16,)},
+                    "p1": {"obs_dim": 6, "num_actions": 2,
+                           "hidden": (16,)},
+                },
+                policy_mapping_fn=lambda agent: "p" + agent[-1])
+            .env_runners(1)
+            .training(minibatch_size=32, num_epochs=2)
+            .build())
+    try:
+        m = algo.train()
+        assert m["episodes_this_iter"] >= 0
+        # both policies updated independently
+        assert "p0/total_loss" in m and "p1/total_loss" in m
+    finally:
+        algo.stop()

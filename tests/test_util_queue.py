@@ -1,0 +1,51 @@
+"""ray_tpu.util.queue tests (reference: ray.util.queue.Queue)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+def test_queue_fifo_and_nowait(rt):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_queue_maxsize(rt):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.2)
+    assert q.get() == 1
+    q.put(3)
+    assert [q.get(), q.get()] == [2, 3]
+
+
+@ray_tpu.remote
+def producer(q, n):
+    for i in range(n):
+        q.put(i * 10)
+    return n
+
+
+@ray_tpu.remote
+def consumer(q, n):
+    return [q.get(timeout=60) for _ in range(n)]
+
+
+def test_queue_across_processes(rt):
+    q = Queue()
+    p = producer.remote(q, 6)
+    c = consumer.remote(q, 6)
+    assert ray_tpu.get(p, timeout=120) == 6
+    assert sorted(ray_tpu.get(c, timeout=120)) == \
+        [0, 10, 20, 30, 40, 50]
