@@ -40,3 +40,15 @@ def tpu_pod_type() -> str | None:
 
 def tpu_worker_id() -> int:
     return int(os.environ.get("TPU_WORKER_ID", "0"))
+
+
+def tpu_gang_resources() -> dict[str, float]:
+    """Pod-slice gang-scheduling resources (reference:
+    TPU-{pod_type}-head at tpu.py:381-386): worker 0 of a slice
+    carries ``TPU-<type>-head: 1`` so a gang placement targets whole
+    slices atomically."""
+    out: dict[str, float] = {}
+    pod = tpu_pod_type()
+    if pod and tpu_worker_id() == 0:
+        out[f"TPU-{pod}-head"] = 1.0
+    return out

@@ -333,6 +333,9 @@ class DriverRuntime:
         head_res: dict[str, float] = {"CPU": float(ncpu)}
         if ntpu:
             head_res["TPU"] = float(ntpu)
+            # Pod-slice gang resource (TPU-<type>-head) on worker 0.
+            from ray_tpu.core.accelerator import tpu_gang_resources
+            head_res.update(tpu_gang_resources())
         if resources:
             head_res.update(resources)
         # Node table (GCS node-manager analog): the head node holds the

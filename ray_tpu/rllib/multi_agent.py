@@ -62,19 +62,26 @@ class MultiAgentEnvRunner:
         episodes: dict[str, list[Episode]] = {}
         open_eps: dict[str, Episode] = {}       # agent -> episode
 
-        def close(agent, terminated):
+        def close(agent, terminated, bootstrap_obs, mark_done=True):
+            """End an agent's trajectory. mark_done=False = fragment
+            boundary: episode stays terminated=truncated=False (the
+            single-agent convention — excluded from reward metrics)
+            but still bootstraps from ``bootstrap_obs``."""
             ep = open_eps.pop(agent, None)
             if ep is None or not ep.length:
                 return
-            ep.terminated = terminated
-            ep.truncated = not terminated
+            if mark_done:
+                ep.terminated = terminated
+                ep.truncated = not terminated
             if terminated:
                 ep.last_value = 0.0
             else:
                 pid = self.mapping(agent)
+                if bootstrap_obs is None:
+                    bootstrap_obs = ep.obs[-1]
                 _, v = self._fwd[pid](
                     self.params[pid],
-                    np.asarray(self._obs[agent], np.float32)[None])
+                    np.asarray(bootstrap_obs, np.float32)[None])
                 ep.last_value = float(v[0])
             episodes.setdefault(self.mapping(agent), []).append(ep)
 
@@ -103,14 +110,26 @@ class MultiAgentEnvRunner:
                 ep.values.append(value)
             done_all = terms.get("__all__", False) or \
                 truncs.get("__all__", False)
-            self._obs = next_obs
             if done_all:
                 for agent in list(open_eps):
-                    close(agent, terms.get(agent,
-                                           terms.get("__all__", False)))
+                    close(agent,
+                          terms.get(agent, terms.get("__all__", False)),
+                          next_obs.get(agent))
+                self._obs, _ = self.env.reset()
+                continue
+            # Per-agent termination without __all__: close THAT
+            # agent's trajectory now and stop stepping it (the env
+            # drops it from obs, or we drop it here).
+            self._obs = dict(next_obs)
+            for agent in list(open_eps):
+                if terms.get(agent, False) or truncs.get(agent, False):
+                    close(agent, terms.get(agent, False),
+                          next_obs.get(agent))
+                    self._obs.pop(agent, None)
+            if not self._obs:      # everyone ended individually
                 self._obs, _ = self.env.reset()
         for agent in list(open_eps):
-            close(agent, False)
+            close(agent, False, self._obs.get(agent), mark_done=False)
         return episodes
 
     def ping(self) -> str:

@@ -109,6 +109,44 @@ def _cmd_metrics(args) -> int:
     return 0
 
 
+def _cmd_logs(args) -> int:
+    """List or tail worker log files of the target session."""
+    address = _discover_address(args.address)
+    log_dir = os.path.join(os.path.dirname(address), "logs")
+    if not os.path.isdir(log_dir):
+        print("no logs directory for this session")
+        return 1
+    names = sorted(n for n in os.listdir(log_dir)
+                   if n.endswith(".log"))
+    if args.file:
+        path = os.path.join(log_dir, args.file)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            print(f"no such log file: {args.file} "
+                  f"(run `logs` with no argument to list)")
+            return 1
+        tail = data[-args.tail_bytes:] if args.tail_bytes else data
+        sys.stdout.write(tail.decode(errors="replace"))
+        return 0
+    for n in names:
+        size = os.path.getsize(os.path.join(log_dir, n))
+        print(f"{n}	{size} bytes")
+    return 0
+
+
+def _cmd_usage(args) -> int:
+    """Print the local usage summary (never transmitted)."""
+    address = _discover_address(args.address)
+    path = os.path.join(os.path.dirname(address), "usage.json")
+    if os.path.exists(path):
+        print(open(path).read())
+        return 0
+    print("no usage.json written yet for this session")
+    return 1
+
+
 def _cmd_doctor(args) -> int:
     print("== ray_tpu doctor ==")
     import ray_tpu
@@ -165,6 +203,17 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("summary", help="task summary by name/state")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_summary)
+
+    p = sub.add_parser("logs", help="list/tail worker logs")
+    p.add_argument("file", nargs="?", default="",
+                   help="log file name to print (empty = list)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--tail-bytes", type=int, default=65536)
+    p.set_defaults(fn=_cmd_logs)
+
+    p = sub.add_parser("usage", help="print local usage summary")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_usage)
 
     p = sub.add_parser("timeline", help="dump chrome trace")
     p.add_argument("--output", "-o", default="timeline.json")

@@ -23,6 +23,9 @@ class Full(Exception):
 
 @ray_tpu.remote
 class _QueueActor:
+    """Single-threaded on purpose (the reference uses an asyncio
+    actor): check-then-act on the deque must not interleave."""
+
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
         self.items: deque = deque()
@@ -38,6 +41,9 @@ class _QueueActor:
             return False, None
         return True, self.items.popleft()
 
+    def can_put(self) -> bool:
+        return self.maxsize <= 0 or len(self.items) < self.maxsize
+
     def qsize(self) -> int:
         return len(self.items)
 
@@ -48,21 +54,26 @@ class Queue:
 
     def __init__(self, maxsize: int = 0, *, actor_options: dict
                  | None = None):
-        opts = {"num_cpus": 0, "max_concurrency": 8,
-                **(actor_options or {})}
+        opts = {"num_cpus": 0, **(actor_options or {})}
         self._actor = _QueueActor.options(**opts).remote(maxsize)
 
     def put(self, item, block: bool = True,
             timeout: float | None = None) -> None:
         deadline = None if timeout is None else \
             time.monotonic() + timeout
+        if ray_tpu.get(self._actor.put.remote(item), timeout=60):
+            return
+        if not block:
+            raise Full()
         while True:
-            if ray_tpu.get(self._actor.put.remote(item), timeout=60):
-                return
-            if not block:
-                raise Full()
             if deadline is not None and time.monotonic() > deadline:
                 raise Full()
+            # Probe cheaply while full — re-shipping the item payload
+            # every poll would re-serialize it each time.
+            if ray_tpu.get(self._actor.can_put.remote(), timeout=60):
+                if ray_tpu.get(self._actor.put.remote(item),
+                               timeout=60):
+                    return
             time.sleep(0.02)
 
     def get(self, block: bool = True, timeout: float | None = None):

@@ -184,3 +184,25 @@ def test_strict_pack_pg_single_node(cluster):
         for i in range(2)], timeout=120)
     assert homes[0] == homes[1]
     ray_tpu.remove_placement_group(pg)
+
+
+def test_tpu_gang_head_resource(monkeypatch):
+    """Worker 0 of a pod slice advertises TPU-<type>-head for gang
+    placement (reference: tpu.py:381-386)."""
+    import ray_tpu
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("RAY_TPU_CHIPS", "4")
+    ray_tpu.init(num_cpus=2)
+    try:
+        res = ray_tpu.cluster_resources()
+        assert res.get("TPU") == 4.0
+        assert res.get("TPU-v5litepod-8-head") == 1.0
+        # Gang placement can target the slice head atomically.
+        pg = ray_tpu.placement_group(
+            [{"CPU": 1, "TPU-v5litepod-8-head": 1}],
+            strategy="STRICT_PACK")
+        assert pg.ready(timeout=30)
+        ray_tpu.remove_placement_group(pg)
+    finally:
+        ray_tpu.shutdown()

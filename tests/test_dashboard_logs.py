@@ -86,3 +86,52 @@ def test_worker_logs_reach_driver(rt):
     text = out.getvalue()
     assert "hello from the worker side" in text
     assert "(worker-" in text
+
+
+def test_usage_and_export_events(rt):
+    import json as jsonlib
+    import tempfile
+
+    from ray_tpu.core.api import get_runtime
+    from ray_tpu.util.usage import (
+        collect_usage, export_events, write_usage_report,
+    )
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    u = collect_usage()
+    assert u["tasks_finished"] >= 1 and u["num_nodes"] >= 1
+    path = write_usage_report()
+    assert path and jsonlib.load(open(path))["version"]
+
+    out = tempfile.mktemp(suffix=".jsonl")
+    n = export_events(out, get_runtime())
+    assert n >= 2   # at least PENDING + FINISHED for task f
+    lines = [jsonlib.loads(line) for line in open(out)]
+    assert any(ev["state"] == "FINISHED" for ev in lines)
+
+
+def test_cli_logs_subcommand(rt):
+    import subprocess
+    import sys
+
+    @ray_tpu.remote
+    def noisy2():
+        print("cli logs marker")
+        return 1
+
+    assert ray_tpu.get(noisy2.remote(), timeout=60) == 1
+    import time as _t
+    _t.sleep(0.5)
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "logs"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0 and ".log" in out.stdout
+    first = out.stdout.split()[0]
+    out2 = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "logs", first],
+        capture_output=True, text=True, timeout=60)
+    assert out2.returncode == 0

@@ -90,3 +90,47 @@ def test_multi_agent_per_policy_smoke(rt):
         assert "p0/total_loss" in m and "p1/total_loss" in m
     finally:
         algo.stop()
+
+
+class EarlyExitEnv:
+    """a0 terminates at step 3 (no __all__) and leaves the obs dict;
+    a1 keeps going until step 8."""
+
+    def __init__(self):
+        self.t = 0
+
+    def _obs(self, agents):
+        return {a: np.array([float(self.t)], np.float32)
+                for a in agents}
+
+    def reset(self, seed=None):
+        self.t = 0
+        return self._obs(["a0", "a1"]), {}
+
+    def step(self, actions):
+        self.t += 1
+        a0_done = self.t >= 3 and "a0" in actions
+        all_done = self.t >= 8
+        agents = ["a1"] if (a0_done or "a0" not in actions) \
+            and not all_done else list(actions)
+        terms = {"a0": a0_done, "a1": all_done, "__all__": all_done}
+        truncs = {"a0": False, "a1": False, "__all__": False}
+        rewards = {a: 1.0 for a in actions}
+        return self._obs(agents), rewards, terms, truncs, {}
+
+
+def test_per_agent_termination_without_all(rt):
+    algo = (MultiAgentPPOConfig()
+            .environment(EarlyExitEnv)
+            .multi_agent(
+                policies={"shared": {"obs_dim": 1, "num_actions": 2,
+                                     "hidden": (8,)}},
+                policy_mapping_fn=lambda a: "shared")
+            .env_runners(1)
+            .training(minibatch_size=8, num_epochs=1)
+            .build())
+    try:
+        m = algo.train()     # must not crash on a0's early exit
+        assert m["episodes_this_iter"] >= 1
+    finally:
+        algo.stop()
