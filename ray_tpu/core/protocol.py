@@ -42,7 +42,7 @@ OP_WAIT = "wait"
 OP_KILL = "kill"
 OP_CANCEL = "cancel"
 OP_GET_ACTOR = "get_actor"
-OP_BORROW = "borrow"
+OP_BORROW = "borrow"            # (action, oid): escape | add | release
 OP_RESOURCES = "resources"
 OP_STATE = "state"            # (kind, filters) -> list[dict] | dict
 OP_PG_CREATE = "pg_create"

@@ -365,9 +365,18 @@ class DriverRuntime:
         self._obj_locations: dict[ObjectID, str] = {}  # "mem" | "shm"
         self._put_counter = itertools.count()
 
-        # Reference counting (driver-local; see object_ref docstring)
+        # Reference counting (driver-local; see object_ref docstring).
+        # Three pins per object (reference: reference_count.h):
+        #   _refcounts — owner-side live ObjectRef objects;
+        #   _escape_count — serialized copies in flight (pickle +1,
+        #     borrower deserialize -1); a copy that is never
+        #     deserialized pins forever (conservative);
+        #   _borrows — live borrower copies in other processes
+        #     (deserialize +1, borrower GC -1).
+        # Deletable only when all three are zero.
         self._refcounts: dict[ObjectID, int] = {}
-        self._escaped: set[ObjectID] = set()
+        self._escape_count: dict[ObjectID, int] = {}
+        self._borrows: dict[ObjectID, int] = {}
         self._ref_lock = threading.Lock()
 
         # Task plane
@@ -452,6 +461,17 @@ class DriverRuntime:
         weakref.finalize(ref, self._dec_ref, ref.id)
         return ref
 
+    def _pinned_locked(self, oid: ObjectID) -> bool:
+        return (self._refcounts.get(oid, 0) > 0
+                or self._escape_count.get(oid, 0) > 0
+                or self._borrows.get(oid, 0) > 0)
+
+    def _delete_object(self, oid: ObjectID) -> None:
+        self.memory_store.delete(oid)
+        self.shm_store.delete(oid)
+        with self._obj_cv:
+            self._obj_locations.pop(oid, None)
+
     def _dec_ref(self, oid: ObjectID) -> None:
         with self._ref_lock:
             cnt = self._refcounts.get(oid, 0) - 1
@@ -459,19 +479,44 @@ class DriverRuntime:
                 self._refcounts[oid] = cnt
                 return
             self._refcounts.pop(oid, None)
-            if oid in self._escaped:
-                # The ref was serialized into a task arg / another object;
-                # a borrower may still resolve it. Pin until shutdown
-                # (distributed borrower tracking is a later round).
+            if self._pinned_locked(oid):
                 return
-        self.memory_store.delete(oid)
-        self.shm_store.delete(oid)
-        with self._obj_cv:
-            self._obj_locations.pop(oid, None)
+        self._delete_object(oid)
 
     def on_ref_escaped(self, oid: ObjectID) -> None:
+        """A copy of this ref was serialized out of the owner (task
+        arg, nested object, client return): pin until a borrower
+        materializes it (which transfers the pin to _borrows) — or
+        forever, if it never does."""
         with self._ref_lock:
-            self._escaped.add(oid)
+            self._escape_count[oid] = \
+                self._escape_count.get(oid, 0) + 1
+
+    def on_borrow_add(self, oid: ObjectID) -> None:
+        """A borrower deserialized a copy: consume one in-flight
+        escape (clamped — retries may rehydrate the same blob twice)
+        and count the live copy."""
+        with self._ref_lock:
+            esc = self._escape_count.get(oid, 0) - 1
+            if esc > 0:
+                self._escape_count[oid] = esc
+            else:
+                self._escape_count.pop(oid, None)
+            self._borrows[oid] = self._borrows.get(oid, 0) + 1
+
+    def on_borrow_release(self, oid: ObjectID) -> None:
+        """A borrower's copy was garbage-collected. When no pins of
+        any kind remain, the object is reclaimed — long-running
+        sessions stop accumulating escaped objects."""
+        with self._ref_lock:
+            cnt = self._borrows.get(oid, 0) - 1
+            if cnt > 0:
+                self._borrows[oid] = cnt
+                return
+            self._borrows.pop(oid, None)
+            if cnt < 0 or self._pinned_locked(oid):
+                return
+        self._delete_object(oid)
 
     def on_ref_deserialized(self, ref: ObjectRef) -> None:
         # Driver re-receiving one of its own refs: nothing to do; the
@@ -1994,6 +2039,14 @@ class DriverRuntime:
         try:
             while True:
                 req_id, op, payload = conn.recv()
+                if op == P.OP_BORROW:
+                    # Borrow add/release are order-sensitive per
+                    # connection (a thread-per-message race could run
+                    # a release before its add and free a live
+                    # object): handle inline — they are cheap and
+                    # never block.
+                    handle(req_id, op, payload)
+                    continue
                 threading.Thread(target=handle,
                                  args=(req_id, op, payload),
                                  daemon=True).start()
@@ -2096,7 +2149,17 @@ class DriverRuntime:
             self.cancel(ObjectRef(ObjectID(oid_bytes)), force)
             return None
         if op == P.OP_BORROW:
-            self.on_ref_escaped(ObjectID(payload))
+            if isinstance(payload, tuple):
+                action, oid_bytes = payload
+            else:                      # legacy single-oid form
+                action, oid_bytes = "escape", payload
+            oid = ObjectID(oid_bytes)
+            if action == "add":
+                self.on_borrow_add(oid)
+            elif action == "release":
+                self.on_borrow_release(oid)
+            else:
+                self.on_ref_escaped(oid)
             return None
         if op == P.OP_RESOURCES:
             return (self.available_resources(), self.cluster_resources())

@@ -36,6 +36,7 @@ class ClientRuntime:
     """
 
     def __init__(self, address: str):
+        from collections import deque
         self._conn = mpc.Client(address, family="AF_UNIX")
         self._conn.send(("hello", "client", ""))
         self._send_lock = threading.Lock()
@@ -45,6 +46,16 @@ class ClientRuntime:
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="client_recv")
         self._recv_thread.start()
+        # Fire-and-forget notifications go through a dedicated sender
+        # thread: _notify is called from weakref finalizers, which can
+        # run mid-GC on a thread that already holds _send_lock — a
+        # direct send would deadlock on the non-reentrant lock.
+        self._notify_buf: deque = deque()
+        self._notify_event = threading.Event()
+        self._notify_thread = threading.Thread(
+            target=self._notify_loop, daemon=True,
+            name="client_notify")
+        self._notify_thread.start()
         self.local_mode = False
 
     def _recv_loop(self):
@@ -65,6 +76,26 @@ class ClientRuntime:
                         ConnectionError("driver connection lost"))))
                     event.set()
                 self._pending.clear()
+
+    def _notify(self, op: str, payload) -> None:
+        """Fire-and-forget op: enqueue only (finalizer-safe — never
+        touches _send_lock on the calling thread); a dedicated thread
+        ships them in order. Replies (req_id -1) are dropped by
+        _recv_loop."""
+        self._notify_buf.append((op, payload))
+        self._notify_event.set()
+
+    def _notify_loop(self) -> None:
+        while True:
+            self._notify_event.wait()
+            self._notify_event.clear()
+            while self._notify_buf:
+                op, payload = self._notify_buf.popleft()
+                try:
+                    with self._send_lock:
+                        self._conn.send((-1, op, payload))
+                except (OSError, BrokenPipeError, ValueError):
+                    return   # driver gone
 
     def _call(self, op: str, payload, timeout: float | None = None):
         req_id = next(self._req_counter)
@@ -214,10 +245,16 @@ class ClientRuntime:
         self._call(P.OP_CANCEL, (ref.id.binary(), force))
 
     def on_ref_escaped(self, oid: ObjectID):
-        self._call(P.OP_BORROW, oid.binary())
+        self._call(P.OP_BORROW, ("escape", oid.binary()))
 
     def on_ref_deserialized(self, ref: ObjectRef):
-        pass
+        # Live borrower tracking (reference: reference_count.h
+        # borrowers): register this copy and release it on GC so the
+        # owner can reclaim the object once no borrower holds it.
+        self._notify(P.OP_BORROW, ("add", ref.id.binary()))
+        import weakref
+        weakref.finalize(ref, self._notify, P.OP_BORROW,
+                         ("release", ref.id.binary()))
 
     def available_resources(self):
         return self._call(P.OP_RESOURCES, None)[0]

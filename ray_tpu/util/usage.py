@@ -66,7 +66,14 @@ def export_events(path: str, runtime=None) -> int:
     if runtime is None:
         from ray_tpu.core.api import get_runtime
         runtime = get_runtime()
-    events = list(runtime._events)
+    for _ in range(5):
+        try:
+            events = list(runtime._events)
+            break
+        except RuntimeError:     # deque mutated during iteration
+            continue
+    else:
+        events = []
     with open(path, "w") as f:
         for ev in events:
             f.write(json.dumps(ev) + "\n")

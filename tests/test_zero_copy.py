@@ -166,3 +166,35 @@ def test_arrays_survive_runtime_shutdown():
     np.testing.assert_array_equal(out, arr)    # no segfault, no junk
     del out
     gc.collect()
+
+
+def test_borrow_release_reclaims_escaped_objects(rt):
+    """A ref borrowed by a worker (nested in an argument) no longer
+    pins the object forever: when the worker's copy is GC'd and the
+    owner's ref dies, the object is reclaimed (reference: borrower
+    tracking, reference_count.h)."""
+    import time
+
+    from ray_tpu.core.api import get_runtime
+    runtime = get_runtime()
+
+    @ray_tpu.remote
+    def consume(box):
+        import ray_tpu as rt
+        return float(rt.get(box["ref"]).sum())
+
+    arr = np.ones(200_000, dtype=np.float64)      # 1.6MB -> shm
+    ref = ray_tpu.put(arr)
+    assert ray_tpu.get(consume.remote({"ref": ref}),
+                       timeout=120) == 200_000.0
+    baseline = runtime.shm_store.used_bytes()
+    assert baseline > 0
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if runtime.shm_store.used_bytes() < baseline:
+            break
+        time.sleep(0.2)
+    assert runtime.shm_store.used_bytes() < baseline, \
+        "escaped object was never reclaimed after borrow release"
