@@ -292,6 +292,13 @@ class WorkerHandle:
             pass
         finally:
             self.dead = True
+            # Reap the child so it doesn't linger as a zombie — a
+            # zombie pid still has a /proc entry, which would make the
+            # store's dead-pin reaper think the reader is alive.
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
             self._runtime._on_worker_exit(self)
 
     def shutdown(self, timeout: float = 2.0) -> None:
@@ -2036,22 +2043,54 @@ class DriverRuntime:
             except BaseException as e:  # noqa: BLE001
                 reply(req_id, P.ST_ERR, ser.dumps(e))
 
+        # Live borrows owed by THIS connection: when the peer dies
+        # (crash, SIGTERM, OOM kill) its release finalizers never run,
+        # so the residual counts are released here on disconnect —
+        # otherwise every killed worker would pin its borrowed
+        # objects for the life of the session.
+        conn_borrows: dict = {}
         try:
             while True:
                 req_id, op, payload = conn.recv()
                 if op == P.OP_BORROW:
-                    # Borrow add/release are order-sensitive per
-                    # connection (a thread-per-message race could run
-                    # a release before its add and free a live
-                    # object): handle inline — they are cheap and
-                    # never block.
-                    handle(req_id, op, payload)
+                    # Order-sensitive per connection: handle inline
+                    # (a thread-per-message race could run a release
+                    # before its add and free a live object). No
+                    # reply for fire-and-forget req_id -1.
+                    try:
+                        if isinstance(payload, tuple):
+                            action, oid_bytes = payload
+                        else:
+                            action, oid_bytes = "escape", payload
+                        oid = ObjectID(oid_bytes)
+                        if action == "add":
+                            conn_borrows[oid] = \
+                                conn_borrows.get(oid, 0) + 1
+                            self.on_borrow_add(oid)
+                        elif action == "release":
+                            if conn_borrows.get(oid, 0) > 0:
+                                conn_borrows[oid] -= 1
+                            self.on_borrow_release(oid)
+                        else:
+                            self.on_ref_escaped(oid)
+                        if req_id != -1:
+                            reply(req_id, P.ST_OK, None)
+                    except BaseException as e:  # noqa: BLE001
+                        if req_id != -1:
+                            reply(req_id, P.ST_ERR, ser.dumps(e))
                     continue
                 threading.Thread(target=handle,
                                  args=(req_id, op, payload),
                                  daemon=True).start()
         except (EOFError, OSError):
             pass
+        finally:
+            for oid, count in conn_borrows.items():
+                for _ in range(count):
+                    try:
+                        self.on_borrow_release(oid)
+                    except Exception:  # noqa: BLE001
+                        pass
 
     def _handle_client_op(self, op: str, payload):
         if op == P.OP_SUBMIT:

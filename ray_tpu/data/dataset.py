@@ -119,7 +119,10 @@ def _apply_fused(block, ops: list):
             block = to_block(rows)
         elif isinstance(op, _Filter):
             rows = [r for r in block_rows(block) if op.fn(r)]
-            block = to_block(rows)
+            # An all-filtered block keeps its schema (a zero-row
+            # slice), so downstream consumers still see the columns.
+            block = (slice_block(block, 0, 0) if not rows
+                     else to_block(rows))
     return block
 
 
@@ -413,6 +416,27 @@ class Dataset:
                 t = torch.from_numpy(arr)
                 out[k] = t.to(device) if device else t
             yield out
+
+    def to_pandas(self):
+        """Materialize as one pandas DataFrame (reference:
+        Dataset.to_pandas)."""
+        import pyarrow as pa
+        # Keep empty blocks that carry a schema: an all-filtered
+        # dataset must still yield its columns.
+        blocks = [b for b in self.iter_blocks() if b.num_columns]
+        if not blocks:
+            import pandas as pd
+            return pd.DataFrame()
+        return pa.concat_tables(blocks).to_pandas()
+
+    def take_batch(self, batch_size: int = 20
+                   ) -> dict[str, np.ndarray]:
+        """First ``batch_size`` rows as one batch dict (reference:
+        Dataset.take_batch)."""
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size):
+            return batch
+        return {}
 
     def __repr__(self):
         return f"Dataset(stages={len(self._plan)})"

@@ -56,10 +56,22 @@ struct Entry {
 };
 
 bool pid_alive(int32_t pid) {
+  // /proc/<pid>/stat exists for zombies too (a SIGKILLed child the
+  // parent has not reaped yet) — read the state field and treat
+  // 'Z'/'X' as dead, or the reaper would wait on them forever.
   char path[64];
-  std::snprintf(path, sizeof(path), "/proc/%d", pid);
-  struct stat st;
-  return ::stat(path, &st) == 0;
+  std::snprintf(path, sizeof(path), "/proc/%d/stat", pid);
+  FILE* f = std::fopen(path, "r");
+  if (f == nullptr) return false;
+  char buf[512];
+  size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  buf[n] = '\0';
+  // state is the first char after the ") " closing the comm field.
+  const char* p = std::strrchr(buf, ')');
+  if (p == nullptr || p[1] == '\0') return false;
+  char state = p[2] == '\0' ? p[1] : p[2];
+  return state != 'Z' && state != 'X';
 }
 
 struct FreeBlock {

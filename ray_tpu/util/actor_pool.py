@@ -1,0 +1,91 @@
+"""ActorPool — round-robin work distribution over a fixed actor set.
+
+Reference analog: ray.util.ActorPool (python/ray/util/actor_pool.py):
+submit (fn, value) pairs to idle actors, collect results in
+submission order (``get_next``) or completion order
+(``get_next_unordered``); ``map``/``map_unordered`` sugar on top.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        self._idle = deque(actors)
+        self._future_to_actor: dict = {}
+        self._pending_submits: deque = deque()
+        self._ordered: deque = deque()      # refs in submission order
+
+    def submit(self, fn, value) -> None:
+        """fn(actor, value) -> ObjectRef; queued if no actor idle."""
+        if self._idle:
+            actor = self._idle.popleft()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = actor
+            self._ordered.append(ref)
+        else:
+            self._pending_submits.append((fn, value))
+
+    def _reclaim(self, ref) -> None:
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is None:
+            return
+        if self._pending_submits:
+            fn, value = self._pending_submits.popleft()
+            new_ref = fn(actor, value)
+            self._future_to_actor[new_ref] = actor
+            self._ordered.append(new_ref)
+        else:
+            self._idle.append(actor)
+
+    def has_next(self) -> bool:
+        return bool(self._ordered)
+
+    def get_next(self, timeout: float | None = None):
+        """Next result in SUBMISSION order."""
+        if not self._ordered:
+            raise StopIteration("no pending results")
+        ref = self._ordered.popleft()
+        value = ray_tpu.get(ref, timeout=timeout)
+        self._reclaim(ref)
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None):
+        """Next result in COMPLETION order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        done, _ = ray_tpu.wait(list(self._future_to_actor),
+                               num_returns=1, timeout=timeout)
+        if not done:
+            raise TimeoutError("no result within timeout")
+        ref = done[0]
+        self._ordered.remove(ref)
+        value = ray_tpu.get(ref)
+        self._reclaim(ref)
+        return value
+
+    def map(self, fn, values):
+        """Ordered results for every value (generator)."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values):
+        for v in values:
+            self.submit(fn, v)
+        while self._future_to_actor:
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.popleft() if self._idle else None
+
+    def push(self, actor) -> None:
+        self._idle.append(actor)

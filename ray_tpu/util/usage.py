@@ -66,14 +66,15 @@ def export_events(path: str, runtime=None) -> int:
     if runtime is None:
         from ray_tpu.core.api import get_runtime
         runtime = get_runtime()
-    for _ in range(5):
+    for attempt in range(5):
         try:
             events = list(runtime._events)
             break
         except RuntimeError:     # deque mutated during iteration
-            continue
-    else:
-        events = []
+            if attempt == 4:
+                raise RuntimeError(
+                    "could not snapshot the event buffer (runtime too "
+                    "busy); retry when task churn settles")
     with open(path, "w") as f:
         for ev in events:
             f.write(json.dumps(ev) + "\n")

@@ -1,0 +1,53 @@
+"""ActorPool tests (reference: ray.util.ActorPool) + small Dataset
+conveniences."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+@ray_tpu.remote
+class Doubler:
+    def work(self, x):
+        import time
+        time.sleep(0.05 if x % 2 else 0.0)
+        return x * 2
+
+
+def test_actor_pool_ordered_and_reuse(rt):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]   # submission order
+    # actors were reused: more work than actors completed fine
+    out2 = list(pool.map(lambda a, v: a.work.remote(v), [10, 11]))
+    assert out2 == [20, 22]
+
+
+def test_actor_pool_unordered(rt):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(
+        lambda a, v: a.work.remote(v), range(6)))
+    assert sorted(out) == [0, 2, 4, 6, 8, 10]
+
+
+def test_actor_pool_submit_get_next(rt):
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.work.remote(v), 3)
+    pool.submit(lambda a, v: a.work.remote(v), 4)   # queued
+    assert pool.has_next()
+    assert pool.get_next(timeout=60) == 6
+    assert pool.get_next(timeout=60) == 8
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_dataset_to_pandas_and_take_batch(rt):
+    from ray_tpu import data as rdata
+    ds = rdata.range(25, parallelism=3)
+    df = ds.to_pandas()
+    assert len(df) == 25 and df["id"].sum() == 300
+    batch = ds.take_batch(10)
+    assert list(batch["id"]) == list(range(10))

@@ -198,3 +198,42 @@ def test_borrow_release_reclaims_escaped_objects(rt):
         time.sleep(0.2)
     assert runtime.shm_store.used_bytes() < baseline, \
         "escaped object was never reclaimed after borrow release"
+
+
+def test_dead_borrower_pins_released(rt):
+    """A worker killed while holding a borrowed ref must not pin the
+    object forever: the connection teardown releases its residual
+    borrows (plasma client-disconnect semantics for refcounts)."""
+    import time
+
+    from ray_tpu.core.api import get_runtime
+    runtime = get_runtime()
+
+    @ray_tpu.remote
+    def hold_forever(box):
+        import time as _t
+        keep = ray_tpu.get(box["r"])      # borrow is live
+        _t.sleep(60)
+        return float(keep[0])
+
+    ref = ray_tpu.put(np.ones(200_000))
+    task_ref = hold_forever.options(max_retries=0).remote({"r": ref})
+    time.sleep(2.0)                       # worker borrowed by now
+    # Kill the borrowing worker.
+    with runtime._pool_lock:
+        victims = [w for w in runtime._workers
+                   if not w.is_actor and w.busy]
+    assert victims
+    victims[0].proc.kill()
+    with pytest.raises(Exception):
+        ray_tpu.get(task_ref, timeout=60)
+    baseline = runtime.shm_store.used_bytes()
+    del ref
+    import gc as _gc
+    _gc.collect()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and \
+            runtime.shm_store.used_bytes() >= baseline:
+        time.sleep(0.2)
+    assert runtime.shm_store.used_bytes() < baseline, \
+        "dead borrower's pins were never released"
