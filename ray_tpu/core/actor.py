@@ -60,6 +60,11 @@ class ActorHandle:
         self._method_meta = method_meta or {}
 
     def __getattr__(self, name: str) -> ActorMethod:
+        if name == "__ray_call__":
+            # Run an arbitrary closure on the actor instance
+            # (reference: actor.__ray_call__.remote(lambda self: ...));
+            # the worker special-cases this method name.
+            return ActorMethod(self, name, 1)
         if name.startswith("_"):
             raise AttributeError(name)
         return ActorMethod(self, name,

@@ -182,6 +182,16 @@ def put(value) -> ObjectRef:
 
 
 def get(refs, timeout: float | None = None):
+    # Channel-mode compiled DAGs hand back CompiledDAGRefs (values ride
+    # shm channels, not the object store) — unwrap them here so
+    # ``ray.get(dag.execute(x))`` works across both modes.
+    from ray_tpu.dag.compiled_dag import CompiledDAGRef
+    if isinstance(refs, CompiledDAGRef):
+        return refs.get(timeout)
+    if isinstance(refs, (list, tuple)) and any(
+            isinstance(r, CompiledDAGRef) for r in refs):
+        return [r.get(timeout) if isinstance(r, CompiledDAGRef)
+                else get_runtime().get(r, timeout) for r in refs]
     return get_runtime().get(refs, timeout)
 
 

@@ -455,7 +455,14 @@ def worker_main(conn, client_address: str) -> None:
             tr.disable()
         try:
             args, kwargs = _materialize_args(args_blob, resolved)
-            bound = getattr(actor_instance, method)
+            if method == "__ray_call__":
+                # args[0] is a callable taking the actor instance
+                # (reference: actor.__ray_call__) — the vehicle for
+                # compiled-DAG worker loops among other things.
+                fn, args = args[0], args[1:]
+                bound = (lambda *a, **k: fn(actor_instance, *a, **k))
+            else:
+                bound = getattr(actor_instance, method)
 
             def run_and_maybe_stream():
                 result = _run_maybe_async(bound, args, kwargs)

@@ -1,25 +1,37 @@
 """Compiled DAG execution — the aDAG analog.
 
-Reference: python/ray/dag/compiled_dag_node.py:516 (CompiledDAG) and
-dag_node_operation.py (static per-actor schedules). ``compile`` walks
-the bound graph ONCE: actors for ClassNodes are created up front, the
-topological order is frozen, and every bound-argument subtree is
-compiled into a closure — so each ``execute()`` is a flat loop of task
-submissions with zero graph traversal, validation, or isinstance
-dispatch.
+Reference: python/ray/dag/compiled_dag_node.py:516 (CompiledDAG),
+dag_node_operation.py (static per-actor READ/COMPUTE/WRITE schedules)
+and python/ray/experimental/channel/shared_memory_channel.py (mutable
+shm channels). ``compile`` walks the bound graph ONCE and picks one of
+two execution modes:
 
-Pipelining falls out of the runtime's design rather than bespoke
-channels: task submission is async and each actor drains an ordered
-FIFO submit queue, so consecutive ``execute()`` calls overlap across
-stages exactly like the reference's static COMPUTE/READ/WRITE
-schedules. Device-resident tensors inside one stage stay on device;
-cross-stage device transfer belongs to the shard_map pipeline
-(ray_tpu.parallel.pipeline), which is the TPU-native analog of the
-reference's NCCL channels (torch_tensor_nccl_channel.py).
+**Channel mode** (all compute nodes are actor methods + native lib
+available — the true aDAG): every cross-actor edge gets a mutable
+shared-memory channel (ray_tpu.native.channel), each actor starts a
+persistent ``read inputs → compute → write outputs`` loop via
+``__ray_call__``, and ``execute()`` is just *one channel write* of the
+input plus a deferred read of the output channels — no per-call
+scheduling, no driver round-trips between stages. Depth-1 channels
+give natural pipeline parallelism: each stage may run one iteration
+ahead of its consumer, exactly like the reference's overlapped static
+schedules.
+
+**Task mode** (fallback; graphs with free-function nodes): actors are
+pre-created, the topo order frozen, and every bound-arg subtree is
+compiled into a closure, so each ``execute()`` is a flat loop of async
+task submissions.
+
+Device-resident tensors inside one stage stay on device; cross-stage
+device transfer belongs to the shard_map pipeline
+(ray_tpu.parallel.pipeline), the TPU-native analog of the reference's
+NCCL channels (torch_tensor_nccl_channel.py).
 """
 
 from __future__ import annotations
 
+import time
+from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ray_tpu.dag.dag_node import (
@@ -32,6 +44,213 @@ from ray_tpu.dag.dag_node import (
     MultiOutputNode,
     _DAGInputData,
 )
+
+
+# --------------------------------------------------------------------------
+# Channel-mode machinery
+# --------------------------------------------------------------------------
+
+def _project_input(input_val, key):
+    if isinstance(input_val, _DAGInputData):
+        return input_val.pick(key)
+    if isinstance(key, int):
+        return input_val[key]
+    if isinstance(input_val, dict):
+        return input_val[key]
+    return getattr(input_val, key)
+
+
+def _eval_token(tok, node_vals, input_val):
+    """Interpret one arg token; returns (value, err | None).
+
+    ``node_vals[key]`` holds (value, is_err) pairs for upstream compute
+    nodes (local or channel-read)."""
+    t = tok[0]
+    if t == "const":
+        return tok[1], None
+    if t == "input":
+        return input_val, None
+    if t == "inattr":
+        return _project_input(input_val, tok[1]), None
+    if t == "node":
+        value, is_err = node_vals[tok[1]]
+        return (value, value) if is_err else (value, None)
+    if t == "seq":           # list/tuple
+        out = []
+        for sub in tok[2]:
+            v, e = _eval_token(sub, node_vals, input_val)
+            if e is not None:
+                return None, e
+            out.append(v)
+        return tok[1](out), None
+    if t == "map":           # dict
+        out = {}
+        for k, sub in tok[1].items():
+            v, e = _eval_token(sub, node_vals, input_val)
+            if e is not None:
+                return None, e
+            out[k] = v
+        return out, None
+    raise TypeError(f"bad arg token {tok!r}")
+
+
+@dataclass
+class _NodeSpec:
+    key: int
+    method: str
+    arg_tokens: list
+    kwarg_tokens: dict
+    chan_deps: list = field(default_factory=list)  # keys read before run
+    out_channel: Any = None        # Channel | None
+
+
+@dataclass
+class _ActorLoopSpec:
+    nodes: list = field(default_factory=list)      # ordered _NodeSpec
+    in_channels: dict = field(default_factory=dict)  # key|"__input__" -> Channel
+    needs_input_value: bool = False
+
+
+def _dag_actor_loop(actor_self, spec: _ActorLoopSpec):
+    """Persistent per-actor loop (reference: the compiled DAG's
+    per-actor static schedule executor, dag_node_operation.py:304).
+    Runs on the actor via ``__ray_call__`` until its channels close.
+
+    Reads are interleaved per node in topological order (the
+    reference's READ/COMPUTE/WRITE triples), NOT hoisted to the top of
+    the iteration: an actor that both feeds and consumes another actor
+    (a→b→a) must write its early nodes before blocking on channels
+    produced from them."""
+    import traceback as _tb
+
+    from ray_tpu.core.exceptions import ActorError
+    from ray_tpu.native.channel import ChannelClosedError
+
+    for ch in spec.in_channels.values():
+        ch.register_reader()
+    for ns in spec.nodes:
+        if ns.out_channel is not None:
+            ns.out_channel.claim_writer()
+
+    def ship(ns, entry) -> bool:
+        """Write one node result; ship write failures (e.g. oversized
+        payload) as errors so the driver never hangs. Returns False
+        when the channel is closed (teardown)."""
+        try:
+            ns.out_channel.write(entry[0], _is_error=entry[1])
+            return True
+        except ChannelClosedError:
+            return False
+        except BaseException:  # noqa: BLE001
+            try:
+                ns.out_channel.write(
+                    ActorError(ns.method, _tb.format_exc(), None),
+                    _is_error=True)
+                return True
+            except ChannelClosedError:
+                return False
+
+    while True:
+        chan_vals: dict = {}
+        closed = False
+        try:
+            if "__input__" in spec.in_channels:
+                value, is_err = spec.in_channels["__input__"]\
+                    .begin_read(copy=True)
+                chan_vals["__input__"] = (value, is_err)
+        except ChannelClosedError:
+            break
+        input_entry = chan_vals.get("__input__", (None, False))
+        input_val = input_entry[0]
+        input_err = input_entry[0] if input_entry[1] else None
+        node_vals: dict = {}
+        for ns in spec.nodes:
+            try:
+                for dep in ns.chan_deps:
+                    if dep not in node_vals:
+                        value, is_err = spec.in_channels[dep]\
+                            .begin_read(copy=True)
+                        node_vals[dep] = (value, is_err)
+            except ChannelClosedError:
+                closed = True
+                break
+            err = input_err if spec.needs_input_value else None
+            args, kwargs = (), {}
+            if err is None:
+                built = []
+                for tok in ns.arg_tokens:
+                    v, e = _eval_token(tok, node_vals, input_val)
+                    if e is not None:
+                        err = e
+                        break
+                    built.append(v)
+                else:
+                    args = tuple(built)
+                    for k, tok in ns.kwarg_tokens.items():
+                        v, e = _eval_token(tok, node_vals, input_val)
+                        if e is not None:
+                            err = e
+                            break
+                        kwargs[k] = v
+            if err is None:
+                try:
+                    result = getattr(actor_self, ns.method)(
+                        *args, **kwargs)
+                    entry = (result, False)
+                except BaseException:  # noqa: BLE001
+                    entry = (ActorError(ns.method, _tb.format_exc(),
+                                        None), True)
+            else:
+                entry = (err, True)
+            node_vals[ns.key] = entry
+            if ns.out_channel is not None and not ship(ns, entry):
+                closed = True
+                break
+        if closed:
+            break
+    return "dag-loop-done"
+
+
+class _ChannelModeIneligible(Exception):
+    """Internal: this graph shape needs the task-mode fallback."""
+
+
+_FEEDER_STOP = object()
+
+
+def _default_buffer_size() -> int:
+    from ray_tpu.native.channel import DEFAULT_BUFFER_SIZE
+    return DEFAULT_BUFFER_SIZE
+
+
+class CompiledDAGRef:
+    """Future for one ``execute()`` of a channel-mode compiled DAG
+    (reference: CompiledDAGRef in compiled_dag_node.py). ``get()``
+    blocks for that execution's outputs; ``ray_tpu.get`` unwraps it."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
+        self._index = index
+        self._taken = False
+
+    def get(self, timeout: float | None = None):
+        if self._taken:
+            raise ValueError(
+                "compiled DAG result was already retrieved")
+        from ray_tpu.native.channel import ChannelTimeoutError
+        try:
+            result = self._dag._fetch_result(self._index, timeout)
+        except ChannelTimeoutError:
+            # Not delivered yet — the ref stays retrievable.
+            raise
+        except BaseException:
+            self._taken = True
+            raise
+        self._taken = True
+        return result
+
+    def __repr__(self):
+        return f"CompiledDAGRef(exec={self._index})"
 
 
 def _compile_arg(obj: Any, index_of: dict[int, int]) -> Callable:
@@ -56,9 +275,8 @@ class CompiledDAG:
 
     def __init__(self, root: DAGNode, **opts):
         # Reference-compatible kwargs (enable_asyncio,
-        # _max_inflight_executions, ...) are accepted and recorded;
-        # execution here is always async-submission over FIFO actor
-        # queues, so they don't change behavior.
+        # _max_inflight_executions, buffer_size_bytes, ...) are
+        # accepted; buffer_size_bytes sizes the shm channels.
         self._opts = opts
         self._root = root
         self._order = root.topological_order()
@@ -84,14 +302,252 @@ class CompiledDAG:
                 handle = n.execute()
                 handles[id(n)] = handle
                 self._owned_actors.append(handle)
-
-        # Freeze one step-closure per node.
-        plan: list[Callable] = []
-        for n in self._order:
-            plan.append(self._compile_node(n, index_of, handles))
-        self._plan = plan
-        self._n = len(plan)
+        self._handles = handles
         self._torn_down = False
+
+        self._mode = "tasks"
+        if opts.get("_use_channels", True):
+            try:
+                if self._try_compile_channel_mode(index_of, handles):
+                    self._mode = "channels"
+            except _ChannelModeIneligible:
+                pass
+        if self._mode == "tasks":
+            # Freeze one step-closure per node.
+            plan: list[Callable] = []
+            for n in self._order:
+                plan.append(self._compile_node(n, index_of, handles))
+            self._plan = plan
+            self._n = len(plan)
+
+    # -- channel-mode compilation ---------------------------------------
+
+    def _try_compile_channel_mode(self, index_of: dict[int, int],
+                                  handles: dict[int, Any]) -> bool:
+        """Build channels + per-actor loop specs; launch the loops.
+
+        Eligible when every compute node is an actor method (the aDAG
+        shape) and the native channel layer is available. Raises
+        _ChannelModeIneligible to fall back."""
+        from ray_tpu.native.channel import Channel, channels_available
+
+        compute_nodes = []
+        for n in self._order:
+            if isinstance(n, FunctionNode):
+                raise _ChannelModeIneligible
+            if isinstance(n, MultiOutputNode) and n is not self._root:
+                raise _ChannelModeIneligible
+            if isinstance(n, ClassMethodNode):
+                if n._is_handle:
+                    # A user-passed live actor would have its dispatch
+                    # loop hijacked by the persistent DAG loop,
+                    # hanging ordinary .remote() calls — use the
+                    # task-mode fallback (the reference rejects actors
+                    # reused outside the DAG for the same reason).
+                    raise _ChannelModeIneligible
+                compute_nodes.append(n)
+        if not compute_nodes or not channels_available():
+            raise _ChannelModeIneligible
+        if not isinstance(self._root, (ClassMethodNode,
+                                       MultiOutputNode)):
+            raise _ChannelModeIneligible
+
+        # Actor of each compute node (actor_id-keyed grouping).
+        def actor_of(n: ClassMethodNode):
+            return n._parent if n._is_handle else handles[id(n._parent)]
+
+        node_actor: dict[int, Any] = {}       # node key -> handle
+        actor_nodes: dict[bytes, list] = {}   # actor -> [node,...]
+        actor_handle: dict[bytes, Any] = {}
+        for n in compute_nodes:
+            h = actor_of(n)
+            akey = h.actor_id.binary()
+            node_actor[index_of[id(n)]] = h
+            actor_nodes.setdefault(akey, []).append(n)
+            actor_handle[akey] = h
+
+        # Tokenize one bound-arg subtree; records channel/input needs.
+        def tokenize(obj, akey: bytes, needs: dict):
+            if isinstance(obj, InputNode):
+                needs["input_value"] = True
+                return ("input",)
+            if isinstance(obj, InputAttributeNode):
+                needs["input_value"] = True
+                return ("inattr", obj._key)
+            if isinstance(obj, ClassMethodNode):
+                pkey = index_of[id(obj)]
+                if node_actor[pkey].actor_id.binary() != akey:
+                    needs["chans"].add(pkey)
+                return ("node", pkey)
+            if isinstance(obj, ClassNode):
+                return ("const", handles[id(obj)])
+            if isinstance(obj, DAGNode):
+                raise _ChannelModeIneligible
+            if isinstance(obj, (list, tuple)):
+                return ("seq", type(obj),
+                        [tokenize(v, akey, needs) for v in obj])
+            if isinstance(obj, dict):
+                return ("map", {k: tokenize(v, akey, needs)
+                                for k, v in obj.items()})
+            return ("const", obj)
+
+        # Which node outputs does the driver read?
+        driver_reads: list[int] = []
+        if isinstance(self._root, ClassMethodNode):
+            out_tokens = [("node", index_of[id(self._root)])]
+            driver_reads.append(index_of[id(self._root)])
+            multi = False
+        else:
+            out_tokens = []
+            dneeds = {"chans": set(), "input_value": False}
+            for child in self._root._bound_args:
+                if isinstance(child, ClassMethodNode):
+                    ckey = index_of[id(child)]
+                    out_tokens.append(("node", ckey))
+                    driver_reads.append(ckey)
+                else:
+                    out_tokens.append(tokenize(child, b"", dneeds))
+                    if dneeds["chans"]:
+                        raise _ChannelModeIneligible
+            multi = True
+
+        # Per-actor specs + channel needs.
+        buffer_size = int(self._opts.get(
+            "buffer_size_bytes",
+            self._opts.get("_buffer_size_bytes", 0)) or
+            _default_buffer_size())
+        specs: dict[bytes, _ActorLoopSpec] = {
+            akey: _ActorLoopSpec() for akey in actor_nodes}
+        chan_readers: dict[int, set] = {}     # node key -> reader akeys
+        actor_inbound: dict[bytes, set] = {
+            akey: set() for akey in actor_nodes}
+        for akey, nodes in actor_nodes.items():
+            spec = specs[akey]
+            for n in nodes:
+                needs = {"chans": set(), "input_value": False}
+                arg_toks = [tokenize(a, akey, needs)
+                            for a in n.user_args]
+                kw_toks = {k: tokenize(v, akey, needs)
+                           for k, v in n._bound_kwargs.items()}
+                for pkey in needs["chans"]:
+                    chan_readers.setdefault(pkey, set()).add(akey)
+                actor_inbound[akey] |= needs["chans"]
+                if needs["input_value"]:
+                    spec.needs_input_value = True
+                spec.nodes.append(_NodeSpec(
+                    key=index_of[id(n)], method=n._method_name,
+                    arg_tokens=arg_toks, kwarg_tokens=kw_toks,
+                    chan_deps=sorted(needs["chans"])))
+
+        for ckey in driver_reads:
+            chan_readers.setdefault(ckey, set()).add(b"__driver__")
+
+        # Native reader-slot cap: wider fan-out falls back to task
+        # mode (channel.cpp kMaxReaders).
+        if any(len(r) > 16 for r in chan_readers.values()):
+            raise _ChannelModeIneligible
+
+        # Create channels: one per produced node output with remote
+        # consumers; one input channel.
+        node_channels: dict[int, Any] = {}
+        expected_readers: dict[str, int] = {}
+        for pkey, readers in chan_readers.items():
+            ch = Channel(buffer_size)
+            node_channels[pkey] = ch
+            expected_readers[ch.name] = len(readers)
+        # Source actors (no inbound channels) use the input channel as
+        # their per-iteration trigger even if no node reads the value.
+        input_readers = set()
+        for akey, spec in specs.items():
+            inbound = actor_inbound[akey]
+            if spec.needs_input_value or not inbound:
+                input_readers.add(akey)
+            for pkey in inbound:
+                spec.in_channels[pkey] = node_channels[pkey]
+            for ns in spec.nodes:
+                ns.out_channel = node_channels.get(ns.key)
+        if len(input_readers) > 16:
+            raise _ChannelModeIneligible
+        self._input_channel = None
+        if input_readers:
+            self._input_channel = Channel(buffer_size)
+            expected_readers[self._input_channel.name] = len(
+                input_readers)
+            for akey in input_readers:
+                specs[akey].in_channels["__input__"] = \
+                    self._input_channel
+
+        # Driver registers as reader of the output channels NOW (before
+        # loops start) so it never misses a version.
+        self._out_channels = {k: node_channels[k] for k in driver_reads}
+        for ch in self._out_channels.values():
+            ch.register_reader()
+
+        # Launch one persistent loop per actor via __ray_call__.
+        self._loop_refs = []
+        for akey, spec in specs.items():
+            h = actor_handle[akey]
+            self._loop_refs.append(
+                h.__ray_call__.remote(_dag_actor_loop, spec))
+
+        # Handshake: wait until every channel has all its readers
+        # registered (loops are up) before allowing the first write.
+        deadline = time.time() + 60
+        for pkey, ch in {**node_channels,
+                         "__input__": self._input_channel}.items():
+            if ch is None:
+                continue
+            want = expected_readers[ch.name]
+            while ch.reader_count() < want:
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        "compiled DAG loops failed to start "
+                        "(channel reader handshake timed out)")
+                time.sleep(0.002)
+
+        self._out_tokens = out_tokens
+        self._multi_output = multi
+        self._all_channels = list(node_channels.values())
+        if self._input_channel is not None:
+            self._all_channels.append(self._input_channel)
+        self._exec_index = 0
+        self._next_fetch = 0
+        self._results: dict[int, Any] = {}
+        self._local_inputs: dict[int, Any] = {}
+        self._partial_vals: dict[int, Any] = {}
+        self._max_inflight = int(self._opts.get(
+            "_max_inflight_executions", 1000))
+
+        # Input writes go through a driver-side feeder thread so a
+        # burst of execute() calls can't deadlock against unread
+        # outputs: the depth-1 channels backpressure the *feeder*, the
+        # driver keeps control (the reference bounds this with
+        # _max_inflight_executions + buffered channels).
+        import queue as _q
+        import threading as _t
+        self._write_q: Any = _q.SimpleQueue()
+        self._writer_err: BaseException | None = None
+
+        def _feed():
+            from ray_tpu.native.channel import ChannelClosedError
+            while True:
+                item = self._write_q.get()
+                if item is _FEEDER_STOP:
+                    break
+                try:
+                    self._input_channel.write(item)
+                except ChannelClosedError:
+                    break
+                except BaseException as e:  # noqa: BLE001
+                    self._writer_err = e
+                    break
+
+        self._feeder = None
+        if self._input_channel is not None:
+            self._feeder = _t.Thread(target=_feed, daemon=True,
+                                     name="cdag_feeder")
+            self._feeder.start()
+        return True
 
     def _compile_node(self, n: DAGNode, index_of: dict[int, int],
                       handles: dict[int, Any]) -> Callable:
@@ -143,7 +599,9 @@ class CompiledDAG:
         raise TypeError(f"cannot compile DAG node {type(n).__name__}")
 
     def execute(self, *input_args, **input_kwargs):
-        """One flat pass over the frozen plan; returns ObjectRef(s)."""
+        """Channel mode: one input-channel write, returns a
+        CompiledDAGRef. Task mode: one flat pass of submissions,
+        returns ObjectRef(s)."""
         if self._torn_down:
             raise RuntimeError("compiled DAG has been torn down")
         if len(input_args) == 1 and not input_kwargs:
@@ -152,24 +610,101 @@ class CompiledDAG:
             inp = None
         else:
             inp = _DAGInputData(input_args, input_kwargs)
+        if self._mode == "channels":
+            if self._writer_err is not None:
+                raise self._writer_err
+            if (self._exec_index - self._next_fetch
+                    >= self._max_inflight):
+                raise RuntimeError(
+                    f"too many in-flight compiled DAG executions "
+                    f"(>{self._max_inflight}); retrieve results or "
+                    f"raise _max_inflight_executions")
+            idx = self._exec_index
+            self._exec_index += 1
+            self._local_inputs[idx] = inp
+            if self._input_channel is not None:
+                self._write_q.put(inp)
+            return CompiledDAGRef(self, idx)
         vals: list[Any] = [None] * self._n
         plan = self._plan
         for i in range(self._n):
             vals[i] = plan[i](vals, inp)
         return vals[-1]
 
+    def _fetch_result(self, idx: int, timeout: float | None = None):
+        """Drain output-channel versions up to execution ``idx`` (reads
+        are strictly ordered: version v ↔ execution v-1)."""
+        while self._next_fetch <= idx:
+            if self._torn_down:
+                raise RuntimeError("compiled DAG has been torn down")
+            i = self._next_fetch
+            # Partial reads survive a timeout in _partial_vals so a
+            # retry never re-reads an already-acked channel (which
+            # would cross outputs between executions).
+            vals = self._partial_vals
+            for pkey, ch in self._out_channels.items():
+                if pkey in vals:
+                    continue
+                value, is_err = ch.begin_read(timeout, copy=True)
+                vals[pkey] = (value, is_err)
+            self._partial_vals = {}
+            inp = self._local_inputs.pop(i, None)
+            outs = []
+            first_err = None
+            for tok in self._out_tokens:
+                v, e = _eval_token(tok, vals, inp)
+                if e is not None and first_err is None:
+                    first_err = e
+                outs.append(v)
+            if first_err is not None:
+                self._results[i] = ("err", first_err)
+            else:
+                self._results[i] = (
+                    "ok", outs if self._multi_output else outs[0])
+            self._next_fetch += 1
+        tag, value = self._results.pop(idx)
+        if tag == "err":
+            raise value
+        return value
+
     def teardown(self) -> None:
-        """Kill actors created by compilation (not user-passed ones)."""
+        """Close channels (stopping the actor loops), then kill actors
+        created by compilation (not user-passed ones)."""
         if self._torn_down:
             return
         self._torn_down = True
         import ray_tpu
+        if self._mode == "channels":
+            if self._feeder is not None:
+                self._write_q.put(_FEEDER_STOP)
+            for ch in self._all_channels:
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._feeder is not None:
+                self._feeder.join(timeout=5)
+            try:
+                ray_tpu.wait(self._loop_refs,
+                             num_returns=len(self._loop_refs),
+                             timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
         for h in self._owned_actors:
             try:
                 ray_tpu.kill(h)
             except Exception:  # noqa: BLE001
                 pass
         self._owned_actors.clear()
+        if self._mode == "channels":
+            for ch in self._all_channels:
+                try:
+                    ch.detach()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._all_channels = []
+            self._out_channels = {}
+            self._input_channel = None
 
     def __del__(self):
         try:

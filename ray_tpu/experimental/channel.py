@@ -1,0 +1,17 @@
+"""Public channel surface (reference: python/ray/experimental/channel/
+shared_memory_channel.py — mutable-object channels behind compiled
+DAGs). The implementation lives in ray_tpu.native.channel (C++ shm
+slot + ctypes); device-to-device transfer inside a stage is XLA's job
+(ray_tpu.parallel / collective.ici), so these channels carry host-side
+values only, like the reference's CPU channels.
+"""
+
+from ray_tpu.native.channel import (  # noqa: F401
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+    channels_available,
+)
+
+__all__ = ["Channel", "ChannelClosedError", "ChannelTimeoutError",
+           "channels_available"]
