@@ -9,12 +9,37 @@ that cross process boundaries — see core/ref_counting.py).
 
 from __future__ import annotations
 
+import itertools
+import os
 from typing import TYPE_CHECKING
 
 from ray_tpu.core.ids import ObjectID
 
 if TYPE_CHECKING:
     from ray_tpu.core.runtime import Runtime
+
+_nonce_counter = itertools.count()
+
+
+def _new_nonce() -> str:
+    """Unique id for one serialized copy of a ref. The owner's escape
+    pin is keyed by it, so exactly the copy that was pickled — and no
+    other — consumes the pin when it materializes (reference: per-copy
+    borrower identity in reference_count.h, vs. a bare counter that
+    can consume pins belonging to unrelated in-flight copies)."""
+    return f"{os.getpid()}-{next(_nonce_counter)}"
+
+
+def _escape_for_pickle(ref: "ObjectRef") -> str | None:
+    nonce = _new_nonce()
+    from ray_tpu.core.api import get_runtime_or_none
+    rt = get_runtime_or_none()
+    if rt is not None:
+        try:
+            rt.on_ref_escaped(ref._id, nonce)
+        except Exception:  # noqa: BLE001
+            pass
+    return nonce
 
 
 class ObjectRef:
@@ -47,18 +72,12 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
-        # Cross-process serialization: mark the ref "escaped" so the
-        # owner pins the object while out-of-process borrowers may hold
-        # it (conservative stand-in for the reference's distributed
-        # borrower protocol, reference_count.h; refined in later rounds).
-        from ray_tpu.core.api import get_runtime_or_none
-        rt = get_runtime_or_none()
-        if rt is not None:
-            try:
-                rt.on_ref_escaped(self._id)
-            except Exception:
-                pass
-        return (_rehydrate_ref, (self._id.binary(), self._owner_hint))
+        # Cross-process serialization: record a nonce-keyed escape pin
+        # so the owner keeps the object alive while THIS copy is in
+        # flight; the pin is consumed when this copy deserializes.
+        nonce = _escape_for_pickle(self)
+        return (_rehydrate_ref,
+                (self._id.binary(), self._owner_hint, nonce))
 
     # Allow `await ref` when running inside async actors.
     def __await__(self):
@@ -127,16 +146,16 @@ class ObjectRefGenerator:
         return f"ObjectRefGenerator({self._task_id_bytes.hex()})"
 
 
-def _rehydrate_ref(id_bytes: bytes, owner_hint):
+def _rehydrate_ref(id_bytes: bytes, owner_hint, nonce=None):
     ref = ObjectRef(ObjectID(id_bytes), owner_hint)
     # Register the deserializing process as a borrower so the owner keeps
     # the object alive while this ref exists (reference: borrower tracking
-    # in reference_count.h).
+    # in reference_count.h). The nonce consumes this copy's escape pin.
     try:
         from ray_tpu.core.api import get_runtime_or_none
         rt = get_runtime_or_none()
         if rt is not None:
-            rt.on_ref_deserialized(ref)
+            rt.on_ref_deserialized(ref, nonce)
     except Exception:
         pass
     return ref

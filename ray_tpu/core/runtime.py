@@ -56,6 +56,18 @@ from ray_tpu.core.object_store import (
 from ray_tpu.core.serialization import SerializedObject
 
 
+def _wire_to_serialized(entry) -> SerializedObject:
+    """(data, buffers[, (ref_id_bytes, nonce) pairs]) wire tuple ->
+    SerializedObject. The optional third element carries nested
+    ObjectRef identities for container pinning."""
+    data, buffers = entry[0], entry[1]
+    refs = None
+    if len(entry) > 2 and entry[2]:
+        refs = [(ObjectID(b), n) for b, n in entry[2]]
+    return SerializedObject(data=data, buffers=list(buffers),
+                            contained_refs=refs)
+
+
 # --------------------------------------------------------------------------
 # Task/actor bookkeeping structures
 # --------------------------------------------------------------------------
@@ -375,15 +387,35 @@ class DriverRuntime:
         # Reference counting (driver-local; see object_ref docstring).
         # Three pins per object (reference: reference_count.h):
         #   _refcounts — owner-side live ObjectRef objects;
-        #   _escape_count — serialized copies in flight (pickle +1,
-        #     borrower deserialize -1); a copy that is never
+        #   _escape_nonces — serialized copies in flight, keyed by a
+        #     per-copy nonce (pickle adds it, exactly that copy's
+        #     materialization consumes it); a copy that is never
         #     deserialized pins forever (conservative);
+        #   _container_pins — refs nested inside stored objects,
+        #     held for the container's lifetime;
         #   _borrows — live borrower copies in other processes
         #     (deserialize +1, borrower GC -1).
         # Deletable only when all three are zero.
         self._refcounts: dict[ObjectID, int] = {}
-        self._escape_count: dict[ObjectID, int] = {}
+        # Escape (transit) pins keyed by per-copy nonce: a pickled
+        # copy pins the object until exactly THAT copy materializes
+        # (consuming its nonce) — a bare counter could consume pins
+        # belonging to unrelated in-flight copies.
+        self._escape_nonces: dict[ObjectID, set] = {}
+        # Nonces consumed before their escape notification arrived
+        # (cross-channel reordering: results ride the exec socket,
+        # escapes the client socket) — bounded memory of recent
+        # consumptions so the late escape doesn't pin forever.
+        self._preconsumed: set = set()
+        self._preconsumed_order: deque = deque(maxlen=8192)
         self._borrows: dict[ObjectID, int] = {}
+        # Container pinning (reference: nested refs in
+        # reference_count.h): a stored object pins every ObjectRef
+        # pickled inside it until the container itself is reclaimed,
+        # so a nested ref can be fetched any number of times
+        # regardless of borrower churn.
+        self._contains: dict[ObjectID, list[ObjectID]] = {}
+        self._container_pins: dict[ObjectID, int] = {}
         self._ref_lock = threading.Lock()
 
         # Task plane
@@ -470,14 +502,66 @@ class DriverRuntime:
 
     def _pinned_locked(self, oid: ObjectID) -> bool:
         return (self._refcounts.get(oid, 0) > 0
-                or self._escape_count.get(oid, 0) > 0
-                or self._borrows.get(oid, 0) > 0)
+                or bool(self._escape_nonces.get(oid))
+                or self._borrows.get(oid, 0) > 0
+                or self._container_pins.get(oid, 0) > 0)
+
+    def _consume_escape_locked(self, oid: ObjectID, nonce) -> None:
+        """Consume one copy's transit pin; remembers early
+        consumptions so a late-arriving escape is dropped."""
+        if nonce is None:
+            return
+        s = self._escape_nonces.get(oid)
+        if s is not None and nonce in s:
+            s.discard(nonce)
+            if not s:
+                self._escape_nonces.pop(oid, None)
+            return
+        if len(self._preconsumed_order) == \
+                self._preconsumed_order.maxlen:
+            self._preconsumed.discard(self._preconsumed_order[0])
+        self._preconsumed.add(nonce)
+        self._preconsumed_order.append(nonce)
 
     def _delete_object(self, oid: ObjectID) -> None:
         self.memory_store.delete(oid)
         self.shm_store.delete(oid)
         with self._obj_cv:
             self._obj_locations.pop(oid, None)
+        # Cascade: refs nested in this object lose their container
+        # pin; reclaim any that became unreferenced.
+        with self._ref_lock:
+            to_free = []
+            for rid in self._contains.pop(oid, ()):
+                c = self._container_pins.get(rid, 0) - 1
+                if c > 0:
+                    self._container_pins[rid] = c
+                else:
+                    self._container_pins.pop(rid, None)
+                    if not self._pinned_locked(rid):
+                        to_free.append(rid)
+        for rid in to_free:
+            self._delete_object(rid)
+
+    def _register_contained_refs(self, oid: ObjectID, obj) -> None:
+        refs = getattr(obj, "contained_refs", None)
+        if not refs:
+            return
+        with self._ref_lock:
+            # Re-stores (task retried / duplicate completion) MERGE:
+            # the retry blob may reference different inner ids, and
+            # whichever blob won the store must have its refs pinned —
+            # over-pinning both attempts until container delete is
+            # safe, dropping either is not.
+            self._contains.setdefault(oid, []).extend(
+                rid for rid, _n in refs)
+            for rid, nonce in refs:
+                self._container_pins[rid] = \
+                    self._container_pins.get(rid, 0) + 1
+                # The container pin supersedes this copy's transit
+                # (escape) pin — consume its nonce so a driver-side
+                # put of refs doesn't pin them forever.
+                self._consume_escape_locked(rid, nonce)
 
     def _dec_ref(self, oid: ObjectID) -> None:
         with self._ref_lock:
@@ -490,25 +574,30 @@ class DriverRuntime:
                 return
         self._delete_object(oid)
 
-    def on_ref_escaped(self, oid: ObjectID) -> None:
+    def on_ref_escaped(self, oid: ObjectID, nonce=None) -> None:
         """A copy of this ref was serialized out of the owner (task
-        arg, nested object, client return): pin until a borrower
-        materializes it (which transfers the pin to _borrows) — or
-        forever, if it never does."""
+        arg, nested object, client return): pin until that copy
+        materializes (transferring the pin to _borrows or a container
+        pin) — or forever, if it never does. A None nonce is a
+        deliberate permanent pin (e.g. results handed to a client
+        process that registers no borrows)."""
         with self._ref_lock:
-            self._escape_count[oid] = \
-                self._escape_count.get(oid, 0) + 1
+            if nonce is None:
+                import uuid
+                nonce = f"perm-{uuid.uuid4().hex}"
+            elif nonce in self._preconsumed:
+                # This copy already materialized (notification raced
+                # ahead on another channel) — nothing to pin.
+                self._preconsumed.discard(nonce)
+                return
+            self._escape_nonces.setdefault(oid, set()).add(nonce)
 
-    def on_borrow_add(self, oid: ObjectID) -> None:
-        """A borrower deserialized a copy: consume one in-flight
-        escape (clamped — retries may rehydrate the same blob twice)
-        and count the live copy."""
+    def on_borrow_add(self, oid: ObjectID, nonce=None) -> None:
+        """A borrower deserialized a copy: consume that copy's escape
+        pin (by nonce — rehydrating the same blob twice consumes it
+        once) and count the live copy."""
         with self._ref_lock:
-            esc = self._escape_count.get(oid, 0) - 1
-            if esc > 0:
-                self._escape_count[oid] = esc
-            else:
-                self._escape_count.pop(oid, None)
+            self._consume_escape_locked(oid, nonce)
             self._borrows[oid] = self._borrows.get(oid, 0) + 1
 
     def on_borrow_release(self, oid: ObjectID) -> None:
@@ -525,10 +614,14 @@ class DriverRuntime:
                 return
         self._delete_object(oid)
 
-    def on_ref_deserialized(self, ref: ObjectRef) -> None:
-        # Driver re-receiving one of its own refs: nothing to do; the
-        # object is pinned via _escaped.
-        pass
+    def on_ref_deserialized(self, ref: ObjectRef, nonce=None) -> None:
+        # Driver re-receiving one of its own refs: register a live
+        # refcount pin tied to THIS instance's lifetime — without it,
+        # a container-delete cascade could reclaim the object while
+        # the driver still holds the rehydrated ref. Deliberately no
+        # nonce consumption: the same blob may still be in flight to
+        # a worker.
+        self.register_ref(ref)
 
     def put(self, value) -> ObjectRef:
         oid = ObjectID.for_put(next(self._put_counter))
@@ -541,6 +634,7 @@ class DriverRuntime:
         return self.register_ref(ObjectRef(oid))
 
     def _store_value(self, oid: ObjectID, obj: SerializedObject) -> None:
+        self._register_contained_refs(oid, obj)
         if obj.total_size >= self.config.max_direct_call_object_size:
             self.shm_store.put(oid, obj)
             loc = "shm"
@@ -830,11 +924,9 @@ class DriverRuntime:
             st = self._streams.get(task_id)
         if st is None:
             # Stream was dropped: free the stored item everywhere it
-            # may live (large items land in shm, not memory_store).
-            self.memory_store.delete(oid)
-            self.shm_store.delete(oid)
-            with self._obj_cv:
-                self._obj_locations.pop(oid, None)
+            # may live (large items land in shm, not memory_store) —
+            # via _delete_object so nested-ref pins cascade.
+            self._delete_object(oid)
             return
         ref = self.register_ref(ObjectRef(oid))
         with st.cv:
@@ -1329,10 +1421,9 @@ class DriverRuntime:
             else:
                 self._finish_task(w, task_id, None, err_blob)
         elif kind == P.RESULT_STREAM:
-            _, task_id_bytes, index, (data, buffers) = msg
-            self._stream_item(
-                TaskID(task_id_bytes), index,
-                SerializedObject(data=data, buffers=list(buffers)))
+            _, task_id_bytes, index, entry = msg
+            self._stream_item(TaskID(task_id_bytes), index,
+                              _wire_to_serialized(entry))
         elif kind == P.RESULT_STREAM_END:
             _, task_id_bytes, _count = msg
             task_id = TaskID(task_id_bytes)
@@ -1355,8 +1446,7 @@ class DriverRuntime:
         if rec is None:
             return
         if err_blob is None:
-            vals = [SerializedObject(data=d, buffers=list(bufs))
-                    for d, bufs in results]
+            vals = [_wire_to_serialized(e) for e in results]
             for oid, v in zip(rec.return_ids, vals):
                 self._store_value(oid, v)
             rec.state = "FINISHED"
@@ -1663,8 +1753,7 @@ class DriverRuntime:
             return
         return_ids, _method = entry
         if err_blob is None:
-            vals = [SerializedObject(data=d, buffers=list(bufs))
-                    for d, bufs in results]
+            vals = [_wire_to_serialized(e) for e in results]
             for oid, v in zip(return_ids, vals):
                 self._store_value(oid, v)
         else:
@@ -2059,20 +2148,22 @@ class DriverRuntime:
                     # reply for fire-and-forget req_id -1.
                     try:
                         if isinstance(payload, tuple):
-                            action, oid_bytes = payload
+                            action, oid_bytes, *rest = payload
                         else:
-                            action, oid_bytes = "escape", payload
+                            action, oid_bytes, rest = \
+                                "escape", payload, ()
+                        nonce = rest[0] if rest else None
                         oid = ObjectID(oid_bytes)
                         if action == "add":
                             conn_borrows[oid] = \
                                 conn_borrows.get(oid, 0) + 1
-                            self.on_borrow_add(oid)
+                            self.on_borrow_add(oid, nonce)
                         elif action == "release":
                             if conn_borrows.get(oid, 0) > 0:
                                 conn_borrows[oid] -= 1
                             self.on_borrow_release(oid)
                         else:
-                            self.on_ref_escaped(oid)
+                            self.on_ref_escaped(oid, nonce)
                         if req_id != -1:
                             reply(req_id, P.ST_OK, None)
                     except BaseException as e:  # noqa: BLE001
@@ -2108,9 +2199,7 @@ class DriverRuntime:
                 self.on_ref_escaped(r.id)
             return [r.id.binary() for r in refs]
         if op == P.OP_PUT:
-            data, buffers = payload
-            ref = self.put_serialized(
-                SerializedObject(data=data, buffers=list(buffers)))
+            ref = self.put_serialized(_wire_to_serialized(payload))
             self.on_ref_escaped(ref.id)  # a remote process holds it
             return ref.id.binary()
         if op == P.OP_GET:
@@ -2189,16 +2278,17 @@ class DriverRuntime:
             return None
         if op == P.OP_BORROW:
             if isinstance(payload, tuple):
-                action, oid_bytes = payload
+                action, oid_bytes, *rest = payload
             else:                      # legacy single-oid form
-                action, oid_bytes = "escape", payload
+                action, oid_bytes, rest = "escape", payload, ()
+            nonce = rest[0] if rest else None
             oid = ObjectID(oid_bytes)
             if action == "add":
-                self.on_borrow_add(oid)
+                self.on_borrow_add(oid, nonce)
             elif action == "release":
                 self.on_borrow_release(oid)
             else:
-                self.on_ref_escaped(oid)
+                self.on_ref_escaped(oid, nonce)
             return None
         if op == P.OP_RESOURCES:
             return (self.available_resources(), self.cluster_resources())

@@ -29,11 +29,16 @@ class SerializedObject:
 
     ``data`` is the pickle bytestream; ``buffers`` are the PickleBuffer
     payloads (raw array memory). Total size is what the object store
-    accounts.
+    accounts. ``contained_refs`` lists the ObjectIDs of any ObjectRefs
+    pickled inside the payload — the owner pins those for the stored
+    object's lifetime (reference: nested-ref accounting in
+    reference_count.h), closing the gap where a ref stored inside an
+    object outlives its last live borrower.
     """
 
     data: bytes
     buffers: list[bytes]
+    contained_refs: list = None  # list[ObjectID] | None
 
     @property
     def total_size(self) -> int:
@@ -50,22 +55,44 @@ class _Pickler(cloudpickle.CloudPickler):
     runtime into a process that doesn't own it.
     """
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.contained_refs: list = []
+
     def reducer_override(self, obj):
         jax = sys.modules.get("jax")
         if jax is not None and isinstance(obj, jax.Array):
             import numpy as np
             return (_from_parts, (np.asarray(obj),))
+        from ray_tpu.core.object_ref import (
+            ObjectRef,
+            _escape_for_pickle,
+            _rehydrate_ref,
+        )
+        if isinstance(obj, ObjectRef):
+            # Record (id, nonce) so the store can transfer this copy's
+            # escape (transit) pin into a container pin.
+            nonce = _escape_for_pickle(obj)
+            self.contained_refs.append((obj.id, nonce))
+            return (_rehydrate_ref,
+                    (obj.id.binary(), obj._owner_hint, nonce))
         return NotImplemented
 
 
-def serialize(value) -> SerializedObject:
+def serialize(value, copy_buffers: bool = True) -> SerializedObject:
+    """``copy_buffers=False`` keeps out-of-band buffers as memoryviews
+    over the source arrays (valid while the value is alive) — callers
+    that immediately copy into their own destination (e.g. shm
+    channels) skip one full payload copy."""
     buffers: list[pickle.PickleBuffer] = []
     buf = io.BytesIO()
     pickler = _Pickler(buf, protocol=5, buffer_callback=buffers.append)
     pickler.dump(value)
     return SerializedObject(
         data=buf.getvalue(),
-        buffers=[b.raw().tobytes() for b in buffers],
+        buffers=[b.raw().tobytes() for b in buffers] if copy_buffers
+        else [b.raw() for b in buffers],
+        contained_refs=pickler.contained_refs or None,
     )
 
 

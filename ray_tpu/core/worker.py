@@ -118,7 +118,10 @@ class ClientRuntime:
 
     def put(self, value) -> ObjectRef:
         obj = ser.serialize(value)
-        oid_bytes = self._call(P.OP_PUT, (obj.data, obj.buffers))
+        oid_bytes = self._call(P.OP_PUT, (
+            obj.data, obj.buffers,
+            [(rid.binary(), n)
+             for rid, n in (obj.contained_refs or ())]))
         return ObjectRef(ObjectID(oid_bytes))
 
     def get_serialized(self, oid: ObjectID,
@@ -244,14 +247,15 @@ class ClientRuntime:
     def cancel(self, ref: ObjectRef, force: bool = False):
         self._call(P.OP_CANCEL, (ref.id.binary(), force))
 
-    def on_ref_escaped(self, oid: ObjectID):
-        self._call(P.OP_BORROW, ("escape", oid.binary()))
+    def on_ref_escaped(self, oid: ObjectID, nonce=None):
+        self._call(P.OP_BORROW, ("escape", oid.binary(), nonce))
 
-    def on_ref_deserialized(self, ref: ObjectRef):
+    def on_ref_deserialized(self, ref: ObjectRef, nonce=None):
         # Live borrower tracking (reference: reference_count.h
-        # borrowers): register this copy and release it on GC so the
-        # owner can reclaim the object once no borrower holds it.
-        self._notify(P.OP_BORROW, ("add", ref.id.binary()))
+        # borrowers): register this copy (consuming its nonce-keyed
+        # escape pin) and release it on GC so the owner can reclaim
+        # the object once no borrower holds it.
+        self._notify(P.OP_BORROW, ("add", ref.id.binary(), nonce))
         import weakref
         weakref.finalize(ref, self._notify, P.OP_BORROW,
                          ("release", ref.id.binary()))
@@ -352,7 +356,11 @@ def _serialize_returns(result, num_returns: int) -> list[tuple]:
     out = []
     for v in values:
         obj = ser.serialize(v)
-        out.append((obj.data, obj.buffers))
+        # Third element: nested ObjectRef ids, so the driver can
+        # container-pin them for the stored return's lifetime.
+        out.append((obj.data, obj.buffers,
+                    [(rid.binary(), n)
+                     for rid, n in (obj.contained_refs or ())]))
     return out
 
 
@@ -391,7 +399,9 @@ def worker_main(conn, client_address: str) -> None:
         for item in result:
             obj = ser.serialize(item)
             send((P.RESULT_STREAM, task_id_bytes, count,
-                  (obj.data, obj.buffers)))
+                  (obj.data, obj.buffers,
+                   [(rid.binary(), n)
+                    for rid, n in (obj.contained_refs or ())])))
             count += 1
         send((P.RESULT_STREAM_END, task_id_bytes, count))
 

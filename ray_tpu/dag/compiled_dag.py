@@ -233,6 +233,16 @@ class CompiledDAGRef:
         self._index = index
         self._taken = False
 
+    def __del__(self):
+        # A dropped, never-fetched ref must not leave its result
+        # buffered forever (the reference bounds this with
+        # max_buffered_results).
+        if not self._taken:
+            try:
+                self._dag._discard_result(self._index)
+            except Exception:  # noqa: BLE001
+                pass
+
     def get(self, timeout: float | None = None):
         if self._taken:
             raise ValueError(
@@ -515,6 +525,7 @@ class CompiledDAG:
         self._results: dict[int, Any] = {}
         self._local_inputs: dict[int, Any] = {}
         self._partial_vals: dict[int, Any] = {}
+        self._skipped: set[int] = set()   # dropped refs: don't buffer
         self._max_inflight = int(self._opts.get(
             "_max_inflight_executions", 1000))
 
@@ -649,6 +660,12 @@ class CompiledDAG:
                 vals[pkey] = (value, is_err)
             self._partial_vals = {}
             inp = self._local_inputs.pop(i, None)
+            if i in self._skipped:
+                # Dropped ref: drain the channel versions (ordering)
+                # but don't evaluate or buffer the output.
+                self._skipped.discard(i)
+                self._next_fetch += 1
+                continue
             outs = []
             first_err = None
             for tok in self._out_tokens:
@@ -666,6 +683,15 @@ class CompiledDAG:
         if tag == "err":
             raise value
         return value
+
+    def _discard_result(self, idx: int) -> None:
+        """A CompiledDAGRef was dropped without get(): free (or never
+        buffer) its output."""
+        if idx < self._next_fetch:
+            self._results.pop(idx, None)
+        else:
+            self._skipped.add(idx)
+        self._local_inputs.pop(idx, None)
 
     def teardown(self) -> None:
         """Close channels (stopping the actor loops), then kill actors

@@ -165,7 +165,8 @@ class GPT2(nn.Module):
         return constrain(x, self.mesh, "batch", "seq", None)
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.config
         B, T = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte",
@@ -191,6 +192,10 @@ class GPT2(nn.Module):
             x = self._constrain(x)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f", dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype)(x)
+        if return_hidden:
+            # Final hidden states for fused/chunked LM-head losses
+            # that never materialize the full (B, S, vocab) logits.
+            return x
         # Tied LM head: bf16 operands into the MXU, f32 accumulation
         # and f32 logits out. Operands are rounded to bf16 (small
         # precision trade, ~2^-8 relative) — accepted for full MXU

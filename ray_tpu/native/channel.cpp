@@ -20,6 +20,7 @@
 // header. Plain C ABI for ctypes (no pybind11 in this image).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <cerrno>
 #include <cstdio>
@@ -46,6 +47,7 @@ constexpr int kError = -4;
 struct ReaderSlot {
   int32_t pid;       // 0 = empty
   uint8_t used;
+  uint64_t start;    // /proc starttime of pid (guards pid reuse)
   uint64_t acked;    // last version this reader finished reading
 };
 
@@ -55,6 +57,7 @@ struct ChanHeader {
   pthread_mutex_t mutex;
   pthread_cond_t cv;
   int32_t writer_pid;
+  uint64_t writer_start;  // starttime of writer_pid
   uint32_t closed;
   uint64_t capacity;   // payload capacity in bytes
   uint64_t size;       // payload size of the current version
@@ -71,19 +74,36 @@ struct Chan {
   char name[256];
 };
 
-bool chan_pid_alive(int32_t pid) {
+// Returns the process's /proc starttime (field 22), or 0 when the
+// process is dead/zombie. Pairing (pid, starttime) defeats pid
+// reuse: a recycled pid has a different starttime, so a dead reader
+// or writer is still detected.
+uint64_t chan_proc_start(int32_t pid) {
   char path[64];
   std::snprintf(path, sizeof(path), "/proc/%d/stat", pid);
   FILE* f = std::fopen(path, "r");
-  if (f == nullptr) return false;
-  char buf[512];
+  if (f == nullptr) return 0;
+  char buf[1024];
   size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
   std::fclose(f);
   buf[n] = '\0';
   const char* p = std::strrchr(buf, ')');
-  if (p == nullptr || p[1] == '\0') return false;
+  if (p == nullptr || p[1] == '\0') return 0;
   char state = p[2] == '\0' ? p[1] : p[2];
-  return state != 'Z' && state != 'X';
+  if (state == 'Z' || state == 'X') return 0;
+  // p points at ")"; fields after it are state(3) ... starttime(22):
+  // skip 20 space-separated fields after the state.
+  const char* q = p + 2;
+  for (int field = 3; field < 22; ++field) {
+    q = std::strchr(q + 1, ' ');
+    if (q == nullptr) return 0;
+  }
+  return std::strtoull(q + 1, nullptr, 10);
+}
+
+bool chan_proc_alive(int32_t pid, uint64_t start) {
+  uint64_t now = chan_proc_start(pid);
+  return now != 0 && now == start;
 }
 
 void chan_lock(ChanHeader* h) {
@@ -119,7 +139,7 @@ bool reap_dead_readers(ChanHeader* h) {
   bool changed = false;
   for (uint32_t i = 0; i < kMaxReaders; ++i) {
     ReaderSlot* r = &h->readers[i];
-    if (r->used && !chan_pid_alive(r->pid)) {
+    if (r->used && !chan_proc_alive(r->pid, r->start)) {
       r->used = 0;
       r->pid = 0;
       changed = true;
@@ -163,6 +183,7 @@ void* chn_create(const char* name, uint64_t capacity) {
   h->magic = kChanMagic;
   h->capacity = capacity;
   h->writer_pid = static_cast<int32_t>(getpid());
+  h->writer_start = chan_proc_start(h->writer_pid);
 
   pthread_mutexattr_t mattr;
   pthread_mutexattr_init(&mattr);
@@ -244,6 +265,7 @@ int chn_reader_register(void* handle) {
   if (slot >= 0) {
     ReaderSlot* r = &h->readers[slot];
     r->pid = static_cast<int32_t>(getpid());
+    r->start = chan_proc_start(r->pid);
     r->used = 1;
     r->acked = h->version;
   }
@@ -263,10 +285,12 @@ void chn_reader_unregister(void* handle, int slot) {
   pthread_mutex_unlock(&h->mutex);
 }
 
-// Publish a new version. Blocks until all registered readers acked
-// the previous one. timeout_ms < 0 = wait forever.
-int chn_write(void* handle, const uint8_t* data, uint64_t size,
-              int64_t timeout_ms) {
+// Acquire the payload region for an in-place write: blocks until all
+// registered readers acked the previous version. With the
+// single-writer discipline the caller may then fill the payload
+// WITHOUT holding the lock (readers only touch it after commit bumps
+// the version). timeout_ms < 0 = wait forever.
+int chn_write_begin(void* handle, uint64_t size, int64_t timeout_ms) {
   Chan* c = static_cast<Chan*>(handle);
   ChanHeader* h = c->h;
   if (size > h->capacity) return kTooLarge;
@@ -286,11 +310,29 @@ int chn_write(void* handle, const uint8_t* data, uint64_t size,
     }
     chan_wait(h, 100);
   }
-  std::memcpy(c->base + sizeof(ChanHeader), data, size);
+  pthread_mutex_unlock(&h->mutex);
+  return kOk;
+}
+
+// Publish the payload written after chn_write_begin.
+void chn_write_commit(void* handle, uint64_t size) {
+  Chan* c = static_cast<Chan*>(handle);
+  ChanHeader* h = c->h;
+  chan_lock(h);
   h->size = size;
   h->version++;
   pthread_cond_broadcast(&h->cv);
   pthread_mutex_unlock(&h->mutex);
+}
+
+// One-shot copying write (begin + memcpy + commit).
+int chn_write(void* handle, const uint8_t* data, uint64_t size,
+              int64_t timeout_ms) {
+  Chan* c = static_cast<Chan*>(handle);
+  int rc = chn_write_begin(handle, size, timeout_ms);
+  if (rc != kOk) return rc;
+  std::memcpy(c->base + sizeof(ChanHeader), data, size);
+  chn_write_commit(handle, size);
   return kOk;
 }
 
@@ -312,7 +354,8 @@ int chn_read_begin(void* handle, int slot, uint64_t* size,
       return kError;
     }
     if (h->version > r->acked) break;
-    if (h->closed || !chan_pid_alive(h->writer_pid)) {
+    if (h->closed ||
+        !chan_proc_alive(h->writer_pid, h->writer_start)) {
       pthread_mutex_unlock(&h->mutex);
       return kClosed;
     }
@@ -359,6 +402,7 @@ void chn_claim_writer(void* handle) {
   ChanHeader* h = c->h;
   chan_lock(h);
   h->writer_pid = static_cast<int32_t>(getpid());
+  h->writer_start = chan_proc_start(h->writer_pid);
   pthread_cond_broadcast(&h->cv);
   pthread_mutex_unlock(&h->mutex);
 }

@@ -61,6 +61,12 @@ def _load():
         lib.chn_write.restype = ctypes.c_int
         lib.chn_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_uint64, ctypes.c_int64]
+        lib.chn_write_begin.restype = ctypes.c_int
+        lib.chn_write_begin.argtypes = [ctypes.c_void_p,
+                                        ctypes.c_uint64,
+                                        ctypes.c_int64]
+        lib.chn_write_commit.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_uint64]
         lib.chn_read_begin.restype = ctypes.c_int
         lib.chn_read_begin.argtypes = [
             ctypes.c_void_p, ctypes.c_int,
@@ -130,39 +136,44 @@ class Channel:
 
     def write(self, value, timeout: float | None = None,
               _is_error: bool = False) -> None:
-        obj = ser.serialize(value)
+        # One copy total: serialize keeps out-of-band buffers as views
+        # over the source arrays; after write_begin grants the payload
+        # region (all readers acked — single-writer, so no lock is
+        # needed while filling it), the frame is assembled directly in
+        # the mapped shm and committed.
+        obj = ser.serialize(value, copy_buffers=False)
         size = _frame_size(obj)
         cap = self._lib.chn_capacity(self._h)
         if size > cap:
             raise ValueError(
                 f"serialized value ({size} B) exceeds channel buffer "
                 f"({cap} B); pass a larger buffer_size at compile/create")
-        buf = bytearray(size)
-        buf[0] = 1 if _is_error else 0
-        pos = 1
-        struct.pack_into("<Q", buf, pos, len(obj.data))
-        pos += 8
-        buf[pos:pos + len(obj.data)] = obj.data
-        pos += len(obj.data)
-        struct.pack_into("<Q", buf, pos, len(obj.buffers))
-        pos += 8
-        for b in obj.buffers:
-            struct.pack_into("<Q", buf, pos, len(b))
-            pos += 8
-            buf[pos:pos + len(b)] = b
-            pos += len(b)
         tmo = -1 if timeout is None else int(timeout * 1000)
-        # Zero-copy into the native memcpy: hand the bytearray's buffer
-        # over directly instead of materializing an extra bytes copy.
-        cbuf = (ctypes.c_char * size).from_buffer(buf)
-        rc = self._lib.chn_write(self._h, cbuf, size, tmo)
-        del cbuf
+        rc = self._lib.chn_write_begin(self._h, size, tmo)
         if rc == _CLOSED:
             raise ChannelClosedError(self.name)
         if rc == _TIMEOUT:
             raise ChannelTimeoutError(f"write to {self.name} timed out")
         if rc != _OK:
             raise OSError(f"channel write failed (rc={rc})")
+        base = self._lib.chn_data_ptr(self._h)
+        addr = ctypes.addressof(base.contents)
+        dst = memoryview((ctypes.c_uint8 * size).from_address(addr))\
+            .cast("B")
+        dst[0] = 1 if _is_error else 0
+        pos = 1
+        struct.pack_into("<Q", dst, pos, len(obj.data))
+        pos += 8
+        dst[pos:pos + len(obj.data)] = obj.data
+        pos += len(obj.data)
+        struct.pack_into("<Q", dst, pos, len(obj.buffers))
+        pos += 8
+        for b in obj.buffers:
+            struct.pack_into("<Q", dst, pos, len(b))
+            pos += 8
+            dst[pos:pos + len(b)] = b
+            pos += len(b)
+        self._lib.chn_write_commit(self._h, size)
 
     def write_error(self, exc: BaseException,
                     timeout: float | None = None) -> None:
