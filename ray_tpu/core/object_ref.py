@@ -19,6 +19,11 @@ if TYPE_CHECKING:
     from ray_tpu.core.runtime import Runtime
 
 _nonce_counter = itertools.count()
+# Random per-process token: (pid, counter) alone collides when a pid
+# is recycled across worker restarts (both could emit "1234-0" for
+# the same object and the owner's nonce set would dedupe two live
+# pins into one).
+_PROC_TOKEN = os.urandom(6).hex()
 
 
 def _new_nonce() -> str:
@@ -27,7 +32,7 @@ def _new_nonce() -> str:
     other — consumes the pin when it materializes (reference: per-copy
     borrower identity in reference_count.h, vs. a bare counter that
     can consume pins belonging to unrelated in-flight copies)."""
-    return f"{os.getpid()}-{next(_nonce_counter)}"
+    return f"{os.getpid()}-{_PROC_TOKEN}-{next(_nonce_counter)}"
 
 
 def _escape_for_pickle(ref: "ObjectRef") -> str | None:

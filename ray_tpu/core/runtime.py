@@ -517,6 +517,13 @@ class DriverRuntime:
             if not s:
                 self._escape_nonces.pop(oid, None)
             return
+        if nonce in self._preconsumed:
+            # Already recorded (e.g. a stored blob re-deserialized many
+            # times re-submits its long-consumed nonces): keep the one
+            # entry instead of flooding the window — and never append
+            # deque duplicates, whose eviction would drop the set entry
+            # while a newer copy is still queued.
+            return
         if len(self._preconsumed_order) == \
                 self._preconsumed_order.maxlen:
             self._preconsumed.discard(self._preconsumed_order[0])

@@ -96,6 +96,14 @@ def serialize(value, copy_buffers: bool = True) -> SerializedObject:
     )
 
 
+def to_wire(obj: SerializedObject) -> tuple:
+    """Wire tuple (data, buffers, [(ref_id_bytes, nonce)...]) — the
+    encoder matching runtime._wire_to_serialized."""
+    return (obj.data, obj.buffers,
+            [(rid.binary(), n)
+             for rid, n in (obj.contained_refs or ())])
+
+
 def _from_parts(np_arr):
     return np_arr
 

@@ -118,10 +118,7 @@ class ClientRuntime:
 
     def put(self, value) -> ObjectRef:
         obj = ser.serialize(value)
-        oid_bytes = self._call(P.OP_PUT, (
-            obj.data, obj.buffers,
-            [(rid.binary(), n)
-             for rid, n in (obj.contained_refs or ())]))
+        oid_bytes = self._call(P.OP_PUT, ser.to_wire(obj))
         return ObjectRef(ObjectID(oid_bytes))
 
     def get_serialized(self, oid: ObjectID,
@@ -353,15 +350,9 @@ def _serialize_returns(result, num_returns: int) -> list[tuple]:
             raise ValueError(
                 f"declared num_returns={num_returns} but returned "
                 f"{len(values)} values")
-    out = []
-    for v in values:
-        obj = ser.serialize(v)
-        # Third element: nested ObjectRef ids, so the driver can
-        # container-pin them for the stored return's lifetime.
-        out.append((obj.data, obj.buffers,
-                    [(rid.binary(), n)
-                     for rid, n in (obj.contained_refs or ())]))
-    return out
+    # to_wire's third element carries nested ObjectRef ids, so the
+    # driver can container-pin them for the stored return's lifetime.
+    return [ser.to_wire(ser.serialize(v)) for v in values]
 
 
 def _run_maybe_async(fn, args, kwargs):
@@ -399,9 +390,7 @@ def worker_main(conn, client_address: str) -> None:
         for item in result:
             obj = ser.serialize(item)
             send((P.RESULT_STREAM, task_id_bytes, count,
-                  (obj.data, obj.buffers,
-                   [(rid.binary(), n)
-                    for rid, n in (obj.contained_refs or ())])))
+                  ser.to_wire(obj)))
             count += 1
         send((P.RESULT_STREAM_END, task_id_bytes, count))
 

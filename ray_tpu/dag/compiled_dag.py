@@ -526,6 +526,13 @@ class CompiledDAG:
         self._local_inputs: dict[int, Any] = {}
         self._partial_vals: dict[int, Any] = {}
         self._skipped: set[int] = set()   # dropped refs: don't buffer
+        import threading as _t2
+        # _book_lock: results/_skipped/_next_fetch vs. __del__-driven
+        # discards (GC runs on arbitrary threads). _drain_lock:
+        # serializes whole drain passes — output-channel reads are
+        # strictly ordered, so two threads must not interleave them.
+        self._book_lock = _t2.Lock()
+        self._drain_lock = _t2.Lock()
         self._max_inflight = int(self._opts.get(
             "_max_inflight_executions", 1000))
 
@@ -645,53 +652,67 @@ class CompiledDAG:
     def _fetch_result(self, idx: int, timeout: float | None = None):
         """Drain output-channel versions up to execution ``idx`` (reads
         are strictly ordered: version v ↔ execution v-1)."""
-        while self._next_fetch <= idx:
-            if self._torn_down:
-                raise RuntimeError("compiled DAG has been torn down")
-            i = self._next_fetch
-            # Partial reads survive a timeout in _partial_vals so a
-            # retry never re-reads an already-acked channel (which
-            # would cross outputs between executions).
-            vals = self._partial_vals
-            for pkey, ch in self._out_channels.items():
-                if pkey in vals:
-                    continue
-                value, is_err = ch.begin_read(timeout, copy=True)
-                vals[pkey] = (value, is_err)
-            self._partial_vals = {}
-            inp = self._local_inputs.pop(i, None)
-            if i in self._skipped:
-                # Dropped ref: drain the channel versions (ordering)
-                # but don't evaluate or buffer the output.
-                self._skipped.discard(i)
-                self._next_fetch += 1
-                continue
-            outs = []
-            first_err = None
-            for tok in self._out_tokens:
-                v, e = _eval_token(tok, vals, inp)
-                if e is not None and first_err is None:
-                    first_err = e
-                outs.append(v)
-            if first_err is not None:
-                self._results[i] = ("err", first_err)
-            else:
-                self._results[i] = (
-                    "ok", outs if self._multi_output else outs[0])
-            self._next_fetch += 1
-        tag, value = self._results.pop(idx)
+        with self._drain_lock:
+            while self._next_fetch <= idx:
+                if self._torn_down:
+                    raise RuntimeError(
+                        "compiled DAG has been torn down")
+                i = self._next_fetch
+                # Partial reads survive a timeout in _partial_vals so
+                # a retry never re-reads an already-acked channel
+                # (which would cross outputs between executions).
+                vals = self._partial_vals
+                for pkey, ch in self._out_channels.items():
+                    if pkey in vals:
+                        continue
+                    value, is_err = ch.begin_read(timeout, copy=True)
+                    vals[pkey] = (value, is_err)
+                self._partial_vals = {}
+                inp = self._local_inputs.pop(i, None)
+                with self._book_lock:
+                    if i in self._skipped:
+                        # Dropped ref: drain the channel versions
+                        # (ordering) but don't buffer the output.
+                        self._skipped.discard(i)
+                        self._next_fetch += 1
+                        continue
+                    buffer_it = True
+                if buffer_it:
+                    outs = []
+                    first_err = None
+                    for tok in self._out_tokens:
+                        v, e = _eval_token(tok, vals, inp)
+                        if e is not None and first_err is None:
+                            first_err = e
+                        outs.append(v)
+                    with self._book_lock:
+                        if i in self._skipped:
+                            # Dropped while we were evaluating.
+                            self._skipped.discard(i)
+                        elif first_err is not None:
+                            self._results[i] = ("err", first_err)
+                        else:
+                            self._results[i] = (
+                                "ok",
+                                outs if self._multi_output else outs[0])
+                        self._next_fetch += 1
+        with self._book_lock:
+            tag, value = self._results.pop(idx)
         if tag == "err":
             raise value
         return value
 
     def _discard_result(self, idx: int) -> None:
         """A CompiledDAGRef was dropped without get(): free (or never
-        buffer) its output."""
-        if idx < self._next_fetch:
-            self._results.pop(idx, None)
-        else:
-            self._skipped.add(idx)
-        self._local_inputs.pop(idx, None)
+        buffer) its output. Runs from __del__ on arbitrary threads —
+        takes only the bookkeeping lock (never the drain lock, which
+        can be held across blocking channel reads)."""
+        with self._book_lock:
+            if idx in self._results:
+                self._results.pop(idx, None)
+            elif idx >= self._next_fetch:
+                self._skipped.add(idx)
+            self._local_inputs.pop(idx, None)
 
     def teardown(self) -> None:
         """Close channels (stopping the actor loops), then kill actors

