@@ -652,6 +652,15 @@ class CompiledDAG:
     def _fetch_result(self, idx: int, timeout: float | None = None):
         """Drain output-channel versions up to execution ``idx`` (reads
         are strictly ordered: version v ↔ execution v-1)."""
+        # Fast path: already drained by another thread — don't queue
+        # behind a drain that may be blocking on a later execution.
+        with self._book_lock:
+            entry = self._results.pop(idx, None)
+        if entry is not None:
+            tag, value = entry
+            if tag == "err":
+                raise value
+            return value
         with self._drain_lock:
             while self._next_fetch <= idx:
                 if self._torn_down:

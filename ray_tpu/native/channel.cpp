@@ -35,6 +35,11 @@
 namespace {
 
 constexpr uint32_t kChanMagic = 0x52544348;  // "RTCH"
+// Bump on ANY ChanHeader/ReaderSlot layout change: attach refuses a
+// mismatched segment instead of reading it through the wrong struct
+// (processes in one session can otherwise load differently-built
+// .so's against the same shm).
+constexpr uint32_t kLayoutVersion = 2;
 constexpr uint32_t kMaxReaders = 16;
 
 // Return codes (match channel.py).
@@ -181,6 +186,7 @@ void* chn_create(const char* name, uint64_t capacity) {
   ChanHeader* h = static_cast<ChanHeader*>(mem);
   std::memset(h, 0, sizeof(ChanHeader));
   h->magic = kChanMagic;
+  h->flags = kLayoutVersion;
   h->capacity = capacity;
   h->writer_pid = static_cast<int32_t>(getpid());
   h->writer_start = chan_proc_start(h->writer_pid);
@@ -224,7 +230,7 @@ void* chn_attach(const char* name) {
     return nullptr;
   }
   ChanHeader* h = static_cast<ChanHeader*>(mem);
-  if (h->magic != kChanMagic) {
+  if (h->magic != kChanMagic || h->flags != kLayoutVersion) {
     munmap(mem, static_cast<size_t>(st.st_size));
     close(fd);
     return nullptr;

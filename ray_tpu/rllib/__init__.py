@@ -10,11 +10,14 @@ propagation.
 """
 
 from ray_tpu.rllib.algorithm import AlgorithmConfig, PPO, PPOConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.bc import BC, BCConfig
+from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup, Episode
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.multi_agent import (
     MultiAgentEnvRunner, MultiAgentPPO, MultiAgentPPOConfig,
 )
@@ -22,8 +25,10 @@ from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = [
     "AlgorithmConfig", "PPO", "PPOConfig",
-    "BC", "BCConfig", "DQN", "DQNConfig", "ReplayBuffer",
-    "Impala", "ImpalaConfig", "SAC", "SACConfig",
+    "APPO", "APPOConfig", "BC", "BCConfig", "CQL", "CQLConfig",
+    "DQN", "DQNConfig", "ReplayBuffer",
+    "Impala", "ImpalaConfig", "MARWIL", "MARWILConfig",
+    "SAC", "SACConfig",
     "EnvRunner", "EnvRunnerGroup", "Episode", "JaxLearner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnvRunner",
 ]

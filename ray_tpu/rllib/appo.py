@@ -1,0 +1,54 @@
+"""APPO — asynchronous PPO (PPO surrogate on the IMPALA architecture).
+
+Reference analog: rllib/algorithms/appo/ — env runners sample with
+stale weights (decoupled via ``broadcast_interval`` like IMPALA), the
+learner corrects off-policyness with V-trace, and the policy update
+uses PPO's clipped surrogate on the V-trace advantages instead of
+IMPALA's plain importance-weighted policy gradient. Everything except
+the policy-gradient term (batching, reverse-scan V-trace, bootstrap
+handling, the training driver) is inherited from
+:class:`ImpalaLearner` / :class:`Impala`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.impala import (
+    Impala,
+    ImpalaConfig,
+    ImpalaHyperparams,
+    ImpalaLearner,
+)
+
+
+@dataclass
+class APPOHyperparams(ImpalaHyperparams):
+    clip_param: float = 0.2         # PPO surrogate clip
+    optimizer: str = "adam"         # small-batch default
+
+
+class APPOLearner(ImpalaLearner):
+    def _policy_loss(self, t: dict):
+        """PPO clipped surrogate on the V-trace advantages — the APPO
+        difference from IMPALA's rho*logp gradient."""
+        hp = self.hp
+        surr1 = t["rho"] * t["adv"]
+        surr2 = jnp.clip(t["rho"], 1 - hp.clip_param,
+                         1 + hp.clip_param) * t["adv"]
+        return -(jnp.minimum(surr1, surr2)
+                 * t["mask"]).sum() / t["denom"]
+
+
+@dataclass
+class APPOConfig(ImpalaConfig):
+    hparams: APPOHyperparams = field(default_factory=APPOHyperparams)
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(Impala):
+    learner_cls = APPOLearner

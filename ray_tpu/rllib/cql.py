@@ -1,0 +1,262 @@
+"""CQL — conservative Q-learning for offline continuous control.
+
+Reference analog: rllib/algorithms/cql/ — SAC trained purely from a
+logged transition dataset, with a conservative penalty that pushes
+down Q-values on out-of-distribution actions:
+
+    penalty = logsumexp_a Q(s, a) - Q(s, a_data)
+
+estimated over a mixture of uniform-random and current-policy action
+samples with importance correction (CQL(H), Kumar et al. 2020). This
+keeps the learned Q from overestimating actions the dataset never
+took — the failure mode of running vanilla SAC offline. TPU-first
+shape: actor, twin-critic (Bellman + penalty), and temperature
+updates are ONE jitted program per minibatch.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.models import (
+    ContinuousConfig, SquashedGaussianActor, TwinQ,
+)
+
+
+@dataclass
+class CQLHyperparams:
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    init_alpha: float = 0.1
+    min_q_weight: float = 5.0       # conservative penalty scale
+    num_penalty_actions: int = 10   # samples per (random, policy) set
+    train_batch_size: int = 256
+    num_gradient_steps: int = 16
+    bc_warmup_steps: int = 0        # actor BC steps before SAC loss
+
+
+class CQLLearner:
+    def __init__(self, policy_config: dict, hp: CQLHyperparams,
+                 seed: int = 0):
+        self.hp = hp
+        cfg = ContinuousConfig(**policy_config)
+        self.action_dim = cfg.action_dim
+        self.actor = SquashedGaussianActor(cfg)
+        self.critic = TwinQ(cfg)
+        ka, kc = jax.random.split(jax.random.key(seed))
+        self.actor_params = self.actor.init_params(ka)
+        self.critic_params = self.critic.init_params(kc)
+        self.target_critic_params = jax.tree.map(
+            jnp.copy, self.critic_params)
+        self.log_alpha = jnp.log(jnp.asarray(hp.init_alpha))
+        self.target_entropy = -float(cfg.action_dim)
+        self.actor_opt = optax.adam(hp.actor_lr)
+        self.critic_opt = optax.adam(hp.critic_lr)
+        self.alpha_opt = optax.adam(hp.alpha_lr)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.critic_opt_state = self.critic_opt.init(
+            self.critic_params)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self.steps = 0
+        self._step = jax.jit(self._step_fn, static_argnames=("bc",))
+
+    # -- penalty helper: Q over N sampled actions per state ----------
+
+    def _q_samples(self, critic_p, obs, actions):
+        """Q1/Q2 for (B, N, A) actions -> (B, N) each."""
+        B, N, A = actions.shape
+        obs_rep = jnp.repeat(obs, N, axis=0)
+        flat = actions.reshape(B * N, A)
+        q1, q2 = self.critic.apply({"params": critic_p}, obs_rep, flat)
+        return q1.reshape(B, N), q2.reshape(B, N)
+
+    def _step_fn(self, actor_p, critic_p, target_p, log_alpha,
+                 actor_os, critic_os, alpha_os, batch, key,
+                 bc: bool):
+        hp = self.hp
+        alpha = jnp.exp(log_alpha)
+        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+        B = batch["obs"].shape[0]
+        N = hp.num_penalty_actions
+
+        # -- critic: soft Bellman target (data actions only) --
+        mu_n, lstd_n = self.actor.apply({"params": actor_p},
+                                        batch["next_obs"])
+        a_next, logp_next = SquashedGaussianActor.sample(
+            mu_n, lstd_n, k1)
+        q1_t, q2_t = self.critic.apply({"params": target_p},
+                                       batch["next_obs"], a_next)
+        q_target = jnp.minimum(q1_t, q2_t) - alpha * logp_next
+        y = batch["rewards"] + hp.gamma * (1 - batch["dones"]) * \
+            jax.lax.stop_gradient(q_target)
+
+        # Penalty action sets (sampled outside the loss; the penalty
+        # differentiates through Q only, like the reference).
+        a_rand = jax.random.uniform(k2, (B, N, self.action_dim),
+                                    minval=-1.0, maxval=1.0)
+        mu_c, lstd_c = self.actor.apply({"params": actor_p},
+                                        batch["obs"])
+        a_pi, logp_pi = SquashedGaussianActor.sample(
+            jnp.repeat(mu_c, N, 0), jnp.repeat(lstd_c, N, 0), k3)
+        a_pi = a_pi.reshape(B, N, self.action_dim)
+        logp_pi = jax.lax.stop_gradient(logp_pi.reshape(B, N))
+        # log density of uniform over [-1,1]^A for the IS correction
+        log_unif = -self.action_dim * jnp.log(2.0)
+
+        def critic_loss_fn(p):
+            q1, q2 = self.critic.apply({"params": p}, batch["obs"],
+                                       batch["actions"])
+            bellman = ((q1 - y) ** 2 + (q2 - y) ** 2).mean()
+            q1_r, q2_r = self._q_samples(p, batch["obs"], a_rand)
+            q1_p, q2_p = self._q_samples(p, batch["obs"], a_pi)
+            # CQL(H): importance-corrected logsumexp over the mixture.
+            cat1 = jnp.concatenate(
+                [q1_r - log_unif, q1_p - logp_pi], axis=1)
+            cat2 = jnp.concatenate(
+                [q2_r - log_unif, q2_p - logp_pi], axis=1)
+            lse1 = jax.scipy.special.logsumexp(cat1, axis=1) \
+                - jnp.log(2 * N)
+            lse2 = jax.scipy.special.logsumexp(cat2, axis=1) \
+                - jnp.log(2 * N)
+            penalty = ((lse1 - q1) + (lse2 - q2)).mean()
+            return bellman + hp.min_q_weight * penalty, \
+                (bellman, penalty)
+
+        (c_loss, (bellman, penalty)), c_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True)(critic_p)
+        c_updates, critic_os = self.critic_opt.update(
+            c_grads, critic_os, critic_p)
+        critic_p = optax.apply_updates(critic_p, c_updates)
+
+        # -- actor: SAC objective, or BC warmup toward data actions --
+        def actor_loss_fn(p):
+            mu, lstd = self.actor.apply({"params": p}, batch["obs"])
+            a, logp = SquashedGaussianActor.sample(mu, lstd, k4)
+            if bc:
+                bc_err = ((jnp.tanh(mu) - batch["actions"]) ** 2)\
+                    .sum(-1).mean()
+                return (alpha * logp).mean() + bc_err, logp.mean()
+            q1, q2 = self.critic.apply({"params": critic_p},
+                                       batch["obs"], a)
+            q = jnp.minimum(q1, q2)
+            return (alpha * logp - q).mean(), logp.mean()
+
+        (a_loss, mean_logp), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True)(actor_p)
+        a_updates, actor_os = self.actor_opt.update(
+            a_grads, actor_os, actor_p)
+        actor_p = optax.apply_updates(actor_p, a_updates)
+
+        # -- temperature --
+        def alpha_loss_fn(la):
+            return -(jnp.exp(la) * jax.lax.stop_gradient(
+                mean_logp + self.target_entropy))
+
+        al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+        al_updates, alpha_os = self.alpha_opt.update(
+            al_grad, alpha_os, log_alpha)
+        log_alpha = optax.apply_updates(log_alpha, al_updates)
+
+        target_p = jax.tree.map(
+            lambda t, o: (1 - hp.tau) * t + hp.tau * o,
+            target_p, critic_p)
+        metrics = {"critic_loss": c_loss, "bellman_loss": bellman,
+                   "cql_penalty": penalty, "actor_loss": a_loss,
+                   "alpha": jnp.exp(log_alpha)}
+        return (actor_p, critic_p, target_p, log_alpha,
+                actor_os, critic_os, alpha_os, metrics)
+
+    def update(self, batch: dict[str, np.ndarray], key) -> dict:
+        mb = {k: jnp.asarray(v) for k, v in batch.items()}
+        bc = self.steps < self.hp.bc_warmup_steps
+        self.steps += 1
+        (self.actor_params, self.critic_params,
+         self.target_critic_params, self.log_alpha,
+         self.actor_opt_state, self.critic_opt_state,
+         self.alpha_opt_state, metrics) = self._step(
+            self.actor_params, self.critic_params,
+            self.target_critic_params, self.log_alpha,
+            self.actor_opt_state, self.critic_opt_state,
+            self.alpha_opt_state, mb, key, bc=bc)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.actor_params)
+
+
+@dataclass
+class CQLConfig:
+    dataset: Any = None
+    policy_config: dict = field(default_factory=dict)
+    hparams: CQLHyperparams = field(default_factory=CQLHyperparams)
+    seed: int = 0
+
+    def environment(self, *, obs_dim: int, action_dim: int,
+                    hidden: tuple = (64, 64)) -> "CQLConfig":
+        return replace(self, policy_config={
+            "obs_dim": obs_dim, "action_dim": action_dim,
+            "hidden": hidden})
+
+    def offline_data(self, dataset) -> "CQLConfig":
+        """Dataset columns: obs, action (float rows), reward,
+        next_obs, done — logged transitions."""
+        return replace(self, dataset=dataset)
+
+    def training(self, **hp_overrides) -> "CQLConfig":
+        return replace(self, hparams=replace(self.hparams,
+                                             **hp_overrides))
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    def __init__(self, config: CQLConfig):
+        assert config.dataset is not None, "call .offline_data(ds)"
+        assert config.policy_config, "call .environment(...)"
+        self.config = config
+        self.learner = CQLLearner(config.policy_config,
+                                  config.hparams, seed=config.seed)
+        self.rng = np.random.default_rng(config.seed)
+        self._key = jax.random.key(config.seed + 1)
+        self.iteration = 0
+        batches = list(config.dataset.iter_batches())
+
+        def col(name, dtype=np.float32):
+            return np.concatenate(
+                [np.asarray(b[name], dtype) for b in batches])
+
+        self._data = {
+            "obs": col("obs"), "actions": col("action"),
+            "rewards": col("reward"), "next_obs": col("next_obs"),
+            "dones": col("done"),
+        }
+
+    def train(self) -> dict:
+        hp = self.config.hparams
+        t0 = time.time()
+        metrics: dict = {}
+        n = len(self._data["obs"])
+        for _ in range(hp.num_gradient_steps):
+            idx = self.rng.integers(0, n, hp.train_batch_size)
+            self._key, sub = jax.random.split(self._key)
+            metrics = self.learner.update(
+                {k: v[idx] for k, v in self._data.items()}, sub)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "num_samples": n,
+                "time_learn_s": round(time.time() - t0, 3),
+                **metrics}
+
+    def stop(self) -> None:
+        pass

@@ -56,66 +56,82 @@ class ImpalaLearner:
         self.opt_state = self.opt.init(self.params)
         self._update = jax.jit(self._update_fn, donate_argnums=(0, 1))
 
+    def _vtrace_terms(self, p, batch) -> dict:
+        """Shared V-trace machinery (forward pass, truncated-IS value
+        targets via reverse scan, advantages) — used by both the
+        IMPALA loss and APPO's clipped-surrogate loss so the subtle
+        padding/bootstrap handling lives in ONE place."""
+        hp = self.hp
+        B, T = batch["actions"].shape
+        obs = batch["obs"].reshape(B * T, -1)
+        logits, values = self.model.apply({"params": p}, obs)
+        logits = logits.reshape(B, T, -1)
+        values = values.reshape(B, T)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        rho = jnp.exp(logp - batch["behavior_logp"])
+        rho_c = jnp.minimum(hp.rho_bar, rho)
+        c = jnp.minimum(hp.c_bar, rho)
+        mask = batch["mask"]
+        discounts = hp.gamma * (1.0 - batch["dones"]) * mask
+
+        # bootstrap: V(x_{t+1}), with V(final_obs) injected at
+        # each episode's LAST REAL step (episodes shorter than T
+        # must not bootstrap from zero-padded obs).
+        v_shift = jnp.concatenate(
+            [values[:, 1:], jnp.zeros((B, 1))], axis=1)
+        col = jnp.arange(T)[None, :]
+        v_tp1 = jnp.where(col == batch["last_step"][:, None],
+                          batch["bootstrap"][:, None], v_shift)
+        # mask kills padded-step deltas: V(zero-padded obs) is
+        # garbage and must not leak into the scan carry.
+        deltas = rho_c * (batch["rewards"] + discounts * v_tp1
+                          - values) * mask
+
+        def backward(carry, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * carry
+            return acc, acc
+
+        # reverse-time scan over T (axes moved to leading dim)
+        _, vs_minus_v = jax.lax.scan(
+            backward, jnp.zeros((B,)),
+            (deltas.T, discounts.T, c.T), reverse=True)
+        vs = values + vs_minus_v.T
+        vs_shift = jnp.concatenate(
+            [vs[:, 1:], jnp.zeros((B, 1))], axis=1)
+        vs_tp1 = jnp.where(col == batch["last_step"][:, None],
+                           batch["bootstrap"][:, None], vs_shift)
+        # Advantage BEFORE any rho weighting; stop-gradient so only
+        # the policy term differentiates through logp.
+        adv = jax.lax.stop_gradient(
+            batch["rewards"] + discounts * vs_tp1 - values)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        ent = -(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+                * mask).sum() / denom
+        vf_loss = (((values - jax.lax.stop_gradient(vs)) ** 2)
+                   * mask).sum() / denom
+        return {"logp": logp, "rho": rho, "rho_c": rho_c,
+                "adv": adv, "mask": mask, "denom": denom,
+                "entropy": ent, "vf_loss": vf_loss}
+
+    def _policy_loss(self, t: dict) -> Any:
+        """IMPALA: importance-weighted policy gradient."""
+        return -(t["logp"] * t["rho_c"] * t["adv"]
+                 * t["mask"]).sum() / t["denom"]
+
     def _update_fn(self, params, opt_state, batch):
         hp = self.hp
 
         def loss_fn(p):
-            B, T = batch["actions"].shape
-            obs = batch["obs"].reshape(B * T, -1)
-            logits, values = self.model.apply({"params": p}, obs)
-            logits = logits.reshape(B, T, -1)
-            values = values.reshape(B, T)
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
-            rho = jnp.exp(logp - batch["behavior_logp"])
-            rho_c = jnp.minimum(hp.rho_bar, rho)
-            c = jnp.minimum(hp.c_bar, rho)
-            mask = batch["mask"]
-            discounts = hp.gamma * (1.0 - batch["dones"]) * mask
-
-            # bootstrap: V(x_{t+1}), with V(final_obs) injected at
-            # each episode's LAST REAL step (episodes shorter than T
-            # must not bootstrap from zero-padded obs).
-            v_shift = jnp.concatenate(
-                [values[:, 1:], jnp.zeros((B, 1))], axis=1)
-            col = jnp.arange(T)[None, :]
-            v_tp1 = jnp.where(col == batch["last_step"][:, None],
-                              batch["bootstrap"][:, None], v_shift)
-            # mask kills padded-step deltas: V(zero-padded obs) is
-            # garbage and must not leak into the scan carry.
-            deltas = rho_c * (batch["rewards"] + discounts * v_tp1
-                              - values) * mask
-
-            def backward(carry, xs):
-                delta_t, disc_t, c_t = xs
-                acc = delta_t + disc_t * c_t * carry
-                return acc, acc
-
-            # reverse-time scan over T (axes moved to leading dim)
-            _, vs_minus_v = jax.lax.scan(
-                backward, jnp.zeros((B,)),
-                (deltas.T, discounts.T, c.T), reverse=True)
-            vs_minus_v = vs_minus_v.T
-            vs = values + vs_minus_v
-            vs_shift = jnp.concatenate(
-                [vs[:, 1:], jnp.zeros((B, 1))], axis=1)
-            vs_tp1 = jnp.where(col == batch["last_step"][:, None],
-                               batch["bootstrap"][:, None], vs_shift)
-
-            pg_adv = jax.lax.stop_gradient(
-                rho_c * (batch["rewards"] + discounts * vs_tp1
-                         - values))
-            denom = jnp.maximum(mask.sum(), 1.0)
-            pi_loss = -(logp * pg_adv * mask).sum() / denom
-            vf_loss = (((values - jax.lax.stop_gradient(vs)) ** 2)
-                       * mask).sum() / denom
-            ent = -(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
-                    * mask).sum() / denom
-            total = (pi_loss + hp.vf_coeff * vf_loss
-                     - hp.entropy_coeff * ent)
-            mean_rho = (rho * mask).sum() / denom
-            return total, (pi_loss, vf_loss, ent, mean_rho)
+            t = self._vtrace_terms(p, batch)
+            pi_loss = self._policy_loss(t)
+            total = (pi_loss + hp.vf_coeff * t["vf_loss"]
+                     - hp.entropy_coeff * t["entropy"])
+            mean_rho = (t["rho"] * t["mask"]).sum() / t["denom"]
+            return total, (pi_loss, t["vf_loss"], t["entropy"],
+                           mean_rho)
 
         (total, (pi_l, vf_l, ent, rho_mean)), grads = \
             jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -203,10 +219,12 @@ class ImpalaConfig:
 
 
 class Impala:
+    learner_cls = ImpalaLearner   # subclasses (APPO) swap the learner
+
     def __init__(self, config: ImpalaConfig):
         assert config.env is not None
         self.config = config
-        self.learner = ImpalaLearner(
+        self.learner = self.learner_cls(
             config.policy_config, config.hparams,
             max_seq_len=config.rollout_fragment_length,
             seed=config.seed)

@@ -1,0 +1,184 @@
+"""MARWIL — monotonic advantage re-weighted imitation learning.
+
+Reference analog: rllib/algorithms/marwil/ — offline RL between BC
+and full policy-gradient: a value head estimates advantages
+A = R - V(s) from logged returns, and the imitation loss weights each
+(obs, action) pair by exp(beta * A / c), where c is a running norm of
+the advantage magnitude (RLlib's moving_average_sqd_adv_norm). With
+beta=0 it degrades exactly to BC. TPU-first shape: the whole update
+(value loss + re-weighted NLL + norm EMA) is ONE jitted program per
+minibatch; the offline data flows in through ray_tpu.data.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+
+
+@dataclass
+class MARWILHyperparams:
+    lr: float = 1e-3
+    beta: float = 1.0               # 0 => plain BC
+    vf_coeff: float = 1.0
+    exp_adv_clip: float = 20.0      # cap on the exp weights
+    norm_ema: float = 1e-2          # advantage-norm update rate
+    train_batch_size: int = 256
+    num_gradient_steps: int = 16
+
+
+def returns_from_rewards(rewards, dones, gamma: float = 0.99):
+    """Discounted return-to-go per step from flat (reward, done)
+    transition logs — convenience for datasets that carry rewards
+    instead of precomputed returns."""
+    out = np.zeros(len(rewards), np.float32)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        if dones[t]:
+            acc = 0.0
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
+class MARWILLearner:
+    def __init__(self, policy_config: dict, hp: MARWILHyperparams,
+                 seed: int = 0):
+        self.hp = hp
+        self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        self.params = self.model.init_params(jax.random.key(seed))
+        self.opt = optax.adam(hp.lr)
+        self.opt_state = self.opt.init(self.params)
+        # Running E[A^2] estimate (c^2); starts at 1 like RLlib.
+        self.adv_sq_norm = jnp.ones(())
+        self._update = jax.jit(self._update_fn,
+                               donate_argnums=(0, 1, 2))
+
+    def _update_fn(self, params, opt_state, adv_sq_norm, batch):
+        hp = self.hp
+
+        def loss_fn(p):
+            logits, values = self.model.apply({"params": p},
+                                              batch["obs"])
+            adv = batch["return"] - values
+            vf_loss = (adv ** 2).mean()
+            c = jnp.sqrt(adv_sq_norm) + 1e-8
+            weights = jnp.minimum(
+                jnp.exp(hp.beta * jax.lax.stop_gradient(adv) / c),
+                hp.exp_adv_clip)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, batch["action"][:, None], axis=-1)[:, 0]
+            pi_loss = (weights * nll).mean()
+            total = pi_loss + hp.vf_coeff * vf_loss
+            return total, (pi_loss, vf_loss, adv,
+                           weights.mean())
+
+        (total, (pi_l, vf_l, adv, w_mean)), grads = \
+            jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        adv_sq_norm = adv_sq_norm + hp.norm_ema * (
+            (adv ** 2).mean() - adv_sq_norm)
+        return params, opt_state, adv_sq_norm, {
+            "total_loss": total, "policy_loss": pi_l,
+            "vf_loss": vf_l, "mean_weight": w_mean,
+        }
+
+    def update(self, batch: dict[str, np.ndarray]) -> dict:
+        mb = {"obs": jnp.asarray(batch["obs"], jnp.float32),
+              "action": jnp.asarray(batch["action"], jnp.int32),
+              "return": jnp.asarray(batch["return"], jnp.float32)}
+        (self.params, self.opt_state, self.adv_sq_norm,
+         metrics) = self._update(self.params, self.opt_state,
+                                 self.adv_sq_norm, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+@dataclass
+class MARWILConfig:
+    dataset: Any = None
+    policy_config: dict = field(default_factory=dict)
+    hparams: MARWILHyperparams = field(
+        default_factory=MARWILHyperparams)
+    gamma: float = 0.99
+    seed: int = 0
+
+    def environment(self, *, obs_dim: int, num_actions: int,
+                    hidden: tuple = (64, 64)) -> "MARWILConfig":
+        return replace(self, policy_config={
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            "hidden": hidden})
+
+    def offline_data(self, dataset) -> "MARWILConfig":
+        """Dataset columns: "obs" (float rows), "action" (int), and
+        either "return" (float return-to-go) or "reward" + "done"
+        (returns are derived with ``returns_from_rewards``)."""
+        return replace(self, dataset=dataset)
+
+    def training(self, *, gamma: float | None = None,
+                 **hp_overrides) -> "MARWILConfig":
+        return replace(
+            self, gamma=self.gamma if gamma is None else gamma,
+            hparams=replace(self.hparams, **hp_overrides))
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class MARWIL:
+    def __init__(self, config: MARWILConfig):
+        assert config.dataset is not None, "call .offline_data(ds)"
+        assert config.policy_config, "call .environment(...)"
+        self.config = config
+        self.learner = MARWILLearner(
+            config.policy_config, config.hparams, seed=config.seed)
+        self.rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+        batches = list(config.dataset.iter_batches())
+        self._obs = np.concatenate(
+            [np.asarray(b["obs"], np.float32) for b in batches])
+        self._act = np.concatenate(
+            [np.asarray(b["action"], np.int64) for b in batches])
+        if all("return" in b for b in batches):
+            self._ret = np.concatenate(
+                [np.asarray(b["return"], np.float32)
+                 for b in batches])
+        else:
+            rewards = np.concatenate(
+                [np.asarray(b["reward"], np.float32)
+                 for b in batches])
+            dones = np.concatenate(
+                [np.asarray(b["done"]) for b in batches])
+            self._ret = returns_from_rewards(rewards, dones,
+                                             config.gamma)
+
+    def train(self) -> dict:
+        hp = self.config.hparams
+        t0 = time.time()
+        metrics: dict = {}
+        n = len(self._obs)
+        for _ in range(hp.num_gradient_steps):
+            idx = self.rng.integers(0, n, hp.train_batch_size)
+            metrics = self.learner.update({
+                "obs": self._obs[idx], "action": self._act[idx],
+                "return": self._ret[idx]})
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "num_samples": n,
+                "time_learn_s": round(time.time() - t0, 3),
+                **metrics}
+
+    def stop(self) -> None:
+        pass
