@@ -192,7 +192,7 @@ class Llama(nn.Module):
         return constrain(x, self.mesh, "batch", "seq", None)
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.config
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, name="wte",
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -211,6 +211,11 @@ class Llama(nn.Module):
             x = self._constrain(x)
         x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     param_dtype=cfg.param_dtype, name="norm_f")(x)
+        if return_hidden:
+            # For chunked LM-head losses (never materialize full
+            # logits); lm_head params exist regardless — init traces
+            # the plain __call__ path.
+            return x
         if cfg.tie_embeddings:
             logits = jnp.einsum(
                 "bte,ve->btv", x.astype(cfg.dtype),
@@ -229,10 +234,25 @@ class Llama(nn.Module):
         return self.init(rng, tokens)["params"]
 
 
-def llama_loss_fn(model: Llama):
-    from ray_tpu.models.gpt2 import cross_entropy_loss
+def llama_loss_fn(model: Llama, fused_ce: bool = True,
+                  ce_chunk: int = 2048):
+    from ray_tpu.models.gpt2 import (
+        chunked_cross_entropy,
+        cross_entropy_loss,
+    )
 
     def loss_fn(params, batch):
+        if fused_ce:
+            h = model.apply({"params": params}, batch["tokens"],
+                            return_hidden=True)
+            if model.config.tie_embeddings:
+                head = params["wte"]["embedding"]        # (V, E)
+            else:
+                # Dense kernel is (E, V); the einsum folds the
+                # transpose into the dot, no materialized copy.
+                head = params["lm_head"]["kernel"].T
+            return chunked_cross_entropy(
+                h, head, batch["targets"], chunk_size=ce_chunk)
         logits = model.apply({"params": params}, batch["tokens"])
         return cross_entropy_loss(logits, batch["targets"])
 

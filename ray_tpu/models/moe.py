@@ -134,7 +134,7 @@ class MoETransformer(nn.Module):
         return causal_attention
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.config
         g = cfg.gpt2()
         B, T = tokens.shape
@@ -153,6 +153,8 @@ class MoETransformer(nn.Module):
                 x = Block(g, name=f"h_{i}")(x, attn_fn, True)
         x = nn.LayerNorm(epsilon=1e-5, name="ln_f", dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype)(x)
+        if return_hidden:
+            return x
         return jnp.einsum("bte,ve->btv", x.astype(cfg.dtype),
                           wte.embedding.astype(cfg.dtype),
                           preferred_element_type=jnp.float32)
@@ -163,14 +165,24 @@ class MoETransformer(nn.Module):
         return self.init(rng, tokens)["params"]
 
 
-def moe_loss_fn(model: MoETransformer):
+def moe_loss_fn(model: MoETransformer, fused_ce: bool = True,
+                ce_chunk: int = 2048):
     """LM loss + router load-balancing aux loss."""
+    from ray_tpu.models.gpt2 import chunked_cross_entropy
 
     def loss_fn(params, batch):
-        logits, state = model.apply(
-            {"params": params}, batch["tokens"],
-            mutable=["intermediates"])
-        lm = cross_entropy_loss(logits, batch["targets"])
+        if fused_ce:
+            h, state = model.apply(
+                {"params": params}, batch["tokens"],
+                return_hidden=True, mutable=["intermediates"])
+            lm = chunked_cross_entropy(
+                h, params["wte"]["embedding"], batch["targets"],
+                chunk_size=ce_chunk)
+        else:
+            logits, state = model.apply(
+                {"params": params}, batch["tokens"],
+                mutable=["intermediates"])
+            lm = cross_entropy_loss(logits, batch["targets"])
         aux_vals = jax.tree_util.tree_leaves(
             state.get("intermediates", {}))
         aux = (sum(jnp.asarray(a, jnp.float32).sum()

@@ -117,8 +117,13 @@ class ImpalaLearner:
                 "entropy": ent, "vf_loss": vf_loss}
 
     def _policy_loss(self, t: dict) -> Any:
-        """IMPALA: importance-weighted policy gradient."""
-        return -(t["logp"] * t["rho_c"] * t["adv"]
+        """IMPALA: importance-weighted policy gradient. rho_c is a
+        WEIGHT here, not part of the objective — stop_gradient, or the
+        clipped ratio's own dependence on logp adds a spurious
+        gradient term (APPO's surrogate, by contrast, differentiates
+        through the ratio on purpose)."""
+        rho_c = jax.lax.stop_gradient(t["rho_c"])
+        return -(t["logp"] * rho_c * t["adv"]
                  * t["mask"]).sum() / t["denom"]
 
     def _update_fn(self, params, opt_state, batch):

@@ -8,6 +8,7 @@ SURVEY.md §2.4 "one pod slice per trial").
 from ray_tpu.tune.search import (
     grid_search, choice, uniform, loguniform, randint,
     BasicVariantGenerator, RandomSearcher, TPESearcher,
+    BayesOptSearcher, BOHBSearcher,
     ConcurrencyLimiter, Searcher,
 )
 from ray_tpu.tune.schedulers import (
@@ -21,6 +22,7 @@ from ray_tpu.tune.tune import (
 __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "BasicVariantGenerator", "RandomSearcher", "TPESearcher",
+    "BayesOptSearcher", "BOHBSearcher",
     "ConcurrencyLimiter", "Searcher",
     "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",

@@ -94,6 +94,10 @@ class Searcher:
     def is_finished(self) -> bool:
         return False
 
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        """Intermediate rung result (model-based searchers like BOHB
+        learn from partial budgets; default no-op)."""
+
     def on_trial_complete(self, trial_id: str, result: dict | None,
                           error: bool = False) -> None:
         pass
@@ -305,7 +309,206 @@ class ConcurrencyLimiter(Searcher):
     def is_finished(self) -> bool:
         return self.searcher.is_finished()
 
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        # Forward rung results so wrapped model-based searchers
+        # (BOHB) keep learning from partial budgets.
+        self.searcher.on_trial_result(trial_id, result)
+
     def on_trial_complete(self, trial_id: str, result: dict | None,
                           error: bool = False) -> None:
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error=error)
+
+
+class BayesOptSearcher(Searcher):
+    """Gaussian-process Bayesian optimization with expected
+    improvement (reference analog: python/ray/tune/search/bayesopt/ —
+    the bayesian-optimization package's GP+EI loop, here numpy-only).
+
+    Continuous dims are normalized to [0, 1] (log-scaled for
+    loguniform); integers round; categoricals map to index/num. After
+    ``n_startup`` random trials an RBF-kernel GP is fit over all
+    observations and the next config maximizes EI over random
+    candidates.
+    """
+
+    def __init__(self, param_space: dict, metric: str = "loss",
+                 mode: str = "min", num_samples: int = 32,
+                 n_startup: int = 6, n_candidates: int = 256,
+                 length_scale: float = 0.25, noise: float = 1e-4,
+                 xi: float = 0.01, seed: int | None = None):
+        self.param_space = param_space
+        self.metric, self.mode = metric, mode
+        self.num_samples = num_samples
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self.xi = xi
+        self.rng = random.Random(seed)
+        self._n = 0
+        self._X: list[list[float]] = []   # normalized configs
+        self._y: list[float] = []         # scores (lower = better)
+        self._pending: dict[str, dict] = {}
+        self._keys = list(param_space.keys())
+
+    # -- [0,1]^d encoding --
+
+    def _encode(self, cfg: dict) -> list[float]:
+        import math
+        out = []
+        for k in self._keys:
+            spec, v = self.param_space[k], cfg[k]
+            if isinstance(spec, _LogUniform):
+                out.append((math.log(v) - math.log(spec.low))
+                           / (math.log(spec.high)
+                              - math.log(spec.low)))
+            elif isinstance(spec, _Uniform):
+                out.append((v - spec.low) / (spec.high - spec.low))
+            elif isinstance(spec, _RandInt):
+                out.append((v - spec.low)
+                           / max(1, spec.high - 1 - spec.low))
+            elif isinstance(spec, (_Choice, _GridSearch)):
+                vals = list(spec.values)
+                out.append(vals.index(v) / max(1, len(vals) - 1))
+            else:
+                out.append(0.0)
+        return out
+
+    def _decode(self, x: list[float]) -> dict:
+        import math
+        cfg = {}
+        for k, u in zip(self._keys, x):
+            spec = self.param_space[k]
+            u = min(1.0, max(0.0, u))
+            if isinstance(spec, _LogUniform):
+                cfg[k] = math.exp(
+                    math.log(spec.low) + u
+                    * (math.log(spec.high) - math.log(spec.low)))
+            elif isinstance(spec, _Uniform):
+                cfg[k] = spec.low + u * (spec.high - spec.low)
+            elif isinstance(spec, _RandInt):
+                cfg[k] = min(spec.high - 1,
+                             spec.low + round(
+                                 u * max(1, spec.high - 1 - spec.low)))
+            elif isinstance(spec, (_Choice, _GridSearch)):
+                vals = list(spec.values)
+                cfg[k] = vals[min(len(vals) - 1,
+                                  round(u * (len(vals) - 1)))]
+            else:
+                cfg[k] = _sample(spec, self.rng)
+        return cfg
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._n >= self.num_samples:
+            return None
+        self._n += 1
+        if len(self._y) < self.n_startup:
+            cfg = {k: _sample(v, self.rng)
+                   for k, v in self.param_space.items()}
+        else:
+            cfg = self._decode(self._ei_argmax())
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def _ei_argmax(self) -> list[float]:
+        import numpy as np
+        X = np.asarray(self._X)
+        y = np.asarray(self._y)
+        y_mu, y_sd = y.mean(), max(y.std(), 1e-12)
+        yn = (y - y_mu) / y_sd
+        ls = self.length_scale
+
+        def rbf(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / ls ** 2)
+
+        K = rbf(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        cand = np.asarray([
+            [self.rng.random() for _ in self._keys]
+            for _ in range(self.n_candidates)])
+        Ks = rbf(cand, X)                      # (C, N)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)           # (N, C)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sd = np.sqrt(var)
+        best = yn.min()
+        z = (best - mu - self.xi) / sd
+        # EI for minimization, with normal cdf/pdf via erf.
+        from math import erf, pi, sqrt
+        cdf = np.asarray([(1 + erf(zi / sqrt(2))) / 2 for zi in z])
+        pdf = np.exp(-0.5 * z ** 2) / sqrt(2 * pi)
+        ei = (best - mu - self.xi) * cdf + sd * pdf
+        return [float(u) for u in cand[int(ei.argmax())]]
+
+    def is_finished(self) -> bool:
+        return self._n >= self.num_samples
+
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or error or not result or \
+                self.metric not in result:
+            return
+        v = float(result[self.metric])
+        score = -v if self.mode == "max" else v
+        self._X.append(self._encode(cfg))
+        self._y.append(score)
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based sampling (reference analog:
+    python/ray/tune/search/bohb/ TuneBOHB): TPE densities fit on
+    observations from the LARGEST budget (training_iteration) that
+    has enough of them — pair with :class:`HyperBandScheduler` for
+    the full BOHB loop (bracketed successive halving + model-based
+    proposals).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._budget_obs: dict[int, list] = {}
+        # (budget, score) most recently recorded per trial — the
+        # final report reaches us twice (on_trial_result for the last
+        # rung, then on_trial_complete with the same metrics) and
+        # must not be double-weighted in the densities.
+        self._last_recorded: dict[str, tuple] = {}
+
+    def _record(self, trial_id: str, result: dict) -> None:
+        cfg = self._pending.get(trial_id)
+        if cfg is None or self.metric not in result:
+            return
+        v = float(result[self.metric])
+        score = -v if self.mode == "max" else v
+        budget = int(result.get("training_iteration", 1))
+        if self._last_recorded.get(trial_id) == (budget, score):
+            return
+        self._last_recorded[trial_id] = (budget, score)
+        self._budget_obs.setdefault(budget, []).append((cfg, score))
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        """Record intermediate rung results keyed by budget (BOHB
+        learns from partial evaluations, not only completions)."""
+        self._record(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        if not error and result:
+            self._record(trial_id, result)
+        self._last_recorded.pop(trial_id, None)
+        super().on_trial_complete(trial_id, result, error=error)
+
+    def _tpe_suggest(self) -> dict:
+        # BOHB rule: model the largest budget with >= n_startup obs.
+        for budget in sorted(self._budget_obs, reverse=True):
+            obs = self._budget_obs[budget]
+            if len(obs) >= self.n_startup:
+                saved = self._obs
+                self._obs = obs
+                try:
+                    return super()._tpe_suggest()
+                finally:
+                    self._obs = saved
+        return super()._tpe_suggest()

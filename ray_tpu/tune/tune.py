@@ -278,6 +278,10 @@ class Tuner:
                 if hasattr(scheduler, "on_checkpoint"):
                     scheduler.on_checkpoint(t.trial_id,
                                             r["checkpoint_dir"])
+            if hasattr(searcher, "on_trial_result"):
+                # Model-based searchers (BOHB) learn from partial
+                # rung results, not only completions.
+                searcher.on_trial_result(t.trial_id, m)
             decision = scheduler.on_result(t.trial_id, m)
             if decision in (STOP, EXPLOIT):
                 break

@@ -210,3 +210,99 @@ def test_hyperband_end_to_end(rt):
     best = grid.get_best_result("loss", mode="min")
     assert best.config["x"] in (0.0, 0.5)
     shutil.rmtree(storage, ignore_errors=True)
+
+
+def test_bayesopt_concentrates_near_optimum():
+    from ray_tpu.tune import (
+        BayesOptSearcher, choice, loguniform, randint,
+    )
+
+    bo = BayesOptSearcher(
+        {"x": uniform(-5, 5)}, metric="loss", mode="min",
+        num_samples=36, n_startup=8, seed=7)
+    suggested = []
+    for i in range(36):
+        tid = f"b{i}"
+        cfg = bo.suggest(tid)
+        assert cfg is not None
+        suggested.append(cfg["x"])
+        bo.on_trial_complete(tid, {"loss": (cfg["x"] - 2.0) ** 2})
+    assert bo.suggest("b36") is None and bo.is_finished()
+    err = lambda xs: sum(abs(x - 2.0) for x in xs) / len(xs)  # noqa
+    assert err(suggested[-8:]) < err(suggested[:8])
+
+    # Mixed space round-trips through the [0,1]^d encoding.
+    bo2 = BayesOptSearcher(
+        {"lr": loguniform(1e-5, 1e-1), "layers": randint(1, 9),
+         "act": choice(["relu", "gelu"])},
+        num_samples=12, n_startup=4, seed=0)
+    for i in range(12):
+        cfg = bo2.suggest(f"m{i}")
+        assert 1e-5 <= cfg["lr"] <= 1e-1
+        assert 1 <= cfg["layers"] <= 8
+        assert cfg["act"] in ("relu", "gelu")
+        bo2.on_trial_complete(
+            f"m{i}", {"loss": abs(cfg["lr"] - 1e-3) * cfg["layers"]})
+
+
+def test_bohb_uses_largest_informative_budget():
+    from ray_tpu.tune import BOHBSearcher
+
+    bohb = BOHBSearcher({"x": uniform(-5, 5)}, metric="loss",
+                        mode="min", num_samples=40, n_startup=6,
+                        seed=5)
+    suggested = []
+    for i in range(40):
+        tid = f"h{i}"
+        cfg = bohb.suggest(tid)
+        suggested.append(cfg["x"])
+        # Two rungs: a noisy budget-1 result and (for half the
+        # trials, as successive halving would) a clean budget-3 one.
+        noisy = (cfg["x"] - 2.0) ** 2 + (10 if i % 2 else 0)
+        bohb.on_trial_result(
+            tid, {"loss": noisy, "training_iteration": 1})
+        if i % 2 == 0:
+            bohb.on_trial_result(
+                tid, {"loss": (cfg["x"] - 2.0) ** 2,
+                      "training_iteration": 3})
+            bohb.on_trial_complete(
+                tid, {"loss": (cfg["x"] - 2.0) ** 2,
+                      "training_iteration": 3})
+        else:
+            bohb.on_trial_complete(
+                tid, {"loss": noisy, "training_iteration": 1})
+    err = lambda xs: sum(abs(x - 2.0) for x in xs) / len(xs)  # noqa
+    assert err(suggested[-10:]) < err(suggested[:10])
+    # The model must have budget-3 observations and prefer them.
+    assert 3 in bohb._budget_obs and len(bohb._budget_obs[3]) >= 6
+
+
+def test_bohb_with_hyperband_e2e(rt):
+    """BOHB pairing: HyperBandScheduler + BOHBSearcher over a real
+    Tuner run (reference: TuneBOHB + HyperBandForBOHB)."""
+    from ray_tpu.train import report
+    from ray_tpu.tune import (
+        BOHBSearcher, HyperBandScheduler, TuneConfig, Tuner,
+    )
+
+    def trainable(config):
+        x = config["x"]
+        for step in range(1, 9):
+            report({"loss": (x - 2.0) ** 2 + 1.0 / step,
+                    "training_iteration": step})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": uniform(-5, 5)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=10,
+            search_alg=BOHBSearcher({"x": uniform(-5, 5)},
+                                    metric="loss", mode="min",
+                                    num_samples=10, n_startup=4,
+                                    seed=1),
+            scheduler=HyperBandScheduler(metric="loss", mode="min",
+                                         max_t=8)))
+    grid = tuner.fit()
+    best = grid.get_best_result(metric="loss", mode="min")
+    assert best is not None
+    assert best.metrics["loss"] < 20
