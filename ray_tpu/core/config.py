@@ -56,6 +56,15 @@ class Config:
     spill_dir: str = "/tmp/ray_tpu_spill"
     # Begin spilling when the store is this full.
     object_spilling_threshold: float = 0.8
+    # Object-manager transfer plane (reference: ObjectBufferPool
+    # chunking + PullManager, object_manager.h:117): objects shipped
+    # to clients that cannot map the shm arena are pulled in chunks
+    # of this size so one huge object never head-of-line blocks the
+    # client channel.
+    object_transfer_chunk_bytes: int = 4 * 1024 * 1024
+    # Inline (single-message) ship objects up to this size; larger
+    # ones go through the chunked pull protocol.
+    object_transfer_inline_max: int = 8 * 1024 * 1024
 
     # --- fault tolerance ---
     # Default task max retries (reference: max_retries=3 default).

@@ -52,6 +52,9 @@ OP_STREAM_NEXT = "stream_next"  # (task_id_bytes, timeout) ->
 OP_STREAM_DROP = "stream_drop"  # task_id_bytes
 OP_SPANS = "spans"              # list of finished span dicts (tracing)
 OP_KV = "kv"                    # (action, key, value, namespace)
+OP_PULL = "pull"                # chunked object pull (ObjectManager
+                                # analog): ("chunk", tid, i) -> bytes;
+                                # ("end", tid) releases the transfer
 
 # client channel, driver -> worker: (req_id, status, payload)
 ST_OK = "ok"

@@ -451,6 +451,13 @@ class DriverRuntime:
         self._kv: dict[tuple[str, bytes], bytes] = {}
         self._kv_lock = threading.Lock()
 
+        # Chunked object transfers in flight (ObjectManager analog):
+        # tid -> (SerializedObject, started_at). Holding the object
+        # keeps its bytes/pinned views alive until the puller ends.
+        self._transfers: dict[str, tuple] = {}
+        self._transfer_lock = threading.Lock()
+        self._transfer_chunks_served = 0
+
         # Events / timeline
         self._events: deque = deque(maxlen=config.task_event_buffer_size)
 
@@ -740,6 +747,51 @@ class DriverRuntime:
         remaining = (None if deadline is None
                      else max(0.0, deadline - time.monotonic()))
         return ("obj", self.get_serialized(oid, remaining))
+
+    # -- chunked transfer plane (ObjectManager analog, SURVEY §2.1
+    # N17: ObjectBufferPool chunking + pull-based flow control; the
+    # "remote node" here is any client that cannot map the shm arena).
+
+    def _start_transfer(self, obj: SerializedObject) -> tuple:
+        import uuid
+        now = time.time()
+        tid = uuid.uuid4().hex
+        with self._transfer_lock:
+            # Purge transfers abandoned by dead clients.
+            stale = [t for t, (_, ts) in self._transfers.items()
+                     if now - ts > 600]
+            for t in stale:
+                self._transfers.pop(t, None)
+            self._transfers[tid] = (obj, now)
+        return ("chunked", tid, len(obj.data),
+                [len(b) for b in obj.buffers],
+                self.config.object_transfer_chunk_bytes)
+
+    def _transfer_chunk(self, tid: str, index: int) -> bytes:
+        with self._transfer_lock:
+            entry = self._transfers.get(tid)
+            if entry is not None:
+                # Refresh activity so a long multi-GB pull is never
+                # purged mid-transfer (expiry is idle-based).
+                self._transfers[tid] = (entry[0], time.time())
+        if entry is None:
+            raise KeyError(f"unknown or expired transfer {tid}")
+        obj, _ = entry
+        chunk = self.config.object_transfer_chunk_bytes
+        start = index * chunk
+        out = bytearray()
+        pos = 0
+        for seg in (obj.data, *obj.buffers):
+            seg_len = len(seg)
+            if start < pos + seg_len and len(out) < chunk:
+                lo = max(0, start - pos)
+                hi = min(seg_len, lo + (chunk - len(out)))
+                out += memoryview(seg)[lo:hi]
+            pos += seg_len
+            if len(out) >= chunk:
+                break
+        self._transfer_chunks_served += 1
+        return bytes(out)
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -2210,12 +2262,30 @@ class DriverRuntime:
             self.on_ref_escaped(ref.id)  # a remote process holds it
             return ref.id.binary()
         if op == P.OP_GET:
-            oid_bytes, timeout = payload
-            kind, val = self.get_serialized_or_desc(
-                ObjectID(oid_bytes), timeout)
-            if kind == "desc":
-                return ("desc", val)
+            oid_bytes, timeout, *rest = payload
+            allow_desc = rest[0] if rest else True
+            if allow_desc:
+                kind, val = self.get_serialized_or_desc(
+                    ObjectID(oid_bytes), timeout)
+                if kind == "desc":
+                    return ("desc", val)
+            else:
+                val = self.get_serialized(ObjectID(oid_bytes),
+                                          timeout)
+            if val.total_size > self.config.object_transfer_inline_max:
+                # Chunked pull (ObjectManager analog): the client
+                # fetches fixed-size chunks as separate req/resp
+                # rounds, so other client ops interleave instead of
+                # queueing behind one multi-GB message.
+                return self._start_transfer(val)
             return ("inline", val.data, val.buffers)
+        if op == P.OP_PULL:
+            action, tid, *prest = payload
+            if action == "chunk":
+                return self._transfer_chunk(tid, prest[0])
+            with self._transfer_lock:
+                self._transfers.pop(tid, None)   # "end"
+            return None
         if op == P.OP_WAIT:
             oid_bytes_list, num_returns, timeout = payload
             done, rest = self.wait_available(

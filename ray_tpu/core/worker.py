@@ -36,9 +36,16 @@ class ClientRuntime:
     """
 
     def __init__(self, address: str):
+        import os
         from collections import deque
         self._conn = mpc.Client(address, family="AF_UNIX")
         self._conn.send(("hello", "client", ""))
+        # Shm descriptors are a same-host optimization; a client that
+        # cannot map the arena (different host / sandbox, or forced
+        # for testing) pulls object bytes over the socket instead —
+        # large ones through the chunked transfer plane.
+        self._allow_desc = os.environ.get(
+            "RAY_TPU_NO_SHM", "0") not in ("1", "true")
         self._send_lock = threading.Lock()
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._pending_lock = threading.Lock()
@@ -123,8 +130,37 @@ class ClientRuntime:
 
     def get_serialized(self, oid: ObjectID,
                        timeout: float | None = None) -> SerializedObject:
-        out = self._call(P.OP_GET, (oid.binary(), timeout))
+        out = self._call(P.OP_GET,
+                         (oid.binary(), timeout, self._allow_desc))
+        if out[0] == "chunked":
+            return self._pull_chunked(out)
         return _resolved_to_serialized(out)
+
+    def _pull_chunked(self, meta) -> SerializedObject:
+        """Pull one object through the chunked transfer plane
+        (ObjectManager analog): fixed-size chunks as separate
+        req/resp rounds, so concurrent client ops interleave."""
+        _, tid, data_len, buf_lens, chunk = meta
+        total = data_len + sum(buf_lens)
+        nchunks = -(-total // chunk) if total else 0
+        buf = bytearray(total)
+        try:
+            for i in range(nchunks):
+                piece = self._call(P.OP_PULL, ("chunk", tid, i))
+                buf[i * chunk:i * chunk + len(piece)] = piece
+        finally:
+            try:
+                self._call(P.OP_PULL, ("end", tid))
+            except Exception:  # noqa: BLE001
+                pass
+        mv = memoryview(buf)
+        buffers = []
+        pos = data_len
+        for ln in buf_lens:
+            buffers.append(mv[pos:pos + ln])
+            pos += ln
+        return SerializedObject(data=bytes(mv[:data_len]),
+                                buffers=buffers)
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)

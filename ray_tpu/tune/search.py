@@ -63,7 +63,9 @@ def randint(low: int, high: int) -> _RandInt:
 
 def _sample(spec, rng: random.Random):
     import math
-    if isinstance(spec, _Choice):
+    if isinstance(spec, (_Choice, _GridSearch)):
+        # Samplers treat grid_search dims as categorical (the grid
+        # semantics belong to BasicVariantGenerator's expansion).
         return rng.choice(list(spec.values))
     if isinstance(spec, _Uniform):
         return rng.uniform(spec.low, spec.high)
@@ -311,8 +313,12 @@ class ConcurrencyLimiter(Searcher):
 
     def on_trial_result(self, trial_id: str, result: dict) -> None:
         # Forward rung results so wrapped model-based searchers
-        # (BOHB) keep learning from partial budgets.
-        self.searcher.on_trial_result(trial_id, result)
+        # (BOHB) keep learning from partial budgets. Guarded like the
+        # Tuner's own hasattr check: a duck-typed searcher that never
+        # defined it must not crash the loop.
+        fwd = getattr(self.searcher, "on_trial_result", None)
+        if callable(fwd):
+            fwd(trial_id, result)
 
     def on_trial_complete(self, trial_id: str, result: dict | None,
                           error: bool = False) -> None:
