@@ -1,30 +1,43 @@
-"""Multi-node-on-one-host test cluster.
+"""Multi-node test cluster backed by real node-daemon processes.
 
 Reference: ``ray.cluster_utils.Cluster`` (python/ray/cluster_utils.py:
 135,201) — the workhorse of the reference's distributed test suite
-(SURVEY.md §4.2): every scheduling/spillback/failure invariant is
-testable on one machine because "a node" is just a resource pool with
-its own worker processes. ``add_node`` registers a logical node with
-the driver runtime's node table; ``remove_node`` simulates node
-failure (workers killed, tasks retried elsewhere, actors restarted).
+(SURVEY.md §4.2). There, "a node" is a real raylet process with its own
+resource spec and object store, so every scheduling/spillback/failure
+invariant is testable on one machine. Here, ``add_node`` spawns a real
+``ray_tpu.core.node_daemon`` OS process that dials the head's TCP
+listener, registers resources, and hosts its own worker pool + local
+object store. ``remove_node`` kills that process — an actual node
+death, not a bookkeeping flip.
+
+``add_node(logical=True)`` keeps the round-1 behavior (a resource-table
+row inside the head, workers spawned by the head itself) for tests that
+only exercise placement math and want to avoid daemon boot latency.
 """
 
 from __future__ import annotations
 
+import json
+import subprocess
+import sys
+import time
 from typing import Any
 
 
 class ClusterNode:
-    def __init__(self, node_id: str, resources: dict[str, float]):
+    def __init__(self, node_id: str, resources: dict[str, float],
+                 proc: subprocess.Popen | None = None):
         self.node_id = node_id
         self.resources = resources
+        self.proc = proc      # the node-daemon process (None = logical)
 
     def __repr__(self):
-        return f"ClusterNode({self.node_id})"
+        kind = "daemon" if self.proc is not None else "logical"
+        return f"ClusterNode({self.node_id}, {kind})"
 
 
 class Cluster:
-    """Start a head node and add/remove logical worker nodes."""
+    """Start a head node and add/remove worker nodes."""
 
     def __init__(self, initialize_head: bool = True,
                  head_node_args: dict[str, Any] | None = None):
@@ -48,31 +61,110 @@ class Cluster:
         """No-op: the driver is already connected (kept for reference
         API compatibility)."""
 
-    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
-                 resources: dict[str, float] | None = None,
-                 labels: dict[str, str] | None = None) -> ClusterNode:
+    def _ensure_head(self, num_cpus: float, num_tpus: float,
+                     resources: dict[str, float] | None):
         if self._rt is None:
+            # First add_node on a headless cluster bootstraps the head
+            # in-process (reference behavior: the first node hosts the
+            # GCS). It carries the requested resources; labels only
+            # apply to daemon nodes.
             import ray_tpu
-            ray_tpu.init(num_cpus=int(num_cpus), resources=resources)
+            kwargs = {}
+            if num_tpus:
+                kwargs["num_tpus"] = int(num_tpus)
+            ray_tpu.init(num_cpus=int(num_cpus), resources=resources,
+                         **kwargs)
             self._rt = ray_tpu.core.api.get_runtime()  # type: ignore
-            node = ClusterNode(self._rt.head_node_id,
-                               dict(resources or {"CPU": num_cpus}))
+            head_res = dict(
+                self._rt._nodes[self._rt.head_node_id].resources)
+            node = ClusterNode(self._rt.head_node_id, head_res)
             self.head_node = node
             self._nodes.append(node)
             return node
+        return None
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: dict[str, float] | None = None,
+                 labels: dict[str, str] | None = None,
+                 logical: bool = False,
+                 timeout_s: float = 30.0) -> ClusterNode:
+        head = self._ensure_head(num_cpus, num_tpus, resources)
+        if head is not None:
+            return head
         res: dict[str, float] = {"CPU": float(num_cpus)}
         if num_tpus:
             res["TPU"] = float(num_tpus)
         if resources:
             res.update(resources)
-        node_id = self._rt.add_node(res, labels)
-        node = ClusterNode(node_id, res)
+
+        if logical:
+            node_id = self._rt.add_node(res, labels)
+            node = ClusterNode(node_id, res)
+            self._nodes.append(node)
+            return node
+
+        host, port = self._rt.ensure_tcp_listener()
+        known = set(self._rt._nodes)
+        import os
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p]
+            + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+               if p])
+        # Token rides the environment, not argv — argv is readable by
+        # every local user via /proc/*/cmdline.
+        env["RAY_TPU_CLUSTER_TOKEN"] = self._rt.cluster_token.hex()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_daemon",
+             "--address", f"{host}:{port}",
+             "--num-cpus", str(num_cpus),
+             "--num-tpus", str(num_tpus),
+             "--resources", json.dumps(resources or {}),
+             "--labels", json.dumps(labels or {})],
+            env=env,
+        )
+        deadline = time.monotonic() + timeout_s
+        node_id = None
+        while time.monotonic() < deadline:
+            with self._rt._res_cv:
+                snapshot = list(self._rt._nodes.items())
+            fresh = [nid for nid, n in snapshot
+                     if nid not in known and n.is_daemon
+                     and n.pid == proc.pid]
+            if fresh:
+                node_id = fresh[0]
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node daemon exited during startup "
+                    f"(rc={proc.returncode})")
+            time.sleep(0.02)
+        if node_id is None:
+            proc.kill()
+            raise TimeoutError(
+                f"node daemon did not register within {timeout_s}s")
+        node = ClusterNode(node_id, res, proc=proc)
         self._nodes.append(node)
         return node
 
     def remove_node(self, node: ClusterNode,
                     allow_graceful: bool = True) -> None:
+        if node.proc is not None and not allow_graceful:
+            # Hard kill first: the head discovers the death through
+            # the broken node channel, exactly like a crashed host.
+            node.proc.kill()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                n = self._rt._nodes.get(node.node_id)
+                if n is None or not n.alive:
+                    break
+                time.sleep(0.02)
         self._rt.remove_node(node.node_id)
+        if node.proc is not None:
+            try:
+                node.proc.wait(5.0)
+            except subprocess.TimeoutExpired:
+                node.proc.kill()
         if node in self._nodes:
             self._nodes.remove(node)
 
@@ -82,6 +174,12 @@ class Cluster:
 
     def shutdown(self) -> None:
         import ray_tpu
+        procs = [n.proc for n in self._nodes if n.proc is not None]
         ray_tpu.shutdown()
+        for p in procs:
+            try:
+                p.wait(3.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
         self._rt = None
         self._nodes.clear()

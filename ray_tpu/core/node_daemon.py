@@ -1,0 +1,634 @@
+"""Per-node daemon: the raylet-analog OS process.
+
+One daemon runs per (real or simulated) node. It owns everything
+node-local, mirroring the reference raylet's responsibilities
+(``src/ray/raylet/main.cc:123``, ``node_manager.h:119``):
+
+- a **worker pool**: spawns/reaps worker processes on instruction from
+  the head; workers dial the daemon's local unix socket for their exec
+  and client channels exactly as they would a same-host driver;
+- a **local object store** (plasma analog): worker ``put``s and large
+  task returns stay here; the head keeps only a directory entry and
+  pulls chunks over TCP on demand (``object_manager.h:117``);
+- the **client-channel proxy**: control-plane ops from its workers
+  (submit/actors/kv/...) are spliced verbatim onto per-worker TCP
+  connections to the head, object ops are served locally when the
+  bytes are here.
+
+The head talks to the daemon over one multiplexed TCP connection (the
+node channel, protocol.py ND_*). Killing the daemon is node death: the
+head observes EOF, fails over the node's workers and objects; workers
+notice their exec socket closing and exit.
+
+Entry: ``python -m ray_tpu.core.node_daemon --address HOST:PORT
+--token HEX [--num-cpus N] [--resources JSON]``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from multiprocessing import connection as mpc
+
+from ray_tpu.core import protocol as P
+from ray_tpu.core import serialization as ser
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import (
+    MemoryStore,
+    make_shared_store,
+    read_descriptor,
+)
+from ray_tpu.core.runtime import (
+    TransferPlane,
+    WorkerHandle,
+    _sendable,
+    _wire_to_serialized,
+)
+from ray_tpu.core.serialization import SerializedObject
+
+
+class NodeDaemon:
+    def __init__(self, head_host: str, head_port: int, token: bytes,
+                 resources: dict[str, float] | None = None,
+                 labels: dict[str, str] | None = None,
+                 object_store_memory: int = 0,
+                 log_to_stdout: bool = True):
+        self.config = Config()
+        self._shutdown = False
+        self.head_addr = (head_host, head_port)
+        self.token = token
+
+        # Local session dir (sockets + worker logs + spill files).
+        sock_dir = f"/tmp/ray_tpu_sessions/node-{os.getpid()}"
+        os.makedirs(sock_dir, exist_ok=True)
+        self.client_address = os.path.join(sock_dir, "runtime.sock")
+        self.log_dir = os.path.join(sock_dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.log_monitor = None
+        if log_to_stdout:
+            from ray_tpu.core.log_monitor import LogMonitor
+            self.log_monitor = LogMonitor(self.log_dir)
+
+        # Local object plane (plasma analog): small objects in memory,
+        # large in the node's shared arena so same-node workers read
+        # them zero-copy via descriptors.
+        cap = object_store_memory or self.config.object_store_memory
+        if cap <= 0:
+            try:
+                total = (os.sysconf("SC_PHYS_PAGES")
+                         * os.sysconf("SC_PAGE_SIZE"))
+            except (ValueError, OSError):
+                total = 8 << 30
+            cap = int(total * 0.2)
+        self.memory_store = MemoryStore()
+        self.shm_store = make_shared_store(
+            cap, os.path.join(sock_dir, "spill"),
+            self.config.object_spilling_threshold)
+        self._local_oids: set[ObjectID] = set()
+        self._store_lock = threading.Lock()
+
+        # Chunked transfers served from the local store. The "nd-"
+        # prefix lets the client splice route pulls for locally owned
+        # transfers here and forward the rest to the head.
+        self.transfer_plane = TransferPlane(
+            self.config.object_transfer_chunk_bytes, prefix="nd-")
+
+        # Worker pool.
+        self._workers: dict[int, WorkerHandle] = {}
+        self._widx_of: dict[WorkerHandle, int] = {}
+        self._send_queues: dict[int, deque] = {}
+        self._send_events: dict[int, threading.Event] = {}
+        self._pool_lock = threading.Lock()
+        self._pending_workers: dict[str, WorkerHandle] = {}
+        self._pending_workers_lock = threading.Lock()
+
+        # task_id_bytes -> (widx, [ObjectID]) so large results can be
+        # kept node-local (head sends ND_TASK_META ahead of the task).
+        self._task_meta: dict[bytes, tuple[int, list[ObjectID]]] = {}
+        self._task_meta_lock = threading.Lock()
+
+        # Upcalls (daemon -> head request/response).
+        self._upcalls: dict[int, tuple] = {}
+        self._upcall_lock = threading.Lock()
+        self._upcall_fid = itertools.count(1)
+
+        # Node channel to the head.
+        self.conn = mpc.Client(self.head_addr, family="AF_INET",
+                               authkey=token)
+        self._conn_lock = threading.Lock()
+        self.conn.send(("hello", "node", ""))
+        import socket
+        self.head_send((P.ND_REGISTER, {
+            "resources": dict(resources or {}),
+            "labels": dict(labels or {}),
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+        }))
+        tag, node_id = self.conn.recv()
+        assert tag == "registered", f"unexpected register reply {tag!r}"
+        self.node_id = node_id
+
+        # Local listener for this node's workers.
+        self._listener = mpc.Listener(self.client_address,
+                                      family="AF_UNIX")
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="nd_accept").start()
+
+    # ------------------------------------------------------------------
+    # head channel
+    # ------------------------------------------------------------------
+
+    def head_send(self, msg: tuple) -> None:
+        with self._conn_lock:
+            self.conn.send(msg)
+
+    def _head_call(self, op: str, payload, timeout: float = 60.0):
+        fid = next(self._upcall_fid)
+        event = threading.Event()
+        slot: list = []
+        with self._upcall_lock:
+            self._upcalls[fid] = (event, slot)
+        self.head_send((P.ND_UPCALL, fid, op, payload))
+        if not event.wait(timeout):
+            with self._upcall_lock:
+                self._upcalls.pop(fid, None)
+            raise TimeoutError(f"head upcall {op} timed out")
+        status, result = slot[0]
+        if status == P.ST_ERR:
+            raise ser.loads(result)
+        return result
+
+    def serve_forever(self) -> None:
+        """Main loop: handle head->daemon messages until shutdown."""
+        try:
+            while not self._shutdown:
+                msg = self.conn.recv()
+                kind = msg[0]
+                if kind == P.ND_WMSG:
+                    _, widx, wmsg = msg
+                    self._enqueue_worker_send(widx, wmsg)
+                elif kind == P.ND_WSPAWN:
+                    _, widx, env_key, env_vars = msg
+                    self._spawn_worker(widx, env_key, env_vars)
+                elif kind == P.ND_TASK_META:
+                    _, widx, task_id_bytes, oid_bytes_list = msg
+                    with self._task_meta_lock:
+                        self._task_meta[task_id_bytes] = (
+                            widx, [ObjectID(b) for b in oid_bytes_list])
+                elif kind == P.ND_WKILL:
+                    _, widx, how = msg
+                    w = self._workers.get(widx)
+                    if w is not None:
+                        try:
+                            if how == "kill":
+                                w.proc.kill()
+                            else:
+                                w.proc.terminate()
+                        except Exception:  # noqa: BLE001
+                            pass
+                elif kind == P.ND_CALL:
+                    _, fid, op, payload = msg
+                    threading.Thread(
+                        target=self._handle_node_call,
+                        args=(fid, op, payload), daemon=True).start()
+                elif kind == P.ND_UPREPLY:
+                    _, fid, status, payload = msg
+                    with self._upcall_lock:
+                        entry = self._upcalls.pop(fid, None)
+                    if entry is not None:
+                        event, slot = entry
+                        slot.append((status, payload))
+                        event.set()
+                elif kind == P.ND_SHUTDOWN:
+                    break
+        except (EOFError, OSError):
+            pass       # head died or link lost: node dies with it
+        finally:
+            self.shutdown()
+
+    # ------------------------------------------------------------------
+    # worker pool (the WorkerHandle "runtime" surface)
+    # ------------------------------------------------------------------
+
+    def _register_pending_worker(self, w: WorkerHandle) -> None:
+        with self._pending_workers_lock:
+            self._pending_workers[w.token] = w
+
+    def _spawn_worker(self, widx: int, env_key: str,
+                      env_vars: dict) -> None:
+        try:
+            w = WorkerHandle(self, env_key, env_vars,
+                             node_id=self.node_id)
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+            self.head_send((P.ND_WEXIT, widx, -1))
+            return
+        with self._pool_lock:
+            self._workers[widx] = w
+            self._widx_of[w] = widx
+            q: deque = deque()
+            ev = threading.Event()
+            self._send_queues[widx] = q
+            self._send_events[widx] = ev
+        threading.Thread(target=self._worker_send_loop,
+                         args=(widx, w, q, ev), daemon=True,
+                         name=f"nd_send_{widx}").start()
+
+    def _enqueue_worker_send(self, widx: int, msg: tuple) -> None:
+        with self._pool_lock:
+            q = self._send_queues.get(widx)
+            ev = self._send_events.get(widx)
+        if q is None:
+            return
+        q.append(msg)
+        ev.set()
+
+    def _worker_send_loop(self, widx: int, w: WorkerHandle,
+                          q: deque, ev: threading.Event) -> None:
+        """Ordered sender per worker: WorkerHandle.send blocks until
+        the worker's exec channel attaches, which must never stall the
+        node channel's main loop."""
+        while not self._shutdown:
+            ev.wait(1.0)
+            ev.clear()
+            while q:
+                msg = q.popleft()
+                try:
+                    w.send(msg)
+                except Exception:  # noqa: BLE001
+                    return   # death is reported via _on_worker_exit
+
+    def _on_worker_message(self, w: WorkerHandle, msg: tuple) -> None:
+        widx = self._widx_of.get(w)
+        if widx is None:
+            return
+        if msg[0] == P.RESULT_OK:
+            _, task_id_bytes, results = msg
+            with self._task_meta_lock:
+                meta = self._task_meta.pop(task_id_bytes, None)
+            if meta is not None:
+                _widx, return_oids = meta
+                entries = self._intern_results(return_oids, results)
+                if any(e[0] == "stored" for e in entries):
+                    self.head_send((P.ND_STORED, widx, task_id_bytes,
+                                    entries))
+                    return
+        elif msg[0] in (P.RESULT_ERR, P.RESULT_STREAM_END):
+            with self._task_meta_lock:
+                self._task_meta.pop(msg[1], None)
+        self.head_send((P.ND_WMSG, widx, msg))
+
+    def _intern_results(self, return_oids: list[ObjectID],
+                        results: list) -> list:
+        """Keep large results in the node store; entry per return:
+        ("inline", wire) | ("stored", oid_bytes, size, refs)."""
+        entries = []
+        thresh = self.config.max_direct_call_object_size
+        for oid, wire in zip(return_oids, results):
+            size = len(wire[0]) + sum(len(b) for b in wire[1])
+            if size < thresh:
+                entries.append(("inline", wire))
+                continue
+            obj = _wire_to_serialized(wire)
+            refs = wire[2] if len(wire) > 2 and wire[2] else []
+            self._store_local(oid, obj)
+            entries.append(("stored", oid.binary(), size, refs))
+        return entries
+
+    def _on_worker_exit(self, w: WorkerHandle) -> None:
+        if self._shutdown:
+            return
+        widx = self._widx_of.pop(w, None)
+        if widx is None:
+            return
+        with self._pool_lock:
+            self._workers.pop(widx, None)
+            self._send_queues.pop(widx, None)
+            self._send_events.pop(widx, None)
+        rc = w.proc.returncode
+        try:
+            self.head_send((P.ND_WEXIT, widx, rc))
+        except (OSError, BrokenPipeError):
+            pass
+
+    def _forget_worker(self, w: WorkerHandle) -> None:
+        # Pre-handshake death: same upward report; the head's dispatch
+        # retry owns the task outcome.
+        self._on_worker_exit(w)
+
+    # ------------------------------------------------------------------
+    # local object plane
+    # ------------------------------------------------------------------
+
+    def _store_local(self, oid: ObjectID, obj: SerializedObject) -> None:
+        if obj.total_size >= self.config.max_direct_call_object_size:
+            self.shm_store.put(oid, obj)
+        else:
+            self.memory_store.put(oid, obj)
+        with self._store_lock:
+            self._local_oids.add(oid)
+
+    def _read_local(self, oid: ObjectID) -> SerializedObject | None:
+        obj = self.memory_store.try_get(oid)
+        if obj is not None:
+            return obj
+        read_local = getattr(self.shm_store, "read_local", None)
+        if read_local is not None:
+            obj = read_local(oid)
+            if obj is not None:
+                return obj
+        desc = self.shm_store.get_descriptor(oid)
+        if desc is not None:
+            return read_descriptor(desc)
+        return None
+
+    def _handle_node_call(self, fid: int, op: str, payload) -> None:
+        try:
+            if op == "fetch":
+                oid = ObjectID(payload)
+                obj = self._read_local(oid)
+                if obj is None:
+                    from ray_tpu.core.exceptions import ObjectLostError
+                    raise ObjectLostError(oid.hex())
+                if (obj.total_size
+                        <= self.config.object_transfer_inline_max):
+                    data, bufs = _sendable(obj)
+                    result = ("inline", data, bufs)
+                else:
+                    result = self._start_transfer(obj)
+            elif op == "chunk":
+                tid, index = payload
+                result = self.transfer_plane.chunk(tid, index)
+            elif op == "end":
+                self.transfer_plane.end(payload)
+                result = None
+            elif op == "free":
+                oid = ObjectID(payload)
+                self.memory_store.delete(oid)
+                self.shm_store.delete(oid)
+                with self._store_lock:
+                    self._local_oids.discard(oid)
+                result = None
+            else:
+                raise ValueError(f"unknown node call {op!r}")
+            status, out = P.ST_OK, result
+        except BaseException as e:  # noqa: BLE001
+            status, out = P.ST_ERR, ser.dumps(e)
+        if fid == -1:
+            return
+        try:
+            self.head_send((P.ND_REPLY, fid, status, out))
+        except (OSError, BrokenPipeError):
+            pass
+
+    def _start_transfer(self, obj: SerializedObject) -> tuple:
+        return self.transfer_plane.start(obj)
+
+    # ------------------------------------------------------------------
+    # local worker connections (exec attach + client splice)
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except Exception:  # noqa: BLE001
+                if self._shutdown:
+                    return
+                continue
+            threading.Thread(target=self._handshake, args=(conn,),
+                             daemon=True).start()
+
+    def _handshake(self, conn) -> None:
+        try:
+            hello = conn.recv()
+        except (EOFError, OSError):
+            return
+        if not (isinstance(hello, tuple) and len(hello) == 3
+                and hello[0] == "hello"):
+            conn.close()
+            return
+        _, kind, token = hello
+        if kind == "exec":
+            with self._pending_workers_lock:
+                w = self._pending_workers.pop(token, None)
+            if w is None:
+                conn.close()
+                return
+            w.attach_conn(conn)
+        else:
+            self._serve_worker_client(conn)
+
+    def _serve_worker_client(self, conn) -> None:
+        """Splice a local worker's client channel onto a dedicated TCP
+        connection to the head, serving object ops from the node store
+        where possible (the worker-side API is oblivious)."""
+        try:
+            upstream = mpc.Client(self.head_addr, family="AF_INET",
+                                  authkey=self.token)
+            upstream.send(("hello", "client", ""))
+        except Exception:  # noqa: BLE001
+            conn.close()
+            return
+        down_lock = threading.Lock()
+        up_lock = threading.Lock()
+
+        def down_send(msg):
+            try:
+                with down_lock:
+                    conn.send(msg)
+            except (OSError, BrokenPipeError):
+                pass
+
+        def pump_up_to_down():
+            try:
+                while True:
+                    msg = upstream.recv()
+                    down_send(msg)
+            except (EOFError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        threading.Thread(target=pump_up_to_down, daemon=True).start()
+
+        def forward_up(msg):
+            with up_lock:
+                upstream.send(msg)
+
+        def handle_local(req_id, op, payload):
+            try:
+                result = self._handle_worker_object_op(op, payload)
+                down_send((req_id, P.ST_OK, result))
+            except BaseException as e:  # noqa: BLE001
+                down_send((req_id, P.ST_ERR, ser.dumps(e)))
+
+        try:
+            while True:
+                req_id, op, payload = conn.recv()
+                if op == P.OP_PUT:
+                    threading.Thread(
+                        target=handle_local,
+                        args=(req_id, op, payload),
+                        daemon=True).start()
+                elif op == P.OP_GET:
+                    oid = ObjectID(payload[0])
+                    if self._has_local(oid):
+                        threading.Thread(
+                            target=handle_local,
+                            args=(req_id, op, payload),
+                            daemon=True).start()
+                    else:
+                        # The head must never hand a same-host arena
+                        # descriptor to a (conceptually) remote
+                        # worker: force the inline/chunked path.
+                        oid_b, timeout, *_rest = payload
+                        forward_up((req_id, op,
+                                    (oid_b, timeout, False)))
+                elif op == P.OP_PULL and isinstance(payload, tuple) \
+                        and len(payload) >= 2 \
+                        and isinstance(payload[1], str) \
+                        and self.transfer_plane.owns(payload[1]):
+                    threading.Thread(
+                        target=handle_local,
+                        args=(req_id, op, payload),
+                        daemon=True).start()
+                else:
+                    forward_up((req_id, op, payload))
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                upstream.close()
+            except OSError:
+                pass
+
+    def _has_local(self, oid: ObjectID) -> bool:
+        with self._store_lock:
+            return oid in self._local_oids
+
+    def _handle_worker_object_op(self, op: str, payload):
+        if op == P.OP_PUT:
+            obj = _wire_to_serialized(payload)
+            refs = payload[2] if len(payload) > 2 and payload[2] else []
+            oid_bytes = self._head_call(
+                "put_loc", (obj.total_size, refs))
+            self._store_local(ObjectID(oid_bytes), obj)
+            return oid_bytes
+        if op == P.OP_GET:
+            oid_bytes, _timeout, *rest = payload
+            allow_desc = rest[0] if rest else True
+            oid = ObjectID(oid_bytes)
+            obj = self._read_local(oid)
+            if obj is None:
+                from ray_tpu.core.exceptions import ObjectLostError
+                raise ObjectLostError(oid.hex())
+            if allow_desc:
+                desc = self.shm_store.get_descriptor(oid)
+                if desc is not None:
+                    return ("desc", desc)
+            if obj.total_size > self.config.object_transfer_inline_max:
+                return self._start_transfer(obj)
+            data, bufs = _sendable(obj)
+            return ("inline", data, bufs)
+        if op == P.OP_PULL:
+            action, tid, *prest = payload
+            if action == "chunk":
+                return self.transfer_plane.chunk(tid, prest[0])
+            self.transfer_plane.end(tid)
+            return None
+        raise ValueError(f"unexpected local op {op!r}")
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        if self.log_monitor is not None:
+            try:
+                self.log_monitor.poll_once()
+                self.log_monitor.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        with self._pool_lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for w in workers:
+            try:
+                w.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            try:
+                w.proc.wait(max(0.1, deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001
+                try:
+                    w.proc.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.client_address)
+        except OSError:
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+        self.shm_store.shutdown()
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        description="ray_tpu node daemon (raylet analog)")
+    ap.add_argument("--address", required=True,
+                    help="head TCP address host:port")
+    ap.add_argument("--token", default="",
+                    help="cluster token (hex); falls back to "
+                         "RAY_TPU_CLUSTER_TOKEN")
+    ap.add_argument("--num-cpus", type=float, default=None)
+    ap.add_argument("--num-tpus", type=float, default=0.0)
+    ap.add_argument("--resources", default="{}",
+                    help="extra resources as JSON")
+    ap.add_argument("--labels", default="{}")
+    ap.add_argument("--object-store-memory", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    host, _, port = args.address.rpartition(":")
+    token_hex = args.token or os.environ.get(
+        "RAY_TPU_CLUSTER_TOKEN", "")
+    if not token_hex:
+        ap.error("--token or RAY_TPU_CLUSTER_TOKEN required")
+    resources: dict[str, float] = {
+        "CPU": float(args.num_cpus if args.num_cpus is not None
+                     else (os.cpu_count() or 1))}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    resources.update(json.loads(args.resources))
+
+    daemon = NodeDaemon(
+        host or "127.0.0.1", int(port), bytes.fromhex(token_hex),
+        resources=resources, labels=json.loads(args.labels),
+        object_store_memory=args.object_store_memory)
+    print(f"ray_tpu node daemon up: node_id={daemon.node_id} "
+          f"head={args.address}", flush=True)
+    daemon.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

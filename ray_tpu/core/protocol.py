@@ -59,3 +59,36 @@ OP_PULL = "pull"                # chunked object pull (ObjectManager
 # client channel, driver -> worker: (req_id, status, payload)
 ST_OK = "ok"
 ST_ERR = "err"
+
+# ---------------------------------------------------------------------------
+# node channel (head <-> node daemon), one TCP connection per node.
+# The daemon (raylet analog, ray_tpu/core/node_daemon.py) registers its
+# resources, spawns workers on demand, and relays their exec channels;
+# large task returns stay in the daemon's local store and are pulled
+# over this channel's chunk plane (reference: node_manager.proto /
+# object_manager.proto services collapsed onto one multiplexed link).
+
+# daemon -> head
+ND_REGISTER = "nd_register"   # (ND_REGISTER, info_dict) — first message
+ND_WMSG = "nd_wmsg"           # (ND_WMSG, widx, exec_msg) worker reply up
+ND_WEXIT = "nd_wexit"         # (ND_WEXIT, widx, returncode)
+ND_STORED = "nd_stored"       # (ND_STORED, widx, task_id_bytes, entries)
+                              #   entry: ("inline", wire) |
+                              #          ("stored", oid_bytes, size, refs)
+ND_REPLY = "nd_reply"         # (ND_REPLY, fid, status, payload)
+ND_UPCALL = "nd_upcall"       # (ND_UPCALL, fid, op, payload) daemon-initiated
+                              #   ops: put_loc(size, refs) -> oid_bytes
+
+# head -> daemon
+ND_WSPAWN = "nd_wspawn"       # (ND_WSPAWN, widx, env_key, env_vars)
+ND_WKILL = "nd_wkill"         # (ND_WKILL, widx, "term"|"kill")
+ND_TASK_META = "nd_task_meta" # (ND_TASK_META, widx, task_id_bytes,
+                              #  [oid_bytes]) — return ids so the daemon
+                              #  can keep large results node-local
+ND_CALL = "nd_call"           # (ND_CALL, fid, op, payload); fid -1 = no
+                              #   reply. ops: fetch(oid) ->
+                              #   ("inline", data, bufs) | chunked meta;
+                              #   chunk(tid, i) -> bytes; end(tid);
+                              #   free(oid)
+ND_UPREPLY = "nd_upreply"     # (ND_UPREPLY, fid, status, payload)
+ND_SHUTDOWN = "nd_shutdown"   # (ND_SHUTDOWN,)

@@ -56,6 +56,15 @@ from ray_tpu.core.object_store import (
 from ray_tpu.core.serialization import SerializedObject
 
 
+def _sendable(obj: SerializedObject) -> tuple[bytes, list[bytes]]:
+    """(data, buffers) with every segment materialized as bytes —
+    shm/arena-backed views are not picklable over a connection."""
+    data = obj.data if isinstance(obj.data, bytes) else bytes(obj.data)
+    bufs = [b if isinstance(b, bytes) else bytes(b)
+            for b in obj.buffers]
+    return data, bufs
+
+
 def _wire_to_serialized(entry) -> SerializedObject:
     """(data, buffers[, (ref_id_bytes, nonce) pairs]) wire tuple ->
     SerializedObject. The optional third element carries nested
@@ -113,6 +122,21 @@ class NodeRecord:
     alive: bool = True
     is_head: bool = False
     started_at: float = field(default_factory=time.time)
+    # Daemon-backed nodes (a real ray_tpu.core.node_daemon process on
+    # the other end of a TCP connection). conn is None for the head
+    # node and for logical test nodes.
+    conn: Any = None
+    send_lock: Any = None
+    pid: int = 0
+    hostname: str = ""
+
+    @property
+    def is_daemon(self) -> bool:
+        return self.conn is not None
+
+    def node_send(self, msg: tuple) -> None:
+        with self.send_lock:
+            self.conn.send(msg)
 
 
 @dataclass
@@ -184,6 +208,70 @@ class PGRecord:
     bundle_nodes: list[str] = field(default_factory=list)
     ready: threading.Event = field(default_factory=threading.Event)
     created: bool = False
+
+
+class TransferPlane:
+    """Chunked object transfers in flight (ObjectManager analog,
+    SURVEY §2.1 N17: ObjectBufferPool chunking + pull-based flow
+    control). Shared by the head runtime and node daemons; a tid
+    prefix lets a splicing proxy route pulls to whichever side owns
+    the transfer. Entries idle >600s are purged lazily."""
+
+    def __init__(self, chunk_bytes: int, prefix: str = ""):
+        self._chunk = chunk_bytes
+        self._prefix = prefix
+        self._table: dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self.chunks_served = 0
+
+    def start(self, obj: SerializedObject) -> tuple:
+        import uuid
+        now = time.time()
+        tid = self._prefix + uuid.uuid4().hex
+        with self._lock:
+            stale = [t for t, (_, ts) in self._table.items()
+                     if now - ts > 600]
+            for t in stale:
+                self._table.pop(t, None)
+            self._table[tid] = (obj, now)
+        return ("chunked", tid, len(obj.data),
+                [len(b) for b in obj.buffers], self._chunk)
+
+    def chunk(self, tid: str, index: int) -> bytes:
+        with self._lock:
+            entry = self._table.get(tid)
+            if entry is not None:
+                # Refresh activity so a long multi-GB pull is never
+                # purged mid-transfer (expiry is idle-based).
+                self._table[tid] = (entry[0], time.time())
+        if entry is None:
+            raise KeyError(f"unknown or expired transfer {tid}")
+        obj, _ = entry
+        start = index * self._chunk
+        out = bytearray()
+        pos = 0
+        for seg in (obj.data, *obj.buffers):
+            seg_len = len(seg)
+            if start < pos + seg_len and len(out) < self._chunk:
+                lo = max(0, start - pos)
+                hi = min(seg_len, lo + (self._chunk - len(out)))
+                out += memoryview(seg)[lo:hi]
+            pos += seg_len
+            if len(out) >= self._chunk:
+                break
+        self.chunks_served += 1
+        return bytes(out)
+
+    def end(self, tid: str) -> None:
+        with self._lock:
+            self._table.pop(tid, None)
+
+    def owns(self, tid: str) -> bool:
+        return bool(self._prefix) and tid.startswith(self._prefix)
+
+    @property
+    def table(self) -> dict:
+        return self._table
 
 
 class WorkerDiedBeforeConnectError(RuntimeError):
@@ -330,6 +418,91 @@ class WorkerHandle:
                 self.proc.kill()
 
 
+class _RemoteProc:
+    """Process shim for a worker living on a node daemon. Mirrors the
+    subprocess.Popen surface the runtime touches (poll/kill/terminate/
+    wait/pid/returncode); signals travel over the node channel."""
+
+    def __init__(self, handle: "RemoteWorkerHandle"):
+        self._h = handle
+        self.pid = -handle.index          # not a local pid
+        self.returncode: int | None = None
+
+    def poll(self):
+        return self.returncode
+
+    def _signal(self, how: str) -> None:
+        try:
+            self._h.node.node_send((P.ND_WKILL, self._h.index, how))
+        except (OSError, BrokenPipeError, AttributeError):
+            pass
+
+    def kill(self):
+        self._signal("kill")
+
+    def terminate(self):
+        self._signal("term")
+
+    def wait(self, timeout=None):
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while self.returncode is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError
+            time.sleep(0.02)
+        return self.returncode
+
+
+class RemoteWorkerHandle:
+    """Head-side proxy of a worker process hosted by a node daemon.
+
+    Presents the same surface as WorkerHandle so the dispatch loop,
+    task retry, and actor restart machinery treat local and remote
+    workers identically (reference: the owner talks to every leased
+    worker over the same gRPC PushTask interface regardless of node,
+    normal_task_submitter.cc:547). ``send`` forwards the exec-channel
+    message over the node's TCP channel; replies come back through
+    ``_serve_node`` -> ``_on_worker_message``.
+    """
+
+    def __init__(self, runtime: "DriverRuntime", node: NodeRecord,
+                 env_key: str, env_vars: dict[str, str]):
+        self.index = next(WorkerHandle._counter)
+        self.env_key = env_key
+        self.node_id = node.node_id
+        self.node = node
+        self.busy = False
+        self.is_actor = False
+        self.actor_id: ActorID | None = None
+        self.dead = False
+        self.last_idle = time.monotonic()
+        self.sent_fn_ids: set[str] = set()
+        self.log_path = None
+        self._runtime = runtime
+        self.proc = _RemoteProc(self)
+        # Non-None => post-attach death handling is owned by the node
+        # channel (ND_WEXIT -> _on_worker_exit), matching the local
+        # reader-thread contract checked in _start_actor.
+        self.conn = ("remote", node.node_id)
+        runtime._remote_workers[self.index] = self
+        node.node_send((P.ND_WSPAWN, self.index, env_key,
+                        dict(env_vars)))
+
+    def send(self, msg: tuple) -> None:
+        if self.dead:
+            raise WorkerDiedBeforeConnectError(
+                f"remote worker {self.index} on {self.node_id} is dead")
+        self.node.node_send((P.ND_WMSG, self.index, msg))
+
+    def shutdown(self, timeout: float = 2.0) -> None:
+        try:
+            self.send((P.EXEC_SHUTDOWN,))
+        except (OSError, BrokenPipeError,
+                WorkerDiedBeforeConnectError):
+            pass
+        self._runtime._remote_workers.pop(self.index, None)
+
+
 # --------------------------------------------------------------------------
 # Driver runtime
 # --------------------------------------------------------------------------
@@ -452,11 +625,10 @@ class DriverRuntime:
         self._kv_lock = threading.Lock()
 
         # Chunked object transfers in flight (ObjectManager analog):
-        # tid -> (SerializedObject, started_at). Holding the object
-        # keeps its bytes/pinned views alive until the puller ends.
-        self._transfers: dict[str, tuple] = {}
-        self._transfer_lock = threading.Lock()
-        self._transfer_chunks_served = 0
+        # holding the object keeps its bytes/pinned views alive until
+        # the puller ends.
+        self.transfer_plane = TransferPlane(
+            config.object_transfer_chunk_bytes)
 
         # Events / timeline
         self._events: deque = deque(maxlen=config.task_event_buffer_size)
@@ -484,6 +656,20 @@ class DriverRuntime:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="client_accept")
         self._accept_thread.start()
+
+        # Cross-host control plane (GCS gRPC analog): a TCP listener
+        # node daemons and remote clients dial, started lazily by
+        # ensure_tcp_listener(). One NodeRecord.conn per daemon.
+        self._tcp_listener = None
+        self.tcp_address: tuple[str, int] | None = None
+        self.cluster_token: bytes = os.urandom(16)
+        self._remote_workers: dict[int, RemoteWorkerHandle] = {}
+        self._node_calls: dict[int, tuple] = {}   # fid -> (event, slot)
+        self._node_calls_lock = threading.Lock()
+        self._node_fid = itertools.count(1)
+        # Objects homed in a daemon's local store (location =
+        # ("node", node_id)): per-node index for death handling.
+        self._node_objects: dict[str, set[ObjectID]] = {}
 
         if not local_mode:
             self._dispatch_thread = threading.Thread(
@@ -541,7 +727,17 @@ class DriverRuntime:
         self.memory_store.delete(oid)
         self.shm_store.delete(oid)
         with self._obj_cv:
-            self._obj_locations.pop(oid, None)
+            loc = self._obj_locations.pop(oid, None)
+        if isinstance(loc, tuple):
+            # Node-homed: tell the daemon to drop its copy.
+            node = self._nodes.get(loc[1])
+            self._node_objects.get(loc[1], set()).discard(oid)
+            if node is not None and node.alive and node.is_daemon:
+                try:
+                    node.node_send((P.ND_CALL, -1, "free",
+                                    oid.binary()))
+                except (OSError, BrokenPipeError):
+                    pass
         # Cascade: refs nested in this object lose their container
         # pin; reclaim any that became unreferenced.
         with self._ref_lock:
@@ -695,7 +891,8 @@ class DriverRuntime:
     def _wait_location(self, oid: ObjectID,
                        deadline: float | None) -> str:
         """Block until the object has a location; raises the stored
-        error or GetTimeoutError. Returns "mem" | "shm"."""
+        error or GetTimeoutError. Returns "mem" | "shm" |
+        ("node", node_id)."""
         with self._obj_cv:
             while oid not in self._obj_locations:
                 remaining = (None if deadline is None
@@ -712,6 +909,8 @@ class DriverRuntime:
                        timeout: float | None = None) -> SerializedObject:
         deadline = None if timeout is None else time.monotonic() + timeout
         loc = self._wait_location(oid, deadline)
+        if isinstance(loc, tuple):      # ("node", node_id)
+            return self._fetch_from_node(loc[1], oid, deadline)
         if loc == "mem":
             obj = self.memory_store.try_get(oid)
             if obj is not None:
@@ -753,45 +952,19 @@ class DriverRuntime:
     # "remote node" here is any client that cannot map the shm arena).
 
     def _start_transfer(self, obj: SerializedObject) -> tuple:
-        import uuid
-        now = time.time()
-        tid = uuid.uuid4().hex
-        with self._transfer_lock:
-            # Purge transfers abandoned by dead clients.
-            stale = [t for t, (_, ts) in self._transfers.items()
-                     if now - ts > 600]
-            for t in stale:
-                self._transfers.pop(t, None)
-            self._transfers[tid] = (obj, now)
-        return ("chunked", tid, len(obj.data),
-                [len(b) for b in obj.buffers],
-                self.config.object_transfer_chunk_bytes)
+        return self.transfer_plane.start(obj)
 
     def _transfer_chunk(self, tid: str, index: int) -> bytes:
-        with self._transfer_lock:
-            entry = self._transfers.get(tid)
-            if entry is not None:
-                # Refresh activity so a long multi-GB pull is never
-                # purged mid-transfer (expiry is idle-based).
-                self._transfers[tid] = (entry[0], time.time())
-        if entry is None:
-            raise KeyError(f"unknown or expired transfer {tid}")
-        obj, _ = entry
-        chunk = self.config.object_transfer_chunk_bytes
-        start = index * chunk
-        out = bytearray()
-        pos = 0
-        for seg in (obj.data, *obj.buffers):
-            seg_len = len(seg)
-            if start < pos + seg_len and len(out) < chunk:
-                lo = max(0, start - pos)
-                hi = min(seg_len, lo + (chunk - len(out)))
-                out += memoryview(seg)[lo:hi]
-            pos += seg_len
-            if len(out) >= chunk:
-                break
-        self._transfer_chunks_served += 1
-        return bytes(out)
+        return self.transfer_plane.chunk(tid, index)
+
+    # Test/introspection shims over the transfer plane.
+    @property
+    def _transfers(self) -> dict:
+        return self.transfer_plane.table
+
+    @property
+    def _transfer_chunks_served(self) -> int:
+        return self.transfer_plane.chunks_served
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -883,13 +1056,35 @@ class DriverRuntime:
         return ser.dumps((args, kwargs)), arg_refs
 
     def _resolve_args_payload(self, rec_args_blob: bytes,
-                              arg_refs: list[ObjectRef]):
+                              arg_refs: list[ObjectRef],
+                              remote: bool = False):
         # Ship resolved values of top-level refs alongside: small
         # objects inline; shm-resident objects as descriptors the
         # worker reads zero-copy from the mapped arena (plasma arg
-        # fetch — the bytes never transit the exec socket).
+        # fetch — the bytes never transit the exec socket). For
+        # daemon-hosted workers, node-homed values go as ("fetch",
+        # oid) markers: the worker pulls through its client channel,
+        # which its local daemon serves straight from the node store
+        # when the object is already there.
         resolved = {}
         for r in arg_refs:
+            if remote:
+                loc = self._obj_locations.get(r.id)
+                if isinstance(loc, tuple):
+                    resolved[r.id.binary()] = ("fetch", r.id.binary())
+                    continue
+                # A daemon-hosted worker cannot map the head's arena:
+                # small values go inline; large ones as fetch markers
+                # so the bytes ride the chunked pull plane instead of
+                # head-of-line-blocking the multiplexed node channel.
+                obj = self.get_serialized(r.id)
+                if (obj.total_size
+                        > self.config.object_transfer_inline_max):
+                    resolved[r.id.binary()] = ("fetch", r.id.binary())
+                else:
+                    data, bufs = _sendable(obj)
+                    resolved[r.id.binary()] = ("inline", data, bufs)
+                continue
             kind, val = self.get_serialized_or_desc(r.id)
             if kind == "desc":
                 resolved[r.id.binary()] = ("desc", val)
@@ -1321,9 +1516,19 @@ class DriverRuntime:
         return node_id
 
     def remove_node(self, node_id: str) -> None:
-        """Simulated node failure: mark dead, kill its worker
-        processes (their exits drive task retry / actor restart —
-        GcsNodeManager::OnNodeFailure analog, gcs_node_manager.cc:408)."""
+        """Node removal / simulated failure: tell a daemon-backed node
+        to exit, then run the death path (mark dead, kill workers,
+        lose its objects — GcsNodeManager::OnNodeFailure analog,
+        gcs_node_manager.cc:408)."""
+        node = self._nodes.get(node_id)
+        if node is not None and node.is_daemon and node.alive:
+            try:
+                node.node_send((P.ND_SHUTDOWN,))
+            except (OSError, BrokenPipeError):
+                pass
+        self._handle_node_death(node_id)
+
+    def _handle_node_death(self, node_id: str) -> None:
         with self._res_cv:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
@@ -1331,13 +1536,39 @@ class DriverRuntime:
             node.alive = False
             node.avail = {}
             self._res_cv.notify_all()
+        # Local worker processes pinned to the (logical) node die by
+        # signal; daemon-hosted workers are marked dead here and fail
+        # over through the same _on_worker_exit path their reader
+        # thread would have taken.
         with self._pool_lock:
             victims = [w for w in self._workers if w.node_id == node_id]
+        remote_victims = [w for w in list(self._remote_workers.values())
+                          if w.node_id == node_id]
         for w in victims:
+            if isinstance(w, RemoteWorkerHandle):
+                continue
             try:
                 w.proc.kill()
             except Exception:  # noqa: BLE001
                 pass
+        for w in remote_victims:
+            self._remote_workers.pop(w.index, None)
+            if not w.dead:
+                w.dead = True
+                w.proc.returncode = -9
+                try:
+                    self._on_worker_exit(w)
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
+        # Objects homed in the dead node's store are lost (reference:
+        # raylets evict a dead node's objects; recovery is lineage
+        # reconstruction's job).
+        lost = self._node_objects.pop(node_id, set())
+        for oid in lost:
+            with self._obj_cv:
+                if self._obj_locations.get(oid) != ("node", node_id):
+                    continue
+            self._on_object_lost(oid, node_id)
         # Re-home placement-group bundles that lived on the dead node.
         with self._res_cv:
             for pg_rec in self._pgs.values():
@@ -1352,6 +1583,15 @@ class DriverRuntime:
                         pg_rec.bundle_nodes[bi] = placed[0]
                         pg_rec.bundle_avail[bi] = dict(
                             pg_rec.bundles[bi])
+
+    def _on_object_lost(self, oid: ObjectID, node_id: str) -> None:
+        """A stored object's home store is gone. Round-2 behavior:
+        surface ObjectLostError to pending/future gets (lineage
+        reconstruction hooks in here next)."""
+        blob = ser.dumps(ObjectLostError(
+            f"object {oid.hex()} was stored on node {node_id}, "
+            f"which died"))
+        self._store_error(oid, blob)
 
     def _env_for_options(self, options: TaskOptions) -> tuple[str, dict]:
         from ray_tpu.runtime_env import (
@@ -1373,6 +1613,16 @@ class DriverRuntime:
             ser.dumps(sorted(env_vars.items()))).hexdigest()[:12]
         return key, env_vars
 
+    def _make_worker(self, env_key: str, env_vars: dict,
+                     node_id: str):
+        """Spawn a worker on the given node: a local subprocess for
+        the head/logical nodes, a daemon-hosted process for real
+        remote nodes (same exec-channel contract either way)."""
+        node = self._nodes.get(node_id)
+        if node is not None and node.is_daemon:
+            return RemoteWorkerHandle(self, node, env_key, env_vars)
+        return WorkerHandle(self, env_key, env_vars, node_id=node_id)
+
     def _take_worker(self, env_key: str, env_vars: dict,
                      node_id: str = "") -> WorkerHandle:
         node_id = node_id or self.head_node_id
@@ -1383,7 +1633,7 @@ class DriverRuntime:
                 if not w.dead:
                     w.busy = True
                     return w
-            w = WorkerHandle(self, env_key, env_vars, node_id=node_id)
+            w = self._make_worker(env_key, env_vars, node_id)
             w.busy = True
             self._workers.append(w)
             return w
@@ -1445,7 +1695,16 @@ class DriverRuntime:
         if rec.fn_id not in w.sent_fn_ids:
             fn_blob = self._fn_cache[rec.fn_id]
             w.sent_fn_ids.add(rec.fn_id)
-        resolved = self._resolve_args_payload(rec.args_blob, rec.arg_refs)
+        is_remote = isinstance(w, RemoteWorkerHandle)
+        resolved = self._resolve_args_payload(
+            rec.args_blob, rec.arg_refs, remote=is_remote)
+        if is_remote and rec.return_ids:
+            # Return ids ride ahead of the task so the daemon can keep
+            # large results in its local store (ND_STORED) instead of
+            # shipping them to the head.
+            w.node.node_send((P.ND_TASK_META, w.index,
+                              rec.task_id.binary(),
+                              [o.binary() for o in rec.return_ids]))
         w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id, fn_blob,
                 rec.args_blob, resolved, rec.options.num_returns,
                 getattr(rec.options, "trace_ctx", None)))
@@ -1498,16 +1757,29 @@ class DriverRuntime:
                     rec.state = "ALIVE"
                     rec.ready_event.set()
 
+    def _store_result_entries(self, w, return_ids, entries) -> None:
+        """Mixed result entries from a node daemon (ND_STORED):
+        ("inline", wire) stores head-side; ("stored", oid, size, refs)
+        registers the daemon-resident copy in the directory."""
+        for oid, e in zip(return_ids, entries):
+            if e[0] == "stored":
+                self._store_remote(oid, w.node_id, e[2], e[3])
+            else:
+                self._store_value(oid, _wire_to_serialized(e[1]))
+
     def _finish_task(self, w: WorkerHandle, task_id: TaskID,
-                     results, err_blob) -> None:
+                     results, err_blob, entries=None) -> None:
         with self._task_lock:
             rec = self._tasks.get(task_id)
         if rec is None:
             return
         if err_blob is None:
-            vals = [_wire_to_serialized(e) for e in results]
-            for oid, v in zip(rec.return_ids, vals):
-                self._store_value(oid, v)
+            if entries is not None:
+                self._store_result_entries(w, rec.return_ids, entries)
+            else:
+                vals = [_wire_to_serialized(e) for e in results]
+                for oid, v in zip(rec.return_ids, vals):
+                    self._store_value(oid, v)
             rec.state = "FINISHED"
         else:
             for oid in rec.return_ids:
@@ -1661,8 +1933,8 @@ class DriverRuntime:
             if rec.env_vars is None:
                 rec.env_key, rec.env_vars = self._env_for_options(
                     rec.options)
-            w = WorkerHandle(self, f"actor_{rec.actor_id.hex()[:8]}",
-                             rec.env_vars, node_id=rec.node_id)
+            w = self._make_worker(f"actor_{rec.actor_id.hex()[:8]}",
+                                  rec.env_vars, rec.node_id)
             w.is_actor = True
             w.actor_id = rec.actor_id
             w.busy = True
@@ -1670,7 +1942,8 @@ class DriverRuntime:
             with self._pool_lock:
                 self._workers.append(w)
             resolved = self._resolve_args_payload(
-                rec.init_args_blob, rec.init_arg_refs)
+                rec.init_args_blob, rec.init_arg_refs,
+                remote=isinstance(w, RemoteWorkerHandle))
             try:
                 w.send((P.EXEC_ACTOR_INIT, rec.actor_id.binary(),
                         rec.cls_blob, rec.init_args_blob, resolved,
@@ -1788,11 +2061,18 @@ class DriverRuntime:
                 if rec.state == "DEAD":
                     raise rec.creation_error or ActorDiedError(
                         rec.actor_id.hex(), "actor is dead")
-                resolved = self._resolve_args_payload(args_blob, arg_refs)
+                w = rec.worker
+                is_remote = isinstance(w, RemoteWorkerHandle)
+                resolved = self._resolve_args_payload(
+                    args_blob, arg_refs, remote=is_remote)
                 rec.in_flight[task_id] = (return_ids, method)
-                rec.worker.send((P.EXEC_ACTOR_CALL, task_id.binary(),
-                                 method, args_blob, resolved,
-                                 num_returns, trace_ctx))
+                if is_remote and return_ids:
+                    w.node.node_send((P.ND_TASK_META, w.index,
+                                      task_id.binary(),
+                                      [o.binary() for o in return_ids]))
+                w.send((P.EXEC_ACTOR_CALL, task_id.binary(),
+                        method, args_blob, resolved,
+                        num_returns, trace_ctx))
             except Exception as e:  # noqa: BLE001
                 rec.in_flight.pop(task_id, None)
                 blob = ser.dumps(e if isinstance(e, ActorDiedError) else
@@ -1803,7 +2083,7 @@ class DriverRuntime:
                 self._finish_stream(task_id, blob)
 
     def _finish_actor_task(self, w: WorkerHandle, task_id: TaskID,
-                           results, err_blob) -> None:
+                           results, err_blob, entries=None) -> None:
         rec = self._actors.get(w.actor_id) if w.actor_id else None
         if rec is None:
             return
@@ -1812,9 +2092,12 @@ class DriverRuntime:
             return
         return_ids, _method = entry
         if err_blob is None:
-            vals = [_wire_to_serialized(e) for e in results]
-            for oid, v in zip(return_ids, vals):
-                self._store_value(oid, v)
+            if entries is not None:
+                self._store_result_entries(w, return_ids, entries)
+            else:
+                vals = [_wire_to_serialized(e) for e in results]
+                for oid, v in zip(return_ids, vals):
+                    self._store_value(oid, v)
         else:
             for oid in return_ids:
                 self._store_error(oid, err_blob)
@@ -2140,12 +2423,35 @@ class DriverRuntime:
         with self._pending_workers_lock:
             self._pending_workers[w.token] = w
 
-    def _accept_loop(self) -> None:
+    def ensure_tcp_listener(self, host: str = "127.0.0.1",
+                            port: int = 0) -> tuple[str, int]:
+        """Start the cross-host TCP listener (idempotent). Node
+        daemons and remote clients authenticate with the session's
+        cluster_token (multiprocessing.connection HMAC handshake —
+        the reference secures this hop with gRPC + cluster identity)."""
+        if self._tcp_listener is not None:
+            return self.tcp_address
+        self._tcp_listener = mpc.Listener(
+            (host, port), family="AF_INET",
+            authkey=self.cluster_token)
+        self.tcp_address = self._tcp_listener.address
+        threading.Thread(
+            target=self._accept_loop, args=(self._tcp_listener,),
+            daemon=True, name="tcp_accept").start()
+        return self.tcp_address
+
+    def _accept_loop(self, listener=None) -> None:
+        listener = listener or self._listener
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
-            except (OSError, EOFError):
-                return
+                conn = listener.accept()
+            except Exception:  # noqa: BLE001
+                # Bad token (AuthenticationError) or a dropped dial
+                # must not kill the accept loop; a closed listener
+                # (shutdown() flips the flag first) ends it.
+                if self._shutdown:
+                    return
+                continue
             t = threading.Thread(target=self._handshake, args=(conn,),
                                  daemon=True)
             t.start()
@@ -2154,7 +2460,9 @@ class DriverRuntime:
     def _handshake(self, conn) -> None:
         # First message identifies the connection: ("hello", "exec",
         # token) pairs an exec channel with its WorkerHandle;
-        # ("hello", "client", _) starts an API-proxy session.
+        # ("hello", "client", _) starts an API-proxy session;
+        # ("hello", "node", _) registers a node daemon (the connection
+        # becomes that node's control channel).
         try:
             hello = conn.recv()
         except (EOFError, OSError):
@@ -2171,6 +2479,8 @@ class DriverRuntime:
                 conn.close()
                 return
             w.attach_conn(conn)
+        elif kind == "node":
+            self._serve_node(conn)
         else:
             self._serve_client(conn)
 
@@ -2242,6 +2552,214 @@ class DriverRuntime:
                     except Exception:  # noqa: BLE001
                         pass
 
+    # ---------------- node daemon channel (raylet link) ---------------
+
+    def _serve_node(self, conn) -> None:
+        """Serve one node daemon's control channel for its lifetime.
+        EOF (daemon crash/SIGKILL) is node death: fail over workers,
+        lose node-homed objects, re-home PG bundles (reference:
+        GcsNodeManager::OnNodeFailure, gcs_node_manager.cc:408)."""
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        if not (isinstance(msg, tuple) and msg[0] == P.ND_REGISTER):
+            conn.close()
+            return
+        info = msg[1] or {}
+        resources = dict(info.get("resources") or {"CPU": 1.0})
+        with self._res_cv:
+            node_id = self._add_node_locked_free(
+                resources, info.get("labels"))
+            node = self._nodes[node_id]
+            node.conn = conn
+            node.send_lock = threading.Lock()
+            node.pid = int(info.get("pid", 0))
+            node.hostname = str(info.get("hostname", ""))
+            self._res_cv.notify_all()
+        try:
+            node.node_send(("registered", node_id))
+            while True:
+                msg = conn.recv()
+                kind = msg[0]
+                if kind == P.ND_WMSG:
+                    _, widx, wmsg = msg
+                    w = self._remote_workers.get(widx)
+                    if w is not None:
+                        try:
+                            self._on_worker_message(w, wmsg)
+                        except Exception:  # noqa: BLE001
+                            traceback.print_exc()
+                elif kind == P.ND_WEXIT:
+                    _, widx, rc = msg
+                    w = self._remote_workers.pop(widx, None)
+                    if w is not None and not w.dead:
+                        w.dead = True
+                        w.proc.returncode = rc if rc is not None else -1
+                        try:
+                            self._on_worker_exit(w)
+                        except Exception:  # noqa: BLE001
+                            traceback.print_exc()
+                elif kind == P.ND_STORED:
+                    _, widx, task_id_bytes, entries = msg
+                    w = self._remote_workers.get(widx)
+                    if w is None:
+                        continue
+                    task_id = TaskID(task_id_bytes)
+                    try:
+                        if w.is_actor:
+                            self._finish_actor_task(
+                                w, task_id, None, None, entries=entries)
+                        else:
+                            self._finish_task(
+                                w, task_id, None, None, entries=entries)
+                    except Exception:  # noqa: BLE001
+                        traceback.print_exc()
+                elif kind == P.ND_REPLY:
+                    _, fid, status, payload = msg
+                    with self._node_calls_lock:
+                        entry = self._node_calls.pop(fid, None)
+                    if entry is not None:
+                        event, slot, _nid = entry
+                        slot.append((status, payload))
+                        event.set()
+                elif kind == P.ND_UPCALL:
+                    _, fid, op, payload = msg
+                    threading.Thread(
+                        target=self._handle_node_upcall,
+                        args=(node, fid, op, payload),
+                        daemon=True).start()
+        except (EOFError, OSError):
+            pass
+        finally:
+            self._on_node_disconnect(node_id)
+
+    def _handle_node_upcall(self, node: NodeRecord, fid: int, op: str,
+                            payload) -> None:
+        try:
+            if op == "put_loc":
+                # A worker on this node put an object into the node's
+                # local store: assign the id centrally and record the
+                # location (directory entry). The remote holder pins it
+                # like any client put.
+                size, refs = payload
+                oid = ObjectID.for_put(next(self._put_counter))
+                self._store_remote(oid, node.node_id, size, refs)
+                self.on_ref_escaped(oid)
+                result = oid.binary()
+            else:
+                raise ValueError(f"unknown node upcall {op!r}")
+            status, out = P.ST_OK, result
+        except BaseException as e:  # noqa: BLE001
+            status, out = P.ST_ERR, ser.dumps(e)
+        if fid == -1:
+            return
+        try:
+            node.node_send((P.ND_UPREPLY, fid, status, out))
+        except (OSError, BrokenPipeError):
+            pass
+
+    def _node_call(self, node: NodeRecord, op: str, payload,
+                   timeout: float | None = 60.0):
+        """Request/response over a node daemon channel (fetch/chunk/
+        free). Replies are demuxed by fid in _serve_node."""
+        fid = next(self._node_fid)
+        event = threading.Event()
+        slot: list = []
+        with self._node_calls_lock:
+            self._node_calls[fid] = (event, slot, node.node_id)
+        try:
+            node.node_send((P.ND_CALL, fid, op, payload))
+        except (OSError, BrokenPipeError) as e:
+            with self._node_calls_lock:
+                self._node_calls.pop(fid, None)
+            raise ObjectLostError(
+                f"node {node.node_id} unreachable") from e
+        if not event.wait(timeout):
+            with self._node_calls_lock:
+                self._node_calls.pop(fid, None)
+            raise GetTimeoutError(
+                f"node {node.node_id} op {op} timed out")
+        status, result = slot[0]
+        if status == P.ST_ERR:
+            raise ser.loads(result)
+        return result
+
+    def _on_node_disconnect(self, node_id: str) -> None:
+        if self._shutdown:
+            return
+        # Fail any in-flight node calls against this node.
+        with self._node_calls_lock:
+            stale = [fid for fid, (_e, _s, nid)
+                     in self._node_calls.items() if nid == node_id]
+            for fid in stale:
+                event, slot, _nid = self._node_calls.pop(fid)
+                slot.append((P.ST_ERR, ser.dumps(ObjectLostError(
+                    f"node {node_id} disconnected"))))
+                event.set()
+        self._handle_node_death(node_id)
+
+    def _fetch_from_node(self, node_id: str, oid: ObjectID,
+                         deadline: float | None) -> SerializedObject:
+        """Pull one node-homed object over the daemon channel's chunk
+        plane (ObjectManager pull analog, object_manager.h:117)."""
+        node = self._nodes.get(node_id)
+        if node is None or not node.alive or not node.is_daemon:
+            raise ObjectLostError(oid.hex())
+        def remaining() -> float | None:
+            if deadline is None:
+                return None
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise GetTimeoutError(oid.hex())
+            return left
+
+        meta = self._node_call(node, "fetch", oid.binary(),
+                               remaining())
+        if meta[0] == "inline":
+            return SerializedObject(data=meta[1],
+                                    buffers=list(meta[2]))
+        _, tid, data_len, buf_lens, chunk = meta
+        total = data_len + sum(buf_lens)
+        nchunks = -(-total // chunk) if total else 0
+        buf = bytearray(total)
+        try:
+            for i in range(nchunks):
+                piece = self._node_call(node, "chunk", (tid, i),
+                                        remaining())
+                buf[i * chunk:i * chunk + len(piece)] = piece
+        finally:
+            try:
+                node.node_send((P.ND_CALL, -1, "end", tid))
+            except (OSError, BrokenPipeError):
+                pass
+        mv = memoryview(buf)
+        buffers = []
+        pos = data_len
+        for ln in buf_lens:
+            buffers.append(mv[pos:pos + ln])
+            pos += ln
+        return SerializedObject(data=bytes(mv[:data_len]),
+                                buffers=buffers)
+
+    def _store_remote(self, oid: ObjectID, node_id: str, size: int,
+                      refs) -> None:
+        """Directory entry for an object living in a node daemon's
+        local store (reference: ownership_based_object_directory.cc).
+        refs: [(ref_id_bytes, nonce)] nested inside the stored value —
+        container-pinned exactly like locally stored objects."""
+        if refs:
+            shim = SerializedObject(
+                data=b"", buffers=[],
+                contained_refs=[(ObjectID(b), n) for b, n in refs])
+            self._register_contained_refs(oid, shim)
+        with self._obj_cv:
+            self._obj_locations[oid] = ("node", node_id)
+            self._node_objects.setdefault(node_id, set()).add(oid)
+            self._obj_cv.notify_all()
+        with self._res_cv:
+            self._res_cv.notify_all()
+
     def _handle_client_op(self, op: str, payload):
         if op == P.OP_SUBMIT:
             fn_id, fn_blob, fn_name, args_kwargs_blob, opts_blob = payload
@@ -2278,13 +2796,13 @@ class DriverRuntime:
                 # rounds, so other client ops interleave instead of
                 # queueing behind one multi-GB message.
                 return self._start_transfer(val)
-            return ("inline", val.data, val.buffers)
+            data, bufs = _sendable(val)
+            return ("inline", data, bufs)
         if op == P.OP_PULL:
             action, tid, *prest = payload
             if action == "chunk":
                 return self._transfer_chunk(tid, prest[0])
-            with self._transfer_lock:
-                self._transfers.pop(tid, None)   # "end"
+            self.transfer_plane.end(tid)   # "end"
             return None
         if op == P.OP_WAIT:
             oid_bytes_list, num_returns, timeout = payload
@@ -2410,16 +2928,31 @@ class DriverRuntime:
             self.log_monitor.stop()
         with self._res_cv:
             self._res_cv.notify_all()
+            daemons = [n for n in self._nodes.values()
+                       if n.is_daemon and n.alive]
+        for n in daemons:
+            try:
+                n.node_send((P.ND_SHUTDOWN,))
+            except (OSError, BrokenPipeError):
+                pass
         with self._pool_lock:
             workers = list(self._workers)
             self._workers.clear()
             self._idle.clear()
         for w in workers:
+            if isinstance(w, RemoteWorkerHandle):
+                continue     # its daemon tears it down
             w.shutdown(timeout=1.0)
+        self._remote_workers.clear()
         try:
             self._listener.close()
         except OSError:
             pass
+        if self._tcp_listener is not None:
+            try:
+                self._tcp_listener.close()
+            except OSError:
+                pass
         try:
             os.unlink(self.client_address)
         except OSError:

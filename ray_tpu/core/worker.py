@@ -351,6 +351,13 @@ def _resolved_to_serialized(entry) -> SerializedObject:
                 return get_runtime().get_serialized(
                     ObjectID(desc[2]), timeout=30)
             raise
+    if entry[0] == "fetch":
+        # Node-homed value (daemon-hosted workers): pull through the
+        # client channel — the local daemon serves same-node objects
+        # from its store, the head relays cross-node pulls.
+        from ray_tpu.core.api import get_runtime
+        return get_runtime().get_serialized(ObjectID(entry[1]),
+                                            timeout=120)
     _tag, data, buffers = entry
     return SerializedObject(data=data, buffers=list(buffers))
 

@@ -1,0 +1,226 @@
+"""Real distributed control plane: head + node daemons as separate
+OS processes over TCP.
+
+Reference analogs: the raylet process boundary
+(src/ray/raylet/main.cc:123), chunked inter-node object pull
+(object_manager.h:117), node-death failover
+(gcs_node_manager.cc:408 OnNodeFailure). These tests assert actual
+process boundaries: distinct PIDs, objects homed in the daemon's
+store, SIGKILL-driven failover.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def test_daemons_are_separate_processes(cluster):
+    n1 = cluster.add_node(num_cpus=1)
+    n2 = cluster.add_node(num_cpus=1)
+    assert n1.proc is not None and n2.proc is not None
+    pids = {os.getpid(), n1.proc.pid, n2.proc.pid}
+    assert len(pids) == 3      # head + 2 daemons, 3 OS processes
+    # The head's node table carries the daemon pids.
+    rt = ray_tpu.core.api.get_runtime()
+    assert rt._nodes[n1.node_id].pid == n1.proc.pid
+    assert rt._nodes[n2.node_id].is_daemon
+
+
+def test_task_runs_inside_daemon_process_tree(cluster):
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def whoami():
+        return (os.getpid(), os.getppid(),
+                ray_tpu.get_runtime_context().get_node_id())
+
+    ref = whoami.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id)).remote()
+    pid, ppid, node_id = ray_tpu.get(ref, timeout=60)
+    assert node_id == n2.node_id
+    assert ppid == n2.proc.pid       # spawned by the daemon, not head
+    assert pid not in (os.getpid(), n2.proc.pid)
+
+
+def test_large_result_stays_node_local_and_pulls_chunked(cluster):
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        return np.arange(3_000_000, dtype=np.float32)  # ~12 MB
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id)).remote()
+    ray_tpu.wait([ref], timeout=60)
+    rt = ray_tpu.core.api.get_runtime()
+    loc = rt._obj_locations.get(ref.id)
+    assert loc == ("node", n2.node_id)       # homed in daemon's store
+    val = ray_tpu.get(ref, timeout=60)       # pulled over TCP chunks
+    assert val.shape == (3_000_000,)
+    assert float(val[12345]) == 12345.0
+
+
+def test_cross_node_object_consumption(cluster):
+    n2 = cluster.add_node(num_cpus=1)
+    n3 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        return np.ones(500_000, dtype=np.float64)    # ~4 MB
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return float(arr.sum()), \
+            ray_tpu.get_runtime_context().get_node_id()
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id)).remote()
+    out = consume.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n3.node_id)).remote(ref)
+    total, home = ray_tpu.get(out, timeout=90)
+    assert total == 500_000.0
+    assert home == n3.node_id
+
+
+def test_same_node_arg_served_locally(cluster):
+    n2 = cluster.add_node(num_cpus=1)
+    pin = NodeAffinitySchedulingStrategy(n2.node_id)
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        return np.full(400_000, 7.0)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return float(arr[0])
+
+    ref = produce.options(scheduling_strategy=pin).remote()
+    assert ray_tpu.get(
+        consume.options(scheduling_strategy=pin).remote(ref),
+        timeout=90) == 7.0
+
+
+def test_nested_remote_calls_from_daemon_worker(cluster):
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote(num_cpus=1)
+    def outer():
+        # Control-plane ops proxied daemon -> head over TCP.
+        return ray_tpu.get(inner.remote(21), timeout=60)
+
+    ref = outer.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id)).remote()
+    assert ray_tpu.get(ref, timeout=90) == 42
+
+
+def test_worker_put_homed_on_node(cluster):
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def put_and_pass():
+        ref = ray_tpu.put(np.arange(300_000))   # ~2.4 MB
+        return [ref]
+
+    [inner_ref] = ray_tpu.get(
+        put_and_pass.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                n2.node_id)).remote(), timeout=60)
+    rt = ray_tpu.core.api.get_runtime()
+    assert rt._obj_locations.get(inner_ref.id) == ("node", n2.node_id)
+    assert int(ray_tpu.get(inner_ref, timeout=60)[299_999]) == 299_999
+
+
+def test_sigkill_node_daemon_retries_task(cluster):
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def slow_where():
+        time.sleep(2.0)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    ref = slow_where.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=True)).remote()
+    time.sleep(1.0)                  # let it start on n2
+    n2.proc.kill()                   # real SIGKILL, head sees TCP EOF
+    out = ray_tpu.get(ref, timeout=120)
+    assert out == cluster.head_node.node_id
+
+
+def test_sigkill_node_daemon_restarts_actor(cluster):
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1, max_restarts=2)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return (self.n,
+                    ray_tpu.get_runtime_context().get_node_id())
+
+    a = Counter.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=True)).remote()
+    n, home = ray_tpu.get(a.bump.remote(), timeout=60)
+    assert (n, home) == (1, n2.node_id)
+    n2.proc.kill()
+    deadline = time.time() + 60
+    out = None
+    while time.time() < deadline:
+        try:
+            out = ray_tpu.get(a.bump.remote(), timeout=30)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(0.5)
+    assert out is not None
+    n, home = out
+    assert home == cluster.head_node.node_id
+    assert n == 1        # fresh incarnation (state reset on restart)
+
+
+def test_sigkill_node_loses_homed_objects(cluster):
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=0)
+    def produce():
+        return np.zeros(1_000_000)
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id)).remote()
+    ray_tpu.wait([ref], timeout=60)
+    n2.proc.kill()
+    deadline = time.time() + 30
+    rt = ray_tpu.core.api.get_runtime()
+    while time.time() < deadline:
+        if not rt._nodes[n2.node_id].alive:
+            break
+        time.sleep(0.05)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(ref, timeout=30)
