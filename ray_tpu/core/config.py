@@ -69,6 +69,15 @@ class Config:
     # --- fault tolerance ---
     # Default task max retries (reference: max_retries=3 default).
     task_max_retries: int = 3
+    # Lineage reconstruction (reference: ObjectRecoveryManager,
+    # object_recovery_manager.h:41): re-execute the creating task when
+    # a stored object is lost with its node. The lineage cache retains
+    # task specs up to this many bytes of pickled args (reference:
+    # lineage bytes cap, task_manager.h:215-222); 0 disables
+    # reconstruction entirely.
+    lineage_cache_max_bytes: int = 256 * 1024 * 1024
+    # Max re-executions of one task for object recovery.
+    max_reconstructions: int = 3
     # Default actor max restarts.
     actor_max_restarts: int = 0
     # Health-check period for actor/worker processes.

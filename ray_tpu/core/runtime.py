@@ -196,6 +196,26 @@ class ActorRecord:
 
 
 @dataclass
+class LineageRecord:
+    """Retained spec of a finished task so its return objects can be
+    rebuilt by re-execution after loss (reference: lineage retention
+    in TaskManager, task_manager.h:560-602; recovery driven by
+    ObjectRecoveryManager, object_recovery_manager.h:41). Holding
+    arg_refs pins the argument objects — the reference's "lineage
+    pinning" — until the record is evicted by the byte budget."""
+    fn_id: str
+    name: str
+    args_blob: bytes
+    arg_refs: list
+    options: "TaskOptions"
+    return_ids: list
+    nbytes: int = 0
+    reconstructions: int = 0
+    rebuilding: bool = False
+    live_returns: set = field(default_factory=set)
+
+
+@dataclass
 class PGRecord:
     pg_id: PlacementGroupID
     bundles: list[dict[str, float]]
@@ -604,6 +624,14 @@ class DriverRuntime:
         self._streams: dict[TaskID, _StreamState] = {}
         self._stream_lock = threading.Lock()
 
+        # Lineage cache for object reconstruction (LRU by insertion,
+        # evicted once the pickled-args budget is exceeded).
+        from collections import OrderedDict
+        self._lineage: "OrderedDict[TaskID, LineageRecord]" = \
+            OrderedDict()
+        self._lineage_bytes = 0
+        self._lineage_lock = threading.Lock()
+
         # Worker pool
         self._workers: list[WorkerHandle] = []
         self._idle: dict[str, list[WorkerHandle]] = {}
@@ -726,6 +754,7 @@ class DriverRuntime:
     def _delete_object(self, oid: ObjectID) -> None:
         self.memory_store.delete(oid)
         self.shm_store.delete(oid)
+        self._lineage_release_return(oid)
         with self._obj_cv:
             loc = self._obj_locations.pop(oid, None)
         if isinstance(loc, tuple):
@@ -910,7 +939,21 @@ class DriverRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         loc = self._wait_location(oid, deadline)
         if isinstance(loc, tuple):      # ("node", node_id)
-            return self._fetch_from_node(loc[1], oid, deadline)
+            try:
+                return self._fetch_from_node(loc[1], oid, deadline)
+            except ObjectLostError:
+                # The holder died under us (get racing node death):
+                # the death handler may not have reached this oid yet,
+                # so try lineage recovery here instead of surfacing a
+                # loss the system can repair.
+                with self._obj_cv:
+                    if self._obj_locations.get(oid) == loc:
+                        self._obj_locations.pop(oid, None)
+                if not self._try_reconstruct(oid):
+                    raise
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                return self.get_serialized(oid, remaining)
         if loc == "mem":
             obj = self.memory_store.try_get(oid)
             if obj is not None:
@@ -922,9 +965,15 @@ class DriverRuntime:
                 return obj
         desc = self.shm_store.get_descriptor(oid)
         if desc is None:
-            # raced a deletion
+            # raced a deletion, or the spilled copy is gone
             obj = self.memory_store.try_get(oid)
             if obj is None:
+                with self._obj_cv:
+                    self._obj_locations.pop(oid, None)
+                if self._try_reconstruct(oid):
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    return self.get_serialized(oid, remaining)
                 raise ObjectLostError(oid.hex())
             return obj
         return read_descriptor(desc)
@@ -1031,6 +1080,19 @@ class DriverRuntime:
             env_key=env_key, env_vars=env_vars)
         with self._task_lock:
             self._tasks[task_id] = rec
+        effective_retries = (options.max_retries
+                             if options.max_retries >= 0
+                             else self.config.task_max_retries)
+        if (not streaming and effective_retries > 0
+                and self.config.lineage_cache_max_bytes > 0):
+            # max_retries=0 declares the task unsafe to re-run (side
+            # effects): its returns are not reconstructable, matching
+            # the reference's retryable-task gate.
+            self._lineage_put(task_id, LineageRecord(
+                fn_id=fn_id, name=rec.name, args_blob=args_blob,
+                arg_refs=list(arg_refs), options=options,
+                return_ids=list(return_ids),
+                nbytes=len(args_blob) + 256))
         if streaming:
             with self._stream_lock:
                 self._streams[task_id] = _StreamState(
@@ -1585,13 +1647,134 @@ class DriverRuntime:
                             pg_rec.bundles[bi])
 
     def _on_object_lost(self, oid: ObjectID, node_id: str) -> None:
-        """A stored object's home store is gone. Round-2 behavior:
-        surface ObjectLostError to pending/future gets (lineage
-        reconstruction hooks in here next)."""
+        """A stored object's home store is gone: rebuild it through
+        lineage if we can (reference: ObjectRecoveryManager re-submits
+        the creating task, object_recovery_manager.h:41), else surface
+        ObjectLostError to pending/future gets."""
+        with self._obj_cv:
+            self._obj_locations.pop(oid, None)
+        if self._try_reconstruct(oid):
+            return
         blob = ser.dumps(ObjectLostError(
             f"object {oid.hex()} was stored on node {node_id}, "
-            f"which died"))
+            f"which died, and could not be reconstructed"))
         self._store_error(oid, blob)
+
+    # ---------------- lineage reconstruction ----------------
+
+    def _lineage_put(self, task_id: TaskID,
+                     lin: LineageRecord) -> None:
+        lin.live_returns = set(lin.return_ids)
+        with self._lineage_lock:
+            self._lineage[task_id] = lin
+            self._lineage_bytes += lin.nbytes
+            budget = self.config.lineage_cache_max_bytes
+            while self._lineage_bytes > budget and self._lineage:
+                _tid, old = self._lineage.popitem(last=False)
+                self._lineage_bytes -= old.nbytes
+
+    def _lineage_release_return(self, oid: ObjectID) -> None:
+        """A return object was reclaimed: once every return of the
+        creating task is gone, drop its lineage record so the pinned
+        argument refs can be released (reference: lineage released
+        when the produced objects go out of scope,
+        task_manager.h:560-602)."""
+        if oid.is_put_object():
+            return
+        with self._lineage_lock:
+            lin = self._lineage.get(oid.task_id())
+            if lin is None:
+                return
+            lin.live_returns.discard(oid)
+            if lin.live_returns:
+                return
+            self._lineage.pop(oid.task_id(), None)
+            self._lineage_bytes -= lin.nbytes
+
+    def _try_reconstruct(self, oid: ObjectID) -> bool:
+        """Re-submit the task that created ``oid`` (transitively
+        recovering lost arguments). Returns True when a rebuild is in
+        flight — dependents keep waiting on the object's location
+        instead of seeing an error. ray.put objects embed a nil task
+        id and are never reconstructable, matching the reference."""
+        if oid.is_put_object():
+            return False
+        task_id = oid.task_id()
+        with self._lineage_lock:
+            lin = self._lineage.get(task_id)
+            if lin is None:
+                return False
+            if lin.reconstructions >= self.config.max_reconstructions:
+                return False
+            with self._task_lock:
+                if task_id in self._tasks:
+                    return True      # already being re-executed
+            if lin.rebuilding:
+                return True          # another thread is on it
+            lin.rebuilding = True
+        try:
+            return self._launch_reconstruction(task_id, lin)
+        finally:
+            with self._lineage_lock:
+                lin.rebuilding = False
+
+    def _launch_reconstruction(self, task_id: TaskID,
+                               lin: LineageRecord) -> bool:
+        # Clear stale state for every return that no longer has a
+        # healthy copy, so gets/deps wait for the re-execution.
+        with self._obj_cv:
+            for rid in lin.return_ids:
+                loc = self._obj_locations.get(rid)
+                healthy = loc in ("mem", "shm") or (
+                    isinstance(loc, tuple)
+                    and (n := self._nodes.get(loc[1])) is not None
+                    and n.alive)
+                if not healthy:
+                    self._obj_locations.pop(rid, None)
+                    self._errors.pop(rid, None)
+        # Recover lost arguments first (transitive lineage walk,
+        # bounded by each task's own reconstruction budget).
+        for aref in lin.arg_refs:
+            loc = self._obj_locations.get(aref.id)
+            lost = loc is None or (
+                isinstance(loc, tuple)
+                and ((n := self._nodes.get(loc[1])) is None
+                     or not n.alive))
+            if loc == "err":
+                blob = self._errors.get(aref.id)
+                try:
+                    lost = blob is not None and isinstance(
+                        ser.loads(blob), ObjectLostError)
+                except Exception:  # noqa: BLE001
+                    lost = False
+                if lost:
+                    with self._obj_cv:
+                        self._obj_locations.pop(aref.id, None)
+                        self._errors.pop(aref.id, None)
+            if lost and not self._try_reconstruct(aref.id):
+                return False        # an argument is unrecoverable
+        try:
+            env_key, env_vars = self._env_for_options(lin.options)
+        except Exception:  # noqa: BLE001
+            return False
+        rec = TaskRecord(
+            task_id=task_id, fn_id=lin.fn_id, name=lin.name,
+            args_blob=lin.args_blob, arg_refs=list(lin.arg_refs),
+            options=lin.options, return_ids=list(lin.return_ids),
+            submitted_at=time.time(), env_key=env_key,
+            env_vars=env_vars)
+        with self._task_lock:
+            if task_id in self._tasks:
+                return True
+            self._tasks[task_id] = rec
+        # Charge the budget only for a rebuild that actually launched.
+        with self._lineage_lock:
+            lin.reconstructions += 1
+        self._event(rec, "RECONSTRUCTING")
+        with self._res_cv:
+            self._pending.append(rec)
+            self._res_cv.notify_all()
+        return True
 
     def _env_for_options(self, options: TaskOptions) -> tuple[str, dict]:
         from ray_tpu.runtime_env import (

@@ -1,0 +1,127 @@
+"""Lineage reconstruction of lost objects.
+
+Reference: ObjectRecoveryManager re-executes the creating task when a
+stored object is lost (object_recovery_manager.h:41); lineage bytes
+are capped (task_manager.h:215-222); ray.put objects are never
+reconstructable.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def _wait_node_dead(node_id, timeout=30.0):
+    rt = ray_tpu.core.api.get_runtime()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        n = rt._nodes.get(node_id)
+        if n is None or not n.alive:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"node {node_id} still alive")
+
+
+def test_reconstruct_after_node_death(cluster):
+    """The VERDICT scenario: create an object on node B via a task,
+    SIGKILL node B, get succeeds via re-execution."""
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        return np.arange(1_000_000, dtype=np.int64)   # ~8 MB
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=True)).remote()
+    ray_tpu.wait([ref], timeout=60)
+    rt = ray_tpu.core.api.get_runtime()
+    assert rt._obj_locations.get(ref.id) == ("node", n2.node_id)
+
+    n2.proc.kill()
+    _wait_node_dead(n2.node_id)
+    val = ray_tpu.get(ref, timeout=120)     # re-executed on the head
+    assert val.shape == (1_000_000,)
+    assert int(val[424242]) == 424242
+
+
+def test_reconstruct_transitive_chain(cluster):
+    """b depends on a; both homed on the dead node: recovering b
+    recursively re-executes a first."""
+    n2 = cluster.add_node(num_cpus=2)
+    pin = NodeAffinitySchedulingStrategy(n2.node_id, soft=True)
+
+    @ray_tpu.remote(num_cpus=1)
+    def base():
+        return np.full(300_000, 3.0)
+
+    @ray_tpu.remote(num_cpus=1)
+    def double(x):
+        return x * 2
+
+    a = base.options(scheduling_strategy=pin).remote()
+    b = double.options(scheduling_strategy=pin).remote(a)
+    ray_tpu.wait([b], timeout=60)
+    rt = ray_tpu.core.api.get_runtime()
+    assert rt._obj_locations.get(a.id) == ("node", n2.node_id)
+    assert rt._obj_locations.get(b.id) == ("node", n2.node_id)
+
+    n2.proc.kill()
+    _wait_node_dead(n2.node_id)
+    out = ray_tpu.get(b, timeout=120)
+    assert float(out[0]) == 6.0
+
+
+def test_put_objects_are_not_reconstructable(cluster):
+    """ray.put has no creating task (nil task id): loss is final."""
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def put_inside():
+        return [ray_tpu.put(np.ones(300_000))]
+
+    [inner] = ray_tpu.get(
+        put_inside.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                n2.node_id)).remote(), timeout=60)
+    rt = ray_tpu.core.api.get_runtime()
+    assert rt._obj_locations.get(inner.id) == ("node", n2.node_id)
+    n2.proc.kill()
+    _wait_node_dead(n2.node_id)
+    with pytest.raises(ray_tpu.ObjectLostError):
+        ray_tpu.get(inner, timeout=30)
+
+
+def test_reconstruction_reexecutes_function(cluster):
+    """The recovered value comes from a fresh execution (observable
+    through a nondeterministic payload)."""
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(num_cpus=1)
+    def stamp():
+        import os
+        return (os.getpid(), np.random.default_rng().random(200_000))
+
+    ref = stamp.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=True)).remote()
+    pid1, _ = ray_tpu.get(ref, timeout=60)
+    n2.proc.kill()
+    _wait_node_dead(n2.node_id)
+    pid2, arr = ray_tpu.get(ref, timeout=120)
+    assert pid2 != pid1          # different worker process re-ran it
+    assert arr.shape == (200_000,)

@@ -204,7 +204,10 @@ def test_sigkill_node_daemon_restarts_actor(cluster):
     assert n == 1        # fresh incarnation (state reset on restart)
 
 
-def test_sigkill_node_loses_homed_objects(cluster):
+def test_sigkill_node_loses_objects_of_nonretryable_task(cluster):
+    """max_retries=0 declares a task unsafe to re-run: its returns
+    record no lineage, so losing their home node is final (reference:
+    only retryable tasks are reconstructable)."""
     n2 = cluster.add_node(num_cpus=1)
 
     @ray_tpu.remote(num_cpus=1, max_retries=0)
