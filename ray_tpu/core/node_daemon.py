@@ -221,6 +221,10 @@ class NodeDaemon:
 
     def _spawn_worker(self, widx: int, env_key: str,
                       env_vars: dict) -> None:
+        env_vars = dict(env_vars)
+        # Tell workers which address reaches the cluster head — the
+        # routable-interface probe for multi-host rendezvous.
+        env_vars.setdefault("RAY_TPU_HEAD_IP", self.head_addr[0])
         try:
             w = WorkerHandle(self, env_key, env_vars,
                              node_id=self.node_id)

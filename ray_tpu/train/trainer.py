@@ -113,7 +113,10 @@ class JaxTrainer:
         try:
             group.barrier()
             if self.scaling.num_workers > 1 or self._setup_single_worker:
-                coordinator = f"127.0.0.1:{_free_port()}"
+                # Rank 0 advertises the rendezvous point from its own
+                # (possibly remote) host — the driver's loopback means
+                # nothing to a gang spanning node daemons.
+                coordinator = group.coordinator()
                 group.run(self._backend_setup, coordinator,
                           timeout=120)
             ctx_kwargs = {
@@ -199,10 +202,3 @@ class _WorkerGroupError(Exception):
         super().__init__(error)
         self.error = error
         self.latest_ckpt = latest_ckpt
-
-
-def _free_port() -> int:
-    import socket
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]

@@ -34,6 +34,20 @@ class TrainWorker:
         self._error: str | None = None
         self._session = None
 
+    def get_coordinator(self) -> str:
+        """Advertise a rendezvous address on THIS worker's host, so a
+        gang spanning node daemons on different hosts forms one
+        jax.distributed world (reference: TorchConfig picks the master
+        addr from worker 0's node, torch/config.py:66). The driver
+        must never pick the address — it may not even share a machine
+        with rank 0."""
+        import socket
+        host = _routable_ip()
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        return f"{host}:{port}"
+
     def setup_distributed(self, coordinator: str) -> bool:
         """jax.distributed rendezvous (the TorchConfig
         master-addr/port analog, reference torch/config.py:66)."""
@@ -109,6 +123,35 @@ class TrainWorker:
         return "ok"
 
 
+def _routable_ip() -> str:
+    """This host's address as seen by peers. Prefer the route toward
+    the cluster head (RAY_TPU_HEAD_IP, set by the node daemon for its
+    workers) — an address this process's host provably reaches, which
+    also yields the right interface on air-gapped networks where the
+    8.8.8.8 probe has no route. The UDP connect performs only a route
+    lookup, no packets. Single-machine clusters correctly resolve to
+    loopback through the head probe."""
+    import os
+    import socket
+    probes = []
+    head_ip = os.environ.get("RAY_TPU_HEAD_IP")
+    if head_ip:
+        probes.append(head_ip)
+    probes.append("8.8.8.8")
+    for target in probes:
+        try:
+            with socket.socket(socket.AF_INET,
+                               socket.SOCK_DGRAM) as s:
+                s.connect((target, 80))
+                return s.getsockname()[0]
+        except OSError:
+            continue
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
 def _takes_config(fn: Callable) -> bool:
     import inspect
     try:
@@ -143,6 +186,11 @@ class WorkerGroup:
     def barrier(self, timeout: float = 120.0) -> None:
         ray_tpu.get([w.ping.remote() for w in self.workers],
                     timeout=timeout)
+
+    def coordinator(self, timeout: float = 60.0) -> str:
+        """Rendezvous address chosen by rank 0 from its own host."""
+        return ray_tpu.get(
+            self.workers[0].get_coordinator.remote(), timeout=timeout)
 
     def run(self, method: str, *args, timeout: float | None = None,
             **kwargs) -> list:

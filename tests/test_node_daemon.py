@@ -227,3 +227,44 @@ def test_sigkill_node_loses_objects_of_nonretryable_task(cluster):
         time.sleep(0.05)
     with pytest.raises(ray_tpu.RayTpuError):
         ray_tpu.get(ref, timeout=30)
+
+
+def _psum_loop(config):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.train import get_context, report
+    ctx = get_context()
+    local = jax.local_device_count()
+    vals = jnp.full((local,), float(ctx.world_rank + 1))
+    out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(vals)
+    report({"psum": float(out[0]), "local": local,
+            "world_devices": jax.device_count(),
+            "node": __import__("os").environ.get("RAY_TPU_NODE_ID")})
+
+
+def test_multihost_gang_psum_across_daemons(cluster):
+    """Two node-daemon-hosted trainer workers rendezvous through rank
+    0's node-addressable coordinator and complete a psum (VERDICT #3
+    acceptance: the gang spans daemon processes, not just local
+    forks)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    cluster.add_node(num_cpus=2, resources={"gang": 1})
+    cluster.add_node(num_cpus=2, resources={"gang": 1})
+
+    trainer = JaxTrainer(
+        _psum_loop,
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"gang": 1},
+            placement_strategy="STRICT_SPREAD"),
+        run_config=RunConfig(storage_path="/tmp/ray_tpu_test_exp"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    m = result.metrics
+    # Each of the 2 ranks contributes (rank+1) on each of its local
+    # devices: global psum = (1+2) * local_device_count.
+    assert m["psum"] == 3.0 * m["local"]
+    assert m["world_devices"] == 2 * m["local"]
