@@ -1,0 +1,183 @@
+"""Core-runtime microbenchmarks (the ``ray_perf`` analog).
+
+Reference: ``python/ray/_private/ray_perf.py`` driven by
+``release/microbenchmark/run_microbenchmark.py``; the SURVEY §6 table
+(952 sync tasks/s, 1,950 sync actor calls/s, plasma put/get rates) is
+the bar these numbers are compared against.
+
+Run: ``python -m ray_tpu.perf [--quick]`` — prints one JSON line per
+metric: {"metric": ..., "value": ..., "unit": "calls/s"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn, batch: int = 1, *, seconds: float = 2.0,
+           quick: bool = False) -> dict:
+    """Run fn repeatedly for ~seconds, report batch*iters/elapsed."""
+    if quick:
+        seconds = 0.5
+    fn()                       # warmup (worker boot, fn shipping)
+    iters = 0
+    start = time.perf_counter()
+    deadline = start + seconds
+    while time.perf_counter() < deadline:
+        fn()
+        iters += 1
+    elapsed = time.perf_counter() - start
+    value = batch * iters / elapsed
+    out = {"metric": name, "value": round(value, 1),
+           "unit": "calls/s"}
+    print(json.dumps(out), flush=True)
+    return out
+
+
+@ray_tpu.remote(num_cpus=1)
+def _small_task():
+    # num_cpus=1 (reference default): a zero-CPU task escapes the
+    # scheduler's concurrency gate entirely, so a 100-task batch would
+    # boot 100 fresh workers instead of reusing the pool.
+    return b"ok"
+
+
+@ray_tpu.remote(num_cpus=0)
+class _Actor:
+    def small_value(self) -> bytes:
+        return b"ok"
+
+    def small_value_arg(self, x) -> bytes:
+        return b"ok"
+
+
+@ray_tpu.remote(num_cpus=0)
+class _AsyncActor:
+    async def small_value(self) -> bytes:
+        return b"ok"
+
+
+def run_all(quick: bool = False) -> list[dict]:
+    results: list[dict] = []
+    own_runtime = False
+    try:
+        ray_tpu.core.api.get_runtime()
+    except Exception:  # noqa: BLE001
+        ray_tpu.init(num_cpus=8)
+        own_runtime = True
+
+    def rec(r):
+        results.append(r)
+
+    # -- tasks --
+    rec(timeit("single_client_tasks_sync",
+               lambda: ray_tpu.get(_small_task.remote()),
+               quick=quick))
+    rec(timeit("single_client_tasks_async",
+               lambda: ray_tpu.get(
+                   [_small_task.remote() for _ in range(100)]),
+               batch=100, quick=quick))
+
+    # -- actor calls --
+    a = _Actor.remote()
+    ray_tpu.get(a.small_value.remote())
+    rec(timeit("1_1_actor_calls_sync",
+               lambda: ray_tpu.get(a.small_value.remote()),
+               quick=quick))
+    rec(timeit("1_1_actor_calls_async",
+               lambda: ray_tpu.get(
+                   [a.small_value.remote() for _ in range(100)]),
+               batch=100, quick=quick))
+    aa = _AsyncActor.options(max_concurrency=8).remote()
+    ray_tpu.get(aa.small_value.remote())
+    rec(timeit("1_1_async_actor_calls_async",
+               lambda: ray_tpu.get(
+                   [aa.small_value.remote() for _ in range(100)]),
+               batch=100, quick=quick))
+    n_actors = 4
+    actors = [_Actor.remote() for _ in range(n_actors)]
+    ray_tpu.get([b.small_value.remote() for b in actors])
+    rec(timeit("n_n_actor_calls_async",
+               lambda: ray_tpu.get(
+                   [b.small_value.remote() for b in actors
+                    for _ in range(25)]),
+               batch=25 * n_actors, quick=quick))
+
+    # -- object store --
+    small = b"x" * 1024
+    rec(timeit("single_client_put_calls_1KiB",
+               lambda: ray_tpu.put(small), quick=quick))
+    big_ref = ray_tpu.put(np.zeros(1 << 18, dtype=np.uint8))  # 256 KiB
+    rec(timeit("single_client_get_calls_256KiB",
+               lambda: ray_tpu.get(big_ref), quick=quick))
+    chunk = np.zeros(100 << 20, dtype=np.uint8)  # 100 MiB
+
+    def put_big():
+        r = ray_tpu.put(chunk)
+        del r
+
+    t = timeit("single_client_put_100MiB_calls", put_big, quick=quick)
+    rec(t)
+    gb = {"metric": "single_client_put_gigabytes",
+          "value": round(t["value"] * 100 / 1024, 2),
+          "unit": "GiB/s"}
+    print(json.dumps(gb), flush=True)
+    rec(gb)
+
+    if own_runtime:
+        ray_tpu.shutdown()
+    return results
+
+
+def run_serve_bench(quick: bool = False) -> dict:
+    """Serve requests/s through a 2-replica deployment (steady-state
+    path: long-poll-cached routing + pow-2 probes, zero controller
+    RPCs per request)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+    ray_tpu.get(handle.remote(0), timeout=60)
+    out = timeit(
+        "serve_requests_per_s",
+        lambda: ray_tpu.get([handle.remote(i) for i in range(20)],
+                            timeout=60),
+        batch=20, quick=quick)
+    rpcs = handle._router.controller_rpcs
+    serve.shutdown()
+    out["extra"] = {"controller_rpcs_during_bench": rpcs}
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description="ray_tpu microbenchmarks")
+    ap.add_argument("--quick", action="store_true",
+                    help="0.5s per metric instead of 2s")
+    ap.add_argument("--serve", action="store_true",
+                    help="include the serve requests/s benchmark")
+    args = ap.parse_args(argv)
+    # Logical CPUs above the physical count: microbench workloads are
+    # tiny RPCs, and serve needs room for its replicas even on a
+    # 1-core host.
+    ray_tpu.init(num_cpus=8)
+    try:
+        run_all(quick=args.quick)
+        if args.serve:
+            run_serve_bench(quick=args.quick)
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -196,6 +196,8 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 def shutdown() -> None:
     global _proxy, _proxy_port
+    from ray_tpu.serve.router import LongPollClient
+    LongPollClient.shutdown_all()   # stop this process's poll thread
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)

@@ -36,9 +36,17 @@ class ServeController:
         self.draining: dict[str, list] = {}
         self._stop = False
         self._rec_lock = threading.Lock()
+        # Long-poll wakeups (reference: LongPollHost, long_poll.py:177)
+        # — routers block in listen_for_change until a version bump.
+        self._version_cv = threading.Condition()
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True)
         self._thread.start()
+
+    def _bump_version(self, name: str) -> None:
+        with self._version_cv:
+            self.versions[name] = self.versions.get(name, 0) + 1
+            self._version_cv.notify_all()
 
     # -- desired state --
 
@@ -69,19 +77,42 @@ class ServeController:
 
     # -- live state queries (router/long-poll surface) --
 
-    def get_version(self, name: str) -> int:
-        return self.versions.get(name, 0)
-
     def get_replicas(self, name: str):
         return self.versions.get(name, 0), list(
             self.replicas.get(name, []))
 
     def get_routing_state(self, name: str):
         """(version, replicas, model_map) in one call — the router's
-        refresh payload (long-poll snapshot analog)."""
+        refresh payload."""
         return (self.versions.get(name, 0),
                 list(self.replicas.get(name, [])),
                 dict(self.model_map.get(name, {})))
+
+    def listen_for_change(self, known: dict, timeout: float = 30.0):
+        """Multiplexed long-poll: block until ANY watched deployment's
+        version moves past its known value (or the timeout lapses),
+        then return {name: routing_state} for the changed ones. Each
+        client process keeps exactly ONE of these outstanding for all
+        its routers (reference: LongPollHost.listen_for_change
+        multiplexes keys the same way, long_poll.py:177), so parked
+        listeners scale with processes — not handles — and the
+        16-thread actor pool never starves control calls."""
+        deadline = time.time() + timeout
+
+        def changed() -> dict:
+            return {name: self.get_routing_state(name)
+                    for name, v in known.items()
+                    if self.versions.get(name, 0) != v}
+        with self._version_cv:
+            while not self._stop:
+                out = changed()
+                if out:
+                    return out
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {}
+                self._version_cv.wait(min(1.0, remaining))
+        return changed()
 
     def get_model_replicas(self, name: str, model_id: str):
         """Replicas that had ``model_id`` resident at the last probe —
@@ -119,7 +150,7 @@ class ServeController:
                         ray_tpu.kill(r)
                     except Exception:  # noqa: BLE001
                         pass
-                self.versions[name] = self.versions.get(name, 0) + 1
+                self._bump_version(name)
         for name, spec in self.desired.items():
             live = self.replicas.setdefault(name, [])
             # probe replicas: liveness + stats (queue lens, models)
@@ -169,7 +200,7 @@ class ServeController:
             self.replicas[name] = live
             self._reap_draining(name)
             if changed:
-                self.versions[name] = self.versions.get(name, 0) + 1
+                self._bump_version(name)
 
     def _reap_draining(self, name: str) -> None:
         still = []
@@ -195,6 +226,8 @@ class ServeController:
 
     def graceful_shutdown(self) -> bool:
         self._stop = True
+        with self._version_cv:
+            self._version_cv.notify_all()   # release parked listeners
         for name in list(self.desired):
             self.desired.pop(name)
         self._reconcile_once()

@@ -1,17 +1,95 @@
 """Request router: power-of-two-choices replica selection.
 
-Reference analog: PowerOfTwoChoicesReplicaScheduler
-(replica_scheduler/pow_2_scheduler.py:51): sample two replicas, probe
-their queue lengths, pick the shorter. Probes are fire-and-forget
-actor calls; the replica set refreshes from the controller on a
-version bump (the long-poll analog is a poll-on-version-mismatch).
+Reference analogs: PowerOfTwoChoicesReplicaScheduler
+(replica_scheduler/pow_2_scheduler.py:51) + LongPollClient
+(long_poll.py:64). Routing state is PUSHED: one process-wide
+LongPollClient keeps a single multiplexed ``listen_for_change`` call
+outstanding against the controller for ALL routers in this process and
+swaps their cached snapshots when it returns. The steady-state request
+path (pick_replica) touches only the cache and the two sampled
+replicas' queue-length probes: zero controller RPCs per request.
 """
 
 from __future__ import annotations
 
 import random
+import threading
+import time
 
 import ray_tpu
+
+
+class LongPollClient:
+    """One per (process, controller): multiplexes every local router's
+    watch into a single outstanding long-poll so parked listeners on
+    the controller scale with client processes, not handles."""
+
+    _instances: dict = {}
+    _instances_lock = threading.Lock()
+
+    @classmethod
+    def for_controller(cls, controller) -> "LongPollClient":
+        key = getattr(controller, "_actor_id", id(controller))
+        with cls._instances_lock:
+            inst = cls._instances.get(key)
+            if inst is None or inst._stop:
+                inst = cls(controller)
+                cls._instances[key] = inst
+            return inst
+
+    @classmethod
+    def shutdown_all(cls) -> None:
+        with cls._instances_lock:
+            for inst in cls._instances.values():
+                inst._stop = True
+            cls._instances.clear()
+
+    def __init__(self, controller):
+        self._controller = controller
+        self._routers: dict[str, list] = {}    # name -> [Router]
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve_longpoll")
+        self._thread.start()
+
+    def register(self, router: "Router") -> None:
+        with self._lock:
+            self._routers.setdefault(router._name, []).append(router)
+
+    def unregister(self, router: "Router") -> None:
+        with self._lock:
+            lst = self._routers.get(router._name, [])
+            if router in lst:
+                lst.remove(router)
+            if not lst:
+                self._routers.pop(router._name, None)
+
+    def _loop(self) -> None:
+        backoff = 0.5
+        while not self._stop:
+            with self._lock:
+                known = {name: min(r._version for r in routers)
+                         for name, routers in self._routers.items()
+                         if routers}
+            if not known:
+                time.sleep(0.1)
+                continue
+            try:
+                updates = ray_tpu.get(
+                    self._controller.listen_for_change.remote(known),
+                    timeout=60)
+                backoff = 0.5
+            except Exception:  # noqa: BLE001 — controller down/busy
+                if self._stop:
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+                continue
+            with self._lock:
+                for name, state in (updates or {}).items():
+                    for r in self._routers.get(name, []):
+                        r._apply(state)
 
 
 class Router:
@@ -22,30 +100,59 @@ class Router:
         self._model_map: dict[str, list[int]] = {}
         self._version = -1
         self._rng = random.Random()
+        self._lock = threading.Lock()
+        # Counts synchronous controller round-trips — steady state
+        # must not grow this (asserted by tests/benchmarks).
+        self.controller_rpcs = 0
+        self._longpoll = LongPollClient.for_controller(controller)
+        self._longpoll.register(self)
 
-    def _refresh(self) -> None:
-        version, replicas, model_map = ray_tpu.get(
-            self._controller.get_routing_state.remote(self._name))
-        self._version = version
-        self._replicas = replicas
-        self._model_map = model_map
+    def close(self) -> None:
+        self._longpoll.unregister(self)
+
+    # -- snapshot maintenance (push path) --
+
+    def _apply(self, state) -> None:
+        version, replicas, model_map = state
+        with self._lock:
+            if version < self._version:
+                return    # stale in-flight response must not regress
+            self._version = version
+            self._replicas = replicas
+            self._model_map = model_map
+
+    def _refresh_sync(self) -> None:
+        """Cold-start / error-recovery pull; never on the hot path
+        once a snapshot exists."""
+        self.controller_rpcs += 1
+        self._apply(ray_tpu.get(
+            self._controller.get_routing_state.remote(self._name),
+            timeout=30))
+
+    # -- hot path --
 
     def pick_replica(self, multiplexed_model_id: str = ""):
-        version = ray_tpu.get(
-            self._controller.get_version.remote(self._name))
-        if version != self._version or not self._replicas:
-            self._refresh()
-        if not self._replicas:
-            raise RuntimeError(
-                f"deployment {self._name!r} has no replicas")
-        pool = self._replicas
+        with self._lock:
+            replicas = self._replicas
+            model_map = self._model_map
+        if not replicas:
+            # Deployment still coming up (or we raced a scale-from-
+            # zero): one synchronous pull, then fail clearly.
+            self._refresh_sync()
+            with self._lock:
+                replicas = self._replicas
+                model_map = self._model_map
+            if not replicas:
+                raise RuntimeError(
+                    f"deployment {self._name!r} has no replicas")
+        pool = replicas
         if multiplexed_model_id:
             # Model-locality-aware pick (reference: multiplex-aware
             # pow-2): prefer replicas with the model resident, from
-            # the version-gated cached map — no extra hot-path RPC.
-            idxs = self._model_map.get(multiplexed_model_id, [])
-            with_model = [self._replicas[i] for i in idxs
-                          if i < len(self._replicas)]
+            # the pushed cached map — no extra hot-path RPC.
+            idxs = model_map.get(multiplexed_model_id, [])
+            with_model = [replicas[i] for i in idxs
+                          if i < len(replicas)]
             if with_model:
                 pool = with_model
         if len(pool) == 1:
@@ -55,8 +162,10 @@ class Router:
             qa, qb = ray_tpu.get(
                 [a.queue_len.remote(), b.queue_len.remote()],
                 timeout=5)
-        except Exception:  # noqa: BLE001 — probe failure: refresh next
-            self._version = -1
+        except Exception:  # noqa: BLE001 — probe failure: let the
+            # long-poll (or next cold refresh) repair the set
+            with self._lock:
+                self._version = -1
             return a
         return a if qa <= qb else b
 

@@ -1,0 +1,20 @@
+"""Microbenchmark harness sanity (ray_perf analog).
+
+Thresholds are deliberately far below the recorded numbers
+(PERF_r02.jsonl: ~3k sync tasks/s, ~4k sync actor calls/s on a 1-core
+host vs the reference bar of 952 / 1,950 from SURVEY §6) — this guards
+against order-of-magnitude control-plane regressions, not noise.
+"""
+
+import pytest
+
+from ray_tpu.perf import run_all
+
+
+@pytest.mark.slow
+def test_microbench_floors(rt):
+    results = {r["metric"]: r["value"] for r in run_all(quick=True)}
+    assert results["single_client_tasks_sync"] > 300
+    assert results["1_1_actor_calls_sync"] > 500
+    assert results["1_1_actor_calls_async"] > 1000
+    assert results["single_client_put_calls_1KiB"] > 1000

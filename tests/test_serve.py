@@ -148,3 +148,22 @@ def test_batching(serve_rt):
                        timeout=60)
     assert {o["x"] for o in outs} == {0, 1, 2, 3}
     assert max(o["n"] for o in outs) >= 2  # batching occurred
+
+
+def test_steady_state_zero_controller_rpcs(serve_rt):
+    """The hot path must not talk to the controller: routing state is
+    pushed via long-poll (reference: LongPollClient, long_poll.py:64).
+    After warmup, 20 requests add zero synchronous controller
+    round-trips."""
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+    assert ray_tpu.get(handle.remote("warm"), timeout=60) == "warm"
+    router = handle._router
+    before = router.controller_rpcs
+    for i in range(20):
+        assert ray_tpu.get(handle.remote(i), timeout=60) == i
+    assert router.controller_rpcs == before
