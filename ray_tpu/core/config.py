@@ -78,6 +78,10 @@ class Config:
     lineage_cache_max_bytes: int = 256 * 1024 * 1024
     # Max re-executions of one task for object recovery.
     max_reconstructions: int = 3
+    # Recently-consumed escape-nonce window (reordering tolerance
+    # between the exec and client channels); evictions under heavy
+    # borrow traffic can leave conservative permanent pins.
+    preconsumed_window: int = 65536
     # Default actor max restarts.
     actor_max_restarts: int = 0
     # Health-check period for actor/worker processes.

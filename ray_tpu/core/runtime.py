@@ -600,7 +600,12 @@ class DriverRuntime:
         # escapes the client socket) — bounded memory of recent
         # consumptions so the late escape doesn't pin forever.
         self._preconsumed: set = set()
-        self._preconsumed_order: deque = deque(maxlen=8192)
+        self._preconsumed_order: deque = deque(
+            maxlen=config.preconsumed_window)
+        # Window evictions mean a late escape notification for an
+        # already-consumed nonce would pin its object forever
+        # (conservative but silent) — counted for observability.
+        self._preconsumed_evictions = 0
         self._borrows: dict[ObjectID, int] = {}
         # Container pinning (reference: nested refs in
         # reference_count.h): a stored object pins every ObjectRef
@@ -748,6 +753,15 @@ class DriverRuntime:
         if len(self._preconsumed_order) == \
                 self._preconsumed_order.maxlen:
             self._preconsumed.discard(self._preconsumed_order[0])
+            self._preconsumed_evictions += 1
+            if self._preconsumed_evictions == 1:
+                import sys
+                print(
+                    "ray_tpu: preconsumed-nonce window overflowed; "
+                    "under heavy borrow traffic a reordered escape "
+                    "notification may leave a permanent object pin "
+                    "(raise RAY_TPU_PRECONSUMED_WINDOW to avoid)",
+                    file=sys.stderr)
         self._preconsumed.add(nonce)
         self._preconsumed_order.append(nonce)
 

@@ -476,6 +476,14 @@ class CompiledDAG:
                 spec.in_channels[pkey] = node_channels[pkey]
             for ns in spec.nodes:
                 ns.out_channel = node_channels.get(ns.key)
+        if not input_readers and specs:
+            # Every actor has inbound channels and none binds the
+            # input (e.g. a node-level a->b->a loop built from
+            # constants): without an input channel the loops would
+            # free-run one pipeline depth ahead of execute(). Gate
+            # every actor on the input channel so stateful methods run
+            # exactly once per execute().
+            input_readers = set(specs)
         if len(input_readers) > 16:
             raise _ChannelModeIneligible
         self._input_channel = None
@@ -493,27 +501,52 @@ class CompiledDAG:
         for ch in self._out_channels.values():
             ch.register_reader()
 
-        # Launch one persistent loop per actor via __ray_call__.
+        # Launch one persistent loop per actor via __ray_call__. From
+        # here on a failure must tear down what was launched: the
+        # loops block on channel reads forever and the caller holds no
+        # object to call teardown() on (the constructor raised).
         self._loop_refs = []
-        for akey, spec in specs.items():
-            h = actor_handle[akey]
-            self._loop_refs.append(
-                h.__ray_call__.remote(_dag_actor_loop, spec))
+        try:
+            for akey, spec in specs.items():
+                h = actor_handle[akey]
+                self._loop_refs.append(
+                    h.__ray_call__.remote(_dag_actor_loop, spec))
 
-        # Handshake: wait until every channel has all its readers
-        # registered (loops are up) before allowing the first write.
-        deadline = time.time() + 60
-        for pkey, ch in {**node_channels,
-                         "__input__": self._input_channel}.items():
-            if ch is None:
-                continue
-            want = expected_readers[ch.name]
-            while ch.reader_count() < want:
-                if time.time() > deadline:
-                    raise RuntimeError(
-                        "compiled DAG loops failed to start "
-                        "(channel reader handshake timed out)")
-                time.sleep(0.002)
+            # Handshake: wait until every channel has all its readers
+            # registered (loops are up) before allowing the first
+            # write.
+            deadline = time.time() + 60
+            for pkey, ch in {**node_channels,
+                             "__input__": self._input_channel}.items():
+                if ch is None:
+                    continue
+                want = expected_readers[ch.name]
+                while ch.reader_count() < want:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "compiled DAG loops failed to start "
+                            "(channel reader handshake timed out)")
+                    time.sleep(0.002)
+        except BaseException:
+            import ray_tpu as _ray
+            for ch in node_channels.values():
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._input_channel is not None:
+                try:
+                    self._input_channel.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            for h in self._owned_actors:
+                try:
+                    _ray.kill(h)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._owned_actors.clear()
+            self._torn_down = True
+            raise
 
         self._out_tokens = out_tokens
         self._multi_output = multi
@@ -651,7 +684,12 @@ class CompiledDAG:
 
     def _fetch_result(self, idx: int, timeout: float | None = None):
         """Drain output-channel versions up to execution ``idx`` (reads
-        are strictly ordered: version v ↔ execution v-1)."""
+        are strictly ordered: version v ↔ execution v-1). ``timeout``
+        bounds the WHOLE call: it converts to one deadline up front and
+        each channel read gets the remaining budget (a per-read timeout
+        would multiply by pending executions x output channels)."""
+        deadline = (None if timeout is None
+                    else time.time() + timeout)
         # Fast path: already drained by another thread — don't queue
         # behind a drain that may be blocking on a later execution.
         with self._book_lock:
@@ -674,7 +712,9 @@ class CompiledDAG:
                 for pkey, ch in self._out_channels.items():
                     if pkey in vals:
                         continue
-                    value, is_err = ch.begin_read(timeout, copy=True)
+                    remaining = (None if deadline is None else
+                                 max(0.0, deadline - time.time()))
+                    value, is_err = ch.begin_read(remaining, copy=True)
                     vals[pkey] = (value, is_err)
                 self._partial_vals = {}
                 inp = self._local_inputs.pop(i, None)

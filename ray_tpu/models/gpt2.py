@@ -19,6 +19,7 @@ tokens/sec/chip). TPU-first choices:
 from __future__ import annotations
 
 from dataclasses import dataclass
+import functools
 from functools import partial
 from typing import Any, Callable
 
@@ -83,20 +84,35 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, attn_fn: Callable, deterministic: bool = True):
         cfg = self.config
         B, T, _ = x.shape
-        dense = partial(nn.Dense, use_bias=True, dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype,
-                        kernel_init=nn.initializers.normal(0.02))
-        q = dense(cfg.n_embd, name="q")(x)
-        k = dense(cfg.n_embd, name="k")(x)
-        v = dense(cfg.n_embd, name="v")(x)
-        q = q.reshape(B, T, cfg.n_head, cfg.head_dim)
-        k = k.reshape(B, T, cfg.n_head, cfg.head_dim)
-        v = v.reshape(B, T, cfg.n_head, cfg.head_dim)
+        # One fused qkv projection as an einsum with a [E, 3, H, D]
+        # kernel: the head split falls out of the parameter layout, so
+        # no post-matmul reshape/transpose copies hit HBM (the
+        # [B,T,H,D] outputs feed the flash kernel's fold directly and
+        # XLA folds the permutation into the matmul epilogue). The
+        # sharding table's qkv pattern still splits heads over tp.
+        kernel_init = nn.initializers.normal(0.02)
+        qkv_w = self.param(
+            "qkv_kernel", kernel_init,
+            (cfg.n_embd, 3, cfg.n_head, cfg.head_dim),
+            cfg.param_dtype)
+        qkv_b = self.param(
+            "qkv_bias", nn.initializers.zeros,
+            (3, cfg.n_head, cfg.head_dim), cfg.param_dtype)
+        qkv = jnp.einsum(
+            "bte,eshd->bsthd", x.astype(cfg.dtype),
+            qkv_w.astype(cfg.dtype)) \
+            + qkv_b.astype(cfg.dtype)[None, :, None]
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
         y = attn_fn(q, k, v)
-        y = y.reshape(B, T, cfg.n_embd)
-        y = dense(cfg.n_embd, name="proj",
-                  kernel_init=nn.initializers.normal(
-                      0.02 / (2 * cfg.n_layer) ** 0.5))(y)
+        proj_w = self.param(
+            "proj_kernel",
+            nn.initializers.normal(0.02 / (2 * cfg.n_layer) ** 0.5),
+            (cfg.n_head, cfg.head_dim, cfg.n_embd), cfg.param_dtype)
+        proj_b = self.param("proj_bias", nn.initializers.zeros,
+                            (cfg.n_embd,), cfg.param_dtype)
+        y = jnp.einsum("bthd,hde->bte", y.astype(cfg.dtype),
+                       proj_w.astype(cfg.dtype)) + proj_b.astype(
+                           cfg.dtype)
         if cfg.dropout > 0:
             y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return y
@@ -223,22 +239,91 @@ def cross_entropy_loss(logits, targets, ignore_index: int = -1):
     return nll.sum() / jnp.maximum(mask.sum(), 1)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _chunked_ce_core(rows_c, emb, tgt_c, ignore_index):
+    (tot, cnt), _ = _chunked_ce_fwd_scan(rows_c, emb, tgt_c,
+                                         ignore_index)
+    return tot / jnp.maximum(cnt, 1).astype(jnp.float32)
+
+
+def _chunk_logits(x_c, emb):
+    return jnp.einsum("ce,ve->cv", x_c, emb,
+                      preferred_element_type=jnp.float32)
+
+
+def _chunked_ce_fwd_scan(rows_c, emb, tgt_c, ignore_index):
+    def one(carry, xt):
+        x_c, t_c = xt
+        logits = _chunk_logits(x_c, emb)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        mask = t_c != ignore_index
+        safe = jnp.where(mask, t_c, 0)
+        picked = jnp.take_along_axis(logits, safe[:, None], 1)[:, 0]
+        nll = jnp.where(mask, lse - picked, 0.0)
+        tot, cnt = carry
+        return (tot + nll.sum(), cnt + mask.sum()), lse
+
+    return jax.lax.scan(
+        one, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (rows_c, tgt_c))
+
+
+def _chunked_ce_core_fwd(rows_c, emb, tgt_c, ignore_index):
+    (tot, cnt), lse_c = _chunked_ce_fwd_scan(rows_c, emb, tgt_c,
+                                             ignore_index)
+    loss = tot / jnp.maximum(cnt, 1).astype(jnp.float32)
+    return loss, (rows_c, emb, tgt_c, lse_c, cnt)
+
+
+def _chunked_ce_core_bwd(ignore_index, res, g):
+    # Hand-written backward: recompute each chunk's logits but REUSE
+    # the saved log-sum-exp (a jax.checkpoint formulation re-runs the
+    # full logsumexp reduction too). dlogits = (softmax - onehot)/cnt.
+    rows_c, emb, tgt_c, lse_c, cnt = res
+    scale = (g / jnp.maximum(cnt, 1).astype(jnp.float32))
+
+    def one(demb, xt):
+        x_c, t_c, lse = xt
+        logits = _chunk_logits(x_c, emb)
+        mask = (t_c != ignore_index)
+        p = jnp.exp(logits - lse[:, None])
+        safe = jnp.where(mask, t_c, 0)
+        onehot = jax.nn.one_hot(safe, logits.shape[-1],
+                                dtype=p.dtype)
+        dlogits = (p - onehot) * (scale * mask)[:, None]
+        dlb = dlogits.astype(emb.dtype)
+        dx = jax.lax.dot_general(
+            dlb, emb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x_c.dtype)
+        demb = demb + jax.lax.dot_general(
+            dlb, x_c, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return demb, dx
+
+    demb0 = jnp.zeros(emb.shape, jnp.float32)
+    demb, dx_c = jax.lax.scan(one, demb0, (rows_c, tgt_c, lse_c))
+    return dx_c, demb.astype(emb.dtype), None
+
+
+_chunked_ce_core.defvjp(_chunked_ce_core_fwd, _chunked_ce_core_bwd)
+
+
 def chunked_cross_entropy(hidden, embedding, targets,
                           ignore_index: int = -1,
                           chunk_size: int = 2048):
     """Cross-entropy that never materializes the full (B, S, vocab)
-    logits: the tied LM head + loss run per row-chunk under
-    ``jax.checkpoint`` (bwd recomputes each chunk's logits).
+    logits: the tied LM head + loss run per row-chunk with a
+    hand-written VJP (bwd recomputes each chunk's logits but reuses
+    the saved per-row log-sum-exp).
 
     TPU rationale: full GPT-2 logits are B*S*50304 f32 — 1.6 GB at
     the bench shape — and the softmax/backward over them is pure HBM
     traffic. Chunking keeps the live logits block at
     chunk_size*vocab (~400 MB at 2048), trading one extra LM-head
-    matmul in bwd for most of that bandwidth. Measured on v5e:
-    ~+4% step throughput at the bench shape; larger models/vocabs
-    gain more.
+    matmul in bwd for most of that bandwidth.
     """
     B, S, E = hidden.shape
+    compute_dtype = hidden.dtype
     rows = hidden.reshape(B * S, E)
     tgt = targets.reshape(B * S)
     n_rows = B * S
@@ -248,29 +333,12 @@ def chunked_cross_entropy(hidden, embedding, targets,
         rows = jnp.pad(rows, ((0, pad), (0, 0)))
         tgt = jnp.pad(tgt, (0, pad), constant_values=ignore_index)
     n = rows.shape[0] // chunk
-    rows_c = rows.reshape(n, chunk, E)
+    rows_c = rows.reshape(n, chunk, E).astype(compute_dtype)
     tgt_c = tgt.reshape(n, chunk)
-    compute_dtype = hidden.dtype
-
-    @jax.checkpoint
-    def one(carry, xt):
-        x_c, t_c = xt
-        logits = jnp.einsum(
-            "ce,ve->cv", x_c.astype(compute_dtype),
-            embedding.astype(compute_dtype),
-            preferred_element_type=jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        mask = t_c != ignore_index
-        safe = jnp.where(mask, t_c, 0)
-        picked = jnp.take_along_axis(logits, safe[:, None], 1)[:, 0]
-        nll = jnp.where(mask, lse - picked, 0.0)
-        tot, cnt = carry
-        return (tot + nll.sum(), cnt + mask.sum()), None
-
-    (tot, cnt), _ = jax.lax.scan(
-        one, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-        (rows_c, tgt_c))
-    return tot / jnp.maximum(cnt, 1).astype(jnp.float32)
+    # Cast the tied embedding ONCE outside the scan (fwd and bwd both
+    # consume the bf16 copy).
+    emb = embedding.astype(compute_dtype)
+    return _chunked_ce_core(rows_c, emb, tgt_c, ignore_index)
 
 
 def gpt2_loss_fn(model: GPT2, fused_ce: bool = True,

@@ -204,10 +204,15 @@ class Channel:
                    copy: bool = False):
         """Block for the next version; returns (value, is_error).
 
-        Zero-copy: deserialized buffers view the mapped payload, which
-        the writer cannot overwrite until ``end_read``. Pass
-        ``copy=True`` to copy out and ack immediately (the value then
-        survives subsequent writes — used by driver-side reads).
+        Zero-copy aliasing contract: with ``copy=False`` the
+        deserialized buffers VIEW the mapped payload. The writer
+        cannot overwrite them until ``end_read`` — but any value
+        retained past ``end_read()`` is silently overwritten by the
+        writer's next commit. Views are handed out read-only (numpy
+        arrays arrive with ``writeable=False``) so mutation races are
+        at least one-directional. Pass ``copy=True`` to copy out and
+        ack immediately (the value then survives subsequent writes —
+        used by driver-side reads).
         """
         self._ensure_reader()
         size = ctypes.c_uint64()
@@ -238,7 +243,7 @@ class Channel:
         for _ in range(nbufs):
             (blen,) = struct.unpack_from("<Q", view, pos)
             pos += 8
-            buffers.append(view[pos:pos + blen])
+            buffers.append(view[pos:pos + blen].toreadonly())
             pos += blen
         if copy:
             data = bytes(data)

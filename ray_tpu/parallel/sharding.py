@@ -126,6 +126,12 @@ DEFAULT_PARAM_PATTERNS: list[tuple[str, tuple[str | None, ...]]] = [
     (r"moe.*router", ("embed", None)),
     (r"moe.*w_up", ("experts", "embed", "mlp")),
     (r"moe.*w_down", ("experts", "mlp", "embed")),
+    # GPT-2 head-structured projections ([E,3,H,D] / [H,D,E] einsum
+    # kernels — the head split lives in the param layout so attention
+    # inputs need no transpose copies):
+    (r"(attn|attention).*qkv_kernel", ("embed", None, "heads", None)),
+    (r"(attn|attention).*qkv_bias", (None, "heads", None)),
+    (r"(attn|attention).*proj_kernel", ("heads", None, "embed")),
     (r"(attn|attention).*(q|k|v|qkv).*kernel", ("embed", "heads")),
     (r"(attn|attention).*(out|proj).*kernel", ("heads", "embed")),
     (r"mlp.*(fc|up|gate).*kernel", ("embed", "mlp")),

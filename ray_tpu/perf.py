@@ -74,6 +74,15 @@ def run_all(quick: bool = False) -> list[dict]:
     def rec(r):
         results.append(r)
 
+    try:
+        _run_benchmarks(rec, quick)
+    finally:
+        if own_runtime:
+            ray_tpu.shutdown()
+    return results
+
+
+def _run_benchmarks(rec, quick: bool) -> None:
     # -- tasks --
     rec(timeit("single_client_tasks_sync",
                lambda: ray_tpu.get(_small_task.remote()),
@@ -128,10 +137,6 @@ def run_all(quick: bool = False) -> list[dict]:
           "unit": "GiB/s"}
     print(json.dumps(gb), flush=True)
     rec(gb)
-
-    if own_runtime:
-        ray_tpu.shutdown()
-    return results
 
 
 def run_serve_bench(quick: bool = False) -> dict:

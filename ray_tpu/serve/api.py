@@ -67,7 +67,8 @@ class DeploymentHandle:
         self._name = deployment_name
         self._controller = controller or ray_tpu.get_actor(
             CONTROLLER_NAME)
-        self._router = Router(self._controller, deployment_name)
+        self._router = Router.for_deployment(
+            self._controller, deployment_name)
         self._model_id = multiplexed_model_id
         self._stream = stream
 
@@ -196,8 +197,10 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 def shutdown() -> None:
     global _proxy, _proxy_port
-    from ray_tpu.serve.router import LongPollClient
+    from ray_tpu.serve.router import LongPollClient, Router
     LongPollClient.shutdown_all()   # stop this process's poll thread
+    with Router._cache_lock:
+        Router._cache.clear()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)

@@ -39,7 +39,8 @@ class ProxyActor:
             from ray_tpu.serve.router import Router
             if self._controller is None:
                 self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            self._routers[deployment] = Router(self._controller,
+            self._routers[deployment] = Router.for_deployment(
+                self._controller,
                                                deployment)
         return self._routers[deployment]
 

@@ -49,6 +49,7 @@ class LongPollClient:
         self._routers: dict[str, list] = {}    # name -> [Router]
         self._lock = threading.Lock()
         self._stop = False
+        self._have_routers = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serve_longpoll")
         self._thread.start()
@@ -56,6 +57,7 @@ class LongPollClient:
     def register(self, router: "Router") -> None:
         with self._lock:
             self._routers.setdefault(router._name, []).append(router)
+        self._have_routers.set()
 
     def unregister(self, router: "Router") -> None:
         with self._lock:
@@ -64,16 +66,20 @@ class LongPollClient:
                 lst.remove(router)
             if not lst:
                 self._routers.pop(router._name, None)
+            if not self._routers:
+                self._have_routers.clear()
 
     def _loop(self) -> None:
         backoff = 0.5
         while not self._stop:
+            # Park (instead of spinning) until some router watches.
+            if not self._have_routers.wait(timeout=1.0):
+                continue
             with self._lock:
                 known = {name: min(r._version for r in routers)
                          for name, routers in self._routers.items()
                          if routers}
             if not known:
-                time.sleep(0.1)
                 continue
             try:
                 updates = ray_tpu.get(
@@ -93,6 +99,25 @@ class LongPollClient:
 
 
 class Router:
+    # One router per (controller, deployment) per process: handles are
+    # created freely (serve.run, get_deployment_handle, __reduce__ on
+    # every deserialization) and must share the cached snapshot
+    # instead of each registering a fresh long-poll watcher.
+    _cache: dict = {}
+    _cache_lock = threading.Lock()
+
+    @classmethod
+    def for_deployment(cls, controller,
+                       deployment_name: str) -> "Router":
+        key = (getattr(controller, "_actor_id", id(controller)),
+               deployment_name)
+        with cls._cache_lock:
+            r = cls._cache.get(key)
+            if r is None:
+                r = cls(controller, deployment_name)
+                cls._cache[key] = r
+            return r
+
     def __init__(self, controller, deployment_name: str):
         self._controller = controller
         self._name = deployment_name

@@ -11,6 +11,7 @@ Two layers:
 from ray_tpu.train.step import (
     TrainState,
     init_train_state,
+    make_multi_train_step,
     make_train_step,
     shard_batch,
 )
@@ -28,7 +29,8 @@ from ray_tpu.train.session import (
 from ray_tpu.train.trainer import JaxTrainer, Result
 
 __all__ = [
-    "TrainState", "init_train_state", "make_train_step", "shard_batch",
+    "TrainState", "init_train_state", "make_train_step",
+    "make_multi_train_step", "shard_batch",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Checkpoint", "get_context", "report",
     "JaxTrainer", "Result",

@@ -122,3 +122,37 @@ def test_gpt2_remat_matches():
     l1 = gpt2_loss_fn(model)(params, batch)
     l2 = gpt2_loss_fn(model_r)(params, batch)
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+def test_chunked_ce_custom_vjp_matches_dense():
+    """chunked_cross_entropy (hand-written VJP reusing saved LSE)
+    must match full-logits cross-entropy in value AND gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import (
+        chunked_cross_entropy, cross_entropy_loss,
+    )
+
+    B, S, E, V = 2, 64, 32, 128
+    hidden = jax.random.normal(jax.random.key(0), (B, S, E))
+    emb = jax.random.normal(jax.random.key(1), (V, E)) * 0.1
+    tgt = jax.random.randint(jax.random.key(2), (B, S), 0, V)
+    tgt = tgt.at[0, :5].set(-1)      # ignored positions
+
+    def loss_chunked(h, e):
+        return chunked_cross_entropy(h, e, tgt, chunk_size=32)
+
+    def loss_plain(h, e):
+        return cross_entropy_loss(
+            jnp.einsum("bse,ve->bsv", h, e), tgt)
+
+    l1, (gh1, ge1) = jax.value_and_grad(
+        loss_chunked, argnums=(0, 1))(hidden, emb)
+    l2, (gh2, ge2) = jax.value_and_grad(
+        loss_plain, argnums=(0, 1))(hidden, emb)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh2),
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ge1), np.asarray(ge2),
+                               rtol=1e-3, atol=1e-5)

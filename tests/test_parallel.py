@@ -69,10 +69,11 @@ def test_shard_params_gpt2_patterns():
     # wte: (vocab->tp, embed->fsdp)
     wte = find("wte")[0]
     assert wte.spec == jax.sharding.PartitionSpec("tp", "fsdp")
-    # attention q kernel: (embed->fsdp, heads->tp)
+    # attention qkv kernel [E, 3, H, D]: embed->fsdp, heads->tp
     qk = [s for name, s in by_name.items()
-          if "attn" in name and "'q'" in name and "kernel" in name][0]
-    assert qk.spec == jax.sharding.PartitionSpec("fsdp", "tp")
+          if "attn" in name and "qkv_kernel" in name][0]
+    assert qk.spec == jax.sharding.PartitionSpec(
+        "fsdp", None, "tp")
     # layer norm scale: replicated
     ln = [s for name, s in by_name.items() if "ln_1" in name][0]
     assert ln.spec == jax.sharding.PartitionSpec()
