@@ -50,6 +50,7 @@ def init(num_cpus: int | None = None,
          runtime_env: dict[str, Any] | None = None,
          address: str | None = None,
          log_to_driver: bool = True,
+         cluster_token: str | bytes | None = None,
          _system_config: dict[str, Any] | None = None):
     """Start the single-node runtime in this process (driver), or —
     with ``address`` — connect this process as a CLIENT of a running
@@ -90,7 +91,14 @@ def init(num_cpus: int | None = None,
                     f"would be silently ignored — remove them or drop "
                     f"address")
             from ray_tpu.core.worker import ClientRuntime
-            _runtime = ClientRuntime(_resolve_address(address))
+            token = cluster_token
+            if token is None:
+                import os
+                token = os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+            if isinstance(token, str):
+                token = bytes.fromhex(token)
+            _runtime = ClientRuntime(_resolve_address(address),
+                                     token=token)
             atexit.register(_shutdown_at_exit)
             return _runtime
         cfg = Config.from_env(_system_config)

@@ -1,0 +1,131 @@
+"""Standalone head daemon: the GCS-server-analog OS process.
+
+Runs a DriverRuntime as a dedicated control-plane process (reference:
+``gcs_server_main.cc:41``) with:
+
+- a TCP listener on a FIXED port so node daemons and clients can
+  (re)connect across head restarts;
+- a continuous journal: the control-plane tables (KV, named-actor
+  specs, PG specs) snapshot to ``<journal>/head_state.json`` on a
+  short interval (reference: GCS tables journaled to a Redis store,
+  ``redis_store_client.cc``);
+- restart recovery: a new head process started with the same journal,
+  port, and cluster token restores the snapshot, node daemons
+  reconnect and re-register (reviving their node ids, re-reporting
+  held objects and live workers), and surviving actor incarnations
+  are RE-ADOPTED with their state intact — the raylet-resync flow of
+  ``NotifyGCSRestart`` (node_manager.proto:383).
+
+Entry: ``python -m ray_tpu.core.head --port P [--journal DIR]
+[--num-cpus N]`` with RAY_TPU_CLUSTER_TOKEN in the environment.
+
+Clients connect with ``ray_tpu.init(address="host:P",
+cluster_token=...)``; daemons with ``python -m
+ray_tpu.core.node_daemon --address host:P``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+
+def run_head(port: int, token: bytes,
+             num_cpus: int | None = None,
+             journal_dir: str | None = None,
+             journal_interval_s: float = 0.25,
+             adopt_grace_s: float = 8.0,
+             host: str = "0.0.0.0"):
+    """Start the head runtime; returns (runtime, stop_event)."""
+    from ray_tpu.core import api
+    from ray_tpu.core.config import Config, set_config
+
+    cfg = Config.from_env()
+    set_config(cfg)
+    from ray_tpu.core.runtime import DriverRuntime
+    rt = DriverRuntime(cfg, num_cpus=num_cpus)
+    api._set_runtime(rt)
+    rt.cluster_token = token
+
+    # Restore BEFORE the listener opens: a daemon that reconnects
+    # against an empty actor table would have its surviving named
+    # actors treated as unknown incarnations instead of re-adopted.
+    snap_path = None
+    if journal_dir:
+        os.makedirs(journal_dir, exist_ok=True)
+        snap_path = os.path.join(journal_dir, "head_state.json")
+        if os.path.exists(snap_path):
+            with open(snap_path) as f:
+                state = json.load(f)
+            restored = rt.restore_snapshot(
+                state, adopt_grace_s=adopt_grace_s)
+            print(f"ray_tpu head: restored journal "
+                  f"{restored}", flush=True)
+    rt.ensure_tcp_listener(host, port)
+
+    stop = threading.Event()
+
+    def journal_loop():
+        last = None
+        while not stop.is_set():
+            try:
+                state = rt.snapshot_state()
+                if state != last:
+                    rt.save_snapshot(snap_path)
+                    last = state
+            except Exception:  # noqa: BLE001
+                pass
+            stop.wait(journal_interval_s)
+
+    if snap_path is not None:
+        threading.Thread(target=journal_loop, daemon=True,
+                         name="head_journal").start()
+    return rt, stop
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="ray_tpu head daemon (GCS analog)")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--num-cpus", type=int, default=None)
+    ap.add_argument("--journal", default="",
+                    help="journal dir for restartable head state")
+    ap.add_argument("--journal-interval", type=float, default=0.25)
+    ap.add_argument("--adopt-grace", type=float, default=8.0)
+    args = ap.parse_args(argv)
+
+    token_hex = os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
+    if not token_hex:
+        ap.error("RAY_TPU_CLUSTER_TOKEN required in environment")
+
+    rt, stop = run_head(
+        args.port, bytes.fromhex(token_hex),
+        num_cpus=args.num_cpus,
+        journal_dir=args.journal or None,
+        journal_interval_s=args.journal_interval,
+        adopt_grace_s=args.adopt_grace,
+        host=args.host)
+    print(f"ray_tpu head up: {args.host}:{args.port} "
+          f"pid={os.getpid()}", flush=True)
+
+    def on_term(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    try:
+        while not stop.is_set():
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    rt.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

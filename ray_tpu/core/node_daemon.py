@@ -117,21 +117,17 @@ class NodeDaemon:
         self._upcall_lock = threading.Lock()
         self._upcall_fid = itertools.count(1)
 
-        # Node channel to the head.
-        self.conn = mpc.Client(self.head_addr, family="AF_INET",
-                               authkey=token)
+        # Node channel to the head. On head death the daemon buffers
+        # outbound traffic and re-registers against the restarted head
+        # (raylet reconnect after NotifyGCSRestart).
+        self.resources = dict(resources or {})
+        self.labels = dict(labels or {})
+        self.reconnect_window_s = 60.0
         self._conn_lock = threading.Lock()
-        self.conn.send(("hello", "node", ""))
-        import socket
-        self.head_send((P.ND_REGISTER, {
-            "resources": dict(resources or {}),
-            "labels": dict(labels or {}),
-            "pid": os.getpid(),
-            "hostname": socket.gethostname(),
-        }))
-        tag, node_id = self.conn.recv()
-        assert tag == "registered", f"unexpected register reply {tag!r}"
-        self.node_id = node_id
+        self._conn_down = False
+        self._outbox: deque = deque(maxlen=10000)
+        self.node_id = ""
+        self.conn = self._dial_and_register()
 
         # Local listener for this node's workers.
         self._listener = mpc.Listener(self.client_address,
@@ -143,9 +139,75 @@ class NodeDaemon:
     # head channel
     # ------------------------------------------------------------------
 
+    def _dial_and_register(self):
+        import socket
+        conn = mpc.Client(self.head_addr, family="AF_INET",
+                          authkey=self.token)
+        conn.send(("hello", "node", ""))
+        info = {
+            "resources": self.resources,
+            "labels": self.labels,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+        }
+        if self.node_id:
+            # Re-registration: revive our identity, re-report held
+            # objects and live workers so the restarted head rebuilds
+            # its directory and re-adopts surviving actors.
+            with self._store_lock:
+                objects = [o.binary() for o in self._local_oids]
+            with self._pool_lock:
+                workers = [
+                    (widx, bool(getattr(w, "is_actor", False)),
+                     getattr(w, "actor_id_bytes", None),
+                     w.env_key)
+                    for widx, w in self._workers.items()
+                    if not w.dead]
+            info["node_id"] = self.node_id
+            info["objects"] = objects
+            info["workers"] = workers
+        conn.send((P.ND_REGISTER, info))
+        tag, node_id = conn.recv()
+        assert tag == "registered", \
+            f"unexpected register reply {tag!r}"
+        self.node_id = node_id
+        return conn
+
+    def _reconnect(self) -> bool:
+        deadline = time.monotonic() + self.reconnect_window_s
+        while not self._shutdown and time.monotonic() < deadline:
+            try:
+                conn = self._dial_and_register()
+            except Exception:  # noqa: BLE001
+                time.sleep(0.5)
+                continue
+            with self._conn_lock:
+                self.conn = conn
+                self._conn_down = False
+                while self._outbox:
+                    try:
+                        conn.send(self._outbox.popleft())
+                    except (OSError, BrokenPipeError):
+                        self._conn_down = True
+                        break
+            if not self._conn_down:
+                print(f"ray_tpu node daemon: reconnected to head as "
+                      f"{self.node_id}", flush=True)
+                return True
+        return False
+
     def head_send(self, msg: tuple) -> None:
         with self._conn_lock:
-            self.conn.send(msg)
+            if self._conn_down:
+                self._outbox.append(msg)
+                return
+            try:
+                self.conn.send(msg)
+            except (OSError, BrokenPipeError):
+                # Head gone: buffer until the reconnect loop (driven
+                # by serve_forever's recv EOF) re-establishes us.
+                self._conn_down = True
+                self._outbox.append(msg)
 
     def _head_call(self, op: str, payload, timeout: float = 60.0):
         fid = next(self._upcall_fid)
@@ -164,23 +226,38 @@ class NodeDaemon:
         return result
 
     def serve_forever(self) -> None:
-        """Main loop: handle head->daemon messages until shutdown."""
-        try:
-            while not self._shutdown:
-                msg = self.conn.recv()
-                kind = msg[0]
-                if kind == P.ND_WMSG:
+        """Main loop: handle head->daemon messages until shutdown.
+        A lost head connection triggers the reconnect window instead
+        of node death — workers keep running through the outage."""
+        while not self._shutdown:
+            try:
+                self._serve_conn()
+            except (EOFError, OSError):
+                pass
+            if self._shutdown:
+                break
+            with self._conn_lock:
+                self._conn_down = True
+            if not self._reconnect():
+                break     # head never came back: die with it
+        self.shutdown()
+
+    def _serve_conn(self) -> None:
+        while not self._shutdown:
+            msg = self.conn.recv()
+            kind = msg[0]
+            if kind == P.ND_WMSG:
                     _, widx, wmsg = msg
                     self._enqueue_worker_send(widx, wmsg)
-                elif kind == P.ND_WSPAWN:
+            elif kind == P.ND_WSPAWN:
                     _, widx, env_key, env_vars = msg
                     self._spawn_worker(widx, env_key, env_vars)
-                elif kind == P.ND_TASK_META:
+            elif kind == P.ND_TASK_META:
                     _, widx, task_id_bytes, oid_bytes_list = msg
                     with self._task_meta_lock:
                         self._task_meta[task_id_bytes] = (
                             widx, [ObjectID(b) for b in oid_bytes_list])
-                elif kind == P.ND_WKILL:
+            elif kind == P.ND_WKILL:
                     _, widx, how = msg
                     w = self._workers.get(widx)
                     if w is not None:
@@ -191,12 +268,12 @@ class NodeDaemon:
                                 w.proc.terminate()
                         except Exception:  # noqa: BLE001
                             pass
-                elif kind == P.ND_CALL:
+            elif kind == P.ND_CALL:
                     _, fid, op, payload = msg
                     threading.Thread(
                         target=self._handle_node_call,
                         args=(fid, op, payload), daemon=True).start()
-                elif kind == P.ND_UPREPLY:
+            elif kind == P.ND_UPREPLY:
                     _, fid, status, payload = msg
                     with self._upcall_lock:
                         entry = self._upcalls.pop(fid, None)
@@ -204,12 +281,9 @@ class NodeDaemon:
                         event, slot = entry
                         slot.append((status, payload))
                         event.set()
-                elif kind == P.ND_SHUTDOWN:
-                    break
-        except (EOFError, OSError):
-            pass       # head died or link lost: node dies with it
-        finally:
-            self.shutdown()
+            elif kind == P.ND_SHUTDOWN:
+                    self._shutdown = True
+                    return
 
     # ------------------------------------------------------------------
     # worker pool (the WorkerHandle "runtime" surface)
@@ -247,8 +321,15 @@ class NodeDaemon:
         with self._pool_lock:
             q = self._send_queues.get(widx)
             ev = self._send_events.get(widx)
+            w = self._workers.get(widx)
         if q is None:
             return
+        if w is not None and msg and msg[0] == P.EXEC_ACTOR_INIT:
+            # Remember actor identity so a re-registration after a
+            # head restart lets the new head re-adopt this
+            # incarnation.
+            w.is_actor = True
+            w.actor_id_bytes = msg[1]
         q.append(msg)
         ev.set()
 
@@ -432,11 +513,22 @@ class NodeDaemon:
         """Splice a local worker's client channel onto a dedicated TCP
         connection to the head, serving object ops from the node store
         where possible (the worker-side API is oblivious)."""
-        try:
-            upstream = mpc.Client(self.head_addr, family="AF_INET",
-                                  authkey=self.token)
-            upstream.send(("hello", "client", ""))
-        except Exception:  # noqa: BLE001
+        upstream = None
+        deadline = time.monotonic() + self.reconnect_window_s
+        while upstream is None and not self._shutdown:
+            try:
+                upstream = mpc.Client(self.head_addr,
+                                      family="AF_INET",
+                                      authkey=self.token)
+                upstream.send(("hello", "client", ""))
+            except Exception:  # noqa: BLE001
+                # Head mid-restart: keep trying within the window so
+                # worker API calls resume instead of failing.
+                if time.monotonic() > deadline:
+                    conn.close()
+                    return
+                time.sleep(0.5)
+        if upstream is None:
             conn.close()
             return
         down_lock = threading.Lock()
@@ -610,6 +702,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="extra resources as JSON")
     ap.add_argument("--labels", default="{}")
     ap.add_argument("--object-store-memory", type=int, default=0)
+    ap.add_argument("--reconnect-window", type=float, default=60.0,
+                    help="seconds to retry the head after a lost "
+                         "connection before giving up")
     args = ap.parse_args(argv)
 
     host, _, port = args.address.rpartition(":")
@@ -628,6 +723,7 @@ def main(argv: list[str] | None = None) -> int:
         host or "127.0.0.1", int(port), bytes.fromhex(token_hex),
         resources=resources, labels=json.loads(args.labels),
         object_store_memory=args.object_store_memory)
+    daemon.reconnect_window_s = args.reconnect_window
     print(f"ray_tpu node daemon up: node_id={daemon.node_id} "
           f"head={args.address}", flush=True)
     daemon.serve_forever()

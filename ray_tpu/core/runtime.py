@@ -1576,8 +1576,12 @@ class DriverRuntime:
 
     def _add_node_locked_free(self, resources: dict[str, float],
                               labels: dict[str, str] | None = None,
-                              is_head: bool = False) -> str:
-        node_id = f"node_{next(self._node_seq):04d}_{os.urandom(4).hex()}"
+                              is_head: bool = False,
+                              node_id: str = "") -> str:
+        """Create (or, given a prior id from a re-registering daemon,
+        revive) a node-table entry."""
+        node_id = node_id or \
+            f"node_{next(self._node_seq):04d}_{os.urandom(4).hex()}"
         self._nodes[node_id] = NodeRecord(
             node_id=node_id, resources=dict(resources),
             avail=dict(resources), labels=dict(labels or {}),
@@ -1673,6 +1677,189 @@ class DriverRuntime:
             f"object {oid.hex()} was stored on node {node_id}, "
             f"which died, and could not be reconstructed"))
         self._store_error(oid, blob)
+
+    # ---------------- head snapshot / restore (GCS HA analog) ---------
+
+    def snapshot_state(self) -> dict:
+        """Control-plane tables as a JSON-serializable dict (reference:
+        GCS tables journaled to Redis, redis_store_client.cc): KV,
+        named-actor specs (with identity, so a surviving node daemon's
+        live incarnation can be re-adopted), PG specs."""
+        import base64
+
+        def e(b: bytes) -> str:
+            return base64.b64encode(b).decode()
+
+        kv_rows = []
+        with self._kv_lock:
+            for (ns, k), v in self._kv.items():
+                kv_rows.append({"ns": ns, "k": e(k), "v": e(v)})
+        actor_rows = []
+        with self._actor_lock:
+            named = dict(self._named_actors)
+        for name, actor_id in named.items():
+            rec = self._actors.get(actor_id)
+            if rec is None or rec.state == "DEAD":
+                continue
+            pg = rec.options.placement_group
+            actor_rows.append({
+                "name": name,
+                "actor_id": actor_id.hex(),
+                "cls_name": rec.cls_name,
+                "cls_blob": e(rec.cls_blob),
+                "init_args_blob": e(rec.init_args_blob),
+                "options_blob": e(ser.dumps(rec.options)),
+                "pg_id": pg.id.hex() if pg is not None else None,
+                "max_restarts": rec.max_restarts,
+                "max_concurrency": rec.max_concurrency,
+            })
+        pg_rows = []
+        with self._pg_lock:
+            for pg_id, pg in self._pgs.items():
+                if pg.created:
+                    pg_rows.append({"id": pg_id.hex(),
+                                    "bundles": pg.bundles,
+                                    "strategy": pg.strategy})
+        return {"kv": kv_rows, "named_actors": actor_rows,
+                "pgs": pg_rows}
+
+    def save_snapshot(self, path: str) -> dict:
+        import json
+        state = self.snapshot_state()
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, path)
+        return {"kv": len(state["kv"]),
+                "named_actors": len(state["named_actors"]),
+                "pgs": len(state["pgs"])}
+
+    def restore_snapshot(self, state: dict,
+                         adopt_grace_s: float = 8.0) -> dict:
+        """Replay a head snapshot into THIS runtime after a head
+        restart. KV restores verbatim; PGs re-reserve; named actors
+        enter RESTARTING under their OLD identity — if a reconnecting
+        node daemon reports that incarnation still alive within the
+        grace window it is ADOPTED (state preserved), else it restarts
+        fresh (reference semantics: GCS restart + raylet resync,
+        NotifyGCSRestart, node_manager.proto:383)."""
+        import base64
+
+        def d(s: str) -> bytes:
+            return base64.b64decode(s)
+
+        for row in state.get("kv", []):
+            self.kv_put(d(row["k"]), d(row["v"]), row["ns"])
+
+        from ray_tpu.core.placement_group import PlacementGroup
+        pg_map: dict[str, PlacementGroup] = {}
+        for row in state.get("pgs", []):
+            bundles = [dict(b) for b in row["bundles"]]
+            new_id = self.create_placement_group(bundles,
+                                                 row["strategy"])
+            pg_map[row.get("id", "")] = PlacementGroup(
+                new_id, bundles, row["strategy"])
+
+        restored = []
+        for row in state.get("named_actors", []):
+            name = row["name"]
+            with self._actor_lock:
+                if name in self._named_actors:
+                    continue
+            options = ser.loads(d(row["options_blob"]))
+            if row.get("pg_id") is not None:
+                options.placement_group = pg_map.get(row["pg_id"])
+                if options.placement_group is None:
+                    options.placement_group_bundle_index = -1
+                    options.scheduling_strategy = "DEFAULT"
+            actor_id = (ActorID(bytes.fromhex(row["actor_id"]))
+                        if row.get("actor_id") else
+                        ActorID.of(self.job_id))
+            rec = ActorRecord(
+                actor_id=actor_id, name=name,
+                cls_name=row["cls_name"], cls_blob=d(row["cls_blob"]),
+                init_args_blob=d(row["init_args_blob"]),
+                init_arg_refs=[], options=options,
+                max_restarts=row["max_restarts"],
+                max_concurrency=row["max_concurrency"],
+                state="RESTARTING")
+            with self._actor_lock:
+                self._named_actors[name] = actor_id
+                self._actors[actor_id] = rec
+            restored.append(name)
+
+            def _grace_start(rec=rec):
+                time.sleep(adopt_grace_s)
+                if (rec.worker is None and rec.state == "RESTARTING"
+                        and not self._shutdown):
+                    self._start_actor(rec)
+
+            threading.Thread(target=_grace_start, daemon=True).start()
+        return {"kv": len(state.get("kv", [])),
+                "named_actors": restored, "pgs": len(pg_map)}
+
+    def _adopt_worker(self, node: NodeRecord, widx: int,
+                      is_actor: bool, actor_id_bytes: bytes | None,
+                      env_key: str) -> None:
+        """A reconnecting daemon reports a live worker from before the
+        head restart: rebuild its head-side handle without spawning,
+        and re-bind a RESTARTING actor record to its surviving
+        incarnation (state preserved)."""
+        # Keep future worker indexes clear of adopted ones.
+        current = next(WorkerHandle._counter)
+        if widx >= current:
+            WorkerHandle._counter = itertools.count(widx + 1)
+        else:
+            WorkerHandle._counter = itertools.count(current)
+        w = RemoteWorkerHandle.__new__(RemoteWorkerHandle)
+        w.index = widx
+        w.env_key = env_key or "adopted"
+        w.node_id = node.node_id
+        w.node = node
+        w.busy = True
+        w.is_actor = bool(is_actor)
+        w.actor_id = (ActorID(actor_id_bytes)
+                      if actor_id_bytes else None)
+        w.dead = False
+        w.last_idle = time.monotonic()
+        w.sent_fn_ids = set()
+        w.log_path = None
+        w._runtime = self
+        w.proc = _RemoteProc(w)
+        w.conn = ("remote", node.node_id)
+        self._remote_workers[widx] = w
+        with self._pool_lock:
+            self._workers.append(w)
+        if w.is_actor and w.actor_id is not None:
+            with self._actor_lock:
+                rec = self._actors.get(w.actor_id)
+                bind = (rec is not None and rec.worker is None
+                        and rec.state in ("RESTARTING", "PENDING"))
+                if bind:
+                    rec.worker = w
+                    rec.node_id = node.node_id
+            if bind:
+                # The surviving incarnation holds its resources on the
+                # revived node: account them (no acquire ran).
+                with self._res_cv:
+                    self._take_from_node(
+                        node, self._effective_resources(rec.options))
+                rec.state = "ALIVE"
+                rec.ready_event.set()
+            else:
+                # Unknown incarnation (not in the snapshot), or a
+                # fresh restart already claimed the record (transient
+                # link drop, not a head restart): exactly one
+                # incarnation may live — drop this one.
+                w.proc.terminate()
+        else:
+            # Pooled worker: make it reusable.
+            w.busy = False
+            with self._pool_lock:
+                self._idle.setdefault(
+                    (node.node_id, w.env_key), []).append(w)
 
     # ---------------- lineage reconstruction ----------------
 
@@ -2765,17 +2952,36 @@ class DriverRuntime:
             return
         info = msg[1] or {}
         resources = dict(info.get("resources") or {"CPU": 1.0})
+        prior_id = info.get("node_id") or ""
         with self._res_cv:
             node_id = self._add_node_locked_free(
-                resources, info.get("labels"))
+                resources, info.get("labels"), node_id=prior_id)
             node = self._nodes[node_id]
+            node.alive = True
             node.conn = conn
             node.send_lock = threading.Lock()
             node.pid = int(info.get("pid", 0))
             node.hostname = str(info.get("hostname", ""))
             self._res_cv.notify_all()
         try:
+            # The registration ack MUST be the first message on the
+            # channel — adoption below may emit ND_WKILL, which would
+            # otherwise arrive inside the daemon's handshake recv.
             node.node_send(("registered", node_id))
+            # Re-registration after a head restart: rebuild the
+            # directory entries for objects the daemon still stores
+            # and re-adopt its surviving workers/actors (raylet
+            # resync after NotifyGCSRestart, node_manager.proto:383).
+            for oid_bytes in info.get("objects", []):
+                self._store_remote(ObjectID(oid_bytes), node_id, 0, [])
+            for went in info.get("workers", []):
+                widx, is_actor, actor_id_bytes, env_key = went
+                try:
+                    self._adopt_worker(node, int(widx),
+                                       bool(is_actor),
+                                       actor_id_bytes, env_key or "")
+                except Exception:  # noqa: BLE001
+                    traceback.print_exc()
             while True:
                 msg = conn.recv()
                 kind = msg[0]

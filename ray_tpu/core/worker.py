@@ -35,11 +35,17 @@ class ClientRuntime:
     ``put``) never deadlock on the single connection.
     """
 
-    def __init__(self, address: str):
+    def __init__(self, address, token: bytes | None = None,
+                 reconnect_window_s: float = 30.0):
         import os
         from collections import deque
-        self._conn = mpc.Client(address, family="AF_UNIX")
-        self._conn.send(("hello", "client", ""))
+        self._address = address
+        self._token = token
+        self._reconnect_window_s = reconnect_window_s
+        self._conn_gen = 0
+        self._conn_dead = False
+        self._conn_lock = threading.Lock()
+        self._conn = self._dial()
         # Shm descriptors are a same-host optimization; a client that
         # cannot map the arena (different host / sandbox, or forced
         # for testing) pulls object bytes over the socket instead —
@@ -65,10 +71,48 @@ class ClientRuntime:
         self._notify_thread.start()
         self.local_mode = False
 
+    def _dial(self):
+        """Open the control connection: unix path for a same-host
+        head/daemon, host:port (authenticated) for a remote head."""
+        addr = self._address
+        if isinstance(addr, str) and ":" in addr \
+                and not addr.startswith("/"):
+            host, _, port = addr.rpartition(":")
+            conn = mpc.Client((host or "127.0.0.1", int(port)),
+                              family="AF_INET", authkey=self._token)
+        else:
+            conn = mpc.Client(addr, family="AF_UNIX")
+        conn.send(("hello", "client", ""))
+        return conn
+
+    def _try_reconnect(self) -> bool:
+        """Re-dial after the head connection dropped (head restart —
+        reference: raylets/clients reconnecting after a GCS restart,
+        NotifyGCSRestart). Retries within the window; on success a
+        fresh recv thread serves the new connection."""
+        import time as _time
+        deadline = _time.monotonic() + self._reconnect_window_s
+        while _time.monotonic() < deadline:
+            try:
+                conn = self._dial()
+            except (OSError, ConnectionError, EOFError, Exception):
+                _time.sleep(0.3)
+                continue
+            with self._conn_lock:
+                self._conn = conn
+                self._conn_gen += 1
+                self._conn_dead = False
+            threading.Thread(target=self._recv_loop, daemon=True,
+                             name="client_recv").start()
+            return True
+        return False
+
     def _recv_loop(self):
+        conn = self._conn
+        gen = self._conn_gen
         try:
             while True:
-                req_id, status, payload = self._conn.recv()
+                req_id, status, payload = conn.recv()
                 with self._pending_lock:
                     entry = self._pending.pop(req_id, None)
                 if entry is not None:
@@ -76,7 +120,13 @@ class ClientRuntime:
                     slot.append((status, payload))
                     event.set()
         except (EOFError, OSError):
-            # Driver went away; fail all pending requests.
+            # Head went away; mark the conn dead (a send into a dead
+            # TCP buffer can "succeed" locally, so _call must not
+            # trust it) and fail all pending requests. New calls
+            # attempt a reconnect (_call).
+            with self._conn_lock:
+                if gen == self._conn_gen:
+                    self._conn_dead = True
             with self._pending_lock:
                 for event, slot in self._pending.values():
                     slot.append((P.ST_ERR, ser.dumps(
@@ -102,23 +152,46 @@ class ClientRuntime:
                     with self._send_lock:
                         self._conn.send((-1, op, payload))
                 except (OSError, BrokenPipeError, ValueError):
-                    return   # driver gone
+                    # Head gone: drop the notification (a restarted
+                    # head rebuilds borrow bookkeeping from scratch)
+                    # but keep serving — the conn may be replaced by
+                    # _try_reconnect.
+                    continue
 
-    def _call(self, op: str, payload, timeout: float | None = None):
+    def _call(self, op: str, payload, timeout: float | None = None,
+              _retried: bool = False):
+        if self._conn_dead:
+            if _retried or not self._try_reconnect():
+                raise ConnectionError(
+                    f"head connection lost (op {op})")
         req_id = next(self._req_counter)
         event = threading.Event()
         slot: list = []
         with self._pending_lock:
             self._pending[req_id] = (event, slot)
-        with self._send_lock:
-            self._conn.send((req_id, op, payload))
+        try:
+            with self._send_lock:
+                self._conn.send((req_id, op, payload))
+        except (OSError, BrokenPipeError) as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            if not _retried and self._try_reconnect():
+                return self._call(op, payload, timeout, _retried=True)
+            raise ConnectionError(
+                f"head connection lost during {op}") from e
         if not event.wait(timeout):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
             raise GetTimeoutError(f"driver op {op} timed out")
         status, result = slot[0]
         if status == P.ST_ERR:
-            raise ser.loads(result)
+            err = ser.loads(result)
+            if isinstance(err, ConnectionError) and not _retried \
+                    and self._try_reconnect():
+                # The in-flight request died with the old head; replay
+                # it against the restarted one.
+                return self._call(op, payload, timeout, _retried=True)
+            raise err
         return result
 
     # -- object API --

@@ -2,28 +2,26 @@
 
 Reference analog (SURVEY.md §5.3 GCS failure/HA): with Redis
 persistence the GCS journals its tables (actors, placement groups,
-KV, jobs) and a restarted GCS replays them — named/detached actors
-are restarted fresh and placement groups re-reserved
-(``NotifyGCSRestart``). Here the control plane is the driver runtime,
-so HA = snapshot the control-plane tables to disk and replay them
-into a new runtime after a head restart:
+KV, jobs) and a restarted GCS replays them. Two tiers here:
 
-    ray_tpu.util.ha.save_head_state(path)        # old head
-    ...head dies, new process...
-    ray_tpu.init(); ray_tpu.util.ha.restore_head_state(path)
+- **Live head restart** (the full GCS-HA flow): run the head as a
+  standalone journaled process — ``python -m ray_tpu.core.head
+  --journal DIR`` — and a SIGKILL'd head restarted with the same
+  journal/port/token recovers automatically: daemons reconnect and
+  re-register, surviving actor incarnations are re-adopted with state
+  intact, clients resume through ClientRuntime's reconnect. See
+  ray_tpu/core/head.py and tests/test_head_restart.py.
 
-Restored: internal KV, NAMED actors (restarted fresh — same semantics
-as a GCS-driven actor restart: state is lost, identity and
-reachability survive), and placement-group specs (re-reserved).
-Anonymous actors/objects die with the head, as their handles did.
+- **Manual snapshot/replay** (this module): explicit
+  ``save_head_state(path)`` / ``restore_head_state(path)`` for
+  in-driver runtimes — named actors are restarted fresh (identity and
+  reachability survive; state does not, since the old incarnations
+  died with the driver).
 """
 
 from __future__ import annotations
 
-import base64
 import json
-import os
-from typing import Any
 
 
 def _rt():
@@ -31,107 +29,18 @@ def _rt():
     return get_runtime()
 
 
-def _e(b: bytes) -> str:
-    return base64.b64encode(b).decode()
-
-
-def _d(s: str) -> bytes:
-    return base64.b64decode(s)
-
-
 def save_head_state(path: str) -> dict:
     """Snapshot KV + named-actor specs + PG specs to ``path``
     (atomic). Returns the counts written."""
-    from ray_tpu.core import serialization as ser
-    rt = _rt()
-
-    kv_rows = []
-    with rt._kv_lock:
-        for (ns, k), v in rt._kv.items():
-            kv_rows.append({"ns": ns, "k": _e(k), "v": _e(v)})
-
-    actor_rows = []
-    with rt._actor_lock:
-        named = dict(rt._named_actors)
-    for name, actor_id in named.items():
-        rec = rt._actors.get(actor_id)
-        if rec is None or rec.state == "DEAD":
-            continue
-        pg = rec.options.placement_group
-        actor_rows.append({
-            "name": name,
-            "cls_name": rec.cls_name,
-            "cls_blob": _e(rec.cls_blob),
-            "init_args_blob": _e(rec.init_args_blob),
-            "options_blob": _e(ser.dumps(rec.options)),
-            "pg_id": pg.id.hex() if pg is not None else None,
-            "max_restarts": rec.max_restarts,
-            "max_concurrency": rec.max_concurrency,
-        })
-
-    pg_rows = []
-    with rt._pg_lock:
-        for pg_id, pg in rt._pgs.items():
-            if pg.created:
-                pg_rows.append({"id": pg_id.hex(),
-                                "bundles": pg.bundles,
-                                "strategy": pg.strategy})
-
-    state = {"kv": kv_rows, "named_actors": actor_rows, "pgs": pg_rows}
-    tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(tmp, "w") as f:
-        json.dump(state, f)
-    os.replace(tmp, path)
-    return {"kv": len(kv_rows), "named_actors": len(actor_rows),
-            "pgs": len(pg_rows)}
+    return _rt().save_snapshot(path)
 
 
 def restore_head_state(path: str) -> dict:
     """Replay a head snapshot into the CURRENT runtime: KV entries
-    restored verbatim, named actors recreated (fresh state), PGs
-    re-reserved. Returns what was restored; actors whose name is
-    already taken are skipped (idempotent replay)."""
-    from ray_tpu.core import serialization as ser
-    rt = _rt()
+    restored verbatim, named actors recreated, PGs re-reserved.
+    Actors whose name is already live are skipped (idempotent replay).
+    With no node daemons around to adopt surviving incarnations, the
+    zero-second grace restarts every restored actor fresh."""
     with open(path) as f:
         state = json.load(f)
-
-    for row in state["kv"]:
-        rt.kv_put(_d(row["k"]), _d(row["v"]), row["ns"])
-
-    # Re-reserve placement groups FIRST, mapping old ids -> new PGs so
-    # restored actors that lived in a PG land in its replacement.
-    from ray_tpu.core.placement_group import PlacementGroup
-    pg_map: dict[str, PlacementGroup] = {}
-    for row in state["pgs"]:
-        bundles = [dict(b) for b in row["bundles"]]
-        new_id = rt.create_placement_group(bundles, row["strategy"])
-        pg_map[row.get("id", "")] = PlacementGroup(
-            new_id, bundles, row["strategy"])
-
-    restored_actors = []
-    for row in state["named_actors"]:
-        try:
-            rt.get_named_actor(row["name"])
-            continue                      # name already live
-        except ValueError:
-            pass
-        options = ser.loads(_d(row["options_blob"]))
-        if row.get("pg_id") is not None:
-            # The snapshotted options carry the OLD runtime's PG id —
-            # relink to the re-reserved group (or drop to plain
-            # resource placement if it wasn't restorable).
-            options.placement_group = pg_map.get(row["pg_id"])
-            if options.placement_group is None:
-                options.placement_group_bundle_index = -1
-                options.scheduling_strategy = "DEFAULT"
-        args, kwargs = ser.loads(_d(row["init_args_blob"]))
-        rt.create_actor(
-            _d(row["cls_blob"]), row["cls_name"], args, kwargs,
-            options, row["name"], row["max_restarts"],
-            row["max_concurrency"])
-        restored_actors.append(row["name"])
-
-    return {"kv": len(state["kv"]), "named_actors": restored_actors,
-            "pgs": len(pg_map)}
+    return _rt().restore_snapshot(state, adopt_grace_s=0.0)
