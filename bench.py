@@ -1,28 +1,224 @@
-"""Headline benchmark: GPT-2 training throughput on the local TPU chip.
+"""Headline benchmark with a hang-proof watchdog harness.
 
 Prints ONE JSON line:
   {"metric": "gpt2_tokens_per_sec_per_chip", "value": N,
-   "unit": "tokens/s/chip", "vs_baseline": R}
+   "unit": "tokens/s/chip", "vs_baseline": R, "extra": {...}}
 
-vs_baseline compares against the north-star reference from
-BASELINE.json ("≥90% of published A100-DDP throughput"): GPT-2 124M
-pretraining on one A100-80GB with bf16 + flash attention sustains
-~1.78e5 tokens/s (nanoGPT-class harness — the same model/batch recipe
-the reference's release train tests use per-GPU). vs_baseline =
-tokens_per_sec_per_chip / 178_000.
+The parent process never imports jax. Backend init runs in a child
+process under a hard timeout (the TPU tunnel can *hang* rather than
+raise — reference failure mode: driver BENCH_r02 rc=1 and a 570 s
+silent hang). Probe attempts: 2 with backoff; a dead backend yields
+the error JSON line in well under 90 s. Each benchmark then runs in
+its own child with a generous timeout, so a mid-run wedge still
+produces the error line.
+
+Sub-benchmarks (children of this same file):
+  --probe     init backend, report device count/platform
+  --gpt2      GPT-2 124M training throughput (tokens/s/chip)
+  --resnet50  ResNet-50 training throughput (images/s/chip); reference
+              harness shape: release/air_tests/air_benchmarks/
+              mlperf-train/resnet50_ray_air.py:186-203,357
+  --scaling   8-device virtual-CPU dp=1 vs dp=8 step-time ratio at a
+              fixed global batch (sharding-overhead proxy; the only
+              multi-chip stand-in this single-chip environment allows)
+
+vs_baseline for gpt2 compares against the north-star reference from
+BASELINE.json: GPT-2 124M pretraining on one A100-80GB with bf16 +
+flash attention sustains ~1.78e5 tokens/s. ResNet-50's baseline is the
+A100 bf16 train recipe (~2.5e3 images/s/GPU) from the same class of
+harness the reference's release tests use.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
 A100_GPT2_TOKENS_PER_S = 178_000.0
+A100_RESNET50_IMAGES_PER_S = 2_500.0
+
+HEADLINE = "gpt2_tokens_per_sec_per_chip"
+
+# Watchdog budget: two probe attempts + backoff stays < 90 s even when
+# every attempt hangs to its full timeout.
+PROBE_TIMEOUTS = (45.0, 30.0)
+PROBE_BACKOFF_S = 3.0
+BENCH_TIMEOUT_S = 600.0
+SCALING_TIMEOUT_S = 420.0
+# Global wall-clock target for the whole orchestration. The driver's
+# own timeout was observed near ~570 s; finishing (with whatever
+# completed) beats being killed holding an unprinted result.
+DEADLINE_S = 540.0
 
 
-def main() -> None:
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _run_child(flag: str, timeout: float, extra_env: dict | None = None):
+    """Run `python bench.py <flag>` in a new session; parse the last
+    JSON line of stdout. Returns (dict|None, error_str|None). On
+    timeout the whole process group is killed (jax spawns threads that
+    can survive a plain terminate while wedged on the tunnel)."""
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), flag],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True, env=env, text=True)
+    _LIVE_CHILDREN.add(proc.pid)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        _LIVE_CHILDREN.discard(proc.pid)
+        return None, f"timeout after {timeout:.0f}s"
+    _LIVE_CHILDREN.discard(proc.pid)
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    tail = (err or out or "").strip().splitlines()[-3:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)[:300]
+
+
+def _probe() -> tuple[dict | None, str]:
+    """Backend init under watchdog, with retry."""
+    timeouts = [
+        _env_f("RAY_TPU_BENCH_PROBE_TIMEOUT", t) for t in PROBE_TIMEOUTS]
+    errs = []
+    for i, t in enumerate(timeouts):
+        res, err = _run_child("--probe", t)
+        if res and res.get("ok"):
+            return res, ""
+        errs.append(err or str(res))
+        if i + 1 < len(timeouts):
+            time.sleep(_env_f("RAY_TPU_BENCH_PROBE_BACKOFF", PROBE_BACKOFF_S))
+    return None, "; ".join(e for e in errs if e)
+
+
+_LIVE_CHILDREN: set = set()
+
+
+def _emit(value: float, vs_baseline: float, extra: dict,
+          error: str | None = None, rc: int = 0) -> None:
+    # Reap any still-running child process groups (e.g. the concurrent
+    # scaling run when the probe fails early) so the driver's wait on
+    # us doesn't inherit orphans.
+    for pid in list(_LIVE_CHILDREN):
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    line = {
+        "metric": HEADLINE, "value": value, "unit": "tokens/s/chip",
+        "vs_baseline": vs_baseline,
+    }
+    if error:
+        line["error"] = error[:500]
+    if extra:
+        line["extra"] = extra
+    print(json.dumps(line), flush=True)
+    sys.exit(rc)
+
+
+def orchestrate() -> None:
+    t_start = time.monotonic()
+    deadline = _env_f("RAY_TPU_BENCH_DEADLINE", DEADLINE_S)
+
+    def budget(want: float) -> float:
+        """Clamp a child timeout to the global deadline; <=0 = skip."""
+        return min(want, deadline - (time.monotonic() - t_start) - 5.0)
+
+    extra: dict = {}
+    probe, perr = _probe()
+    if probe is None:
+        _emit(0.0, 0.0, extra,
+              error=f"backend init failed/hung: {perr}", rc=1)
+    extra["platform"] = probe.get("platform")
+    extra["n_chips"] = probe.get("n_devices")
+
+    bench_timeout = _env_f("RAY_TPU_BENCH_TIMEOUT", BENCH_TIMEOUT_S)
+    gpt2, gerr = _run_child("--gpt2", max(budget(bench_timeout), 60.0))
+    if gpt2 and "error" in gpt2:
+        gpt2, gerr = None, gpt2["error"]
+
+    # Secondary benches run serially AFTER the headline (no host
+    # contention in its timed region) and are skipped rather than
+    # allowed to push total wall time past the driver's budget.
+    if not os.environ.get("RAY_TPU_BENCH_SKIP_RESNET"):
+        t = budget(bench_timeout)
+        if t > 45:
+            resnet, rerr = _run_child("--resnet50", t)
+            if resnet and "error" not in resnet:
+                extra["resnet50_images_per_s"] = resnet.get("value")
+                extra["resnet50"] = resnet.get("extra")
+            else:
+                extra["resnet50_error"] = (rerr or (resnet or {}).get(
+                    "error", ""))[:200]
+        else:
+            extra["resnet50_error"] = "skipped: deadline"
+
+    if not os.environ.get("RAY_TPU_BENCH_SKIP_SCALING"):
+        t = budget(_env_f("RAY_TPU_BENCH_SCALING_TIMEOUT",
+                          SCALING_TIMEOUT_S))
+        if t > 45:
+            scaling, serr = _run_child("--scaling", t)
+            if scaling and "error" not in scaling:
+                extra["dp8_scaling_efficiency_proxy"] = scaling.get(
+                    "value")
+                extra["scaling"] = scaling.get("extra")
+            else:
+                extra["scaling_error"] = (serr or (scaling or {}).get(
+                    "error", ""))[:200]
+        else:
+            extra["scaling_error"] = "skipped: deadline"
+
+    if gpt2 is None:
+        _emit(0.0, 0.0, extra, error=f"gpt2 bench failed: {gerr}", rc=1)
+    extra.update(gpt2.get("extra") or {})
+    _emit(gpt2["value"], gpt2.get("vs_baseline", 0.0), extra)
+
+
+# ---------------------------------------------------------------------------
+# Children
+
+
+def probe_main() -> None:
+    if os.environ.get("RAY_TPU_BENCH_FAKE_HANG"):
+        time.sleep(3600)  # simulated wedged tunnel
+    if os.environ.get("RAY_TPU_BENCH_FAKE_FAIL"):
+        raise RuntimeError("simulated backend init failure")
+    _maybe_cpu_smoke()
+    t0 = time.time()
     import jax
+
+    devs = jax.devices()
+    print(json.dumps({
+        "ok": True, "n_devices": len(devs),
+        "platform": jax.default_backend(),
+        "init_s": round(time.time() - t0, 1),
+    }), flush=True)
+
+
+def gpt2_main() -> None:
+    smoke = _maybe_cpu_smoke()
+    import jax
+    import jax.numpy as jnp
     import numpy as np
     import optax
 
@@ -36,19 +232,17 @@ def main() -> None:
     n_dev = len(jax.devices())
     mesh = make_mesh({"dp": n_dev})
 
-    cfg = GPT2Config.small()          # 124M, seq 1024
-    batch_per_chip = 8
+    cfg = GPT2Config.tiny() if smoke else GPT2Config.small()  # 124M
+    batch_per_chip = 2 if smoke else 8
     model = GPT2(cfg, mesh=mesh)
     params = model.init_params(jax.random.key(0))
     # bf16 first moment: halves Adam's mu HBM traffic; second moment
     # stays f32 (bf16 variance underflows small squared grads).
-    import jax.numpy as jnp
     opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=jnp.bfloat16)
     state = init_train_state(params, opt, mesh)
     # K optimizer steps per dispatch (lax.scan over a fresh-data
     # stack): same math as K single steps, amortizing per-dispatch
-    # overhead the way a deep async queue would. grad_norm off: the
-    # benchmark recipe (nanoGPT-class) does not clip.
+    # overhead. grad_norm off: the benchmark recipe does not clip.
     k_steps = 20
     step = make_multi_train_step(gpt2_loss_fn(model), opt,
                                  grad_norm=False)
@@ -92,30 +286,203 @@ def main() -> None:
     mfu = 6 * n_params * per_chip / 197e12
 
     print(json.dumps({
-        "metric": "gpt2_tokens_per_sec_per_chip",
+        "metric": HEADLINE,
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(per_chip / A100_GPT2_TOKENS_PER_S, 4),
         "extra": {
-            "n_chips": n_dev,
             "batch_per_chip": batch_per_chip,
             "seq_len": cfg.seq_len,
-            "model": "gpt2-124M",
+            "model": "gpt2-tiny-smoke" if smoke else "gpt2-124M",
             "loss": round(final_loss, 4),
             "step_time_ms": round(dt / n_steps * 1e3, 2),
             "mfu_vs_v5e_peak": round(mfu, 4),
         },
-    }))
+    }), flush=True)
+
+
+def _maybe_cpu_smoke() -> bool:
+    """RAY_TPU_BENCH_CPU=1 pins the child to the virtual CPU backend —
+    a correctness smoke for environments without the chip."""
+    if not os.environ.get("RAY_TPU_BENCH_CPU"):
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+    return True
+
+
+def resnet50_main() -> None:
+    smoke = _maybe_cpu_smoke()
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import ResNet, ResNet50Config
+    from ray_tpu.models.resnet import resnet_loss_fn
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.train import (
+        init_train_state, make_multi_train_step, shard_batch,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"dp": n_dev})
+
+    if smoke:
+        cfg = ResNet50Config.tiny()
+        batch_per_chip, image_size = 4, 32
+    else:
+        cfg = ResNet50Config()        # full ResNet-50, 1000 classes
+        batch_per_chip, image_size = 128, 224
+    model = ResNet(cfg)
+    variables = model.init_variables(jax.random.key(0), image_size)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    state = init_train_state(params, opt, mesh, extra=batch_stats)
+    k_steps = 10
+    step = make_multi_train_step(resnet_loss_fn(model), opt,
+                                 has_extra=True, grad_norm=False)
+
+    bsz = batch_per_chip * n_dev
+    rng = np.random.default_rng(0)
+
+    def fresh_stack():
+        imgs = rng.standard_normal(
+            (k_steps, bsz, image_size, image_size, 3),
+            dtype=np.float32)
+        labels = rng.integers(0, cfg.num_classes,
+                              (k_steps, bsz)).astype(np.int32)
+        return shard_batch({"image": imgs, "label": labels}, mesh,
+                           batch_dim=1)
+
+    for _ in range(2):
+        state, metrics = step(state, fresh_stack())
+    float(metrics["loss"])
+
+    n_calls = 2
+    stacks = [fresh_stack() for _ in range(n_calls)]
+    t0 = time.perf_counter()
+    for b in stacks:
+        state, metrics = step(state, b)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    n_steps = n_calls * k_steps
+    images_per_s = bsz * n_steps / dt
+    per_chip = images_per_s / n_dev
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_s",
+        "value": round(per_chip, 1),
+        "unit": "images/s/chip",
+        "vs_baseline": round(per_chip / A100_RESNET50_IMAGES_PER_S, 4),
+        "extra": {
+            "batch_per_chip": batch_per_chip,
+            "image_size": image_size,
+            "loss": round(final_loss, 4),
+            "step_time_ms": round(dt / n_steps * 1e3, 2),
+        },
+    }), flush=True)
+
+
+def scaling_main() -> None:
+    """dp=1 vs dp=8 at the SAME global batch on 8 virtual CPU devices.
+
+    Total FLOPs and total cores are identical in both runs, so the
+    step-time ratio t(dp=1)/t(dp=8) isolates the cost the sharded
+    program adds (partitioning, gradient psum). ~1.0 means the dp
+    sharding is overhead-free at this scale; this is the stand-in for
+    real 8-chip weak scaling that a single-chip environment allows.
+    """
+    import jax
+
+    # jax.config (not env vars): the ambient sitecustomize registers
+    # the axon PJRT plugin in every interpreter, and with the tunnel
+    # down, backend discovery hangs unless the platform is pinned via
+    # config before first device use (same recipe as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    devs = jax.devices()
+    assert len(devs) >= 8, f"need 8 virtual devices, got {len(devs)}"
+
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.models.gpt2 import gpt2_loss_fn
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.train import (
+        init_train_state, make_train_step, shard_batch,
+    )
+
+    cfg = GPT2Config.tiny()
+    global_batch = 8
+    rng = np.random.default_rng(0)
+
+    def bench_mesh(dp: int) -> float:
+        mesh = make_mesh({"dp": dp})
+        model = GPT2(cfg, mesh=mesh)
+        params = model.init_params(jax.random.key(0))
+        opt = optax.adamw(3e-4)
+        state = init_train_state(params, opt, mesh)
+        step = make_train_step(gpt2_loss_fn(model), opt,
+                               grad_norm=False)
+
+        def batch():
+            toks = rng.integers(
+                0, cfg.vocab_size,
+                (global_batch, cfg.seq_len)).astype(np.int32)
+            return shard_batch(
+                {"tokens": toks, "targets": np.roll(toks, -1, 1)}, mesh)
+
+        for _ in range(3):
+            state, m = step(state, batch())
+        float(m["loss"])
+        n = 10
+        bs = [batch() for _ in range(n)]
+        t0 = time.perf_counter()
+        for b in bs:
+            state, m = step(state, b)
+        float(m["loss"])
+        return (time.perf_counter() - t0) / n
+
+    t1 = bench_mesh(1)
+    t8 = bench_mesh(8)
+    eff = t1 / t8
+    toks = global_batch * cfg.seq_len
+    print(json.dumps({
+        "metric": "dp8_scaling_efficiency_proxy",
+        "value": round(eff, 4),
+        "unit": "t_dp1/t_dp8 at fixed global batch",
+        "vs_baseline": round(eff, 4),
+        "extra": {
+            "dp1_tokens_per_s": round(toks / t1, 1),
+            "dp8_tokens_per_s": round(toks / t8, 1),
+            "global_batch": global_batch,
+            "seq_len": cfg.seq_len,
+            "model": "gpt2-tiny",
+            "n_virtual_devices": 8,
+        },
+    }), flush=True)
+
+
+def main() -> None:
+    arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    child = {"--probe": probe_main, "--gpt2": gpt2_main,
+             "--resnet50": resnet50_main, "--scaling": scaling_main}
+    if arg in child:
+        try:
+            child[arg]()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": arg.lstrip("-"), "value": 0.0,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            }), flush=True)
+            sys.exit(1)
+        return
+    orchestrate()
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except Exception as e:  # noqa: BLE001
-        # Still emit one JSON line so the driver records the failure.
-        print(json.dumps({
-            "metric": "gpt2_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:500],
-        }))
-        sys.exit(1)
+    main()

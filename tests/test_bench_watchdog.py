@@ -1,0 +1,65 @@
+"""bench.py must never hang: a dead/wedged TPU backend yields the
+error JSON line quickly (reference failure mode: the axon tunnel makes
+``jax.devices()`` hang forever rather than raise, which shipped a red
+BENCH_r02 artifact)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+BENCH = REPO / "bench.py"
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_default_probe_budget_under_90s():
+    b = _load_bench()
+    worst = sum(b.PROBE_TIMEOUTS) + b.PROBE_BACKOFF_S * (
+        len(b.PROBE_TIMEOUTS) - 1)
+    # Leave margin for process spawn/kill overhead on top.
+    assert worst <= 85, worst
+
+
+def test_dead_backend_emits_error_json_and_exits_nonzero():
+    env = dict(os.environ)
+    env.update({
+        "RAY_TPU_BENCH_FAKE_HANG": "1",
+        "RAY_TPU_BENCH_PROBE_TIMEOUT": "3",
+        "RAY_TPU_BENCH_PROBE_BACKOFF": "1",
+        "RAY_TPU_BENCH_SKIP_SCALING": "1",
+        "RAY_TPU_BENCH_SKIP_RESNET": "1",
+    })
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, str(BENCH)], capture_output=True, text=True,
+        env=env, timeout=60)
+    dt = time.time() - t0
+    assert out.returncode == 1
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "gpt2_tokens_per_sec_per_chip"
+    assert line["value"] == 0.0
+    assert "error" in line and "hung" in line["error"]
+    assert dt < 45, dt
+
+
+def test_child_crash_reports_json():
+    # A child that raises (not hangs) must still print a JSON line.
+    out = subprocess.run(
+        [sys.executable, str(BENCH), "--probe"], capture_output=True,
+        text=True, timeout=30,
+        env={**os.environ, "RAY_TPU_BENCH_FAKE_FAIL": "1"})
+    assert out.returncode == 1
+    line = json.loads(
+        [l for l in out.stdout.strip().splitlines()  # noqa: E741
+         if l.strip().startswith("{")][-1])
+    assert "error" in line
