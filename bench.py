@@ -73,7 +73,6 @@ def _run_child(flag: str, timeout: float, extra_env: dict | None = None):
         [sys.executable, os.path.abspath(__file__), flag],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         start_new_session=True, env=env, text=True)
-    _LIVE_CHILDREN.add(proc.pid)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -82,9 +81,7 @@ def _run_child(flag: str, timeout: float, extra_env: dict | None = None):
         except (ProcessLookupError, PermissionError):
             pass
         proc.wait()
-        _LIVE_CHILDREN.discard(proc.pid)
         return None, f"timeout after {timeout:.0f}s"
-    _LIVE_CHILDREN.discard(proc.pid)
     for line in reversed(out.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -111,19 +108,8 @@ def _probe() -> tuple[dict | None, str]:
     return None, "; ".join(e for e in errs if e)
 
 
-_LIVE_CHILDREN: set = set()
-
-
 def _emit(value: float, vs_baseline: float, extra: dict,
           error: str | None = None, rc: int = 0) -> None:
-    # Reap any still-running child process groups (e.g. the concurrent
-    # scaling run when the probe fails early) so the driver's wait on
-    # us doesn't inherit orphans.
-    for pid in list(_LIVE_CHILDREN):
-        try:
-            os.killpg(pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
     line = {
         "metric": HEADLINE, "value": value, "unit": "tokens/s/chip",
         "vs_baseline": vs_baseline,
@@ -481,7 +467,18 @@ def main() -> None:
             }), flush=True)
             sys.exit(1)
         return
-    orchestrate()
+    try:
+        orchestrate()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001
+        # The driver contract is ONE JSON line no matter what.
+        print(json.dumps({
+            "metric": HEADLINE, "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": f"orchestrator: {type(e).__name__}: {e}"[:500],
+        }), flush=True)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

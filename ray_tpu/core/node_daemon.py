@@ -90,6 +90,7 @@ class NodeDaemon:
             cap, os.path.join(sock_dir, "spill"),
             self.config.object_spilling_threshold)
         self._local_oids: set[ObjectID] = set()
+        self._local_obj_meta: dict[ObjectID, tuple[int, list]] = {}
         self._store_lock = threading.Lock()
 
         # Chunked transfers served from the local store. The "nd-"
@@ -125,7 +126,14 @@ class NodeDaemon:
         self.reconnect_window_s = 60.0
         self._conn_lock = threading.Lock()
         self._conn_down = False
-        self._outbox: deque = deque(maxlen=10000)
+        # Unbounded-with-accounting outbox: on overflow the OLDEST
+        # message is dropped, but any worker whose RESULT/WEXIT
+        # traffic it carried is recorded so the reconnect path can
+        # kill it and report ND_WEXIT — a silently dropped RESULT_OK
+        # would otherwise hang the driver's get forever (ADVICE r2).
+        self._outbox: deque = deque()
+        self._outbox_cap = 10000
+        self._outbox_dropped: set[int] = set()
         self.node_id = ""
         self.conn = self._dial_and_register()
 
@@ -155,7 +163,9 @@ class NodeDaemon:
             # objects and live workers so the restarted head rebuilds
             # its directory and re-adopts surviving actors.
             with self._store_lock:
-                objects = [o.binary() for o in self._local_oids]
+                objects = [
+                    (o.binary(),) + self._local_obj_meta.get(o, (0, []))
+                    for o in self._local_oids]
             with self._pool_lock:
                 workers = [
                     (widx, bool(getattr(w, "is_actor", False)),
@@ -181,25 +191,58 @@ class NodeDaemon:
             except Exception:  # noqa: BLE001
                 time.sleep(0.5)
                 continue
+            dropped: set[int] = set()
             with self._conn_lock:
                 self.conn = conn
                 self._conn_down = False
                 while self._outbox:
+                    # Peek-send-pop: a send failure mid-drain must not
+                    # silently lose the in-flight message (it may carry
+                    # a RESULT_OK whose loss hangs a driver get).
                     try:
-                        conn.send(self._outbox.popleft())
+                        conn.send(self._outbox[0])
                     except (OSError, BrokenPipeError):
                         self._conn_down = True
                         break
+                    self._outbox.popleft()
+                if not self._conn_down and self._outbox_dropped:
+                    dropped = self._outbox_dropped
+                    self._outbox_dropped = set()
             if not self._conn_down:
+                # Workers whose results were lost to outbox overflow
+                # are in an indeterminate state: kill them so the
+                # ND_WEXIT below is truthful and the head's retry
+                # path re-runs their tasks.
+                for widx in dropped:
+                    with self._pool_lock:
+                        w = self._workers.get(widx)
+                    if w is not None:
+                        try:
+                            w.proc.kill()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    print(f"ray_tpu node daemon: outbox overflow "
+                          f"dropped messages for worker {widx}; "
+                          f"reporting it dead for retry", flush=True)
+                    self.head_send((P.ND_WEXIT, widx, -1))
                 print(f"ray_tpu node daemon: reconnected to head as "
                       f"{self.node_id}", flush=True)
                 return True
         return False
 
+    def _buffer_outbox(self, msg: tuple) -> None:
+        # Caller holds _conn_lock.
+        self._outbox.append(msg)
+        while len(self._outbox) > self._outbox_cap:
+            old = self._outbox.popleft()
+            if old and old[0] in (P.ND_WMSG, P.ND_STORED,
+                                  P.ND_WEXIT):
+                self._outbox_dropped.add(old[1])
+
     def head_send(self, msg: tuple) -> None:
         with self._conn_lock:
             if self._conn_down:
-                self._outbox.append(msg)
+                self._buffer_outbox(msg)
                 return
             try:
                 self.conn.send(msg)
@@ -207,7 +250,7 @@ class NodeDaemon:
                 # Head gone: buffer until the reconnect loop (driven
                 # by serve_forever's recv EOF) re-establishes us.
                 self._conn_down = True
-                self._outbox.append(msg)
+                self._buffer_outbox(msg)
 
     def _head_call(self, op: str, payload, timeout: float = 60.0):
         fid = next(self._upcall_fid)
@@ -343,6 +386,8 @@ class NodeDaemon:
             ev.clear()
             while q:
                 msg = q.popleft()
+                if msg is None:
+                    return   # sentinel from _on_worker_exit
                 try:
                     w.send(msg)
                 except Exception:  # noqa: BLE001
@@ -381,7 +426,7 @@ class NodeDaemon:
                 continue
             obj = _wire_to_serialized(wire)
             refs = wire[2] if len(wire) > 2 and wire[2] else []
-            self._store_local(oid, obj)
+            self._store_local(oid, obj, refs=refs)
             entries.append(("stored", oid.binary(), size, refs))
         return entries
 
@@ -393,8 +438,13 @@ class NodeDaemon:
             return
         with self._pool_lock:
             self._workers.pop(widx, None)
-            self._send_queues.pop(widx, None)
-            self._send_events.pop(widx, None)
+            q = self._send_queues.pop(widx, None)
+            ev = self._send_events.pop(widx, None)
+        if q is not None and ev is not None:
+            # Wake the ordered sender so it exits instead of polling
+            # its event at 1 Hz for the daemon's lifetime.
+            q.append(None)
+            ev.set()
         rc = w.proc.returncode
         try:
             self.head_send((P.ND_WEXIT, widx, rc))
@@ -410,13 +460,21 @@ class NodeDaemon:
     # local object plane
     # ------------------------------------------------------------------
 
-    def _store_local(self, oid: ObjectID, obj: SerializedObject) -> None:
+    def _store_local(self, oid: ObjectID, obj: SerializedObject,
+                     refs=None) -> None:
         if obj.total_size >= self.config.max_direct_call_object_size:
             self.shm_store.put(oid, obj)
         else:
             self.memory_store.put(oid, obj)
         with self._store_lock:
             self._local_oids.add(oid)
+            # (size, contained refs) survive a head restart: the
+            # re-registration report rebuilds directory entries AND
+            # the container pins of refs nested inside stored values
+            # (ADVICE r2: size-0/refs-[] entries let inner objects be
+            # reclaimed while reachable through the container).
+            self._local_obj_meta[oid] = (obj.total_size,
+                                         list(refs or ()))
 
     def _read_local(self, oid: ObjectID) -> SerializedObject | None:
         obj = self.memory_store.try_get(oid)
@@ -458,6 +516,7 @@ class NodeDaemon:
                 self.shm_store.delete(oid)
                 with self._store_lock:
                     self._local_oids.discard(oid)
+                    self._local_obj_meta.pop(oid, None)
                 result = None
             else:
                 raise ValueError(f"unknown node call {op!r}")
@@ -569,6 +628,11 @@ class NodeDaemon:
             while True:
                 req_id, op, payload = conn.recv()
                 if op == P.OP_PUT:
+                    # Served from the node-local store: strip the
+                    # dedupe envelope (it protects the client↔head
+                    # leg; the worker↔daemon leg is same-host and
+                    # dies only with the daemon, store included).
+                    _dd, payload = P.unwrap_dd(payload)
                     threading.Thread(
                         target=handle_local,
                         args=(req_id, op, payload),
@@ -615,7 +679,7 @@ class NodeDaemon:
             refs = payload[2] if len(payload) > 2 and payload[2] else []
             oid_bytes = self._head_call(
                 "put_loc", (obj.total_size, refs))
-            self._store_local(ObjectID(oid_bytes), obj)
+            self._store_local(ObjectID(oid_bytes), obj, refs=refs)
             return oid_bytes
         if op == P.OP_GET:
             oid_bytes, _timeout, *rest = payload

@@ -92,3 +92,24 @@ ND_CALL = "nd_call"           # (ND_CALL, fid, op, payload); fid -1 = no
                               #   free(oid)
 ND_UPREPLY = "nd_upreply"     # (ND_UPREPLY, fid, status, payload)
 ND_SHUTDOWN = "nd_shutdown"   # (ND_SHUTDOWN,)
+
+
+# --- mutating-op dedupe -----------------------------------------------------
+# A client replaying a mutating op after a transport drop attaches a
+# client-unique id; the head caches replies keyed by it and drops the
+# repeat instead of double-executing (reference behavior: client
+# retries deduped by request identity). Wire shape: the payload slot
+# carries ("__dd__", dd_id, real_payload).
+DD_TAG = "__dd__"
+
+
+def wrap_dd(dd_id, payload):
+    return (DD_TAG, dd_id, payload) if dd_id else payload
+
+
+def unwrap_dd(payload):
+    """-> (dd_id | None, real_payload)."""
+    if (isinstance(payload, tuple) and len(payload) == 3
+            and payload[0] == DD_TAG):
+        return payload[1], payload[2]
+    return None, payload

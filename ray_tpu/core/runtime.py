@@ -686,6 +686,12 @@ class DriverRuntime:
         self._pending_workers: dict[str, WorkerHandle] = {}
         self._pending_workers_lock = threading.Lock()
         self._client_threads: list[threading.Thread] = []
+        # Reply cache for client-replayed mutating ops (see
+        # protocol.wrap_dd): dd_id -> (status, payload), plus in-flight
+        # events so a replay racing the original coalesces onto it.
+        self._dd_lock = threading.Lock()
+        self._dd_results: "OrderedDict[str, tuple]" = OrderedDict()
+        self._dd_inflight: dict[str, threading.Event] = {}
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="client_accept")
         self._accept_thread.start()
@@ -1807,12 +1813,14 @@ class DriverRuntime:
         head restart: rebuild its head-side handle without spawning,
         and re-bind a RESTARTING actor record to its surviving
         incarnation (state preserved)."""
-        # Keep future worker indexes clear of adopted ones.
-        current = next(WorkerHandle._counter)
-        if widx >= current:
-            WorkerHandle._counter = itertools.count(widx + 1)
-        else:
-            WorkerHandle._counter = itertools.count(current)
+        # Keep future worker indexes clear of adopted ones. Two
+        # daemons re-registering concurrently race on the read-then-
+        # replace, so the bump runs under the pool lock (duplicate
+        # indexes would cross-wire _remote_workers entries).
+        with self._pool_lock:
+            current = next(WorkerHandle._counter)
+            WorkerHandle._counter = itertools.count(
+                max(widx + 1, current))
         w = RemoteWorkerHandle.__new__(RemoteWorkerHandle)
         w.index = widx
         w.env_key = env_key or "adopted"
@@ -2879,11 +2887,19 @@ class DriverRuntime:
                 pass
 
         def handle(req_id, op, payload):
+            dd, payload = P.unwrap_dd(payload)
+            if dd is not None:
+                cached = self._dd_begin(dd)
+                if cached is not None:
+                    reply(req_id, *cached)
+                    return
             try:
-                result = self._handle_client_op(op, payload)
-                reply(req_id, P.ST_OK, result)
+                out = (P.ST_OK, self._handle_client_op(op, payload))
             except BaseException as e:  # noqa: BLE001
-                reply(req_id, P.ST_ERR, ser.dumps(e))
+                out = (P.ST_ERR, ser.dumps(e))
+            if dd is not None:
+                self._dd_finish(dd, out)
+            reply(req_id, *out)
 
         # Live borrows owed by THIS connection: when the peer dies
         # (crash, SIGTERM, OOM kill) its release finalizers never run,
@@ -2972,8 +2988,13 @@ class DriverRuntime:
             # directory entries for objects the daemon still stores
             # and re-adopt its surviving workers/actors (raylet
             # resync after NotifyGCSRestart, node_manager.proto:383).
-            for oid_bytes in info.get("objects", []):
-                self._store_remote(ObjectID(oid_bytes), node_id, 0, [])
+            for ent in info.get("objects", []):
+                if isinstance(ent, tuple):
+                    oid_bytes, size, refs = ent
+                else:      # legacy bare-oid report
+                    oid_bytes, size, refs = ent, 0, []
+                self._store_remote(ObjectID(oid_bytes), node_id,
+                                   size, refs)
             for went in info.get("workers", []):
                 widx, is_actor, actor_id_bytes, env_key = went
                 try:
@@ -3162,6 +3183,35 @@ class DriverRuntime:
             self._obj_cv.notify_all()
         with self._res_cv:
             self._res_cv.notify_all()
+
+    def _dd_begin(self, dd: str):
+        """Returns the cached reply for a replayed mutating op, or
+        None if this caller should execute it. A replay arriving while
+        the original is still executing waits for its result instead
+        of re-executing."""
+        while True:
+            with self._dd_lock:
+                hit = self._dd_results.get(dd)
+                if hit is not None:
+                    return hit
+                ev = self._dd_inflight.get(dd)
+                if ev is None:
+                    self._dd_inflight[dd] = threading.Event()
+                    return None
+            if not ev.wait(30.0):
+                # Original wedged — execute rather than hang the
+                # client forever (worst case we double-execute, which
+                # is the pre-dedupe behavior).
+                return None
+
+    def _dd_finish(self, dd: str, out: tuple) -> None:
+        with self._dd_lock:
+            self._dd_results[dd] = out
+            while len(self._dd_results) > 8192:
+                self._dd_results.popitem(last=False)
+            ev = self._dd_inflight.pop(dd, None)
+        if ev is not None:
+            ev.set()
 
     def _handle_client_op(self, op: str, payload):
         if op == P.OP_SUBMIT:

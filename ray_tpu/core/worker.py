@@ -56,6 +56,12 @@ class ClientRuntime:
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._pending_lock = threading.Lock()
         self._req_counter = itertools.count()
+        # Dedupe identity for mutating ops: a reconnect replay re-sends
+        # the SAME dd id, so the head can drop the repeat if the first
+        # send actually landed (ADVICE r2: replaying OP_SUBMIT /
+        # actor-create / put after a transient reset double-executes).
+        self._dd_prefix = os.urandom(8).hex()
+        self._dd_counter = itertools.count()
         self._recv_thread = threading.Thread(
             target=self._recv_loop, daemon=True, name="client_recv")
         self._recv_thread.start()
@@ -158,12 +164,29 @@ class ClientRuntime:
                     # _try_reconnect.
                     continue
 
+    # Ops whose replay after a reconnect would double-execute work:
+    # they get a dedupe id the head caches replies under. Read-only
+    # ops (get/wait/state/resources/...) replay safely without one.
+    _MUTATING_OPS = frozenset({
+        P.OP_SUBMIT, P.OP_PUT, P.OP_CREATE_ACTOR, P.OP_SUBMIT_ACTOR,
+        P.OP_PG_CREATE, P.OP_STREAM_NEXT,
+    })
+    _MUTATING_KV_ACTIONS = frozenset({"put", "put_if_absent", "del"})
+
+    def _needs_dd(self, op: str, payload) -> bool:
+        if op in self._MUTATING_OPS:
+            return True
+        return (op == P.OP_KV and isinstance(payload, tuple)
+                and payload and payload[0] in self._MUTATING_KV_ACTIONS)
+
     def _call(self, op: str, payload, timeout: float | None = None,
-              _retried: bool = False):
+              _retried: bool = False, _dd: str | None = None):
         if self._conn_dead:
             if _retried or not self._try_reconnect():
                 raise ConnectionError(
                     f"head connection lost (op {op})")
+        if _dd is None and self._needs_dd(op, payload):
+            _dd = f"{self._dd_prefix}:{next(self._dd_counter)}"
         req_id = next(self._req_counter)
         event = threading.Event()
         slot: list = []
@@ -171,12 +194,13 @@ class ClientRuntime:
             self._pending[req_id] = (event, slot)
         try:
             with self._send_lock:
-                self._conn.send((req_id, op, payload))
+                self._conn.send((req_id, op, P.wrap_dd(_dd, payload)))
         except (OSError, BrokenPipeError) as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
             if not _retried and self._try_reconnect():
-                return self._call(op, payload, timeout, _retried=True)
+                return self._call(op, payload, timeout, _retried=True,
+                                  _dd=_dd)
             raise ConnectionError(
                 f"head connection lost during {op}") from e
         if not event.wait(timeout):
@@ -189,8 +213,11 @@ class ClientRuntime:
             if isinstance(err, ConnectionError) and not _retried \
                     and self._try_reconnect():
                 # The in-flight request died with the old head; replay
-                # it against the restarted one.
-                return self._call(op, payload, timeout, _retried=True)
+                # it (same dd id: if the old head already executed it
+                # and the cluster state survived, the repeat is
+                # dropped server-side).
+                return self._call(op, payload, timeout, _retried=True,
+                                  _dd=_dd)
             raise err
         return result
 

@@ -1,0 +1,92 @@
+"""Mutating-op replay dedupe (reference behavior: client RPC retries
+are deduped by request identity so a reconnect replay cannot
+double-execute a submit/put/actor-create — ADVICE r2 on
+ClientRuntime._call's transparent replay)."""
+
+import threading
+
+import ray_tpu
+from ray_tpu.core import protocol as P
+from ray_tpu.core.api import get_runtime
+from ray_tpu.core.worker import ClientRuntime
+
+
+def test_put_replay_same_dd_returns_same_object(rt):
+    runtime = get_runtime()
+    client = ClientRuntime(runtime.client_address)
+    try:
+        from ray_tpu.core import serialization as ser
+        obj = ser.serialize({"v": 42})
+        wire = ser.to_wire(obj)
+        dd = "test-dd:1"
+        oid1 = client._call(P.OP_PUT, wire, _dd=dd)
+        oid2 = client._call(P.OP_PUT, wire, _dd=dd)   # replay
+        assert oid1 == oid2, "replay minted a second object"
+        # A distinct dd is a distinct logical op.
+        oid3 = client._call(P.OP_PUT, wire, _dd="test-dd:2")
+        assert oid3 != oid1
+    finally:
+        client.shutdown()
+
+
+def test_submit_replay_runs_task_once(rt):
+    runtime = get_runtime()
+
+    @ray_tpu.remote
+    def bump(x):
+        return x + 1
+
+    # Submit through a raw client with a fixed dd, twice: one task.
+    client = ClientRuntime(runtime.client_address)
+    try:
+        from ray_tpu.core import serialization as ser
+        from ray_tpu.core.remote_function import make_task_options
+        fn_id, fn_blob = runtime.register_function(bump._fn)
+        payload = (fn_id, fn_blob, "bump",
+                   ser.dumps(((7,), {})),
+                   ser.dumps(make_task_options()))
+        dd = "test-submit:1"
+        refs1 = client._call(P.OP_SUBMIT, payload, _dd=dd)
+        refs2 = client._call(P.OP_SUBMIT, payload, _dd=dd)
+        assert refs1 == refs2, "replay submitted a second task"
+        from ray_tpu.core.ids import ObjectID
+        out = ser.deserialize(client.get_serialized(ObjectID(refs1[0])))
+        assert out == 8
+    finally:
+        client.shutdown()
+
+
+def test_concurrent_duplicate_coalesces(rt):
+    runtime = get_runtime()
+    results = []
+    dd = "test-race:1"
+
+    from ray_tpu.core import serialization as ser
+    wire = ser.to_wire(ser.serialize("payload"))
+
+    def do_put():
+        c = ClientRuntime(runtime.client_address)
+        try:
+            results.append(c._call(P.OP_PUT, wire, _dd=dd))
+        finally:
+            c.shutdown()
+
+    ts = [threading.Thread(target=do_put) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(set(results)) == 1, results
+
+
+def test_read_only_ops_carry_no_dd(rt):
+    client = ClientRuntime(get_runtime().client_address)
+    try:
+        assert not client._needs_dd(P.OP_GET, (b"x", None, True))
+        assert not client._needs_dd(P.OP_WAIT, ([], 1, None))
+        assert not client._needs_dd(
+            P.OP_KV, ("get", b"k", None, b"ns"))
+        assert client._needs_dd(P.OP_KV, ("put", b"k", b"v", b"ns"))
+        assert client._needs_dd(P.OP_SUBMIT, ())
+    finally:
+        client.shutdown()
