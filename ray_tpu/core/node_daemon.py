@@ -99,6 +99,26 @@ class NodeDaemon:
         self.transfer_plane = TransferPlane(
             self.config.object_transfer_chunk_bytes, prefix="nd-")
 
+        # Direct daemon↔daemon object plane (reference: peer-to-peer
+        # ObjectManager chunk pulls, object_manager.h:117,
+        # pull_manager.h:52): a token-authenticated TCP listener
+        # serving fetch/chunk/end from the local store. With it, the
+        # head is directory-only for cross-node transfers — its NIC
+        # never carries other nodes' object bytes.
+        self._object_listener = mpc.Listener(
+            ("0.0.0.0", 0), family="AF_INET", authkey=token)
+        self.object_addr = (self._routable_ip(),
+                            self._object_listener.address[1])
+        self._peer_pools: dict[tuple, list] = {}
+        self._peer_lock = threading.Lock()
+        # One in-flight p2p pull per oid: concurrent consumers of the
+        # same remote object coalesce onto a single transfer, then
+        # read the cached local copy.
+        self._pull_inflight: dict[ObjectID, threading.Event] = {}
+        self._pull_lock = threading.Lock()
+        threading.Thread(target=self._object_accept_loop, daemon=True,
+                         name="nd_obj_accept").start()
+
         # Worker pool.
         self._workers: dict[int, WorkerHandle] = {}
         self._widx_of: dict[WorkerHandle, int] = {}
@@ -157,6 +177,7 @@ class NodeDaemon:
             "labels": self.labels,
             "pid": os.getpid(),
             "hostname": socket.gethostname(),
+            "object_addr": self.object_addr,
         }
         if self.node_id:
             # Re-registration: revive our identity, re-report held
@@ -534,6 +555,257 @@ class NodeDaemon:
         return self.transfer_plane.start(obj)
 
     # ------------------------------------------------------------------
+    # direct daemon<->daemon object plane
+    # ------------------------------------------------------------------
+
+    def _routable_ip(self) -> str:
+        """The local interface address a peer daemon can dial — probed
+        by routing toward the head (no packets sent)."""
+        import socket as _socket
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        try:
+            s.connect((self.head_addr[0], self.head_addr[1] or 1))
+            return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+        finally:
+            s.close()
+
+    def _object_accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._object_listener.accept()
+            except Exception:  # noqa: BLE001
+                if self._shutdown:
+                    return
+                continue
+            threading.Thread(target=self._object_serve_conn,
+                             args=(conn,), daemon=True).start()
+
+    def _object_serve_conn(self, conn) -> None:
+        """Serve one peer's pulls: ("fetch", oid_bytes) |
+        ("chunk", tid, i) | ("end", tid); replies (status, payload)."""
+        try:
+            while not self._shutdown:
+                msg = conn.recv()
+                try:
+                    op = msg[0]
+                    if op == "fetch":
+                        oid = ObjectID(msg[1])
+                        obj = self._read_local(oid)
+                        if obj is None:
+                            from ray_tpu.core.exceptions import (
+                                ObjectLostError,
+                            )
+                            raise ObjectLostError(oid.hex())
+                        if (obj.total_size
+                                <= self.config.object_transfer_inline_max):
+                            data, bufs = _sendable(obj)
+                            out = ("inline", data, bufs)
+                        else:
+                            out = self.transfer_plane.start(obj)
+                    elif op == "chunk":
+                        out = self.transfer_plane.chunk(msg[1], msg[2])
+                    elif op == "end":
+                        self.transfer_plane.end(msg[1])
+                        out = None
+                    else:
+                        raise ValueError(f"unknown object op {op!r}")
+                    conn.send((P.ST_OK, out))
+                except BaseException as e:  # noqa: BLE001
+                    conn.send((P.ST_ERR, ser.dumps(e)))
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _peer_acquire(self, addr: tuple):
+        with self._peer_lock:
+            pool = self._peer_pools.get(addr)
+            if pool:
+                return pool.pop()
+        return mpc.Client(tuple(addr), family="AF_INET",
+                          authkey=self.token)
+
+    def _peer_release(self, addr: tuple, conn, ok: bool) -> None:
+        if not ok:
+            # The peer at this address is suspect (died/restarted —
+            # a restart advertises a new port): drop its whole pool
+            # so dead sockets don't accumulate across node churn.
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._peer_lock:
+                stale = self._peer_pools.pop(addr, [])
+            for c in stale:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            return
+        with self._peer_lock:
+            pool = self._peer_pools.setdefault(addr, [])
+            if len(pool) < 4:
+                pool.append(conn)
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _peer_call(self, conn, msg: tuple, deadline: float | None):
+        conn.send(msg)
+        if deadline is not None:
+            left = deadline - time.monotonic()
+            if left <= 0 or not conn.poll(left):
+                from ray_tpu.core.exceptions import GetTimeoutError
+                raise GetTimeoutError("peer pull timed out")
+        status, payload = conn.recv()
+        if status == P.ST_ERR:
+            raise ser.loads(payload)
+        return payload
+
+    def _pull_from_peer(self, addr: tuple, oid: ObjectID,
+                        deadline: float | None) -> SerializedObject:
+        conn = self._peer_acquire(addr)
+        ok = False
+        try:
+            meta = self._peer_call(conn, ("fetch", oid.binary()),
+                                   deadline)
+            if meta[0] == "inline":
+                obj = SerializedObject(data=meta[1],
+                                       buffers=list(meta[2]))
+                ok = True
+                return obj
+            obj = ser.reassemble_chunked(
+                meta,
+                lambda tid, i: self._peer_call(
+                    conn, ("chunk", tid, i), deadline),
+                lambda tid: self._peer_call(conn, ("end", tid),
+                                            deadline))
+            ok = True
+            return obj
+        finally:
+            self._peer_release(addr, conn, ok)
+
+    def _p2p_get(self, req_id: int, payload, forward_up,
+                 down_send) -> None:
+        """Serve a worker's get of a non-local object by pulling
+        straight from the peer daemon that stores it (head = directory
+        only). Falls back to the head-relay path on any failure —
+        including the holder dying mid-pull, where the head then
+        drives lineage reconstruction."""
+        oid_b, timeout, *_rest = payload
+        oid = ObjectID(oid_b)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        try:
+            while True:
+                # Coalesce with any in-flight pull of the same oid,
+                # then serve the cached local copy.
+                with self._pull_lock:
+                    ev = self._pull_inflight.get(oid)
+                    if ev is None and not self._has_local(oid):
+                        ev = threading.Event()
+                        self._pull_inflight[oid] = ev
+                        i_pull = True
+                    else:
+                        i_pull = False
+                if not i_pull:
+                    if ev is not None:
+                        ev.wait(60.0)
+                    if self._has_local(oid):
+                        obj = self._read_local(oid)
+                        if obj is not None:
+                            self._reply_obj(req_id, obj, down_send)
+                            return
+                    if ev is None:
+                        # Marked local but unreadable (eviction race):
+                        # let the head serve it.
+                        break
+                    # The puller failed; fall through to try being
+                    # the puller ourselves (or time out).
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    from ray_tpu.core.exceptions import GetTimeoutError
+                    raise GetTimeoutError(oid.hex())
+                if not i_pull:
+                    continue
+                try:
+                    served = self._pull_once(req_id, oid, deadline,
+                                             down_send)
+                finally:
+                    with self._pull_lock:
+                        self._pull_inflight.pop(oid, None)
+                    ev.set()
+                if served == "served":
+                    return
+                if served == "fallback":
+                    break
+                # "pending": keep waiting for a location.
+        except Exception:  # noqa: BLE001
+            pass
+        # Fallback: let the head serve it (it may reconstruct a lost
+        # object through lineage first).
+        left = (None if deadline is None
+                else max(deadline - time.monotonic(), 0.0))
+        try:
+            forward_up((req_id, P.OP_GET, (oid_b, left, False)))
+        except (OSError, BrokenPipeError) as e:
+            down_send((req_id, P.ST_ERR, ser.dumps(e)))
+
+    def _reply_obj(self, req_id: int, obj: SerializedObject,
+                   down_send) -> None:
+        if obj.total_size <= self.config.object_transfer_inline_max:
+            data, bufs = _sendable(obj)
+            down_send((req_id, P.ST_OK, ("inline", data, bufs)))
+        else:
+            down_send((req_id, P.ST_OK,
+                       self.transfer_plane.start(obj)))
+
+    def _pull_once(self, req_id: int, oid: ObjectID,
+                   deadline: float | None, down_send) -> str:
+        """One locate+pull attempt. Returns "served" (replied),
+        "pending" (no location yet — caller loops), or "fallback"
+        (let the head relay path serve it)."""
+        left = (None if deadline is None
+                else deadline - time.monotonic())
+        loc = self._head_call(
+            "locate",
+            (oid.binary(), 25.0 if left is None else min(left, 25.0)),
+            timeout=40.0)
+        if loc[0] == "pending":
+            return "pending"
+        if not (loc[0] == "node" and loc[1] != self.node_id
+                and loc[2]):
+            return "fallback"
+        obj = self._pull_from_peer(tuple(loc[2]), oid, deadline)
+        # Cache node-locally (plasma caches pulled copies the same
+        # way) so sibling consumers hit the _has_local fast path; the
+        # head tracks the replica for free/promotion. A "stale"
+        # verdict means we raced the delete — drop the copy.
+        if obj.total_size >= self.config.max_direct_call_object_size:
+            self._store_local(oid, obj)
+            try:
+                verdict = self._head_call("cache_loc", oid.binary(),
+                                          timeout=10.0)
+            except Exception:  # noqa: BLE001
+                verdict = None
+            if verdict != "ok":
+                self.memory_store.delete(oid)
+                self.shm_store.delete(oid)
+                with self._store_lock:
+                    self._local_oids.discard(oid)
+                    self._local_obj_meta.pop(oid, None)
+        self._reply_obj(req_id, obj, down_send)
+        return "served"
+
+    # ------------------------------------------------------------------
     # local worker connections (exec attach + client splice)
     # ------------------------------------------------------------------
 
@@ -645,12 +917,16 @@ class NodeDaemon:
                             args=(req_id, op, payload),
                             daemon=True).start()
                     else:
-                        # The head must never hand a same-host arena
-                        # descriptor to a (conceptually) remote
-                        # worker: force the inline/chunked path.
-                        oid_b, timeout, *_rest = payload
-                        forward_up((req_id, op,
-                                    (oid_b, timeout, False)))
+                        # Pull peer-to-peer where possible; the
+                        # fallback forwards to the head with
+                        # allow_desc forced off (the head must never
+                        # hand a same-host arena descriptor to a
+                        # conceptually remote worker).
+                        threading.Thread(
+                            target=self._p2p_get,
+                            args=(req_id, payload, forward_up,
+                                  down_send),
+                            daemon=True).start()
                 elif op == P.OP_PULL and isinstance(payload, tuple) \
                         and len(payload) >= 2 \
                         and isinstance(payload[1], str) \
@@ -711,6 +987,10 @@ class NodeDaemon:
         if self._shutdown:
             return
         self._shutdown = True
+        try:
+            self._object_listener.close()
+        except Exception:  # noqa: BLE001
+            pass
         if self.log_monitor is not None:
             try:
                 self.log_monitor.poll_once()

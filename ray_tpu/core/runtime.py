@@ -129,6 +129,10 @@ class NodeRecord:
     send_lock: Any = None
     pid: int = 0
     hostname: str = ""
+    # (host, port) of the daemon's direct object-plane listener, so
+    # peers pull chunks from each other instead of relaying through
+    # the head (reference: ObjectManager p2p, object_manager.h:117).
+    object_addr: Any = None
 
     @property
     def is_daemon(self) -> bool:
@@ -662,6 +666,10 @@ class DriverRuntime:
         # the puller ends.
         self.transfer_plane = TransferPlane(
             config.object_transfer_chunk_bytes)
+        # Chunks the head pulled from a node on behalf of some other
+        # consumer — the relay traffic the p2p object plane exists to
+        # eliminate (asserted zero in tests/test_p2p_transfer.py).
+        self._relay_chunks = 0
 
         # Events / timeline
         self._events: deque = deque(maxlen=config.task_event_buffer_size)
@@ -709,6 +717,11 @@ class DriverRuntime:
         # Objects homed in a daemon's local store (location =
         # ("node", node_id)): per-node index for death handling.
         self._node_objects: dict[str, set[ObjectID]] = {}
+        # Secondary copies made by p2p pulls (plasma caches pulled
+        # objects the same way): oid -> nodes holding a replica.
+        # Freed together with the primary; promoted to primary when
+        # the home node dies (saving a lineage reconstruction).
+        self._obj_replicas: dict[ObjectID, set[str]] = {}
 
         if not local_mode:
             self._dispatch_thread = threading.Thread(
@@ -777,10 +790,15 @@ class DriverRuntime:
         self._lineage_release_return(oid)
         with self._obj_cv:
             loc = self._obj_locations.pop(oid, None)
+        with self._obj_cv:
+            replica_nodes = self._obj_replicas.pop(oid, set())
         if isinstance(loc, tuple):
-            # Node-homed: tell the daemon to drop its copy.
-            node = self._nodes.get(loc[1])
             self._node_objects.get(loc[1], set()).discard(oid)
+            replica_nodes.add(loc[1])
+        # Node-homed copies (primary + p2p replicas): tell each daemon
+        # to drop its copy.
+        for nid in replica_nodes:
+            node = self._nodes.get(nid)
             if node is not None and node.alive and node.is_daemon:
                 try:
                     node.node_send((P.ND_CALL, -1, "free",
@@ -1650,9 +1668,30 @@ class DriverRuntime:
         # raylets evict a dead node's objects; recovery is lineage
         # reconstruction's job).
         lost = self._node_objects.pop(node_id, set())
+        with self._obj_cv:
+            for reps in self._obj_replicas.values():
+                reps.discard(node_id)
         for oid in lost:
             with self._obj_cv:
                 if self._obj_locations.get(oid) != ("node", node_id):
+                    continue
+                # A live p2p replica makes reconstruction unnecessary:
+                # promote it to primary (reference: the object
+                # directory simply points at the surviving copy).
+                promoted = None
+                for nid in self._obj_replicas.get(oid, set()):
+                    n = self._nodes.get(nid)
+                    if n is not None and n.alive:
+                        promoted = nid
+                        break
+                if promoted is not None:
+                    self._obj_replicas[oid].discard(promoted)
+                    if not self._obj_replicas[oid]:
+                        self._obj_replicas.pop(oid, None)
+                    self._obj_locations[oid] = ("node", promoted)
+                    self._node_objects.setdefault(
+                        promoted, set()).add(oid)
+                    self._obj_cv.notify_all()
                     continue
             self._on_object_lost(oid, node_id)
         # Re-home placement-group bundles that lived on the dead node.
@@ -2978,6 +3017,7 @@ class DriverRuntime:
             node.send_lock = threading.Lock()
             node.pid = int(info.get("pid", 0))
             node.hostname = str(info.get("hostname", ""))
+            node.object_addr = info.get("object_addr")
             self._res_cv.notify_all()
         try:
             # The registration ack MUST be the first message on the
@@ -3061,7 +3101,48 @@ class DriverRuntime:
     def _handle_node_upcall(self, node: NodeRecord, fid: int, op: str,
                             payload) -> None:
         try:
-            if op == "put_loc":
+            if op == "locate":
+                # Directory lookup for a daemon's p2p pull: where does
+                # this object live right now? ("node", id, obj_addr)
+                # lets the asker pull straight from the holder;
+                # ("head",) means the head itself serves it;
+                # ("pending",) tells the asker to re-poll (bounded
+                # wait keeps the upcall thread from parking forever).
+                oid_bytes, timeout = payload
+                deadline = (None if timeout is None
+                            else time.monotonic() + timeout)
+                try:
+                    loc = self._wait_location(ObjectID(oid_bytes),
+                                              deadline)
+                except GetTimeoutError:
+                    result = ("pending",)
+                else:
+                    if isinstance(loc, tuple):
+                        holder = self._nodes.get(loc[1])
+                        if (holder is not None and holder.alive
+                                and holder.object_addr):
+                            result = ("node", loc[1],
+                                      tuple(holder.object_addr))
+                        else:
+                            result = ("head",)
+                    else:
+                        result = ("head",)
+            elif op == "cache_loc":
+                # A daemon cached a p2p-pulled copy. Record the
+                # replica — unless the object is already gone, in
+                # which case the daemon must drop the copy (it raced
+                # the delete).
+                oid = ObjectID(payload)
+                with self._obj_cv:
+                    loc = self._obj_locations.get(oid)
+                    if (isinstance(loc, tuple)
+                            and loc[1] != node.node_id):
+                        self._obj_replicas.setdefault(
+                            oid, set()).add(node.node_id)
+                        result = "ok"
+                    else:
+                        result = "stale"
+            elif op == "put_loc":
                 # A worker on this node put an object into the node's
                 # local store: assign the id centrally and record the
                 # location (directory entry). The remote holder pins it
@@ -3143,28 +3224,16 @@ class DriverRuntime:
         if meta[0] == "inline":
             return SerializedObject(data=meta[1],
                                     buffers=list(meta[2]))
-        _, tid, data_len, buf_lens, chunk = meta
-        total = data_len + sum(buf_lens)
-        nchunks = -(-total // chunk) if total else 0
-        buf = bytearray(total)
-        try:
-            for i in range(nchunks):
-                piece = self._node_call(node, "chunk", (tid, i),
-                                        remaining())
-                buf[i * chunk:i * chunk + len(piece)] = piece
-        finally:
-            try:
-                node.node_send((P.ND_CALL, -1, "end", tid))
-            except (OSError, BrokenPipeError):
-                pass
-        mv = memoryview(buf)
-        buffers = []
-        pos = data_len
-        for ln in buf_lens:
-            buffers.append(mv[pos:pos + ln])
-            pos += ln
-        return SerializedObject(data=bytes(mv[:data_len]),
-                                buffers=buffers)
+
+        def fetch_chunk(tid, i):
+            piece = self._node_call(node, "chunk", (tid, i),
+                                    remaining())
+            self._relay_chunks += 1
+            return piece
+
+        return ser.reassemble_chunked(
+            meta, fetch_chunk,
+            lambda tid: node.node_send((P.ND_CALL, -1, "end", tid)))
 
     def _store_remote(self, oid: ObjectID, node_id: str, size: int,
                       refs) -> None:
@@ -3172,6 +3241,17 @@ class DriverRuntime:
         local store (reference: ownership_based_object_directory.cc).
         refs: [(ref_id_bytes, nonce)] nested inside the stored value —
         container-pinned exactly like locally stored objects."""
+        with self._obj_cv:
+            existing = self._obj_locations.get(oid)
+            if (isinstance(existing, tuple) and existing[1] != node_id
+                    and self._nodes.get(existing[1]) is not None
+                    and self._nodes[existing[1]].alive):
+                # Another live node already homes this object (e.g.
+                # both the primary and a p2p-replica holder re-report
+                # after a head restart): record a replica, don't
+                # re-pin or flip the primary.
+                self._obj_replicas.setdefault(oid, set()).add(node_id)
+                return
         if refs:
             shim = SerializedObject(
                 data=b"", buffers=[],

@@ -120,3 +120,32 @@ def dumps(value) -> bytes:
 
 def loads(data: bytes):
     return pickle.loads(data)
+
+
+def reassemble_chunked(meta: tuple, fetch_chunk, end) -> SerializedObject:
+    """Rebuild one object from a chunked-transfer announcement
+    (("chunked", tid, data_len, buf_lens, chunk)) by calling
+    ``fetch_chunk(tid, index) -> bytes`` for each chunk and
+    ``end(tid)`` when done (always, also on error). Shared by every
+    puller — head<-node, daemon<-daemon, client<-head — so the
+    reassembly logic exists exactly once."""
+    _, tid, data_len, buf_lens, chunk = meta
+    total = data_len + sum(buf_lens)
+    nchunks = -(-total // chunk) if total else 0
+    buf = bytearray(total)
+    try:
+        for i in range(nchunks):
+            piece = fetch_chunk(tid, i)
+            buf[i * chunk:i * chunk + len(piece)] = piece
+    finally:
+        try:
+            end(tid)
+        except Exception:  # noqa: BLE001
+            pass
+    mv = memoryview(buf)
+    buffers = []
+    pos = data_len
+    for ln in buf_lens:
+        buffers.append(mv[pos:pos + ln])
+        pos += ln
+    return SerializedObject(data=bytes(mv[:data_len]), buffers=buffers)

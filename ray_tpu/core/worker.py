@@ -240,27 +240,10 @@ class ClientRuntime:
         """Pull one object through the chunked transfer plane
         (ObjectManager analog): fixed-size chunks as separate
         req/resp rounds, so concurrent client ops interleave."""
-        _, tid, data_len, buf_lens, chunk = meta
-        total = data_len + sum(buf_lens)
-        nchunks = -(-total // chunk) if total else 0
-        buf = bytearray(total)
-        try:
-            for i in range(nchunks):
-                piece = self._call(P.OP_PULL, ("chunk", tid, i))
-                buf[i * chunk:i * chunk + len(piece)] = piece
-        finally:
-            try:
-                self._call(P.OP_PULL, ("end", tid))
-            except Exception:  # noqa: BLE001
-                pass
-        mv = memoryview(buf)
-        buffers = []
-        pos = data_len
-        for ln in buf_lens:
-            buffers.append(mv[pos:pos + ln])
-            pos += ln
-        return SerializedObject(data=bytes(mv[:data_len]),
-                                buffers=buffers)
+        return ser.reassemble_chunked(
+            meta,
+            lambda tid, i: self._call(P.OP_PULL, ("chunk", tid, i)),
+            lambda tid: self._call(P.OP_PULL, ("end", tid)))
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
