@@ -1,10 +1,14 @@
-"""Host-plane collective groups over a rendezvous actor.
+"""Host-plane collective groups: ring algorithms over a direct
+rank-to-rank TCP mesh, with a named store actor used only for
+rendezvous.
 
-Reference analog: the Gloo path of ``ray.util.collective``
-(gloo_collective_group.py) with NCCL's rendezvous-via-named-store
-pattern (nccl_collective_group.py): a named store actor per group keys
-each op by a monotonically increasing sequence number per rank;
-reductions happen once in the store; ranks poll for the result.
+Reference analog: ``ray.util.collective`` — ring collectives as in
+the gloo backend (gloo_collective_group.py), rendezvous-via-named-
+store as in the NCCL unique-id pattern (nccl_collective_group.py).
+The data path is event-driven peer sockets (collective.mesh); the
+store actor never carries payload bytes. Set
+``RAY_TPU_COLLECTIVE_FUNNEL=1`` to fall back to the legacy
+store-actor funnel (also used for A/B in tests/benchmarks).
 
 This plane is for host arrays (control tensors, cross-slice
 coordination, parameter broadcast between gangs) — NOT the training
@@ -14,23 +18,57 @@ collective.ici).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.collective.mesh import (
+    PeerMesh,
+    ring_allgather,
+    ring_allreduce,
+    ring_broadcast,
+    ring_reducescatter,
+)
 
 _GROUP_PREFIX = "ray_tpu_collective:"
-_local = {}  # group_name -> (handle, rank, world_size, seq counters)
+_local = {}  # group_name -> _GroupState
+
+
+def _use_funnel() -> bool:
+    return os.environ.get("RAY_TPU_COLLECTIVE_FUNNEL", "0") in (
+        "1", "true")
 
 
 @ray_tpu.remote
 class _GroupStore:
-    def __init__(self, world_size: int):
+    """Rendezvous (token + address exchange) and the legacy funnel
+    reduce path. In mesh mode no payload ever reaches this actor."""
+
+    def __init__(self, world_size: int, token: bytes):
         self.world_size = world_size
+        self.token = token
+        self.addrs: dict[int, tuple] = {}
         self.ops: dict[tuple, dict] = {}     # (op_kind, seq) -> state
         self.p2p: dict[tuple, Any] = {}      # (src, dst, seq) -> value
+
+    def meta(self):
+        return self.token, self.world_size
+
+    def register_addr(self, rank: int, addr: tuple):
+        self.addrs[int(rank)] = tuple(addr)
+
+    def addresses(self):
+        if len(self.addrs) == self.world_size:
+            return self.addrs
+        return None
+
+    def num_registered(self) -> int:
+        return len(self.addrs)
+
+    # -- legacy funnel ops (RAY_TPU_COLLECTIVE_FUNNEL=1) ---------------
 
     def _entry(self, key):
         if key not in self.ops:
@@ -54,8 +92,6 @@ class _GroupStore:
                         acc = np.minimum(acc, p)
                     else:
                         raise ValueError(reduce_op)
-                if reduce_op == "sum":
-                    pass
                 e["result"] = acc
             elif op == "allgather":
                 e["result"] = parts
@@ -91,10 +127,12 @@ class _GroupStore:
 
 
 class _GroupState:
-    def __init__(self, handle, rank: int, world_size: int):
+    def __init__(self, handle, rank: int, world_size: int,
+                 mesh: PeerMesh | None):
         self.handle = handle
         self.rank = rank
         self.world_size = world_size
+        self.mesh = mesh
         self.seq: dict[str, int] = {}
         self.p2p_seq: dict[tuple, int] = {}
 
@@ -106,14 +144,43 @@ class _GroupState:
 
 def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
-    """Join (rank 0 creates) the named group store."""
+    """Join (rank 0 creates) the named group; establish the p2p mesh
+    unless the legacy funnel is forced."""
     name = _GROUP_PREFIX + group_name
     if rank == 0:
+        token = os.urandom(16)
         handle = _GroupStore.options(name=name, num_cpus=0).remote(
-            world_size)
+            world_size, token)
+        ray_tpu.get(handle.meta.remote())     # created before others join
     else:
         handle = _wait_for_actor(name)
-    _local[group_name] = _GroupState(handle, rank, world_size)
+        token, ws = ray_tpu.get(handle.meta.remote())
+        assert ws == world_size, (ws, world_size)
+
+    mesh = None
+    if not _use_funnel():
+        probe = os.environ.get("RAY_TPU_HEAD_IP", "127.0.0.1")
+        mesh = PeerMesh(rank, world_size, bytes(token),
+                        probe_host=probe)
+        ray_tpu.get(handle.register_addr.remote(rank, mesh.addr))
+        # Rendezvous wait (setup only — the data path never polls).
+        deadline = time.monotonic() + 60.0
+        addrs = None
+        while time.monotonic() < deadline:
+            addrs = ray_tpu.get(handle.addresses.remote())
+            if addrs is not None:
+                break
+            time.sleep(0.02)
+        if addrs is None:
+            try:
+                n_reg = ray_tpu.get(handle.num_registered.remote())
+            except Exception:  # noqa: BLE001
+                n_reg = "?"
+            raise TimeoutError(
+                f"collective group {group_name!r}: only {n_reg}/"
+                f"{world_size} ranks registered within 60s")
+        mesh.set_addresses(addrs)
+    _local[group_name] = _GroupState(handle, rank, world_size, mesh)
     barrier(group_name)
 
 
@@ -129,11 +196,14 @@ def _wait_for_actor(name: str, timeout: float = 60.0):
 
 def destroy_collective_group(group_name: str = "default") -> None:
     st = _local.pop(group_name, None)
-    if st is not None and st.rank == 0:
-        try:
-            ray_tpu.kill(st.handle)
-        except Exception:  # noqa: BLE001
-            pass
+    if st is not None:
+        if st.mesh is not None:
+            st.mesh.close()
+        if st.rank == 0:
+            try:
+                ray_tpu.kill(st.handle)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def _group(group_name: str) -> _GroupState:
@@ -144,9 +214,9 @@ def _group(group_name: str) -> _GroupState:
     return _local[group_name]
 
 
-def _collective(op: str, value, group_name: str,
-                reduce_op: str = "sum", timeout: float = 120.0):
-    st = _group(group_name)
+def _funnel_collective(st: _GroupState, op: str, value,
+                       reduce_op: str = "sum",
+                       timeout: float = 120.0):
     seq = st.next_seq(op)
     ray_tpu.get(st.handle.contribute.remote(op, seq, st.rank, value,
                                             reduce_op))
@@ -156,28 +226,49 @@ def _collective(op: str, value, group_name: str,
         if ok:
             return result
         time.sleep(0.005)
-    raise TimeoutError(f"collective {op} timed out in {group_name!r}")
+    raise TimeoutError(f"collective {op} timed out")
 
 
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
-    return _collective("allreduce", np.asarray(tensor), group_name, op)
+    st = _group(group_name)
+    x = np.asarray(tensor)
+    if st.mesh is None:
+        return _funnel_collective(st, "allreduce", x, op)
+    return ring_allreduce(st.mesh, st.next_seq("allreduce"), x, op)
 
 
 def allgather(tensor, group_name: str = "default") -> list:
-    return _collective("allgather", np.asarray(tensor), group_name)
+    st = _group(group_name)
+    x = np.asarray(tensor)
+    if st.mesh is None:
+        return _funnel_collective(st, "allgather", x)
+    return ring_allgather(st.mesh, st.next_seq("allgather"), x)
 
 
 def reducescatter(tensor, group_name: str = "default"):
-    return _collective("reducescatter", np.asarray(tensor), group_name)
+    st = _group(group_name)
+    x = np.asarray(tensor)
+    if st.mesh is None:
+        return _funnel_collective(st, "reducescatter", x)
+    return ring_reducescatter(st.mesh, st.next_seq("reducescatter"), x)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    parts = _collective("allgather", np.asarray(tensor), group_name)
-    return parts[src_rank]
+    st = _group(group_name)
+    if st.mesh is None:
+        parts = _funnel_collective(st, "allgather", np.asarray(tensor))
+        return parts[src_rank]
+    return ring_broadcast(st.mesh, st.next_seq("broadcast"),
+                          np.asarray(tensor), src_rank)
 
 
 def barrier(group_name: str = "default") -> None:
-    _collective("barrier", 0, group_name)
+    st = _group(group_name)
+    if st.mesh is None:
+        _funnel_collective(st, "barrier", 0)
+        return
+    ring_allreduce(st.mesh, st.next_seq("barrier"),
+                   np.zeros(1, np.int8))
 
 
 def send(tensor, dst_rank: int, group_name: str = "default") -> None:
@@ -185,8 +276,11 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     key = (st.rank, dst_rank)
     seq = st.p2p_seq.get(key, 0)
     st.p2p_seq[key] = seq + 1
-    ray_tpu.get(st.handle.put_p2p.remote(st.rank, dst_rank, seq,
-                                         np.asarray(tensor)))
+    if st.mesh is None:
+        ray_tpu.get(st.handle.put_p2p.remote(st.rank, dst_rank, seq,
+                                             np.asarray(tensor)))
+        return
+    st.mesh.send(dst_rank, ("p2p", seq), np.asarray(tensor))
 
 
 def recv(src_rank: int, group_name: str = "default",
@@ -195,11 +289,13 @@ def recv(src_rank: int, group_name: str = "default",
     key = (src_rank, st.rank)
     seq = st.p2p_seq.get(key, 0)
     st.p2p_seq[key] = seq + 1
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        value, ok = ray_tpu.get(
-            st.handle.get_p2p.remote(src_rank, st.rank, seq))
-        if ok:
-            return value
-        time.sleep(0.005)
-    raise TimeoutError(f"recv from {src_rank} timed out")
+    if st.mesh is None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            value, ok = ray_tpu.get(
+                st.handle.get_p2p.remote(src_rank, st.rank, seq))
+            if ok:
+                return value
+            time.sleep(0.005)
+        raise TimeoutError(f"recv from {src_rank} timed out")
+    return st.mesh.recv(src_rank, ("p2p", seq), timeout)

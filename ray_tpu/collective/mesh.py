@@ -1,0 +1,312 @@
+"""Rank-to-rank TCP mesh + ring collectives for the host plane.
+
+Reference analog: the ring algorithms of
+``ray.util.collective``'s gloo backend (gloo_collective_group.py) —
+chunked ring reduce-scatter + all-gather over direct peer
+connections, with the named store actor used ONLY for rendezvous
+(address exchange), as in the NCCL unique-id pattern
+(nccl_collective_group.py). No polling anywhere in the data path:
+sends are kernel-buffered writes, receives block on per-(peer, tag)
+queues fed by demux threads.
+
+Wire: each logical message is two frames on the peer socket —
+``(tag, (dtype, shape))`` via pickle, then the raw payload via
+``send_bytes`` (no pickle copy of the array body).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from multiprocessing import connection as mpc
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.util.net import routable_ip as _routable_ip
+
+_RAW = "__raw__"
+
+
+class PeerDiedError(RuntimeError):
+    pass
+
+
+class _Poison:
+    def __init__(self, src: int):
+        self.src = src
+
+
+class PeerMesh:
+    """Full-duplex connections between ranks of one collective group,
+    established lazily; messages demuxed into per-(src, tag) queues."""
+
+    def __init__(self, rank: int, world_size: int, token: bytes,
+                 probe_host: str = "127.0.0.1"):
+        self.rank = rank
+        self.world_size = world_size
+        self.token = token
+        self._listener = mpc.Listener(("0.0.0.0", 0),
+                                      family="AF_INET", authkey=token)
+        self.addr = (_routable_ip(probe_host),
+                     self._listener.address[1])
+        self._addrs: dict[int, tuple] = {}
+        self._conns: dict[int, Any] = {}
+        self._all_conns: list = []
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._dead: set[int] = set()
+        self._lock = threading.Lock()
+        self._inbox: dict[tuple, queue.Queue] = {}
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"mesh_accept_r{rank}").start()
+
+    # -- wiring --------------------------------------------------------
+
+    def set_addresses(self, addrs: dict[int, tuple]) -> None:
+        self._addrs = {int(r): tuple(a) for r, a in addrs.items()}
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn = self._listener.accept()
+                hello = conn.recv()
+            except Exception:  # noqa: BLE001
+                if self._closed:
+                    return
+                continue
+            if not (isinstance(hello, tuple) and hello[0] == "hello"):
+                conn.close()
+                continue
+            src = int(hello[1])
+            self._register(src, conn)
+
+    def _register(self, src: int, conn) -> None:
+        # Cross-dials may create two sockets per pair; both stay
+        # alive with their own recv threads (closing a "duplicate"
+        # would race the peer's choice of send socket). Each side
+        # sends on the first socket it learned about.
+        with self._lock:
+            self._all_conns.append(conn)
+            if src not in self._conns:
+                self._conns[src] = conn
+                self._send_locks.setdefault(src, threading.Lock())
+        threading.Thread(target=self._recv_loop, args=(src, conn),
+                         daemon=True,
+                         name=f"mesh_recv_{self.rank}<{src}").start()
+
+    def _conn_to(self, dst: int):
+        with self._lock:
+            conn = self._conns.get(dst)
+        if conn is not None:
+            return conn
+        addr = self._addrs.get(dst)
+        if addr is None:
+            raise RuntimeError(f"rank {dst} has no known address")
+        conn = mpc.Client(addr, family="AF_INET", authkey=self.token)
+        conn.send(("hello", self.rank))
+        with self._lock:
+            self._all_conns.append(conn)
+            if dst not in self._conns:
+                self._conns[dst] = conn
+                self._send_locks.setdefault(dst, threading.Lock())
+            use = self._conns[dst]
+        threading.Thread(target=self._recv_loop, args=(dst, conn),
+                         daemon=True,
+                         name=f"mesh_recv_{self.rank}<{dst}").start()
+        return use
+
+    def _recv_loop(self, src: int, conn) -> None:
+        try:
+            while True:
+                tag, meta = conn.recv()
+                if meta is None:
+                    payload = conn.recv()
+                elif meta[0] == _RAW:
+                    payload = conn.recv_bytes()
+                else:
+                    # Receive straight into a writable array: one
+                    # fewer copy than recv_bytes+frombuffer, and
+                    # callers get mutable results (funnel parity).
+                    dtype, shape = meta
+                    arr = np.empty(shape, dtype=dtype)
+                    if arr.nbytes:
+                        conn.recv_bytes_into(
+                            memoryview(arr).cast("B"))
+                    else:
+                        conn.recv_bytes()
+                    payload = arr
+                self._q((src, tag)).put(payload)
+        except (EOFError, OSError):
+            pass
+        finally:
+            with self._lock:
+                self._dead.add(src)
+                queues = [q for (s, _t), q in self._inbox.items()
+                          if s == src]
+            poison = _Poison(src)
+            for q in queues:
+                q.put(poison)
+
+    def _q(self, key: tuple) -> queue.Queue:
+        with self._lock:
+            q = self._inbox.get(key)
+            if q is None:
+                q = self._inbox[key] = queue.Queue()
+            return q
+
+    # -- data path -----------------------------------------------------
+
+    def send(self, dst: int, tag, value) -> None:
+        conn = self._conn_to(dst)
+        lock = self._send_locks.setdefault(dst, threading.Lock())
+        try:
+            with lock:
+                if isinstance(value, np.ndarray):
+                    arr = np.ascontiguousarray(value)
+                    conn.send((tag, (arr.dtype.str, arr.shape)))
+                    conn.send_bytes(arr.data.cast("B"))
+                elif isinstance(value, (bytes, bytearray, memoryview)):
+                    conn.send((tag, (_RAW,)))
+                    conn.send_bytes(value)
+                else:
+                    conn.send((tag, None))
+                    conn.send(value)
+        except (OSError, BrokenPipeError) as e:
+            raise PeerDiedError(f"rank {dst} unreachable") from e
+
+    def recv(self, src: int, tag, timeout: float | None = None):
+        if src in self._dead:
+            # Drain anything already delivered before death.
+            q = self._q((src, tag))
+            try:
+                out = q.get_nowait()
+            except queue.Empty:
+                raise PeerDiedError(f"rank {src} died") from None
+        else:
+            try:
+                out = self._q((src, tag)).get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"recv(src={src}, tag={tag}) timed out") from None
+        if isinstance(out, _Poison):
+            raise PeerDiedError(f"rank {src} died")
+        return out
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except Exception:  # noqa: BLE001
+            pass
+        with self._lock:
+            conns = list(self._all_conns)
+            self._all_conns.clear()
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# ring algorithms
+
+
+def _reduce_into(dst: np.ndarray, src: np.ndarray, op: str) -> None:
+    """dst op= src, in place (dst views the result buffer — no
+    per-step allocations)."""
+    if op == "sum":
+        np.add(dst, src, out=dst)
+    elif op == "max":
+        np.maximum(dst, src, out=dst)
+    elif op == "min":
+        np.minimum(dst, src, out=dst)
+    else:
+        raise ValueError(f"unknown reduce op {op!r}")
+
+
+def ring_allreduce(mesh: PeerMesh, seq: int, x: np.ndarray,
+                   reduce_op: str = "sum",
+                   timeout: float | None = 120.0) -> np.ndarray:
+    """Bandwidth-optimal ring: reduce-scatter then all-gather; each
+    rank moves 2*(W-1)/W of the payload total. All mutation happens
+    in one result buffer (blocks are views into it); sends overlap
+    receives because every peer socket has a dedicated drain thread."""
+    w, r = mesh.world_size, mesh.rank
+    x = np.asarray(x)
+    if w == 1:
+        return x.copy()
+    out = x.ravel().copy()
+    blocks = np.array_split(out, w)       # views into out
+    right, left = (r + 1) % w, (r - 1) % w
+    for step in range(w - 1):
+        sblk = (r - step) % w
+        rblk = (r - step - 1) % w
+        mesh.send(right, ("rs", seq, step), blocks[sblk])
+        incoming = mesh.recv(left, ("rs", seq, step), timeout)
+        _reduce_into(blocks[rblk], incoming, reduce_op)
+    for step in range(w - 1):
+        sblk = (r + 1 - step) % w
+        rblk = (r - step) % w
+        mesh.send(right, ("ag", seq, step), blocks[sblk])
+        blocks[rblk][:] = mesh.recv(left, ("ag", seq, step), timeout)
+    return out.reshape(x.shape)
+
+
+def ring_reducescatter(mesh: PeerMesh, seq: int, x: np.ndarray,
+                       reduce_op: str = "sum",
+                       timeout: float | None = 120.0) -> np.ndarray:
+    """Rank r returns block r of the element-wise reduction, where
+    blocks split the ORIGINAL array along axis 0 (matching the
+    store-funnel semantics for ndim>1 inputs; blocks may be empty or
+    uneven)."""
+    w, r = mesh.world_size, mesh.rank
+    x = np.asarray(x)
+    if w == 1:
+        return x.copy()
+    buf = x.copy()
+    blocks = np.array_split(buf, w)       # views into buf, axis 0
+    right, left = (r + 1) % w, (r - 1) % w
+    for step in range(w - 1):
+        sblk = (r - step - 1) % w
+        rblk = (r - step - 2) % w
+        mesh.send(right, ("rsc", seq, step), blocks[sblk])
+        incoming = mesh.recv(left, ("rsc", seq, step), timeout)
+        _reduce_into(blocks[rblk], incoming, reduce_op)
+    return blocks[r].copy()
+
+
+def ring_allgather(mesh: PeerMesh, seq: int, x: np.ndarray,
+                   timeout: float | None = 120.0) -> list:
+    w, r = mesh.world_size, mesh.rank
+    x = np.asarray(x)
+    if w == 1:
+        return [x.copy()]
+    parts: list = [None] * w
+    parts[r] = x.copy()   # no aliasing of the caller's input
+    right, left = (r + 1) % w, (r - 1) % w
+    for step in range(w - 1):
+        sblk = (r - step) % w
+        rblk = (r - step - 1) % w
+        mesh.send(right, ("gag", seq, step), parts[sblk])
+        parts[rblk] = mesh.recv(left, ("gag", seq, step), timeout)
+    return parts
+
+
+def ring_broadcast(mesh: PeerMesh, seq: int, x, src: int,
+                   timeout: float | None = 120.0):
+    """Pipeline around the ring starting at src; O(W) latency but
+    each link carries the payload exactly once."""
+    w, r = mesh.world_size, mesh.rank
+    if w == 1:
+        return np.asarray(x).copy()
+    right, left = (r + 1) % w, (r - 1) % w
+    if r == src:
+        mesh.send(right, ("bc", seq), np.asarray(x))
+        return np.asarray(x).copy()
+    out = mesh.recv(left, ("bc", seq), timeout)
+    if right != src:
+        mesh.send(right, ("bc", seq), out)
+    return out

@@ -559,17 +559,9 @@ class NodeDaemon:
     # ------------------------------------------------------------------
 
     def _routable_ip(self) -> str:
-        """The local interface address a peer daemon can dial — probed
-        by routing toward the head (no packets sent)."""
-        import socket as _socket
-        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
-        try:
-            s.connect((self.head_addr[0], self.head_addr[1] or 1))
-            return s.getsockname()[0]
-        except OSError:
-            return "127.0.0.1"
-        finally:
-            s.close()
+        """The local interface address a peer daemon can dial."""
+        from ray_tpu.util.net import routable_ip
+        return routable_ip(self.head_addr[0])
 
     def _object_accept_loop(self) -> None:
         while not self._shutdown:
@@ -717,7 +709,12 @@ class NodeDaemon:
                         i_pull = False
                 if not i_pull:
                     if ev is not None:
-                        ev.wait(60.0)
+                        wait_s = 60.0
+                        if deadline is not None:
+                            wait_s = min(
+                                wait_s,
+                                max(deadline - time.monotonic(), 0.0))
+                        ev.wait(wait_s)
                     if self._has_local(oid):
                         obj = self._read_local(oid)
                         if obj is not None:
@@ -796,7 +793,7 @@ class NodeDaemon:
                                           timeout=10.0)
             except Exception:  # noqa: BLE001
                 verdict = None
-            if verdict != "ok":
+            if verdict not in ("ok", "primary"):
                 self.memory_store.delete(oid)
                 self.shm_store.delete(oid)
                 with self._store_lock:

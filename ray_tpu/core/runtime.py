@@ -3140,6 +3140,13 @@ class DriverRuntime:
                         self._obj_replicas.setdefault(
                             oid, set()).add(node.node_id)
                         result = "ok"
+                    elif isinstance(loc, tuple):
+                        # The asker became the PRIMARY between its
+                        # pull and this upcall (lineage re-ran the
+                        # producer there, or a promotion landed):
+                        # it must keep the copy — deleting would
+                        # orphan the directory entry.
+                        result = "primary"
                     else:
                         result = "stale"
             elif op == "put_loc":

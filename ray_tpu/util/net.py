@@ -1,0 +1,19 @@
+"""Small shared networking helpers."""
+
+from __future__ import annotations
+
+import socket
+
+
+def routable_ip(probe_host: str) -> str:
+    """The local interface address a peer can dial, probed by routing
+    toward ``probe_host`` (UDP connect — no packets sent). Falls back
+    to loopback when unroutable."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((probe_host, 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
