@@ -176,12 +176,19 @@ def init_collective_group(world_size: int, rank: int,
                 n_reg = ray_tpu.get(handle.num_registered.remote())
             except Exception:  # noqa: BLE001
                 n_reg = "?"
+            mesh.close()
             raise TimeoutError(
                 f"collective group {group_name!r}: only {n_reg}/"
                 f"{world_size} ranks registered within 60s")
         mesh.set_addresses(addrs)
     _local[group_name] = _GroupState(handle, rank, world_size, mesh)
-    barrier(group_name)
+    try:
+        barrier(group_name)
+    except BaseException:
+        _local.pop(group_name, None)
+        if mesh is not None:
+            mesh.close()
+        raise
 
 
 def _wait_for_actor(name: str, timeout: float = 60.0):
@@ -234,7 +241,8 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     x = np.asarray(tensor)
     if st.mesh is None:
         return _funnel_collective(st, "allreduce", x, op)
-    return ring_allreduce(st.mesh, st.next_seq("allreduce"), x, op)
+    return ring_allreduce(st.mesh, ("ar", st.next_seq("allreduce")),
+                          x, op)
 
 
 def allgather(tensor, group_name: str = "default") -> list:
@@ -242,7 +250,7 @@ def allgather(tensor, group_name: str = "default") -> list:
     x = np.asarray(tensor)
     if st.mesh is None:
         return _funnel_collective(st, "allgather", x)
-    return ring_allgather(st.mesh, st.next_seq("allgather"), x)
+    return ring_allgather(st.mesh, ("ag", st.next_seq("allgather")), x)
 
 
 def reducescatter(tensor, group_name: str = "default"):
@@ -250,7 +258,8 @@ def reducescatter(tensor, group_name: str = "default"):
     x = np.asarray(tensor)
     if st.mesh is None:
         return _funnel_collective(st, "reducescatter", x)
-    return ring_reducescatter(st.mesh, st.next_seq("reducescatter"), x)
+    return ring_reducescatter(
+        st.mesh, ("rsc", st.next_seq("reducescatter")), x)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
@@ -258,7 +267,7 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     if st.mesh is None:
         parts = _funnel_collective(st, "allgather", np.asarray(tensor))
         return parts[src_rank]
-    return ring_broadcast(st.mesh, st.next_seq("broadcast"),
+    return ring_broadcast(st.mesh, ("bc", st.next_seq("broadcast")),
                           np.asarray(tensor), src_rank)
 
 
@@ -267,7 +276,9 @@ def barrier(group_name: str = "default") -> None:
     if st.mesh is None:
         _funnel_collective(st, "barrier", 0)
         return
-    ring_allreduce(st.mesh, st.next_seq("barrier"),
+    # Distinct tag namespace: concurrent barrier/allreduce with
+    # mismatched call order across ranks must never share tags.
+    ring_allreduce(st.mesh, ("bar", st.next_seq("barrier")),
                    np.zeros(1, np.int8))
 
 

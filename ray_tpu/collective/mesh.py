@@ -176,19 +176,27 @@ class PeerMesh:
             raise PeerDiedError(f"rank {dst} unreachable") from e
 
     def recv(self, src: int, tag, timeout: float | None = None):
+        key = (src, tag)
         if src in self._dead:
             # Drain anything already delivered before death.
-            q = self._q((src, tag))
+            q = self._q(key)
             try:
                 out = q.get_nowait()
             except queue.Empty:
                 raise PeerDiedError(f"rank {src} died") from None
         else:
             try:
-                out = self._q((src, tag)).get(timeout=timeout)
+                out = self._q(key).get(timeout=timeout)
             except queue.Empty:
                 raise TimeoutError(
                     f"recv(src={src}, tag={tag}) timed out") from None
+        # Tags are single-use (they embed the op seq): drop the queue
+        # once drained so _inbox doesn't grow one entry per collective
+        # for the life of the process.
+        with self._lock:
+            q = self._inbox.get(key)
+            if q is not None and q.empty():
+                del self._inbox[key]
         if isinstance(out, _Poison):
             raise PeerDiedError(f"rank {src} died")
         return out

@@ -198,11 +198,21 @@ class NodeDaemon:
             info["objects"] = objects
             info["workers"] = workers
         conn.send((P.ND_REGISTER, info))
-        tag, node_id = conn.recv()
-        assert tag == "registered", \
-            f"unexpected register reply {tag!r}"
-        self.node_id = node_id
-        return conn
+        # The head binds our channel before sending the ack, so its
+        # health checker (ND_PING) or dispatcher (ND_WSPAWN/ND_WMSG)
+        # can race messages ahead of "registered": answer pings
+        # inline, buffer the rest for the serve loop.
+        backlog: list = []
+        while True:
+            msg = conn.recv()
+            if msg[0] == "registered":
+                self.node_id = msg[1]
+                self._pre_msgs = backlog
+                return conn
+            if msg[0] == P.ND_PING:
+                conn.send((P.ND_PONG,))
+                continue
+            backlog.append(msg)
 
     def _reconnect(self) -> bool:
         deadline = time.monotonic() + self.reconnect_window_s
@@ -307,10 +317,17 @@ class NodeDaemon:
         self.shutdown()
 
     def _serve_conn(self) -> None:
+        backlog = getattr(self, "_pre_msgs", None) or []
+        self._pre_msgs = []
         while not self._shutdown:
-            msg = self.conn.recv()
+            msg = backlog.pop(0) if backlog else self.conn.recv()
             kind = msg[0]
-            if kind == P.ND_WMSG:
+            if kind == P.ND_PING:
+                    # Inline reply: the pong IS the liveness signal
+                    # of this recv loop (a wedged daemon won't send
+                    # it, which is the point).
+                    self.head_send((P.ND_PONG,))
+            elif kind == P.ND_WMSG:
                     _, widx, wmsg = msg
                     self._enqueue_worker_send(widx, wmsg)
             elif kind == P.ND_WSPAWN:

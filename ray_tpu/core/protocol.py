@@ -92,6 +92,8 @@ ND_CALL = "nd_call"           # (ND_CALL, fid, op, payload); fid -1 = no
                               #   free(oid)
 ND_UPREPLY = "nd_upreply"     # (ND_UPREPLY, fid, status, payload)
 ND_SHUTDOWN = "nd_shutdown"   # (ND_SHUTDOWN,)
+ND_PING = "nd_ping"           # (ND_PING,) head -> daemon liveness probe
+ND_PONG = "nd_pong"           # (ND_PONG,) daemon -> head reply
 
 
 # --- mutating-op dedupe -----------------------------------------------------

@@ -133,6 +133,12 @@ class NodeRecord:
     # peers pull chunks from each other instead of relaying through
     # the head (reference: ObjectManager p2p, object_manager.h:117).
     object_addr: Any = None
+    # Active health checking (reference: GcsHealthCheckManager,
+    # gcs_health_check_manager.h:39): last ND_PONG seen, and whether
+    # a ping send is already in flight (a wedged daemon can block the
+    # sender on its full socket).
+    last_pong: float = 0.0
+    ping_inflight: bool = False
 
     @property
     def is_daemon(self) -> bool:
@@ -2993,6 +2999,66 @@ class DriverRuntime:
 
     # ---------------- node daemon channel (raylet link) ---------------
 
+    def _ensure_health_thread(self) -> None:
+        """Active daemon health checking (reference:
+        GcsHealthCheckManager, gcs_health_check_manager.h:39 — the
+        GCS pings every raylet; EOF-only detection misses wedged
+        processes: SIGSTOP, half-open TCP). A node that misses
+        ``health_check_failure_threshold`` periods gets its channel
+        closed, which drives the ordinary node-death failover."""
+        with self._pool_lock:
+            if getattr(self, "_health_thread", None) is not None:
+                return
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True,
+                name="node_health")
+        self._health_thread.start()
+
+    def _safe_ping(self, node: NodeRecord) -> None:
+        try:
+            node.node_send((P.ND_PING,))
+        except Exception:  # noqa: BLE001
+            pass           # send failure surfaces via the serve loop
+        finally:
+            node.ping_inflight = False
+
+    def _health_loop(self) -> None:
+        period = self.config.health_check_period_s
+        thresh = self.config.health_check_failure_threshold
+        while not self._shutdown:
+            time.sleep(period)
+            now = time.monotonic()
+            for node in list(self._nodes.values()):
+                if not (node.alive and node.is_daemon):
+                    continue
+                if now - node.last_pong > period * thresh:
+                    print(f"ray_tpu: node {node.node_id} missed "
+                          f"{thresh} health checks — declaring it "
+                          f"dead", flush=True)
+                    node.last_pong = now   # one declaration only
+                    # shutdown(SHUT_RDWR), not close(): closing an fd
+                    # does NOT wake a thread blocked in recv on it;
+                    # shutdown does, and the serve loop's EOF handler
+                    # then runs the single node-death failover path.
+                    try:
+                        import socket as _s
+                        sd = _s.fromfd(node.conn.fileno(), _s.AF_INET,
+                                       _s.SOCK_STREAM)
+                        try:
+                            sd.shutdown(_s.SHUT_RDWR)
+                        finally:
+                            sd.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    continue
+                if not node.ping_inflight:
+                    # Own thread per ping: a wedged daemon's full
+                    # socket must not block the checker itself.
+                    node.ping_inflight = True
+                    threading.Thread(target=self._safe_ping,
+                                     args=(node,),
+                                     daemon=True).start()
+
     def _serve_node(self, conn) -> None:
         """Serve one node daemon's control channel for its lifetime.
         EOF (daemon crash/SIGKILL) is node death: fail over workers,
@@ -3018,7 +3084,10 @@ class DriverRuntime:
             node.pid = int(info.get("pid", 0))
             node.hostname = str(info.get("hostname", ""))
             node.object_addr = info.get("object_addr")
+            node.last_pong = time.monotonic()
+            node.ping_inflight = False
             self._res_cv.notify_all()
+        self._ensure_health_thread()
         try:
             # The registration ack MUST be the first message on the
             # channel — adoption below may emit ND_WKILL, which would
@@ -3046,7 +3115,9 @@ class DriverRuntime:
             while True:
                 msg = conn.recv()
                 kind = msg[0]
-                if kind == P.ND_WMSG:
+                if kind == P.ND_PONG:
+                    node.last_pong = time.monotonic()
+                elif kind == P.ND_WMSG:
                     _, widx, wmsg = msg
                     w = self._remote_workers.get(widx)
                     if w is not None:

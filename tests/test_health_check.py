@@ -1,0 +1,104 @@
+"""Active health checking of node daemons (reference:
+GcsHealthCheckManager, gcs_health_check_manager.h:39; threshold flags
+ray_config_def.h:847). EOF-only detection misses a wedged-but-connected
+daemon — SIGSTOP one and the head must declare it dead within the
+configured period*threshold and fail its work over; SIGCONT lets it
+re-register."""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+@pytest.fixture
+def fast_health_env():
+    os.environ["RAY_TPU_HEALTH_CHECK_PERIOD_S"] = "0.2"
+    os.environ["RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD"] = "5"
+    # Reset the cached global config so the overrides take effect.
+    import ray_tpu.core.config as cfg
+    cfg._global = None
+    yield
+    os.environ.pop("RAY_TPU_HEALTH_CHECK_PERIOD_S", None)
+    os.environ.pop("RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD", None)
+    cfg._global = None
+
+
+def test_sigstop_daemon_is_declared_dead_and_failed_over(
+        fast_health_env):
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        nb = cluster.add_node(num_cpus=1)
+        rt = ray_tpu.core.api.get_runtime()
+
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def work():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        # Warm: nb runs tasks.
+        out = ray_tpu.get(work.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                nb.node_id, soft=True)).remote(), timeout=60)
+        assert out == nb.node_id
+
+        # Wedge the daemon WITHOUT killing it: TCP stays open, so
+        # only an active health check can notice.
+        os.kill(nb.proc.pid, signal.SIGSTOP)
+        try:
+            deadline = time.time() + 15
+            while (rt._nodes[nb.node_id].alive
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            took = 15 - (deadline - time.time())
+            assert not rt._nodes[nb.node_id].alive, \
+                "wedged daemon never declared dead"
+            # period 0.2 * threshold 5 = 1s nominal; allow slack.
+            assert took < 10, took
+
+            # Work keeps flowing on the remaining node (the task that
+            # preferred nb re-homes).
+            out = ray_tpu.get(work.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    nb.node_id, soft=True)).remote(), timeout=60)
+            assert out != nb.node_id
+        finally:
+            os.kill(nb.proc.pid, signal.SIGCONT)
+
+        # The un-wedged daemon reconnects and revives.
+        deadline = time.time() + 30
+        while (not rt._nodes[nb.node_id].alive
+               and time.time() < deadline):
+            time.sleep(0.2)
+        assert rt._nodes[nb.node_id].alive, "daemon never re-registered"
+    finally:
+        cluster.shutdown()
+
+
+def test_healthy_daemons_stay_alive(fast_health_env):
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        nb = cluster.add_node(num_cpus=1)
+        rt = ray_tpu.core.api.get_runtime()
+        # Several threshold windows pass with no false positives.
+        time.sleep(3.0)
+        assert rt._nodes[nb.node_id].alive
+
+        @ray_tpu.remote(num_cpus=1)
+        def sq(x):
+            return x * x
+
+        assert ray_tpu.get(sq.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                nb.node_id)).remote(7), timeout=60) == 49
+    finally:
+        cluster.shutdown()
