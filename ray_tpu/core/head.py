@@ -53,35 +53,54 @@ def run_head(port: int, token: bytes,
     # Restore BEFORE the listener opens: a daemon that reconnects
     # against an empty actor table would have its surviving named
     # actors treated as unknown incarnations instead of re-adopted.
+    # Recovery = snapshot + op-log tail replay: every acked mutation
+    # was fsync'd to the op log first (reference: per-write GCS
+    # journaling to Redis, redis_store_client.cc), so even a SIGKILL
+    # immediately after an ack loses nothing; the snapshot is only
+    # compaction.
     snap_path = None
+    oplog = None
     if journal_dir:
+        from ray_tpu.core.oplog import OpLog, merge_oplog
+
         os.makedirs(journal_dir, exist_ok=True)
         snap_path = os.path.join(journal_dir, "head_state.json")
+        state = {"kv": [], "named_actors": [], "pgs": []}
         if os.path.exists(snap_path):
             with open(snap_path) as f:
                 state = json.load(f)
+        tail = OpLog.read_from(journal_dir,
+                               int(state.get("oplog_gen", 0)))
+        if tail or state.get("kv") or state.get("named_actors") \
+                or state.get("pgs"):
+            state = merge_oplog(state, tail)
             restored = rt.restore_snapshot(
                 state, adopt_grace_s=adopt_grace_s)
-            print(f"ray_tpu head: restored journal "
-                  f"{restored}", flush=True)
+            print(f"ray_tpu head: restored journal {restored} "
+                  f"(+{len(tail)} op-log entries)", flush=True)
+        oplog = OpLog(journal_dir)
+        rt.oplog = oplog
     rt.ensure_tcp_listener(host, port)
 
     stop = threading.Event()
 
-    def journal_loop():
+    def compaction_loop():
         last = None
         while not stop.is_set():
             try:
                 state = rt.snapshot_state()
                 if state != last:
-                    rt.save_snapshot(snap_path)
+                    old_gen = oplog.rotate()
+                    rt.save_snapshot(
+                        snap_path, extra={"oplog_gen": oplog.gen})
+                    oplog.delete_upto(old_gen)
                     last = state
             except Exception:  # noqa: BLE001
                 pass
             stop.wait(journal_interval_s)
 
     if snap_path is not None:
-        threading.Thread(target=journal_loop, daemon=True,
+        threading.Thread(target=compaction_loop, daemon=True,
                          name="head_journal").start()
     return rt, stop
 
@@ -124,6 +143,9 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         pass
     rt.shutdown()
+    log = getattr(rt, "oplog", None)
+    if log is not None:
+        log.close()
     return 0
 
 

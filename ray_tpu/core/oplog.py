@@ -1,0 +1,261 @@
+"""Per-write head journal: segmented append-only op log with
+group-commit fsync.
+
+Reference analog: the GCS journaling every table write to Redis
+(src/ray/gcs/store_client/redis_store_client.cc) so that an acked
+mutation survives an immediate head SIGKILL. The snapshot file is
+COMPACTION only: on restart the head restores the snapshot and
+replays the op-log tail over it (idempotent, in log order).
+
+Durability contract: ``append(entry)`` returns only after the entry
+is fsync'd. Concurrent appenders share one fsync (group commit): a
+writer thread drains the queue, writes all pending lines, fsyncs
+once, then releases every waiter.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import re
+import threading
+
+_SEG_RE = re.compile(r"^oplog\.(\d{8})\.jsonl$")
+
+
+def _seg_name(gen: int) -> str:
+    return f"oplog.{gen:08d}.jsonl"
+
+
+_ROTATE = object()
+
+
+class OpLog:
+    """Every file operation — writes, fsync, segment rotation — runs
+    on the single writer thread, so rotation can never close a file
+    out from under an in-flight batch, and write/fsync failures
+    propagate to the appenders instead of acking unsynced data."""
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+        gens = self.segment_gens(dir_path)
+        self.gen = gens[-1] if gens else 0
+        self._fh = open(os.path.join(dir_path, _seg_name(self.gen)),
+                        "ab")
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # items: (payload_bytes, Event, err_list) | (_ROTATE, Event,
+        # result_list)
+        self._pending: list[tuple] = []
+        self._closed = False
+        self._writer = threading.Thread(target=self._write_loop,
+                                        daemon=True,
+                                        name="oplog_writer")
+        self._writer.start()
+
+    # -- write path ----------------------------------------------------
+
+    def append_async(self, entry: dict):
+        """Enqueue one entry; returns a wait() callable that blocks
+        until the entry is fsync'd (raising if durability failed).
+        Enqueue while holding the same lock that guards the in-memory
+        mutation, so log order always matches mutation order; call
+        the waiter after releasing it."""
+        data = (json.dumps(entry, separators=(",", ":"))
+                .encode() + b"\n")
+        ev = threading.Event()
+        err: list = []
+        with self._cv:
+            if self._closed:
+                return lambda: None
+            self._pending.append((data, ev, err))
+            self._cv.notify()
+
+        def wait(timeout: float = 10.0) -> None:
+            if not ev.wait(timeout):
+                raise TimeoutError("op log fsync stalled")
+            if err:
+                raise RuntimeError(
+                    f"op log write failed: {err[0]}")
+
+        return wait
+
+    def append(self, entry: dict, sync: bool = True) -> None:
+        waiter = self.append_async(entry)
+        if sync:
+            waiter()
+
+    def _write_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._pending:
+                    return
+                batch = self._pending
+                self._pending = []
+            synced: list[tuple] = []
+            failure = None
+            for item in batch:
+                if item[0] is _ROTATE:
+                    # Settle what's written so far into the current
+                    # segment, then switch files — all on this
+                    # thread, so no batch ever races a close.
+                    failure = self._sync(synced, failure)
+                    synced = []
+                    _tag, ev, box = item
+                    try:
+                        self._fh.flush()
+                        os.fsync(self._fh.fileno())
+                        self._fh.close()
+                    except (OSError, ValueError):
+                        pass
+                    with self._cv:
+                        old_gen = self.gen
+                        self.gen += 1
+                        self._fh = open(
+                            os.path.join(self.dir,
+                                         _seg_name(self.gen)), "ab")
+                    box.append(old_gen)
+                    ev.set()
+                    failure = None
+                    continue
+                data, ev, err = item
+                try:
+                    self._fh.write(data)
+                except (OSError, ValueError) as e:
+                    err.append(repr(e))
+                synced.append(item)
+            self._sync(synced, failure)
+
+    def _sync(self, synced: list[tuple], failure):
+        """fsync once for the written items, then release their
+        waiters — recording the failure so append() raises instead of
+        acking a write that never reached disk."""
+        if synced:
+            try:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            except (OSError, ValueError) as e:
+                failure = repr(e)
+        for _data, ev, err in synced:
+            if failure and not err:
+                err.append(failure)
+            ev.set()
+        return failure
+
+    # -- compaction ----------------------------------------------------
+
+    def rotate(self) -> int:
+        """Start a fresh segment; returns the previous generation.
+        Performed by the writer thread (queued like any entry) so it
+        serializes with in-flight batches."""
+        ev = threading.Event()
+        box: list = []
+        with self._cv:
+            if self._closed:
+                return self.gen
+            self._pending.append((_ROTATE, ev, box))
+            self._cv.notify()
+        if not ev.wait(10.0):
+            raise TimeoutError("op log rotation stalled")
+        return box[0]
+
+    def delete_upto(self, gen: int) -> None:
+        """Remove segments with generation <= gen (subsumed by a
+        snapshot that recorded a later generation)."""
+        for g in self.segment_gens(self.dir):
+            if g <= gen:
+                try:
+                    os.unlink(os.path.join(self.dir, _seg_name(g)))
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._writer.join(timeout=5)
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+        except (OSError, ValueError):
+            pass
+
+    # -- read path -----------------------------------------------------
+
+    @staticmethod
+    def segment_gens(dir_path: str) -> list[int]:
+        try:
+            names = os.listdir(dir_path)
+        except OSError:
+            return []
+        gens = []
+        for n in names:
+            m = _SEG_RE.match(n)
+            if m:
+                gens.append(int(m.group(1)))
+        return sorted(gens)
+
+    @staticmethod
+    def read_from(dir_path: str, min_gen: int) -> list[dict]:
+        """All entries from segments with generation >= min_gen, in
+        log order. Torn trailing lines (crash mid-write) are
+        skipped."""
+        out: list[dict] = []
+        for g in OpLog.segment_gens(dir_path):
+            if g < min_gen:
+                continue
+            try:
+                with open(os.path.join(dir_path, _seg_name(g)),
+                          "rb") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            out.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            # torn tail from a crash mid-append
+                            continue
+            except OSError:
+                continue
+        return out
+
+
+def merge_oplog(state: dict, entries: list[dict]) -> dict:
+    """Replay op-log entries over a snapshot dict (the shape
+    ``Runtime.snapshot_state`` produces), in log order. Idempotent:
+    entries already reflected in the snapshot re-apply harmlessly."""
+    kv = {(row["ns"], row["k"]): row["v"]
+          for row in state.get("kv", [])}
+    actors = {row["name"]: row
+              for row in state.get("named_actors", [])}
+    pgs = {row["id"]: row for row in state.get("pgs", [])}
+    for e in entries:
+        op = e.get("op")
+        if op == "kv_put":
+            kv[(e["ns"], e["k"])] = e["v"]
+        elif op == "kv_del":
+            kv.pop((e["ns"], e["k"]), None)
+        elif op == "actor":
+            actors[e["row"]["name"]] = e["row"]
+        elif op == "actor_remove":
+            actors.pop(e.get("name", ""), None)
+        elif op == "pg":
+            pgs[e["row"]["id"]] = e["row"]
+        elif op == "pg_remove":
+            pgs.pop(e.get("id", ""), None)
+    out = dict(state)
+    out["kv"] = [{"ns": ns, "k": k, "v": v}
+                 for (ns, k), v in kv.items()]
+    out["named_actors"] = list(actors.values())
+    out["pgs"] = list(pgs.values())
+    return out
+
+
+def b64e(b: bytes) -> str:
+    return base64.b64encode(bytes(b)).decode()

@@ -1752,18 +1752,7 @@ class DriverRuntime:
             rec = self._actors.get(actor_id)
             if rec is None or rec.state == "DEAD":
                 continue
-            pg = rec.options.placement_group
-            actor_rows.append({
-                "name": name,
-                "actor_id": actor_id.hex(),
-                "cls_name": rec.cls_name,
-                "cls_blob": e(rec.cls_blob),
-                "init_args_blob": e(rec.init_args_blob),
-                "options_blob": e(ser.dumps(rec.options)),
-                "pg_id": pg.id.hex() if pg is not None else None,
-                "max_restarts": rec.max_restarts,
-                "max_concurrency": rec.max_concurrency,
-            })
+            actor_rows.append(self._actor_snapshot_row(name, rec))
         pg_rows = []
         with self._pg_lock:
             for pg_id, pg in self._pgs.items():
@@ -1774,14 +1763,55 @@ class DriverRuntime:
         return {"kv": kv_rows, "named_actors": actor_rows,
                 "pgs": pg_rows}
 
-    def save_snapshot(self, path: str) -> dict:
+    def _actor_snapshot_row(self, name: str, rec) -> dict:
+        from ray_tpu.core.oplog import b64e as e
+
+        pg = rec.options.placement_group
+        return {
+            "name": name,
+            "actor_id": rec.actor_id.hex(),
+            "cls_name": rec.cls_name,
+            "cls_blob": e(rec.cls_blob),
+            "init_args_blob": e(rec.init_args_blob),
+            "options_blob": e(ser.dumps(rec.options)),
+            "pg_id": pg.id.hex() if pg is not None else None,
+            "max_restarts": rec.max_restarts,
+            "max_concurrency": rec.max_concurrency,
+        }
+
+    def _journal(self, entry: dict) -> None:
+        """Durably append one mutation to the head's op log before
+        the caller acks it (reference: per-write GCS journaling to
+        Redis, redis_store_client.cc). No-op unless a head process
+        attached an OpLog."""
+        log = getattr(self, "oplog", None)
+        if log is not None:
+            log.append(entry)
+
+    def _journal_async(self, entry: dict):
+        """Enqueue variant for call sites that must order the log
+        entry under their mutation lock; returns a waiter or None."""
+        log = getattr(self, "oplog", None)
+        if log is None:
+            return None
+        return log.append_async(entry)
+
+    def _journal_actor_remove(self, rec) -> None:
+        if rec.name:
+            self._journal({"op": "actor_remove", "name": rec.name})
+
+    def save_snapshot(self, path: str, extra: dict | None = None) -> dict:
         import json
         state = self.snapshot_state()
+        if extra:
+            state.update(extra)
         tmp = path + ".tmp"
         os.makedirs(os.path.dirname(os.path.abspath(path)),
                     exist_ok=True)
         with open(tmp, "w") as f:
             json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
         return {"kv": len(state["kv"]),
                 "named_actors": len(state["named_actors"]),
@@ -2169,6 +2199,7 @@ class DriverRuntime:
                     rec.creation_error = ser.loads(err_blob)
                     rec.state = "DEAD"
                     rec.ready_event.set()
+                    self._journal_actor_remove(rec)
                 return
             task_id = TaskID(task_id_bytes)
             if w.is_actor:
@@ -2348,6 +2379,11 @@ class DriverRuntime:
                     raise ValueError(f"actor name {name!r} already taken")
                 self._named_actors[name] = actor_id
             self._actors[actor_id] = rec
+        if name:
+            # Durable before the creator's ack: an immediately
+            # SIGKILLed head must still know this named actor.
+            self._journal({"op": "actor",
+                           "row": self._actor_snapshot_row(name, rec)})
         threading.Thread(target=self._start_actor, args=(rec,),
                          daemon=True).start()
         return actor_id
@@ -2442,6 +2478,7 @@ class DriverRuntime:
             rec.creation_error = e
             rec.state = "DEAD"
             rec.ready_event.set()
+            self._journal_actor_remove(rec)
 
     def submit_actor_task(self, actor_id: ActorID, method: str,
                           args: tuple, kwargs: dict,
@@ -2582,6 +2619,7 @@ class DriverRuntime:
             # error for a clean-state exit.
             rec.creation_error = rec.creation_error or err
             rec.ready_event.set()
+            self._journal_actor_remove(rec)
             with self._actor_lock:
                 if rec.name and self._named_actors.get(rec.name) == actor_id:
                     del self._named_actors[rec.name]
@@ -2625,6 +2663,9 @@ class DriverRuntime:
         rec = PGRecord(pg_id=pg_id, bundles=bundles, strategy=strategy)
         with self._pg_lock:
             self._pgs[pg_id] = rec
+        self._journal({"op": "pg", "row": {
+            "id": pg_id.hex(), "bundles": bundles,
+            "strategy": strategy}})
 
         def reserve():
             # All-or-nothing bundle placement across nodes per strategy
@@ -2710,6 +2751,8 @@ class DriverRuntime:
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
         with self._pg_lock:
             rec = self._pgs.pop(pg_id, None)
+        if rec is not None:
+            self._journal({"op": "pg_remove", "id": pg_id.hex()})
         if rec and rec.created:
             # Return only the unclaimed share of each bundle to its
             # node; resources held by still-running PG tasks flow back
@@ -2752,21 +2795,40 @@ class DriverRuntime:
                namespace: str = "", overwrite: bool = True) -> bool:
         """Atomic put; with overwrite=False this is the GCS KV's
         PutIfAbsent (exactly one concurrent caller wins)."""
+        from ray_tpu.core.oplog import b64e
+        waiter = None
         with self._kv_lock:
             k = (namespace, bytes(key))
             if not overwrite and k in self._kv:
                 return False
             self._kv[k] = bytes(value)
-            return True
+            # Enqueue under the mutation lock: log order must match
+            # memory order for same-key writes. The fsync wait
+            # happens after release.
+            waiter = self._journal_async(
+                {"op": "kv_put", "ns": namespace,
+                 "k": b64e(key), "v": b64e(value)})
+        if waiter is not None:
+            waiter()
+        return True
 
     def kv_get(self, key: bytes, namespace: str = "") -> bytes | None:
         with self._kv_lock:
             return self._kv.get((namespace, bytes(key)))
 
     def kv_del(self, key: bytes, namespace: str = "") -> bool:
+        from ray_tpu.core.oplog import b64e
+        waiter = None
         with self._kv_lock:
-            return self._kv.pop((namespace, bytes(key)), None) \
+            hit = self._kv.pop((namespace, bytes(key)), None) \
                 is not None
+            if hit:
+                waiter = self._journal_async(
+                    {"op": "kv_del", "ns": namespace,
+                     "k": b64e(key)})
+        if waiter is not None:
+            waiter()
+        return hit
 
     def kv_exists(self, key: bytes, namespace: str = "") -> bool:
         with self._kv_lock:
