@@ -116,6 +116,8 @@ class NodeDaemon:
         # read the cached local copy.
         self._pull_inflight: dict[ObjectID, threading.Event] = {}
         self._pull_lock = threading.Lock()
+        # Direct (worker-written) puts awaiting commit.
+        self._direct_pending: dict[bytes, tuple] = {}
         threading.Thread(target=self._object_accept_loop, daemon=True,
                          name="nd_obj_accept").start()
 
@@ -910,10 +912,24 @@ class NodeDaemon:
             except BaseException as e:  # noqa: BLE001
                 down_send((req_id, P.ST_ERR, ser.dumps(e)))
 
+        conn_direct: set = set()
         try:
             while True:
                 req_id, op, payload = conn.recv()
-                if op == P.OP_PUT:
+                if op == P.OP_PUT_DIRECT:
+                    # Same-host plasma-style put into THIS daemon's
+                    # arena (the worker maps it; the head only
+                    # assigns the id and records the location at
+                    # commit). The dedupe envelope protects the
+                    # client↔head leg only — strip it here.
+                    _dd, dp = P.unwrap_dd(payload)
+                    try:
+                        down_send((req_id, P.ST_OK,
+                                   self._worker_direct_put(
+                                       dp, conn_direct)))
+                    except BaseException as e:  # noqa: BLE001
+                        down_send((req_id, P.ST_ERR, ser.dumps(e)))
+                elif op == P.OP_PUT:
                     # Served from the node-local store: strip the
                     # dedupe envelope (it protects the client↔head
                     # leg; the worker↔daemon leg is same-host and
@@ -954,6 +970,13 @@ class NodeDaemon:
         except (EOFError, OSError):
             pass
         finally:
+            for oid_bytes in conn_direct:
+                # Crashed mid-write: free the reserved slot.
+                try:
+                    self._direct_pending.pop(oid_bytes, None)
+                    self.shm_store.delete(ObjectID(oid_bytes))
+                except Exception:  # noqa: BLE001
+                    pass
             try:
                 upstream.close()
             except OSError:
@@ -962,6 +985,52 @@ class NodeDaemon:
     def _has_local(self, oid: ObjectID) -> bool:
         with self._store_lock:
             return oid in self._local_oids
+
+    def _worker_direct_put(self, payload, pending: set):
+        """Daemon side of the plasma-style direct put (reference:
+        plasma client create/seal protocol, plasma/store.h:55)."""
+        from ray_tpu.core.object_store import NativeSharedMemoryStore
+        store = self.shm_store
+        action = payload[0]
+        if action == "start":
+            _a, total, refs = payload
+            if not isinstance(store, NativeSharedMemoryStore):
+                return None
+            if total < self.config.max_direct_call_object_size:
+                return None
+            oid_bytes = self._head_call("alloc_oid", None)
+            store.direct_prepare(int(total))
+            self._direct_pending[oid_bytes] = (int(total),
+                                               list(refs or ()))
+            pending.add(oid_bytes)
+            return (oid_bytes, store.name)
+        oid_bytes = payload[1]
+        pending.discard(oid_bytes)
+        if action == "commit":
+            entry = self._direct_pending.pop(oid_bytes, None)
+            if entry is None:
+                raise KeyError("no in-flight direct put")
+            total, refs = entry
+            oid = ObjectID(oid_bytes)
+            store.direct_seal(oid, total)
+            with self._store_lock:
+                self._local_oids.add(oid)
+                self._local_obj_meta[oid] = (total, list(refs or ()))
+            try:
+                self._head_call("put_loc_at", (oid_bytes, total, refs))
+            except BaseException:
+                # Directory registration failed: roll the local
+                # bookkeeping back so this daemon doesn't claim an
+                # object the cluster never learned about.
+                with self._store_lock:
+                    self._local_oids.discard(oid)
+                    self._local_obj_meta.pop(oid, None)
+                store.direct_unseal(oid)
+                raise
+            return oid_bytes
+        self._direct_pending.pop(oid_bytes, None)       # "abort"
+        store.delete(ObjectID(oid_bytes))
+        return None
 
     def _handle_worker_object_op(self, op: str, payload):
         if op == P.OP_PUT:

@@ -216,7 +216,11 @@ class NativeSharedMemoryStore:
     def __init__(self, capacity_bytes: int, spill_dir: str,
                  spill_threshold: float = 0.8):
         from ray_tpu.native.store import NativeStore
-        self.name = f"/rts_{os.getpid()}"
+        # Unique per store INSTANCE, not just per pid: readers cache
+        # attachments by name (_attach), and a same-process re-init
+        # (tests, local_mode restarts) would otherwise hand them a
+        # stale mapping of the old unlinked arena.
+        self.name = f"/rts_{os.getpid()}_{os.urandom(3).hex()}"
         self._store = NativeStore(self.name, capacity_bytes, create=True)
         self._capacity = capacity_bytes
         self._spill_dir = spill_dir
@@ -252,17 +256,37 @@ class NativeSharedMemoryStore:
             pos += ln
         return SerializedObject(data=data, buffers=buffers)
 
-    def put(self, object_id: ObjectID, obj: SerializedObject) -> None:
-        record = self._encode(obj)
+    def direct_prepare(self, total: int) -> None:
+        """Spill check before an external writer reserves ``total``
+        bytes (the direct-put start phase — shared by head and node
+        daemon so the accounting lives in one place)."""
         with self._lock:
-            self._maybe_spill_locked(incoming=len(record))
-            ok = self._store.put(object_id.binary(), record)
-            if not ok:
+            self._maybe_spill_locked(incoming=total)
+
+    def direct_seal(self, object_id: ObjectID, total: int) -> None:
+        """Account an externally written record (direct-put commit)."""
+        with self._lock:
+            self._lru[object_id] = total
+
+    def direct_unseal(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._lru.pop(object_id, None)
+
+    def put(self, object_id: ObjectID, obj: SerializedObject) -> None:
+        # Reserve the arena slot and write the record segments
+        # straight from their source buffers: ONE copy source->arena
+        # (the encode-join + native-put path made two).
+        total = record_size(obj)
+        with self._lock:
+            self._maybe_spill_locked(incoming=total)
+            view = self._store.reserve(object_id.binary(), total)
+            if view is None:
                 # Arena full even after spilling: spill this object
                 # directly (fallback allocation analog).
-                self._spill_record_locked(object_id, record)
+                self._spill_record_locked(object_id, self._encode(obj))
                 return
-            self._lru[object_id] = len(record)
+            write_record(view, obj)
+            self._lru[object_id] = total
 
     def _maybe_spill_locked(self, incoming: int = 0) -> None:
         if self._capacity <= 0:
@@ -487,3 +511,32 @@ def read_descriptor(desc) -> SerializedObject:
         buffers.append(bytes(seg.buf[:size]))
         seg.close()
     return SerializedObject(data=data, buffers=buffers)
+
+
+def record_size(obj: SerializedObject) -> int:
+    """Arena record size for the native-store layout."""
+    lens = [len(b) for b in obj.buffers]
+    return 8 + len(obj.data) + 4 + 8 * len(lens) + sum(lens)
+
+
+def write_record(view: memoryview, obj: SerializedObject) -> None:
+    """Write the native-store record straight from the object's
+    source buffers into a reserved arena view (shared by the owner's
+    put and the plasma-style direct worker put)."""
+    dlen = len(obj.data)
+    pos = 0
+    view[pos:pos + 8] = dlen.to_bytes(8, "little")
+    pos += 8
+    view[pos:pos + dlen] = obj.data
+    pos += dlen
+    view[pos:pos + 4] = len(obj.buffers).to_bytes(4, "little")
+    pos += 4
+    lens = [len(b) for b in obj.buffers]
+    for ln in lens:
+        view[pos:pos + 8] = ln.to_bytes(8, "little")
+        pos += 8
+    for b, ln in zip(obj.buffers, lens):
+        if not isinstance(b, (bytes, bytearray)):
+            b = memoryview(b).cast("B")
+        view[pos:pos + ln] = b
+        pos += ln

@@ -69,7 +69,10 @@ class OpLog:
         err: list = []
         with self._cv:
             if self._closed:
-                return lambda: None
+                def closed_wait(timeout: float = 10.0) -> None:
+                    raise RuntimeError(
+                        "op log closed — mutation not durable")
+                return closed_wait
             self._pending.append((data, ev, err))
             self._cv.notify()
 

@@ -52,6 +52,11 @@ OP_STREAM_NEXT = "stream_next"  # (task_id_bytes, timeout) ->
 OP_STREAM_DROP = "stream_drop"  # task_id_bytes
 OP_SPANS = "spans"              # list of finished span dicts (tracing)
 OP_KV = "kv"                    # (action, key, value, namespace)
+OP_PUT_DIRECT = "put_direct"    # plasma-style same-host put: worker
+                                # writes the arena itself.
+                                # ("start", total, refs)->(oid, name)
+                                # | None; ("commit", oid)->oid;
+                                # ("abort", oid)->None
 OP_PULL = "pull"                # chunked object pull (ObjectManager
                                 # analog): ("chunk", tid, i) -> bytes;
                                 # ("end", tid) releases the transfer

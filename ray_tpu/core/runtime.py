@@ -700,6 +700,9 @@ class DriverRuntime:
         self._pending_workers: dict[str, WorkerHandle] = {}
         self._pending_workers_lock = threading.Lock()
         self._client_threads: list[threading.Thread] = []
+        # In-flight direct (worker-written) puts: oid -> (total, refs)
+        # until the worker commits.
+        self._pending_direct: dict[ObjectID, tuple] = {}
         # Reply cache for client-replayed mutating ops (see
         # protocol.wrap_dd): dd_id -> (status, payload), plus in-flight
         # events so a replay racing the original coalesces onto it.
@@ -908,7 +911,13 @@ class DriverRuntime:
 
     def put(self, value) -> ObjectRef:
         oid = ObjectID.for_put(next(self._put_counter))
-        self._store_value(oid, ser.serialize(value))
+        # copy_buffers=False: the store copies straight from the
+        # source arrays into its destination (arena slot / segment /
+        # materialized bytes) inside _store_value, so the extra
+        # .tobytes() pass here would be pure overhead — this is the
+        # single-copy large-put path.
+        self._store_value(oid, ser.serialize(value,
+                                             copy_buffers=False))
         return self.register_ref(ObjectRef(oid))
 
     def put_serialized(self, obj: SerializedObject) -> ObjectRef:
@@ -919,9 +928,13 @@ class DriverRuntime:
     def _store_value(self, oid: ObjectID, obj: SerializedObject) -> None:
         self._register_contained_refs(oid, obj)
         if obj.total_size >= self.config.max_direct_call_object_size:
-            self.shm_store.put(oid, obj)
+            self.shm_store.put(oid, obj)      # copies into shm now
             loc = "shm"
         else:
+            # The memory store RETAINS the object: materialize any
+            # live-view buffers so a later caller-side mutation can't
+            # reach the stored copy.
+            obj = ser.materialize(obj)
             self.memory_store.put(oid, obj)
             loc = "mem"
         with self._obj_cv:
@@ -1755,11 +1768,13 @@ class DriverRuntime:
             actor_rows.append(self._actor_snapshot_row(name, rec))
         pg_rows = []
         with self._pg_lock:
+            # Pending PGs included: the op log journals them at
+            # creation (the client's ack is durable), so compaction
+            # must not silently drop what a crash would then lose.
             for pg_id, pg in self._pgs.items():
-                if pg.created:
-                    pg_rows.append({"id": pg_id.hex(),
-                                    "bundles": pg.bundles,
-                                    "strategy": pg.strategy})
+                pg_rows.append({"id": pg_id.hex(),
+                                "bundles": pg.bundles,
+                                "strategy": pg.strategy})
         return {"kv": kv_rows, "named_actors": actor_rows,
                 "pgs": pg_rows}
 
@@ -3014,9 +3029,29 @@ class DriverRuntime:
         # otherwise every killed worker would pin its borrowed
         # objects for the life of the session.
         conn_borrows: dict = {}
+        # Direct puts this connection started but hasn't committed:
+        # aborted on disconnect so a crashed worker can't leak
+        # reserved arena slots.
+        conn_direct: set = set()
         try:
             while True:
                 req_id, op, payload = conn.recv()
+                if op == P.OP_PUT_DIRECT:
+                    dd, dp = P.unwrap_dd(payload)
+                    if dd is not None:
+                        cached = self._dd_begin(dd)
+                        if cached is not None:
+                            reply(req_id, *cached)
+                            continue
+                    try:
+                        out = (P.ST_OK, self._handle_direct_put(
+                            dp, conn_direct))
+                    except BaseException as e:  # noqa: BLE001
+                        out = (P.ST_ERR, ser.dumps(e))
+                    if dd is not None:
+                        self._dd_finish(dd, out)
+                    reply(req_id, *out)
+                    continue
                 if op == P.OP_BORROW:
                     # Order-sensitive per connection: handle inline
                     # (a thread-per-message race could run a release
@@ -3052,6 +3087,11 @@ class DriverRuntime:
         except (EOFError, OSError):
             pass
         finally:
+            for oid_bytes in conn_direct:
+                try:
+                    self.direct_put_abort(oid_bytes)
+                except Exception:  # noqa: BLE001
+                    pass
             for oid, count in conn_borrows.items():
                 for _ in range(count):
                     try:
@@ -3234,7 +3274,18 @@ class DriverRuntime:
     def _handle_node_upcall(self, node: NodeRecord, fid: int, op: str,
                             payload) -> None:
         try:
-            if op == "locate":
+            if op == "alloc_oid":
+                # Id assignment for a daemon-local direct put; the
+                # directory entry lands at commit via put_loc_at.
+                result = ObjectID.for_put(
+                    next(self._put_counter)).binary()
+            elif op == "put_loc_at":
+                oid_bytes, size, refs = payload
+                oid = ObjectID(oid_bytes)
+                self._store_remote(oid, node.node_id, size, refs)
+                self.on_ref_escaped(oid)
+                result = None
+            elif op == "locate":
                 # Directory lookup for a daemon's p2p pull: where does
                 # this object live right now? ("node", id, obj_addr)
                 # lets the asker pull straight from the holder;
@@ -3403,6 +3454,69 @@ class DriverRuntime:
             self._obj_cv.notify_all()
         with self._res_cv:
             self._res_cv.notify_all()
+
+    # ---- direct (same-host, plasma-style) puts -----------------------
+    # A worker that can map the arena writes object bytes itself; the
+    # head only assigns the id, runs the spill check, and records the
+    # directory entry at commit (reference: plasma clients write shm
+    # directly; the store only manages allocation/sealing).
+
+    def direct_put_start(self, total: int, refs) -> tuple | None:
+        from ray_tpu.core.object_store import NativeSharedMemoryStore
+        store = self.shm_store
+        if not isinstance(store, NativeSharedMemoryStore):
+            return None
+        if total < self.config.max_direct_call_object_size:
+            return None               # small objects: memory store
+        oid = ObjectID.for_put(next(self._put_counter))
+        store.direct_prepare(total)
+        self._pending_direct[oid] = (total, list(refs or ()))
+        return (oid.binary(), store.name)
+
+    def direct_put_commit(self, oid_bytes: bytes) -> bytes:
+        oid = ObjectID(oid_bytes)
+        entry = self._pending_direct.pop(oid, None)
+        if entry is None:
+            # Unknown/aborted/duplicate commit (e.g. a replay after
+            # the disconnect cleanup freed the slot): fail closed —
+            # fabricating success would register a location whose
+            # bytes are gone.
+            raise KeyError(
+                f"no in-flight direct put for {oid.hex()}")
+        total, refs = entry
+        self.shm_store.direct_seal(oid, total)
+        if refs:
+            shim = SerializedObject(
+                data=b"", buffers=[],
+                contained_refs=[(ObjectID(b), n) for b, n in refs])
+            self._register_contained_refs(oid, shim)
+        with self._obj_cv:
+            self._obj_locations[oid] = "shm"
+            self._obj_cv.notify_all()
+        self.on_ref_escaped(oid)
+        with self._res_cv:
+            self._res_cv.notify_all()
+        return oid_bytes
+
+    def direct_put_abort(self, oid_bytes: bytes) -> None:
+        oid = ObjectID(oid_bytes)
+        self._pending_direct.pop(oid, None)
+        self.shm_store.delete(oid)
+
+    def _handle_direct_put(self, payload, conn_pending: set):
+        action = payload[0]
+        if action == "start":
+            _a, total, refs = payload
+            out = self.direct_put_start(int(total), refs)
+            if out is not None:
+                conn_pending.add(out[0])
+            return out
+        if action == "commit":
+            conn_pending.discard(payload[1])
+            return self.direct_put_commit(payload[1])
+        conn_pending.discard(payload[1])      # "abort"
+        self.direct_put_abort(payload[1])
+        return None
 
     def _dd_begin(self, dd: str):
         """Returns the cached reply for a replayed mutating op, or

@@ -149,3 +149,17 @@ def reassemble_chunked(meta: tuple, fetch_chunk, end) -> SerializedObject:
         buffers.append(mv[pos:pos + ln])
         pos += ln
     return SerializedObject(data=bytes(mv[:data_len]), buffers=buffers)
+
+
+def materialize(obj: SerializedObject) -> SerializedObject:
+    """Copy any live-view buffers (serialize(copy_buffers=False))
+    into bytes. Required before RETAINING an object whose source the
+    caller may mutate; stores that copy into their own destination
+    immediately don't need it."""
+    if all(isinstance(b, (bytes, bytearray)) for b in obj.buffers):
+        return obj
+    return SerializedObject(
+        data=obj.data,
+        buffers=[b if isinstance(b, (bytes, bytearray)) else bytes(b)
+                 for b in obj.buffers],
+        contained_refs=obj.contained_refs)

@@ -169,7 +169,7 @@ class ClientRuntime:
     # ops (get/wait/state/resources/...) replay safely without one.
     _MUTATING_OPS = frozenset({
         P.OP_SUBMIT, P.OP_PUT, P.OP_CREATE_ACTOR, P.OP_SUBMIT_ACTOR,
-        P.OP_PG_CREATE, P.OP_STREAM_NEXT,
+        P.OP_PG_CREATE, P.OP_STREAM_NEXT, P.OP_PUT_DIRECT,
     })
     _MUTATING_KV_ACTIONS = frozenset({"put", "put_if_absent", "del"})
 
@@ -223,10 +223,69 @@ class ClientRuntime:
 
     # -- object API --
 
+    # Direct puts only pay off past this size (3 tiny RPCs vs the
+    # payload copy through the socket).
+    _DIRECT_PUT_MIN = 512 * 1024
+
     def put(self, value) -> ObjectRef:
-        obj = ser.serialize(value)
+        obj = ser.serialize(value, copy_buffers=False)
+        if self._allow_desc and obj.total_size >= self._DIRECT_PUT_MIN:
+            ref = self._try_put_direct(obj)
+            if ref is not None:
+                return ref
+        # Socket path: buffers must be real bytes (the wire pickles
+        # them; live views over the caller's arrays are not safe to
+        # ship asynchronously anyway).
+        obj = ser.materialize(obj)
         oid_bytes = self._call(P.OP_PUT, ser.to_wire(obj))
         return ObjectRef(ObjectID(oid_bytes))
+
+    def _try_put_direct(self, obj: SerializedObject) -> ObjectRef | None:
+        """Plasma-style same-host put: reserve a slot in the owner's
+        arena, write the record directly, commit. Returns None when
+        the arena isn't mappable from here (remote client, python-shm
+        fallback, undersized object) — caller uses the socket path.
+        Reference: plasma clients write shm directly
+        (object_manager/plasma/store.h:55 client protocol)."""
+        if getattr(self, "_direct_put_broken", False):
+            # The owner's arena is not mappable from this process
+            # (remote client / different host): don't pay the
+            # start+abort round trips on every large put.
+            return None
+        oid_bytes = None
+        try:
+            from ray_tpu.core.object_store import (
+                _attach,
+                record_size,
+                write_record,
+            )
+            refs_wire = [(rid.binary(), n)
+                         for rid, n in (obj.contained_refs or ())]
+            total = record_size(obj)
+            meta = self._call(P.OP_PUT_DIRECT,
+                              ("start", total, refs_wire))
+            if not meta:
+                return None
+            oid_bytes, store_name = meta
+            try:
+                store = _attach(store_name)
+            except OSError:
+                self._direct_put_broken = True
+                raise
+            view = store.reserve(oid_bytes, total)
+            if view is None:
+                self._call(P.OP_PUT_DIRECT, ("abort", oid_bytes))
+                return None
+            write_record(view, obj)
+            self._call(P.OP_PUT_DIRECT, ("commit", oid_bytes))
+            return ObjectRef(ObjectID(oid_bytes))
+        except Exception:  # noqa: BLE001
+            if oid_bytes is not None:
+                try:
+                    self._call(P.OP_PUT_DIRECT, ("abort", oid_bytes))
+                except Exception:  # noqa: BLE001
+                    pass
+            return None
 
     def get_serialized(self, oid: ObjectID,
                        timeout: float | None = None) -> SerializedObject:
@@ -400,6 +459,20 @@ class ClientRuntime:
         self._call(P.OP_PG_REMOVE, pg_id.binary())
 
     def shutdown(self):
+        # shutdown(2) before close: our own recv thread is blocked in
+        # read() on this fd, which keeps the open file description
+        # alive past close() — the peer would never see EOF (and our
+        # reader would never wake).
+        try:
+            import socket as _s
+            sd = _s.fromfd(self._conn.fileno(), _s.AF_UNIX,
+                           _s.SOCK_STREAM)
+            try:
+                sd.shutdown(_s.SHUT_RDWR)
+            finally:
+                sd.close()
+        except (OSError, ValueError):
+            pass
         try:
             self._conn.close()
         except OSError:

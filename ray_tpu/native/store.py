@@ -104,6 +104,24 @@ class NativeStore:
             raise KeyError("duplicate object id or table full")
         return rc >= 0
 
+    def reserve(self, object_id: bytes, size: int) -> memoryview | None:
+        """Allocate an arena slot and return a WRITABLE view over it —
+        the zero-extra-copy put path (caller writes payload segments
+        straight from their source buffers). None when the arena is
+        full (caller should spill)."""
+        if self._closed:
+            return None
+        off = self._lib.rts_reserve(self._h, self._check_id(object_id),
+                                    size)
+        if off == -2:
+            raise KeyError("duplicate object id or table full")
+        if off < 0:
+            return None
+        base = self._lib.rts_data_ptr(self._h)
+        addr = ctypes.addressof(base.contents) + off
+        buf = (ctypes.c_uint8 * size).from_address(addr)
+        return memoryview(buf).cast("B")
+
     def get(self, object_id: bytes) -> memoryview | None:
         """Zero-copy view over the mapped bytes (valid until delete)."""
         if self._closed:

@@ -138,6 +138,38 @@ def _run_benchmarks(rec, quick: bool) -> None:
     print(json.dumps(gb), flush=True)
     rec(gb)
 
+    # Multi-client: N workers putting concurrently (reference:
+    # multi_client_put_gigabytes, plasma clients writing shm in
+    # parallel). Here worker puts traverse the client channel into
+    # the owner's arena, so this measures the whole ingest path.
+    # num_cpus=0: this measures the store's concurrent ingest, not
+    # the CPU scheduler — on a 1-core box a CPU gate would serialize
+    # the clients.
+    @ray_tpu.remote(num_cpus=0)
+    def _put_worker(n_puts: int, mb: int):
+        arr = np.zeros(mb << 20, dtype=np.uint8)
+        r = ray_tpu.put(arr)   # warm: arena attach + first reserve
+        del r
+        t0 = time.perf_counter()
+        for _ in range(n_puts):
+            r = ray_tpu.put(arr)
+            del r
+        return time.perf_counter() - t0
+
+    n_clients, n_puts, mb = 2, 3 if quick else 8, 50
+    t0 = time.perf_counter()
+    walls = ray_tpu.get(
+        [_put_worker.remote(n_puts, mb) for _ in range(n_clients)],
+        timeout=300)
+    wall = time.perf_counter() - t0
+    total_gib = n_clients * n_puts * mb / 1024
+    mc = {"metric": "multi_client_put_gigabytes",
+          "value": round(total_gib / wall, 2), "unit": "GiB/s",
+          "extra": {"clients": n_clients,
+                    "max_client_wall_s": round(max(walls), 2)}}
+    print(json.dumps(mc), flush=True)
+    rec(mc)
+
 
 def run_serve_bench(quick: bool = False) -> dict:
     """Serve requests/s through a 2-replica deployment (steady-state

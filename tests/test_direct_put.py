@@ -1,0 +1,75 @@
+"""Plasma-style direct puts: a same-host worker writes large objects
+into the owner's arena itself (reference: plasma clients write shm
+directly, object_manager/plasma/store.h:55 create/seal protocol); the
+control channel carries only start/commit."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import protocol as P
+from ray_tpu.core.api import get_runtime
+from ray_tpu.core.worker import ClientRuntime
+
+
+def test_worker_large_put_roundtrip(rt):
+    @ray_tpu.remote(num_cpus=1)
+    def producer():
+        arr = np.arange(2_000_000, dtype=np.float64)    # 16 MB
+        ref = ray_tpu.put(arr)
+        return ray_tpu.get(ref)[1_234_567]
+
+    assert ray_tpu.get(producer.remote(), timeout=60) == 1_234_567.0
+
+
+def test_client_direct_put_hits_arena(rt):
+    runtime = get_runtime()
+    from ray_tpu.core.object_store import NativeSharedMemoryStore
+    if not isinstance(runtime.shm_store, NativeSharedMemoryStore):
+        pytest.skip("native arena unavailable")
+    client = ClientRuntime(runtime.client_address)
+    try:
+        arr = np.arange(1_000_000, dtype=np.float64)     # 8 MB
+        ref = client.put(arr)
+        # Landed in the owner's shm store with a directory entry.
+        assert runtime._obj_locations.get(ref.id) == "shm"
+        assert runtime.shm_store._store.contains(ref.id.binary())
+        out = ray_tpu.get(ref, timeout=60)
+        np.testing.assert_array_equal(out, arr)
+        assert not runtime._pending_direct
+    finally:
+        client.shutdown()
+
+
+def test_disconnect_mid_direct_put_reclaims_slot(rt):
+    runtime = get_runtime()
+    from ray_tpu.core.object_store import NativeSharedMemoryStore
+    if not isinstance(runtime.shm_store, NativeSharedMemoryStore):
+        pytest.skip("native arena unavailable")
+    client = ClientRuntime(runtime.client_address)
+    meta = client._call(P.OP_PUT_DIRECT, ("start", 4_000_000, []))
+    assert meta is not None
+    oid_bytes, store_name = meta
+    from ray_tpu.core.object_store import _attach
+    view = _attach(store_name).reserve(oid_bytes, 4_000_000)
+    assert view is not None
+    del view
+    used_before = runtime.shm_store._store.used_bytes()
+    # Crash before commit: disconnect must abort + free the slot.
+    client.shutdown()
+    import time
+    deadline = time.time() + 10
+    while runtime._pending_direct and time.time() < deadline:
+        time.sleep(0.05)
+    assert not runtime._pending_direct
+    assert runtime.shm_store._store.used_bytes() < used_before
+
+
+def test_small_puts_skip_direct_path(rt):
+    runtime = get_runtime()
+    client = ClientRuntime(runtime.client_address)
+    try:
+        ref = client.put(b"tiny")
+        assert ray_tpu.get(ref, timeout=30) == b"tiny"
+    finally:
+        client.shutdown()
