@@ -116,8 +116,10 @@ class NodeDaemon:
         # read the cached local copy.
         self._pull_inflight: dict[ObjectID, threading.Event] = {}
         self._pull_lock = threading.Lock()
-        # Direct (worker-written) puts awaiting commit.
+        # Direct (worker-written) puts awaiting commit; orphans wait
+        # out a grace window before their slot is freed.
         self._direct_pending: dict[bytes, tuple] = {}
+        self._direct_orphans: dict[bytes, float] = {}
         threading.Thread(target=self._object_accept_loop, daemon=True,
                          name="nd_obj_accept").start()
 
@@ -918,17 +920,23 @@ class NodeDaemon:
                 req_id, op, payload = conn.recv()
                 if op == P.OP_PUT_DIRECT:
                     # Same-host plasma-style put into THIS daemon's
-                    # arena (the worker maps it; the head only
-                    # assigns the id and records the location at
-                    # commit). The dedupe envelope protects the
-                    # client↔head leg only — strip it here.
+                    # arena. Dispatched on a thread: start/commit do
+                    # blocking head upcalls, and a head outage must
+                    # not stall this connection's daemon-local gets.
+                    # The dedupe envelope protects the client↔head
+                    # leg only — strip it here.
                     _dd, dp = P.unwrap_dd(payload)
-                    try:
-                        down_send((req_id, P.ST_OK,
-                                   self._worker_direct_put(
-                                       dp, conn_direct)))
-                    except BaseException as e:  # noqa: BLE001
-                        down_send((req_id, P.ST_ERR, ser.dumps(e)))
+
+                    def _dp(req_id=req_id, dp=dp):
+                        try:
+                            down_send((req_id, P.ST_OK,
+                                       self._worker_direct_put(
+                                           dp, conn_direct)))
+                        except BaseException as e:  # noqa: BLE001
+                            down_send((req_id, P.ST_ERR,
+                                       ser.dumps(e)))
+
+                    threading.Thread(target=_dp, daemon=True).start()
                 elif op == P.OP_PUT:
                     # Served from the node-local store: strip the
                     # dedupe envelope (it protects the client↔head
@@ -971,12 +979,10 @@ class NodeDaemon:
             pass
         finally:
             for oid_bytes in conn_direct:
-                # Crashed mid-write: free the reserved slot.
-                try:
-                    self._direct_pending.pop(oid_bytes, None)
-                    self.shm_store.delete(ObjectID(oid_bytes))
-                except Exception:  # noqa: BLE001
-                    pass
+                # Crashed mid-write: grace-park the slot (the worker
+                # may still hold a live view; immediate free could
+                # corrupt a re-reservation).
+                self._direct_orphans[oid_bytes] = time.monotonic()
             try:
                 upstream.close()
             except OSError:
@@ -998,6 +1004,17 @@ class NodeDaemon:
                 return None
             if total < self.config.max_direct_call_object_size:
                 return None
+            now = time.monotonic()
+            for ob, ts in list(self._direct_orphans.items()):
+                if ob not in self._direct_pending:
+                    self._direct_orphans.pop(ob, None)
+                elif now - ts > 60.0:
+                    self._direct_orphans.pop(ob, None)
+                    self._direct_pending.pop(ob, None)
+                    try:
+                        store.delete(ObjectID(ob))
+                    except Exception:  # noqa: BLE001
+                        pass
             oid_bytes = self._head_call("alloc_oid", None)
             store.direct_prepare(int(total))
             self._direct_pending[oid_bytes] = (int(total),
@@ -1020,12 +1037,17 @@ class NodeDaemon:
                 self._head_call("put_loc_at", (oid_bytes, total, refs))
             except BaseException:
                 # Directory registration failed: roll the local
-                # bookkeeping back so this daemon doesn't claim an
-                # object the cluster never learned about.
+                # bookkeeping back AND free the record — the worker
+                # finished writing before commit, and it may die
+                # before sending the compensating abort.
                 with self._store_lock:
                     self._local_oids.discard(oid)
                     self._local_obj_meta.pop(oid, None)
                 store.direct_unseal(oid)
+                try:
+                    store.delete(oid)
+                except Exception:  # noqa: BLE001
+                    pass
                 raise
             return oid_bytes
         self._direct_pending.pop(oid_bytes, None)       # "abort"

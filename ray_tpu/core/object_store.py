@@ -370,6 +370,16 @@ _attached_stores: dict[str, Any] = {}
 def _attach(name: str):
     if name not in _attached_stores:
         from ray_tpu.native.store import NativeStore
+        # Evict attachments whose arena was unlinked (owner re-init).
+        # NativeStore.close() unmaps only when this process holds no
+        # pinned zero-copy views into the arena — otherwise it keeps
+        # the mapping so live numpy views can't segfault.
+        for old in [n for n in _attached_stores
+                    if not os.path.exists("/dev/shm/" + n.lstrip("/"))]:
+            try:
+                _attached_stores.pop(old).close()
+            except Exception:  # noqa: BLE001
+                pass
         _attached_stores[name] = NativeStore(name)
     return _attached_stores[name]
 

@@ -701,8 +701,11 @@ class DriverRuntime:
         self._pending_workers_lock = threading.Lock()
         self._client_threads: list[threading.Thread] = []
         # In-flight direct (worker-written) puts: oid -> (total, refs)
-        # until the worker commits.
+        # until the worker commits. Orphans (writer disconnected
+        # mid-put) age out on a grace timer before their slot is
+        # freed — the writer may still hold a live view.
         self._pending_direct: dict[ObjectID, tuple] = {}
+        self._orphan_direct: dict[bytes, float] = {}
         # Reply cache for client-replayed mutating ops (see
         # protocol.wrap_dd): dd_id -> (status, payload), plus in-flight
         # events so a replay racing the original coalesces onto it.
@@ -3088,10 +3091,13 @@ class DriverRuntime:
             pass
         finally:
             for oid_bytes in conn_direct:
-                try:
-                    self.direct_put_abort(oid_bytes)
-                except Exception:  # noqa: BLE001
-                    pass
+                # Do NOT free immediately: a client whose connection
+                # dropped may still be memcpying through its mapped
+                # view — freeing now could hand the extent to another
+                # put mid-write (cross-object corruption). Orphans
+                # are reaped after a grace window (or committed by a
+                # dd-replayed commit on reconnect).
+                self._orphan_direct[oid_bytes] = time.monotonic()
             for oid, count in conn_borrows.items():
                 for _ in range(count):
                     try:
@@ -3468,10 +3474,32 @@ class DriverRuntime:
             return None
         if total < self.config.max_direct_call_object_size:
             return None               # small objects: memory store
+        self._reap_orphan_direct()
         oid = ObjectID.for_put(next(self._put_counter))
         store.direct_prepare(total)
         self._pending_direct[oid] = (total, list(refs or ()))
         return (oid.binary(), store.name)
+
+    _ORPHAN_DIRECT_GRACE_S = 60.0
+
+    def _reap_orphan_direct(self) -> None:
+        """Free slots of direct puts whose writer disconnected more
+        than a grace window ago and never committed (lazy — runs on
+        each new direct-put start)."""
+        now = time.monotonic()
+        for oid_bytes, ts in list(self._orphan_direct.items()):
+            oid = ObjectID(oid_bytes)
+            if oid not in self._pending_direct:
+                # Committed after reconnect (dd replay) or already
+                # aborted: nothing to free.
+                self._orphan_direct.pop(oid_bytes, None)
+                continue
+            if now - ts > self._ORPHAN_DIRECT_GRACE_S:
+                self._orphan_direct.pop(oid_bytes, None)
+                try:
+                    self.direct_put_abort(oid_bytes)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def direct_put_commit(self, oid_bytes: bytes) -> bytes:
         oid = ObjectID(oid_bytes)

@@ -55,13 +55,21 @@ def test_disconnect_mid_direct_put_reclaims_slot(rt):
     assert view is not None
     del view
     used_before = runtime.shm_store._store.used_bytes()
-    # Crash before commit: disconnect must abort + free the slot.
+    # Crash before commit: the slot is grace-parked (the writer may
+    # still hold a live view — immediate free could corrupt a
+    # re-reservation), then reaped lazily after the grace window.
     client.shutdown()
     import time
     deadline = time.time() + 10
-    while runtime._pending_direct and time.time() < deadline:
+    while not runtime._orphan_direct and time.time() < deadline:
         time.sleep(0.05)
+    assert runtime._orphan_direct
+    assert runtime._pending_direct            # parked, not freed yet
+    runtime._ORPHAN_DIRECT_GRACE_S = 0.1
+    time.sleep(0.2)
+    runtime._reap_orphan_direct()
     assert not runtime._pending_direct
+    assert not runtime._orphan_direct
     assert runtime.shm_store._store.used_bytes() < used_before
 
 

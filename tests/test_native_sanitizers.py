@@ -1,0 +1,23 @@
+"""CI hook for the native shm sanitizer/crash-stress harness
+(reference: ASAN/TSAN bazel configs in CI, SURVEY.md §5.2). The
+harness kills lock- and pin-holding processes mid-operation and
+asserts robust-mutex recovery; under TSAN any data race fails it."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "..", "ray_tpu",
+                      "native", "run_sanitizers.sh")
+
+
+@pytest.mark.slow
+def test_sanitizer_stress_harness():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    out = subprocess.run(["bash", SCRIPT], capture_output=True,
+                         text=True, timeout=420)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "SANITIZER HARNESS PASSED" in out.stdout
