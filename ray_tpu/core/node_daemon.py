@@ -978,7 +978,9 @@ class NodeDaemon:
         except (EOFError, OSError):
             pass
         finally:
-            for oid_bytes in conn_direct:
+            # list(): _dp threads may still be mutating conn_direct
+            # (e.g. blocked in a head upcall when the worker died).
+            for oid_bytes in list(conn_direct):
                 # Crashed mid-write: grace-park the slot (the worker
                 # may still hold a live view; immediate free could
                 # corrupt a re-reservation).
@@ -991,6 +993,9 @@ class NodeDaemon:
     def _has_local(self, oid: ObjectID) -> bool:
         with self._store_lock:
             return oid in self._local_oids
+
+    # Same policy/window as DriverRuntime._ORPHAN_DIRECT_GRACE_S.
+    _ORPHAN_DIRECT_GRACE_S = 60.0
 
     def _worker_direct_put(self, payload, pending: set):
         """Daemon side of the plasma-style direct put (reference:
@@ -1008,7 +1013,7 @@ class NodeDaemon:
             for ob, ts in list(self._direct_orphans.items()):
                 if ob not in self._direct_pending:
                     self._direct_orphans.pop(ob, None)
-                elif now - ts > 60.0:
+                elif now - ts > self._ORPHAN_DIRECT_GRACE_S:
                     self._direct_orphans.pop(ob, None)
                     self._direct_pending.pop(ob, None)
                     try:

@@ -365,23 +365,32 @@ class NativeSharedMemoryStore:
 
 
 _attached_stores: dict[str, Any] = {}
+_attach_lock = threading.Lock()
 
 
 def _attach(name: str):
-    if name not in _attached_stores:
-        from ray_tpu.native.store import NativeStore
-        # Evict attachments whose arena was unlinked (owner re-init).
-        # NativeStore.close() unmaps only when this process holds no
-        # pinned zero-copy views into the arena — otherwise it keeps
-        # the mapping so live numpy views can't segfault.
-        for old in [n for n in _attached_stores
-                    if not os.path.exists("/dev/shm/" + n.lstrip("/"))]:
-            try:
-                _attached_stores.pop(old).close()
-            except Exception:  # noqa: BLE001
-                pass
-        _attached_stores[name] = NativeStore(name)
-    return _attached_stores[name]
+    with _attach_lock:
+        if name not in _attached_stores:
+            from ray_tpu.native.store import NativeStore
+            # Evict attachments whose arena was unlinked (owner
+            # re-init). close() unmaps only when this process holds
+            # no pinned zero-copy views — live numpy views can't
+            # segfault. Done under the lock so an eviction can't
+            # close a handle a concurrent caller just looked up but
+            # hasn't pinned yet... almost: the caller must pin under
+            # this same lock (see read_descriptor) or tolerate a
+            # closed-handle error, which NativeStore surfaces as a
+            # clean None/False rather than touching freed memory
+            # (_closed flag guards every ctypes call).
+            for old in [n for n in _attached_stores
+                        if not os.path.exists(
+                            "/dev/shm/" + n.lstrip("/"))]:
+                try:
+                    _attached_stores.pop(old).close()
+                except Exception:  # noqa: BLE001
+                    pass
+            _attached_stores[name] = NativeStore(name)
+        return _attached_stores[name]
 
 
 def make_shared_store(capacity: int, spill_dir: str, threshold: float):

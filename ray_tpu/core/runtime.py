@@ -3583,6 +3583,12 @@ class DriverRuntime:
             refs = self.submit_task(fn_id, fn_blob, fn_name, args,
                                     kwargs, options)
             if isinstance(refs, ObjectRefGenerator):
+                # Ownership moves to the remote client: this local
+                # generator object is about to be GC'd, and its owner
+                # finalizer would drop the stream before the client's
+                # first OP_STREAM_NEXT (the client-side generator
+                # carries the drop-on-GC duty instead).
+                refs._owner = False
                 return ("stream", refs._task_id_bytes)
             # The only holder of these refs is the remote worker: pin
             # them so driver-side GC of the transient ObjectRef objects
@@ -3641,6 +3647,12 @@ class DriverRuntime:
                 ActorID(actor_id_bytes), method, args, kwargs,
                 num_returns, trace_ctx)
             if isinstance(refs, ObjectRefGenerator):
+                # Ownership moves to the remote client: this local
+                # generator object is about to be GC'd, and its owner
+                # finalizer would drop the stream before the client's
+                # first OP_STREAM_NEXT (the client-side generator
+                # carries the drop-on-GC duty instead).
+                refs._owner = False
                 return ("stream", refs._task_id_bytes)
             for r in refs:
                 self.on_ref_escaped(r.id)

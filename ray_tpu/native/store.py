@@ -88,6 +88,10 @@ class NativeStore:
                 f"could not {'create' if create else 'attach'} native "
                 f"store {name!r} (errno={ctypes.get_errno()})")
         self._closed = False
+        # Serializes ctypes calls against close(): the _closed check
+        # and the native call must be atomic, else a concurrent
+        # close() (stale-arena eviction) frees the handle mid-call.
+        self._guard = threading.Lock()
 
     def _check_id(self, object_id: bytes) -> bytes:
         if len(object_id) != _ID_SIZE:
@@ -96,10 +100,11 @@ class NativeStore:
 
     def put(self, object_id: bytes, data: bytes) -> bool:
         """False when the arena is full (caller should spill)."""
-        if self._closed:
-            return False
-        rc = self._lib.rts_put(self._h, self._check_id(object_id),
-                               bytes(data), len(data))
+        with self._guard:
+            if self._closed:
+                return False
+            rc = self._lib.rts_put(self._h, self._check_id(object_id),
+                                   bytes(data), len(data))
         if rc == -2:
             raise KeyError("duplicate object id or table full")
         return rc >= 0
@@ -109,42 +114,46 @@ class NativeStore:
         the zero-extra-copy put path (caller writes payload segments
         straight from their source buffers). None when the arena is
         full (caller should spill)."""
-        if self._closed:
-            return None
-        off = self._lib.rts_reserve(self._h, self._check_id(object_id),
-                                    size)
-        if off == -2:
-            raise KeyError("duplicate object id or table full")
-        if off < 0:
-            return None
-        base = self._lib.rts_data_ptr(self._h)
-        addr = ctypes.addressof(base.contents) + off
-        buf = (ctypes.c_uint8 * size).from_address(addr)
-        return memoryview(buf).cast("B")
+        with self._guard:
+            if self._closed:
+                return None
+            off = self._lib.rts_reserve(
+                self._h, self._check_id(object_id), size)
+            if off == -2:
+                raise KeyError("duplicate object id or table full")
+            if off < 0:
+                return None
+            base = self._lib.rts_data_ptr(self._h)
+            addr = ctypes.addressof(base.contents) + off
+            buf = (ctypes.c_uint8 * size).from_address(addr)
+            return memoryview(buf).cast("B")
 
     def get(self, object_id: bytes) -> memoryview | None:
         """Zero-copy view over the mapped bytes (valid until delete)."""
-        if self._closed:
-            return None
-        off = ctypes.c_uint64()
-        size = ctypes.c_uint64()
-        found = self._lib.rts_get(self._h, self._check_id(object_id),
-                                  ctypes.byref(off), ctypes.byref(size))
-        if not found:
-            return None
-        base = self._lib.rts_data_ptr(self._h)
-        addr = ctypes.addressof(base.contents) + off.value
-        buf = (ctypes.c_uint8 * size.value).from_address(addr)
-        return memoryview(buf).cast("B")
+        with self._guard:
+            if self._closed:
+                return None
+            off = ctypes.c_uint64()
+            size = ctypes.c_uint64()
+            found = self._lib.rts_get(
+                self._h, self._check_id(object_id),
+                ctypes.byref(off), ctypes.byref(size))
+            if not found:
+                return None
+            base = self._lib.rts_data_ptr(self._h)
+            addr = ctypes.addressof(base.contents) + off.value
+            buf = (ctypes.c_uint8 * size.value).from_address(addr)
+            return memoryview(buf).cast("B")
 
     def contains(self, object_id: bytes) -> bool:
-        if self._closed:
-            return False
-        off = ctypes.c_uint64()
-        size = ctypes.c_uint64()
-        return bool(self._lib.rts_get(
-            self._h, self._check_id(object_id),
-            ctypes.byref(off), ctypes.byref(size)))
+        with self._guard:
+            if self._closed:
+                return False
+            off = ctypes.c_uint64()
+            size = ctypes.c_uint64()
+            return bool(self._lib.rts_get(
+                self._h, self._check_id(object_id),
+                ctypes.byref(off), ctypes.byref(size)))
 
     def pin(self, object_id: bytes):
         """Zero-copy read with a reader refcount (plasma Get).
@@ -153,49 +162,56 @@ class NativeStore:
         until ``unpin`` — or ("copy", bytes) when the per-object pid
         table is full (no pin held; data copied out under the lock
         window), or None when the object is missing."""
-        if self._closed:
-            return None
-        off = ctypes.c_uint64()
-        size = ctypes.c_uint64()
-        rc = self._lib.rts_pin(self._h, self._check_id(object_id),
-                               ctypes.byref(off), ctypes.byref(size))
-        if rc == 0:
-            return None
-        if rc == 2:
-            view = self.get(object_id)
-            return None if view is None else ("copy", bytes(view))
-        base = self._lib.rts_data_ptr(self._h)
-        addr = ctypes.addressof(base.contents) + off.value
-        buf = (ctypes.c_uint8 * size.value).from_address(addr)
-        return ("pinned", memoryview(buf).cast("B"))
+        with self._guard:
+            if self._closed:
+                return None
+            off = ctypes.c_uint64()
+            size = ctypes.c_uint64()
+            rc = self._lib.rts_pin(
+                self._h, self._check_id(object_id),
+                ctypes.byref(off), ctypes.byref(size))
+            if rc == 0:
+                return None
+            if rc != 2:
+                base = self._lib.rts_data_ptr(self._h)
+                addr = ctypes.addressof(base.contents) + off.value
+                buf = (ctypes.c_uint8 * size.value).from_address(addr)
+                return ("pinned", memoryview(buf).cast("B"))
+        # pid table full: plain copy (outside the guard — get() takes it)
+        view = self.get(object_id)
+        return None if view is None else ("copy", bytes(view))
 
     def reap_dead_pins(self) -> int:
         """Release pins held by processes that no longer exist (the
         plasma client-disconnect analog; owner calls periodically)."""
-        if self._closed:
-            return 0
-        return self._lib.rts_reap_dead_pins(self._h)
+        with self._guard:
+            if self._closed:
+                return 0
+            return self._lib.rts_reap_dead_pins(self._h)
 
     def unpin(self, object_id: bytes) -> int:
         """Release a pinned read (plasma Release)."""
-        if self._closed:
-            return -1
-        return self._lib.rts_unpin(self._h,
-                                   self._check_id(object_id))
+        with self._guard:
+            if self._closed:
+                return -1
+            return self._lib.rts_unpin(self._h,
+                                       self._check_id(object_id))
 
     def delete(self, object_id: bytes) -> bool:
         # Guard against finalizer-ordered calls after close(): GC can
         # run ObjectRef release callbacks after runtime shutdown, and
         # rts_delete on a munmapped arena is a segfault.
-        if self._closed:
-            return False
-        return bool(self._lib.rts_delete(self._h,
-                                         self._check_id(object_id)))
+        with self._guard:
+            if self._closed:
+                return False
+            return bool(self._lib.rts_delete(
+                self._h, self._check_id(object_id)))
 
     def used_bytes(self) -> int:
-        if self._closed:
-            return 0
-        return self._lib.rts_used_bytes(self._h)
+        with self._guard:
+            if self._closed:
+                return 0
+            return self._lib.rts_used_bytes(self._h)
 
     def capacity(self) -> int:
         if self._closed:
@@ -208,7 +224,9 @@ class NativeStore:
         return self._lib.rts_num_objects(self._h)
 
     def close(self) -> None:
-        if not self._closed:
+        with self._guard:
+            if self._closed:
+                return
             self._closed = True
             # If this process still holds pinned zero-copy views
             # (numpy arrays alive after shutdown), munmap would turn

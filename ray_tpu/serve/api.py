@@ -21,6 +21,8 @@ from ray_tpu.serve.router import Router
 
 _proxy = None
 _proxy_port: int | None = None
+_grpc_proxy = None
+_grpc_proxy_port: int | None = None
 
 
 @dataclass
@@ -163,8 +165,9 @@ def _deploy_tree(app: Application, controller) -> str:
 
 def run(app: Application, *, route_prefix: str = "/",
         http_port: int | None = None,
+        grpc_port: int | None = None,
         blocking: bool = False) -> DeploymentHandle:
-    global _proxy, _proxy_port
+    global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
     controller = _ensure_controller()
     name = _deploy_tree(app, controller)
     # wait until replicas are live
@@ -184,6 +187,17 @@ def run(app: Application, *, route_prefix: str = "/",
             ray_tpu.get(_proxy.ready.remote(), timeout=30)
         routes = {route_prefix: name}
         ray_tpu.get(_proxy.set_routes.remote(routes))
+    if grpc_port is not None:
+        # gRPC ingress (reference: gRPCProxy, proxy.py:545) sharing
+        # the router/replica path with HTTP.
+        if _grpc_proxy is None or _grpc_proxy_port != grpc_port:
+            from ray_tpu.serve.grpc_proxy import GRPCProxyActor
+            _grpc_proxy = GRPCProxyActor.options(
+                num_cpus=0, max_concurrency=32).remote(grpc_port)
+            _grpc_proxy_port = grpc_port
+            ray_tpu.get(_grpc_proxy.ready.remote(), timeout=30)
+        routes = {route_prefix: name}
+        ray_tpu.get(_grpc_proxy.set_routes.remote(routes))
     handle = DeploymentHandle(name, controller)
     if blocking:
         while True:
@@ -196,7 +210,7 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 
 def shutdown() -> None:
-    global _proxy, _proxy_port
+    global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
     from ray_tpu.serve.router import LongPollClient, Router
     LongPollClient.shutdown_all()   # stop this process's poll thread
     with Router._cache_lock:
@@ -214,6 +228,13 @@ def shutdown() -> None:
             pass
         _proxy = None
         _proxy_port = None
+    if _grpc_proxy is not None:
+        try:
+            ray_tpu.kill(_grpc_proxy)
+        except Exception:  # noqa: BLE001
+            pass
+        _grpc_proxy = None
+        _grpc_proxy_port = None
 
 
 _batch_init_lock = None  # created lazily per process (picklability)

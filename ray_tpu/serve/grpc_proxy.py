@@ -1,0 +1,218 @@
+"""gRPC ingress proxy (reference analog: gRPCProxy, proxy.py:545).
+
+Shares the steady-state request path with the HTTP proxy: one cached
+Router per deployment (long-poll-fed replica sets, pow-2 probing) and
+zero controller RPCs per request. The wire contract mirrors the
+reference's generic gRPC ingress:
+
+- method path ``/ray_tpu.serve.RayServeAPIService/<method>`` — the
+  trailing segment names the deployment method (``__call__`` for the
+  callable);
+- the target application comes from request metadata
+  ``application`` (reference: gRPCProxy's application metadata) or
+  falls back to the sole registered route;
+- ``multiplexed_model_id`` metadata routes to model-multiplexed
+  replicas exactly like the handle API;
+- bodies are cloudpickled payloads (request: the single argument;
+  response: the return value); server-streaming is selected by the
+  path suffix ``Streaming`` (``/…/countsStreaming`` dispatches the
+  replica method ``counts`` as a generator) — gRPC's generic handler
+  cannot see the client's call type, so the suffix IS the contract.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import ray_tpu
+
+
+def _loads(b: bytes):
+    import cloudpickle
+    import pickle
+    try:
+        return pickle.loads(b)
+    except Exception:  # noqa: BLE001
+        return cloudpickle.loads(b)
+
+
+def _dumps(v) -> bytes:
+    import cloudpickle
+    return cloudpickle.dumps(v)
+
+
+@ray_tpu.remote
+class GRPCProxyActor:
+    def __init__(self, port: int):
+        self.port = port
+        self.routes: dict[str, str] = {}     # route_prefix -> deployment
+        self._routers: dict[str, object] = {}
+        self._controller = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._started.wait(15)
+
+    def set_routes(self, routes: dict[str, str]) -> bool:
+        self.routes = dict(routes)
+        return True
+
+    def ready(self) -> int:
+        if not self._started.wait(15):
+            raise RuntimeError(
+                f"gRPC proxy failed to start on port {self.port}: "
+                f"{getattr(self, '_start_error', 'timeout')}")
+        return self.port
+
+    def _router_for(self, deployment: str):
+        if deployment not in self._routers:
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+            from ray_tpu.serve.router import Router
+            if self._controller is None:
+                self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            self._routers[deployment] = Router.for_deployment(
+                self._controller, deployment)
+        return self._routers[deployment]
+
+    def _target_for(self, metadata: dict) -> str | None:
+        app = metadata.get("application")
+        if app:
+            # Accept either a deployment name or a route prefix.
+            if app in self.routes:
+                return self.routes[app]
+            if app in self.routes.values():
+                return app
+            return None
+        if len(self.routes) == 1:
+            return next(iter(self.routes.values()))
+        return self.routes.get("/")
+
+    def _serve_forever(self):
+        import asyncio
+
+        import grpc
+
+        proxy = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method.rsplit(
+                    "/", 1)[-1]
+                if method.endswith("Streaming"):
+                    return grpc.unary_stream_rpc_method_handler(
+                        _make_stream(method[:-len("Streaming")]
+                                     or "__call__"),
+                        request_deserializer=None,
+                        response_serializer=None)
+                return grpc.unary_unary_rpc_method_handler(
+                    _make_unary(method),
+                    request_deserializer=None,
+                    response_serializer=None)
+
+        def _md(context) -> dict:
+            return {k: v for k, v in (context.invocation_metadata()
+                                      or ())}
+
+        def _make_unary(method_name: str):
+            async def unary(request: bytes, context):
+                md = _md(context)
+                target = proxy._target_for(md)
+                if target is None:
+                    await context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        "no matching application")
+                arg = _loads(request) if request else None
+                router = proxy._router_for(target)
+                loop = asyncio.get_running_loop()
+
+                def call():
+                    ref = router.assign(
+                        method_name, (arg,), {},
+                        multiplexed_model_id=md.get(
+                            "multiplexed_model_id", ""))
+                    return ray_tpu.get(ref, timeout=120)
+
+                try:
+                    result = await loop.run_in_executor(None, call)
+                except Exception as e:  # noqa: BLE001
+                    await context.abort(grpc.StatusCode.INTERNAL,
+                                        str(e)[:500])
+                return _dumps(result)
+            return unary
+
+        def _make_stream(method_name: str):
+            async def stream(request: bytes, context):
+                md = _md(context)
+                target = proxy._target_for(md)
+                if target is None:
+                    await context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        "no matching application")
+                arg = _loads(request) if request else None
+                router = proxy._router_for(target)
+                loop = asyncio.get_running_loop()
+                # Bounded queue = backpressure: a slow client can't
+                # make the proxy buffer an arbitrarily long stream.
+                q: asyncio.Queue = asyncio.Queue(maxsize=16)
+                DONE, ERR = object(), object()
+                stopped = threading.Event()
+
+                def pump():
+                    gen = None
+                    try:
+                        gen = router.assign(
+                            method_name, (arg,), {},
+                            multiplexed_model_id=md.get(
+                                "multiplexed_model_id", ""),
+                            stream=True)
+                        for ref in gen:
+                            if stopped.is_set():
+                                return   # client went away
+                            item = ray_tpu.get(ref, timeout=120)
+                            asyncio.run_coroutine_threadsafe(
+                                q.put((None, item)), loop).result(120)
+                        asyncio.run_coroutine_threadsafe(
+                            q.put((DONE, None)), loop).result(120)
+                    except Exception as e:  # noqa: BLE001
+                        if not stopped.is_set():
+                            try:
+                                asyncio.run_coroutine_threadsafe(
+                                    q.put((ERR, e)), loop).result(30)
+                            except Exception:  # noqa: BLE001
+                                pass
+
+                threading.Thread(target=pump, daemon=True).start()
+                try:
+                    while True:
+                        tag, item = await q.get()
+                        if tag is DONE:
+                            return
+                        if tag is ERR:
+                            await context.abort(
+                                grpc.StatusCode.INTERNAL,
+                                str(item)[:500])
+                        yield _dumps(item)
+                finally:
+                    # Cancellation/disconnect: stop the pump instead
+                    # of draining the whole replica stream; unblock a
+                    # put() waiting on the bounded queue.
+                    stopped.set()
+                    while not q.empty():
+                        q.get_nowait()
+            return stream
+
+        async def run():
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((_Handler(),))
+            bound = server.add_insecure_port(f"127.0.0.1:{self.port}")
+            if bound == 0:
+                # add_insecure_port reports failure by returning 0
+                # (it does not raise): surface it through ready().
+                self._start_error = f"port {self.port} unavailable"
+                return
+            await server.start()
+            self._started.set()
+            await server.wait_for_termination()
+
+        asyncio.new_event_loop().run_until_complete(run())

@@ -158,3 +158,27 @@ def test_nested_submit_result_survives_gc(rt):
         return float(ray_tpu.get(ref).sum())
 
     assert ray_tpu.get(outer.remote(), timeout=60) == 4.0
+
+
+def test_streaming_consumed_from_worker_context(rt):
+    """The head used to GC its handler-local ObjectRefGenerator whose
+    owner finalizer dropped the stream before the remote client's
+    first OP_STREAM_NEXT — worker-context consumers saw instantly
+    exhausted streams (surfaced by the serve gRPC streaming proxy)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    class Gen:
+        def items(self, n):
+            for i in range(n):
+                yield i * 10
+
+    @ray_tpu.remote(num_cpus=0)
+    class Consumer:
+        def consume(self, h):
+            gen = h.items.options(num_returns="streaming").remote(3)
+            return [ray_tpu.get(r, timeout=30) for r in gen]
+
+    g = Gen.remote()
+    out = ray_tpu.get(Consumer.remote().consume.remote(g), timeout=60)
+    assert out == [0, 10, 20]
