@@ -7,7 +7,12 @@ mesh (SURVEY.md §2.3/§2.4).
 """
 
 from ray_tpu.data.context import DataContext
-from ray_tpu.data.dataset import DataIterator, Dataset, GroupedData
+from ray_tpu.data.dataset import (
+    ActorPoolStrategy,
+    DataIterator,
+    Dataset,
+    GroupedData,
+)
 from ray_tpu.data.io import (
     from_items,
     from_numpy,
@@ -24,6 +29,7 @@ from ray_tpu.data.io import (
 range = range_  # noqa: A001
 
 __all__ = [
+    "ActorPoolStrategy",
     "DataContext", "Dataset", "DataIterator", "GroupedData", "range",
     "from_items",
     "from_numpy", "from_pandas", "read_parquet", "read_csv",

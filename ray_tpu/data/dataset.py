@@ -40,9 +40,42 @@ class _Source:
 
 
 @dataclass
+class ActorPoolStrategy:
+    """compute= strategy for ``map_batches`` (reference:
+    ray.data.ActorPoolStrategy + ActorPoolMapOperator,
+    execution/operators/actor_pool_map_operator.py): the UDF runs in
+    a pool of long-lived actors — a CLASS fn is instantiated once per
+    actor (load-the-model-once pattern) — autoscaling between
+    min_size and max_size on backlog, with at most
+    ``max_tasks_in_flight_per_actor`` blocks outstanding per actor
+    (the per-operator backpressure bound)."""
+
+    size: int | None = None
+    min_size: int = 1
+    max_size: int | None = None
+    max_tasks_in_flight_per_actor: int = 2
+    num_cpus: float = 1.0
+
+    def __post_init__(self):
+        if self.size is not None and self.size < 1:
+            raise ValueError("ActorPoolStrategy.size must be >= 1")
+        if self.min_size < 1:
+            raise ValueError(
+                "ActorPoolStrategy.min_size must be >= 1")
+        if self.max_size is not None and self.max_size < self.min_size:
+            raise ValueError("max_size < min_size")
+
+    def resolve(self) -> tuple[int, int]:
+        if self.size is not None:
+            return self.size, self.size
+        return self.min_size, max(self.max_size or 4, self.min_size)
+
+
+@dataclass
 class _MapBatches:
     fn: Callable
     fn_kwargs: dict = field(default_factory=dict)
+    compute: ActorPoolStrategy | None = None
 
 
 @dataclass
@@ -153,8 +186,21 @@ class Dataset:
     def _append(self, op) -> "Dataset":
         return Dataset(self._plan + [op])
 
-    def map_batches(self, fn: Callable, **fn_kwargs) -> "Dataset":
-        return self._append(_MapBatches(fn, fn_kwargs))
+    def map_batches(self, fn: Callable, *, compute=None,
+                    **fn_kwargs) -> "Dataset":
+        # Legacy string forms (classic ray.data): "tasks" == default,
+        # "actors" == a default-sized pool. Anything else must be an
+        # ActorPoolStrategy — fail HERE, not deep in the executor.
+        if compute == "tasks":
+            compute = None
+        elif compute == "actors":
+            compute = ActorPoolStrategy()
+        elif compute is not None and not isinstance(
+                compute, ActorPoolStrategy):
+            raise TypeError(
+                f"compute= must be None, 'tasks', 'actors', or an "
+                f"ActorPoolStrategy; got {compute!r}")
+        return self._append(_MapBatches(fn, fn_kwargs, compute))
 
     def map(self, fn: Callable) -> "Dataset":
         return self._append(_MapRows(fn))
@@ -267,17 +313,30 @@ class Dataset:
             max_in_flight = DataContext.get_current().max_in_flight
         stages = _split_stages(self._plan)
         refs = None
+
+        # Bind stage payloads BY VALUE: these generators evaluate
+        # lazily, possibly after the loop variables (`payload`,
+        # `fused`) have been rebound by a later stage — a genexpr
+        # closing over the loop variable would then run the WRONG
+        # op list (latent for barrier-only plans, which materialize
+        # eagerly; exposed by lazy stages like the actor pool).
+        def _src_tasks(read_fns, ops):
+            return ((_read_and_transform, (rf, ops))
+                    for rf in read_fns)
+
+        def _fused_tasks(upstream, ops):
+            return ((_transform_block, (r, ops)) for r in upstream)
+
         for kind, payload in stages:
             if kind == "source":
                 read_fns, fused = payload
-                refs = _bounded_submit(
-                    ((_read_and_transform, (rf, fused))
-                     for rf in read_fns), max_in_flight)
+                refs = _bounded_submit(_src_tasks(read_fns, fused),
+                                       max_in_flight)
             elif kind == "fused":
-                upstream, fused = refs, payload
-                refs = _bounded_submit(
-                    ((_transform_block, (r, fused)) for r in upstream),
-                    max_in_flight)
+                refs = _bounded_submit(_fused_tasks(refs, payload),
+                                       max_in_flight)
+            elif kind == "actor_map":
+                refs = _actor_map(refs, payload)
             elif kind == "repartition":
                 refs = iter(_do_repartition(list(refs), payload))
             elif kind == "shuffle":
@@ -505,6 +564,13 @@ class DataIterator:
 
 # -- executor helpers ------------------------------------------------------
 
+def _task_fusable(op) -> bool:
+    # Actor-pool map_batches stages can't fuse into plain tasks: they
+    # run in their own long-lived actor pool.
+    return isinstance(op, _FUSABLE) and getattr(op, "compute",
+                                                None) is None
+
+
 def _split_stages(plan: list) -> list[tuple[str, Any]]:
     """Optimizer: fuse transform chains; barriers separate stages."""
     stages: list[tuple[str, Any]] = []
@@ -512,13 +578,16 @@ def _split_stages(plan: list) -> list[tuple[str, Any]]:
     assert isinstance(plan[0], _Source), "plan must start with a source"
     fused: list = []
     i = 1
-    while i < len(plan) and isinstance(plan[i], _FUSABLE):
+    while i < len(plan) and _task_fusable(plan[i]):
         fused.append(plan[i])
         i += 1
     stages.append(("source", (plan[0].read_fns, fused)))
     while i < len(plan):
         op = plan[i]
-        if isinstance(op, _Repartition):
+        if isinstance(op, _MapBatches) and op.compute is not None:
+            stages.append(("actor_map", op))
+            i += 1
+        elif isinstance(op, _Repartition):
             stages.append(("repartition", op.num_blocks))
             i += 1
         elif isinstance(op, _RandomShuffle):
@@ -541,11 +610,123 @@ def _split_stages(plan: list) -> list[tuple[str, Any]]:
             i += 1
         else:
             fused = []
-            while i < len(plan) and isinstance(plan[i], _FUSABLE):
+            while i < len(plan) and _task_fusable(plan[i]):
                 fused.append(plan[i])
                 i += 1
             stages.append(("fused", fused))
     return stages
+
+
+# Last actor-pool run's observability (tests assert autoscaling and
+# the in-flight bound without reaching into the generator).
+LAST_ACTOR_POOL_STATS: dict = {}
+
+
+@ray_tpu.remote(num_cpus=0)
+class _PoolWorker:
+    """One actor of an ActorPoolStrategy pool. A CLASS udf is
+    constructed once here (stateful UDFs: load the model once, apply
+    per block — reference: ActorPoolMapOperator's actor UDFs)."""
+
+    def __init__(self, fn, fn_kwargs):
+        self._fn = fn() if isinstance(fn, type) else fn
+        self._kw = dict(fn_kwargs or {})
+
+    def apply(self, block):
+        out = self._fn(block_to_batch(block), **self._kw)
+        return to_block(out)
+
+
+def _actor_map(upstream, op: _MapBatches):
+    """Streaming actor-pool stage: pulls upstream lazily (bounded:
+    pool_size * max_tasks_in_flight_per_actor blocks outstanding —
+    the operator's backpressure budget), assigns blocks to the least
+    loaded actor, grows the pool when every actor is busy, retires
+    idle actors during drain, yields refs in submission order."""
+    from collections import deque
+
+    strat = op.compute
+    mn, mx = strat.resolve()
+    per = max(1, strat.max_tasks_in_flight_per_actor)
+    mk = lambda: _PoolWorker.options(  # noqa: E731
+        num_cpus=strat.num_cpus).remote(op.fn, op.fn_kwargs)
+    pool: list = [mk() for _ in range(mn)]
+    load: list[int] = [0] * mn
+    order: deque = deque()            # (out_ref, actor_index)
+    stats = {"max_actors": len(pool), "final_actors": len(pool),
+             "max_in_flight": 0, "submitted": 0}
+    LAST_ACTOR_POOL_STATS.clear()
+    LAST_ACTOR_POOL_STATS.update(stats)
+    it = iter(upstream)
+    exhausted = False
+
+    def _can_grow() -> bool:
+        # Resource-aware scale-up (reference: ActorPoolMapOperator
+        # consults the resource manager): a new actor permanently
+        # reserves its CPUs, so growing must leave headroom for the
+        # upstream block tasks — otherwise the pool starves its own
+        # input and the pipeline deadlocks.
+        if strat.num_cpus <= 0:
+            return True
+        try:
+            avail = ray_tpu.available_resources().get("CPU", 0.0)
+        except Exception:  # noqa: BLE001
+            return False
+        return avail >= strat.num_cpus + 1.0
+
+    def submit(block_ref):
+        idx = min(range(len(pool)), key=load.__getitem__)
+        if load[idx] >= 1 and len(pool) < mx and _can_grow():
+            # Backlog: every actor busy — scale up.
+            pool.append(mk())
+            load.append(0)
+            idx = len(pool) - 1
+            stats["max_actors"] = max(stats["max_actors"], len(pool))
+        load[idx] += 1
+        order.append((pool[idx].apply.remote(block_ref), idx))
+        stats["submitted"] += 1
+        stats["max_in_flight"] = max(stats["max_in_flight"],
+                                     len(order))
+
+    try:
+        while True:
+            while not exhausted and len(order) < len(pool) * per:
+                try:
+                    submit(next(it))
+                except StopIteration:
+                    exhausted = True
+            if not order:
+                break
+            ref, idx = order[0]
+            ray_tpu.wait([ref], num_returns=1)
+            order.popleft()
+            load[idx] -= 1
+            if exhausted:
+                # Drain-phase downscale: retire idle actors above the
+                # floor (reference: the actor pool shrinks when the
+                # operator's input is exhausted).
+                for i in range(len(pool) - 1, mn - 1, -1):
+                    if load[i] == 0 and len(pool) > mn:
+                        a = pool.pop(i)
+                        load.pop(i)
+                        order_fixup = deque(
+                            (r, j - 1 if j > i else j)
+                            for r, j in order)
+                        order.clear()
+                        order.extend(order_fixup)
+                        try:
+                            ray_tpu.kill(a)
+                        except Exception:  # noqa: BLE001
+                            pass
+            yield ref
+    finally:
+        stats["final_actors"] = len(pool)
+        LAST_ACTOR_POOL_STATS.update(stats)
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def _bounded_submit(task_iter, max_in_flight: int):
