@@ -64,9 +64,17 @@ class NodeDaemon:
         self.token = token
 
         # Local session dir (sockets + worker logs + spill files).
-        sock_dir = f"/tmp/ray_tpu_sessions/node-{os.getpid()}"
+        # Unique beyond the pid: pids recycle, and a stale socket
+        # file from a SIGKILLed predecessor would fail our AF_UNIX
+        # bind with EADDRINUSE.
+        sock_dir = (f"/tmp/ray_tpu_sessions/node-{os.getpid()}-"
+                    f"{os.urandom(3).hex()}")
         os.makedirs(sock_dir, exist_ok=True)
         self.client_address = os.path.join(sock_dir, "runtime.sock")
+        try:
+            os.unlink(self.client_address)
+        except FileNotFoundError:
+            pass
         self.log_dir = os.path.join(sock_dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
         self.log_monitor = None
@@ -166,6 +174,20 @@ class NodeDaemon:
                                       family="AF_UNIX")
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="nd_accept").start()
+
+        # Per-node dashboard agent (reference: dashboard/agent.py):
+        # /proc samples ride the node channel to the head.
+        from ray_tpu.dashboard.agent import NodeAgent
+
+        def _pids():
+            with self._pool_lock:
+                return [w.proc.pid for w in self._workers.values()
+                        if w.proc is not None and not w.dead]
+
+        self.agent = NodeAgent(
+            lambda stats: self.head_send(
+                (P.ND_UPCALL, -1, "agent_report", stats)),
+            node_id="", worker_pids_fn=_pids).start()
 
     # ------------------------------------------------------------------
     # head channel

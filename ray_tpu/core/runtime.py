@@ -706,6 +706,8 @@ class DriverRuntime:
         # freed — the writer may still hold a live view.
         self._pending_direct: dict[ObjectID, tuple] = {}
         self._orphan_direct: dict[bytes, float] = {}
+        # node_id -> latest per-node agent sample (dashboard).
+        self._agent_stats: dict[str, dict] = {}
         # Reply cache for client-replayed mutating ops (see
         # protocol.wrap_dd): dd_id -> (status, payload), plus in-flight
         # events so a replay racing the original coalesces onto it.
@@ -3280,7 +3282,14 @@ class DriverRuntime:
     def _handle_node_upcall(self, node: NodeRecord, fid: int, op: str,
                             payload) -> None:
         try:
-            if op == "alloc_oid":
+            if op == "agent_report":
+                # Per-node agent stats (reference: reporter module →
+                # dashboard head aggregation).
+                payload = dict(payload or {})
+                payload["node_id"] = node.node_id
+                self._agent_stats[node.node_id] = payload
+                result = None
+            elif op == "alloc_oid":
                 # Id assignment for a daemon-local direct put; the
                 # directory entry lands at commit via put_loc_at.
                 result = ObjectID.for_put(
@@ -3399,6 +3408,7 @@ class DriverRuntime:
                 slot.append((P.ST_ERR, ser.dumps(ObjectLostError(
                     f"node {node_id} disconnected"))))
                 event.set()
+        self._agent_stats.pop(node_id, None)
         self._handle_node_death(node_id)
 
     def _fetch_from_node(self, node_id: str, oid: ObjectID,

@@ -1,0 +1,110 @@
+"""Per-node dashboard agent (reference: python/ray/dashboard/agent.py
++ the reporter module's node stats). Pure-stdlib /proc sampling — no
+psutil in this image — run as a thread inside every node daemon and
+on the head; reports flow over the existing node control channel
+(ND_UPCALL "agent_report"), no extra listener per node."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+def _read_proc_stat() -> tuple[float, float]:
+    """(busy_jiffies, total_jiffies) summed over all cpus."""
+    with open("/proc/stat") as f:
+        for line in f:
+            if line.startswith("cpu "):
+                vals = [float(v) for v in line.split()[1:]]
+                idle = vals[3] + (vals[4] if len(vals) > 4 else 0.0)
+                return sum(vals) - idle, sum(vals)
+    return 0.0, 0.0
+
+
+def _meminfo() -> dict[str, int]:
+    out = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                out[k] = int(rest.strip().split()[0]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def _proc_rss(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+class NodeAgent:
+    """Samples node stats on an interval; hands each sample to
+    ``report_fn(stats_dict)``."""
+
+    def __init__(self, report_fn, node_id: str = "",
+                 interval_s: float = 2.0,
+                 worker_pids_fn=None):
+        self._report = report_fn
+        self._node_id = node_id
+        self._interval = interval_s
+        self._worker_pids = worker_pids_fn or (lambda: [])
+        self._prev = _read_proc_stat()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node_agent")
+
+    def start(self) -> "NodeAgent":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def sample(self) -> dict:
+        busy, total = _read_proc_stat()
+        pbusy, ptotal = self._prev
+        self._prev = (busy, total)
+        dt = total - ptotal
+        cpu_pct = 100.0 * (busy - pbusy) / dt if dt > 0 else 0.0
+        mem = _meminfo()
+        mem_total = mem.get("MemTotal", 0)
+        mem_avail = mem.get("MemAvailable", 0)
+        try:
+            st = os.statvfs("/")
+            disk_total = st.f_blocks * st.f_frsize
+            disk_free = st.f_bavail * st.f_frsize
+        except OSError:
+            disk_total = disk_free = 0
+        workers = []
+        for pid in self._worker_pids():
+            workers.append({"pid": pid, "rss": _proc_rss(pid)})
+        try:
+            from ray_tpu.core.accelerator import detect_tpu_chips
+            tpu_chips = detect_tpu_chips()
+        except Exception:  # noqa: BLE001
+            tpu_chips = 0
+        return {
+            "node_id": self._node_id,
+            "ts": time.time(),
+            "cpu_percent": round(cpu_pct, 1),
+            "mem_total": mem_total,
+            "mem_used": max(mem_total - mem_avail, 0),
+            "disk_total": disk_total,
+            "disk_free": disk_free,
+            "tpu_chips": tpu_chips,
+            "num_workers": len(workers),
+            "workers": workers,
+            "pid": os.getpid(),
+        }
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._report(self.sample())
+            except Exception:  # noqa: BLE001 — reporting must never
+                pass           # kill the daemon

@@ -22,7 +22,8 @@ def _json_default(o):
 
 
 class _Handler(BaseHTTPRequestHandler):
-    runtime = None   # set by Dashboard
+    runtime = None      # set by Dashboard
+    head_agent = None   # NodeAgent sampling the head host
 
     def log_message(self, *a):       # silence request logging
         pass
@@ -64,6 +65,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(state_api.list_placement_groups())
             elif path == "/api/summary":
                 self._send_json(state_api.summarize_tasks())
+            elif path == "/api/agents":
+                # Per-node agent samples (reference: the reporter
+                # module feeding dashboard node cards). The head node
+                # samples itself on demand.
+                stats = dict(getattr(rt, "_agent_stats", {}))
+                if self.head_agent is not None:
+                    head_row = self.head_agent.sample()
+                    head_row["node_id"] = "head"
+                    stats["head"] = head_row
+                self._send_json(stats)
             elif path == "/api/timeline":
                 self._send_json(rt.timeline())
             elif path == "/api/spans":
@@ -78,6 +89,21 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, b'{"error": "not found"}')
         except Exception as e:  # noqa: BLE001
             self._send(500, json.dumps({"error": str(e)}).encode())
+
+    def _node_rows(self) -> str:
+        stats = dict(getattr(self.runtime, "_agent_stats", {}))
+        if self.head_agent is not None:
+            row = self.head_agent.sample()
+            row["node_id"] = "head"
+            stats["head"] = row
+        gb = 1024 ** 3
+        return "".join(
+            f"<tr><td>{nid}</td><td>{s.get('cpu_percent', 0)}</td>"
+            f"<td>{s.get('mem_used', 0) / gb:.1f} / "
+            f"{s.get('mem_total', 0) / gb:.1f}</td>"
+            f"<td>{s.get('num_workers', 0)}</td>"
+            f"<td>{s.get('tpu_chips', 0)}</td></tr>"
+            for nid, s in sorted(stats.items()))
 
     def _index(self) -> bytes:
         from ray_tpu.util import state as state_api
@@ -103,6 +129,9 @@ padding:4px 10px}}</style></head><body>
 <h2>ray_tpu</h2>
 <h3>Resources (available / total)</h3><table>{rows}</table>
 <h3>Task states</h3><table>{counts}</table>
+<h3>Nodes</h3><table>
+<tr><th>node</th><th>cpu%</th><th>mem used/total (GB)</th>
+<th>workers</th><th>tpu chips</th></tr>{self._node_rows()}</table>
 <p>APIs: <a href="/api/cluster">cluster</a>
 <a href="/api/nodes">nodes</a> <a href="/api/tasks">tasks</a>
 <a href="/api/actors">actors</a> <a href="/api/objects">objects</a>
@@ -120,8 +149,11 @@ class Dashboard:
         if runtime is None:
             from ray_tpu.core.api import get_runtime
             runtime = get_runtime()
+        from ray_tpu.dashboard.agent import NodeAgent
         handler = type("BoundHandler", (_Handler,),
-                       {"runtime": runtime})
+                       {"runtime": runtime,
+                        "head_agent": NodeAgent(lambda s: None,
+                                                node_id="head")})
         self._server = ThreadingHTTPServer((host, port), handler)
         self.host = host
         self.port = self._server.server_address[1]

@@ -14,6 +14,9 @@ from ray_tpu.data.dataset import (
     GroupedData,
 )
 from ray_tpu.data.io import (
+    from_arrow,
+    read_numpy,
+    read_text,
     from_items,
     from_numpy,
     from_pandas,
@@ -32,6 +35,9 @@ __all__ = [
     "ActorPoolStrategy",
     "DataContext", "Dataset", "DataIterator", "GroupedData", "range",
     "from_items",
+    "from_arrow",
+    "read_text",
+    "read_numpy",
     "from_numpy", "from_pandas", "read_parquet", "read_csv",
     "read_json", "read_images", "read_binary_files",
 ]

@@ -48,7 +48,10 @@ def _to_arrow_array(v):
     if arr.ndim <= 1:
         return pa.array(arr.tolist() if arr.dtype == object else arr)
     # N-d columns -> FixedSizeList nesting (tensors per row).
-    flat = arr.reshape(len(arr), -1)
+    # Explicit trailing size: reshape(0, -1) on an empty partition
+    # (shuffle scatter can produce one) is a ValueError.
+    trailing = int(np.prod(arr.shape[1:]))
+    flat = arr.reshape(len(arr), trailing)
     inner = pa.array(flat.ravel())
     for dim in reversed(arr.shape[1:]):
         inner = pa.FixedSizeListArray.from_arrays(inner, dim)

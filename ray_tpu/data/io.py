@@ -186,3 +186,57 @@ def read_binary_files(paths: str | list[str],
         return read
 
     return Dataset([_Source([make(f) for f in files])])
+
+
+def read_text(paths: str | list[str],
+              drop_empty_lines: bool = True) -> Dataset:
+    """One row per line, column "text" (reference:
+    ray.data.read_text)."""
+    files = _expand(paths, ".txt")
+
+    def make(f):
+        def read():
+            with open(f) as fh:
+                lines = [ln.rstrip("\n") for ln in fh]
+            if drop_empty_lines:
+                lines = [ln for ln in lines if ln.strip()]
+            return to_block({"text": np.asarray(lines, dtype=object)})
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
+
+
+def read_numpy(paths: str | list[str],
+               column: str = "data") -> Dataset:
+    """.npy (one array -> one column) or .npz (one column per key)
+    files, one block per file (reference: ray.data.read_numpy)."""
+    try:
+        files = _expand(paths, ".npy")
+    except FileNotFoundError:
+        files = []
+    try:
+        npz = [f for f in _expand(paths, ".npz")
+               if f.endswith(".npz") and f not in files]
+    except FileNotFoundError:
+        npz = []
+    files = sorted(files + npz)
+    if not files:
+        raise FileNotFoundError(f"no .npy/.npz files match {paths}")
+
+    def make(f):
+        def read():
+            loaded = np.load(f, allow_pickle=False)
+            if isinstance(loaded, np.lib.npyio.NpzFile):
+                return to_block({k: loaded[k] for k in loaded.files})
+            return to_block({column: loaded})
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
+
+
+def from_arrow(tables: list) -> Dataset:
+    """Dataset over existing pyarrow Tables (reference:
+    ray.data.from_arrow)."""
+    if not isinstance(tables, list):
+        tables = [tables]
+    return Dataset([_Source([(lambda t=t: t) for t in tables])])

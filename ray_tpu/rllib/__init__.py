@@ -17,17 +17,23 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup, Episode
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.multi_agent import (
     MultiAgentEnvRunner, MultiAgentPPO, MultiAgentPPOConfig,
 )
 from ray_tpu.rllib.sac import SAC, SACConfig
+from ray_tpu.rllib import connectors
+from ray_tpu.rllib import offline
+from ray_tpu.rllib.connectors import ConnectorPipelineV2, ConnectorV2
 
 __all__ = [
     "AlgorithmConfig", "PPO", "PPOConfig",
     "APPO", "APPOConfig", "BC", "BCConfig", "CQL", "CQLConfig",
     "DQN", "DQNConfig", "ReplayBuffer",
     "Impala", "ImpalaConfig", "MARWIL", "MARWILConfig",
+    "connectors", "offline", "ConnectorV2", "ConnectorPipelineV2",
+    "LearnerGroup",
     "SAC", "SACConfig",
     "EnvRunner", "EnvRunnerGroup", "Episode", "JaxLearner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnvRunner",

@@ -22,6 +22,8 @@ class AlgorithmConfig:
     train_batch_size: int = 512
     hparams: PPOHyperparams = field(default_factory=PPOHyperparams)
     seed: int = 0
+    env_to_module: list = field(default_factory=list)
+    module_to_env: list = field(default_factory=list)
 
     def environment(self, env, *, obs_dim: int, num_actions: int,
                     hidden: tuple = (64, 64)) -> "AlgorithmConfig":
@@ -31,6 +33,19 @@ class AlgorithmConfig:
 
     def env_runners(self, num_env_runners: int) -> "AlgorithmConfig":
         return replace(self, num_env_runners=num_env_runners)
+
+    def connectors(self, *, env_to_module: list | None = None,
+                   module_to_env: list | None = None
+                   ) -> "AlgorithmConfig":
+        """ConnectorV2 pipelines for the env runners (reference:
+        AlgorithmConfig.env_to_module_connector /
+        module_to_env_connector of the new API stack)."""
+        return replace(
+            self,
+            env_to_module=list(env_to_module
+                               or self.env_to_module),
+            module_to_env=list(module_to_env
+                               or self.module_to_env))
 
     def training(self, *, train_batch_size: int | None = None,
                  **hp_overrides) -> "AlgorithmConfig":
@@ -56,7 +71,9 @@ class PPO:
                                   seed=config.seed)
         self.runners = EnvRunnerGroup(
             config.env, config.policy_config,
-            num_runners=config.num_env_runners, seed=config.seed)
+            num_runners=config.num_env_runners, seed=config.seed,
+            env_to_module=config.env_to_module,
+            module_to_env=config.module_to_env)
         self.iteration = 0
         # Sync initial weights so sampling matches the learner.
         self.runners.set_weights(self.learner.get_weights())

@@ -43,8 +43,16 @@ class EnvRunner:
     """One sampling actor: vectorized-ish env loop with a host policy."""
 
     def __init__(self, env_maker_or_name, policy_config: dict,
-                 seed: int = 0, policy: str = "categorical"):
+                 seed: int = 0, policy: str = "categorical",
+                 env_to_module=None, module_to_env=None):
         import jax
+
+        from ray_tpu.rllib.connectors import ConnectorPipelineV2
+        # ConnectorV2 pipelines (reference: connector_pipeline_v2.py):
+        # obs flow through env_to_module before the policy forward;
+        # policy outputs flow through module_to_env before env.step.
+        self.env_to_module = ConnectorPipelineV2(env_to_module or [])
+        self.module_to_env = ConnectorPipelineV2(module_to_env or [])
 
         if isinstance(env_maker_or_name, str):
             import gymnasium
@@ -77,6 +85,13 @@ class EnvRunner:
         self._fwd = jax.jit(
             lambda p, o: self.model.apply({"params": p}, o))
         self._obs, _ = self.env.reset(seed=seed)
+        # Transformed current obs: each observation passes through the
+        # (possibly stateful) env_to_module pipeline EXACTLY once —
+        # bootstrap values and episode records reuse this cache, so
+        # FrameStack/NormalizeObs state never double-counts a frame.
+        self._tobs = np.asarray(self.env_to_module(
+            np.asarray(self._obs, np.float32), {"reset": True}),
+            dtype=np.float32)
 
     def set_weights(self, params) -> bool:
         self.params = params
@@ -118,29 +133,36 @@ class EnvRunner:
         episodes: list[Episode] = []
         ep = Episode()
         for _ in range(num_steps):
-            env_action, action, logp, value = self._act(
-                np.asarray(self._obs, dtype=np.float32))
+            obs = self._tobs
+            env_action, action, logp, value = self._act(obs)
+            env_action = self.module_to_env(env_action, {})
             next_obs, reward, term, trunc, _ = self.env.step(env_action)
-            ep.obs.append(np.asarray(self._obs, dtype=np.float32))
+            ep.obs.append(obs)
             ep.actions.append(action)
             ep.rewards.append(float(reward))
             ep.logps.append(logp)
             ep.values.append(value)
             self._obs = next_obs
+            self._tobs = np.asarray(self.env_to_module(
+                np.asarray(next_obs, np.float32), {"reset": False}),
+                dtype=np.float32)
             if term or trunc:
                 ep.terminated, ep.truncated = term, trunc
                 ep.last_value = 0.0
-                ep.final_obs = np.asarray(next_obs, dtype=np.float32)
+                # final_obs lives in the SAME (transformed) space as
+                # ep.obs — off-policy consumers concatenate them.
+                ep.final_obs = self._tobs
                 episodes.append(ep)
                 ep = Episode()
                 self._obs, _ = self.env.reset()
+                self._tobs = np.asarray(self.env_to_module(
+                    np.asarray(self._obs, np.float32),
+                    {"reset": True}), dtype=np.float32)
         if ep.length:
             if self.policy == "categorical":
-                _, last_v = self._fwd(
-                    self.params,
-                    np.asarray(self._obs, np.float32)[None])
+                _, last_v = self._fwd(self.params, self._tobs[None])
                 ep.last_value = float(last_v[0])
-            ep.final_obs = np.asarray(self._obs, dtype=np.float32)
+            ep.final_obs = self._tobs
             episodes.append(ep)
         return episodes
 
@@ -154,14 +176,18 @@ class EnvRunnerGroup:
 
     def __init__(self, env_maker_or_name, policy_config: dict,
                  num_runners: int = 2, seed: int = 0,
-                 policy: str = "categorical"):
+                 policy: str = "categorical",
+                 env_to_module=None, module_to_env=None):
         self._maker = env_maker_or_name
         self._policy_config = policy_config
         self._seed = seed
         self._policy = policy
+        self._e2m = env_to_module
+        self._m2e = module_to_env
         self.runners = [
             EnvRunner.remote(env_maker_or_name, policy_config,
-                             seed + i, policy)
+                             seed + i, policy,
+                             env_to_module, module_to_env)
             for i in range(num_runners)
         ]
 
@@ -174,7 +200,8 @@ class EnvRunnerGroup:
             except Exception:  # noqa: BLE001 — respawn lost runner
                 self.runners[i] = EnvRunner.remote(
                     self._maker, self._policy_config,
-                    self._seed + i + 1000, self._policy)
+                    self._seed + i + 1000, self._policy,
+                    self._e2m, self._m2e)
         return episodes
 
     def set_weights(self, params) -> None:

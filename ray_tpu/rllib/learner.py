@@ -51,30 +51,43 @@ class JaxLearner:
 
     # -- losses --
 
-    def _update_fn(self, params, opt_state, batch):
+    def compute_grads(self, params, batch):
+        """(grads, metrics) without applying — the seam the
+        multi-learner group uses to allreduce gradients between
+        learner processes before the update (reference:
+        torch_learner.py:508-522 DDP hook)."""
+        if not hasattr(self, "_grads_jit"):
+            def gfn(params, batch):
+                (_t, (pi_l, vf_l, ent)), grads = jax.value_and_grad(
+                    self._loss_with_aux, has_aux=True)(params, batch)
+                return grads, {"policy_loss": pi_l,
+                               "vf_loss": vf_l, "entropy": ent}
+            self._grads_jit = jax.jit(gfn)
+        return self._grads_jit(params, batch)
+
+    def _loss_with_aux(self, p, batch):
         hp = self.hp
+        logits, values = self.model.apply({"params": p},
+                                          batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=-1)[:, 0]
+        ratio = jnp.exp(logp - batch["logp_old"])
+        adv = batch["advantages"]
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - hp.clip_eps, 1 + hp.clip_eps) * adv)
+        pi_loss = -surr.mean()
+        vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (pi_loss + hp.vf_coeff * vf_loss
+                 - hp.entropy_coeff * entropy)
+        return total, (pi_loss, vf_loss, entropy)
 
-        def loss_fn(p):
-            logits, values = self.model.apply({"params": p},
-                                              batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None], axis=-1)[:, 0]
-            ratio = jnp.exp(logp - batch["logp_old"])
-            adv = batch["advantages"]
-            surr = jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - hp.clip_eps, 1 + hp.clip_eps) * adv)
-            pi_loss = -surr.mean()
-            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
-            entropy = -jnp.mean(
-                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
-            total = (pi_loss + hp.vf_coeff * vf_loss
-                     - hp.entropy_coeff * entropy)
-            return total, (pi_loss, vf_loss, entropy)
-
+    def _update_fn(self, params, opt_state, batch):
         (total, (pi_l, vf_l, ent)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            self._loss_with_aux, has_aux=True)(params, batch)
         updates, opt_state = self.opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, {

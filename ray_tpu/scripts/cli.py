@@ -185,6 +185,33 @@ def _cmd_job_submit(args) -> int:
     return 0 if status == JobStatus.SUCCEEDED else 1
 
 
+def _cmd_up(args) -> int:
+    import signal
+    import time as _time
+
+    from ray_tpu.autoscaler import launcher as _launcher
+
+    launcher = _launcher.up(args.config)
+    if args.validate:
+        # Smoke: provider built, head listening — then a clean down.
+        launcher.down()
+        print("cluster config validated; brought up and down "
+              "cleanly", flush=True)
+        return 0
+    stop = False
+
+    def _sig(_s, _f):
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    while not stop:
+        _time.sleep(0.5)
+    launcher.down()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="ray-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -225,6 +252,15 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("doctor", help="environment checks")
     p.set_defaults(fn=_cmd_doctor)
+
+    p = sub.add_parser(
+        "up", help="launch a cluster from a YAML/JSON config "
+                   "(reference: ray up, scripts.py:1293)")
+    p.add_argument("config", help="cluster config path")
+    p.add_argument("--validate", action="store_true",
+                   help="bring the cluster up, then immediately "
+                        "down (config smoke test)")
+    p.set_defaults(fn=_cmd_up)
 
     pjob = sub.add_parser("job", help="job submission")
     jsub = pjob.add_subparsers(dest="jobcmd", required=True)

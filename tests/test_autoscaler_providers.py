@@ -1,0 +1,140 @@
+"""Cloud/TPU-slice provider + cluster launcher (reference:
+python/ray/autoscaler/_private/gcp provider pattern, fake_multi_node
+end-to-end pattern, ray up scripts.py:1293)."""
+
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.gce_tpu import GceTpuConfig, GceTpuNodeProvider
+
+
+class MockRunner:
+    """MockProcessRunner analog: records gcloud invocations."""
+
+    def __init__(self):
+        self.calls: list[list[str]] = []
+        self.list_response = "[]"
+
+    def run(self, cmd, timeout=300.0):
+        self.calls.append(list(cmd))
+        if "list" in cmd:
+            return self.list_response
+        return ""
+
+    def joined(self):
+        return [" ".join(c) for c in self.calls]
+
+
+def test_gce_tpu_provider_drives_gcloud():
+    runner = MockRunner()
+    p = GceTpuNodeProvider(GceTpuConfig(
+        project="proj", zone="us-central2-b",
+        accelerator_types={"v5e_16": "v5e-16"},
+        head_address="10.0.0.2:6380"), runner=runner)
+
+    nid = p.create_node("v5e_16", {"CPU": 8, "TPU": 16})
+    cmds = runner.joined()
+    create = next(c for c in cmds if " create " in f" {c} ")
+    assert "--accelerator-type v5e-16" in create
+    assert "--project proj" in create and "--zone us-central2-b" \
+        in create
+    # Bootstrap: worker 0 gets the gang resource; daemon dials head.
+    ssh0 = next(c for c in cmds if "--worker 0" in c)
+    assert "TPU-v5e-16-head" in ssh0
+    assert "node_daemon --address 10.0.0.2:6380" in ssh0
+    assert len(p.non_terminated_nodes()) == 1
+
+    p.terminate_node(nid)
+    assert any(" delete " in f" {c} " for c in runner.joined())
+    assert p.non_terminated_nodes() == []
+
+
+def test_gce_tpu_provider_refresh_recovers_state():
+    runner = MockRunner()
+    cfg = GceTpuConfig(project="p", zone="z",
+                       accelerator_types={"v5e_8": "v5e-8"})
+    p = GceTpuNodeProvider(cfg, runner=runner)
+    runner.list_response = json.dumps([
+        {"name": "projects/p/locations/z/nodes/raytpu-v5e_8-abc123"},
+        {"name": "projects/p/locations/z/nodes/unrelated-vm"},
+    ])
+    p.refresh()
+    nodes = p.non_terminated_nodes()
+    assert [n.node_id for n in nodes] == ["raytpu-v5e_8-abc123"]
+    assert nodes[0].node_type == "v5e_8"
+    runner.list_response = "[]"
+    p.refresh()
+    assert p.non_terminated_nodes() == []
+
+
+def test_unknown_node_type_rejected():
+    p = GceTpuNodeProvider(GceTpuConfig(
+        project="p", zone="z"), runner=MockRunner())
+    with pytest.raises(ValueError):
+        p.create_node("nope", {})
+
+
+@pytest.mark.slow
+def test_launcher_up_scales_real_daemons_on_demand(tmp_path):
+    """End to end: `up` with the fake provider (REAL node-daemon
+    processes), demand appears, the autoscaler launches a daemon,
+    the task runs on it, idle nodes are reaped (reference:
+    fake_multi_node autoscaler e2e)."""
+    from ray_tpu.autoscaler import launcher as L
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = {
+        "cluster_name": "t",
+        "provider": {"type": "fake"},
+        "head": {"port": port, "num_cpus": 0},
+        "node_types": {
+            "cpu": {"resources": {"CPU": 2}, "min_workers": 0,
+                    "max_workers": 3},
+        },
+        "idle_timeout_s": 2.0,
+        "update_interval_s": 0.2,
+    }
+    path = tmp_path / "cluster.json"
+    path.write_text(json.dumps(cfg))
+
+    # Pooled workers pin their node as busy until the worker idle TTL
+    # reaps them; shorten it so scale-down happens inside the test.
+    import os
+    os.environ["RAY_TPU_IDLE_WORKER_TTL_S"] = "1.5"
+    import ray_tpu.core.config as ccfg
+    ccfg._global = None
+
+    launcher = L.up(str(path))
+    try:
+        # `up` installed the head runtime in this process — drive it
+        # directly (a remote client would attach via
+        # init(address=..., cluster_token=...)).
+
+        @ray_tpu.remote(num_cpus=1)
+        def work(x):
+            return x * 2
+
+        # Head has 0 CPUs: this demand can only be met by a launched
+        # worker node.
+        assert ray_tpu.get(work.remote(21), timeout=120) == 42
+        assert launcher.autoscaler.launched_total >= 1
+
+        # Idle: the worker is reaped back to min_workers=0.
+        deadline = time.time() + 30
+        while (launcher.autoscaler.provider.non_terminated_nodes()
+               and time.time() < deadline):
+            time.sleep(0.3)
+        assert not launcher.autoscaler.provider.non_terminated_nodes()
+    finally:
+        launcher.down()
+        import ray_tpu.core.api as api
+        api._runtime = None     # head runtime torn down by launcher
+        os.environ.pop("RAY_TPU_IDLE_WORKER_TTL_S", None)
+        ccfg._global = None

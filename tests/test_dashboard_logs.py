@@ -135,3 +135,47 @@ def test_cli_logs_subcommand(rt):
         [sys.executable, "-m", "ray_tpu.scripts.cli", "logs", first],
         capture_output=True, text=True, timeout=60)
     assert out2.returncode == 0
+
+
+def test_node_agent_reports_reach_dashboard():
+    """Per-node agent (reference: dashboard/agent.py + reporter
+    module): daemons push /proc samples over the node channel; the
+    dashboard serves them plus a self-sample for the head."""
+    import json
+    import time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dashboard.head import start_dashboard
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        nb = cluster.add_node(num_cpus=1)
+        rt = ray_tpu.core.api.get_runtime()
+        dash = start_dashboard(port=0, runtime=rt)
+        try:
+            deadline = time.time() + 30
+            stats = {}
+            while time.time() < deadline:
+                with urllib.request.urlopen(
+                        dash.url + "/api/agents", timeout=10) as r:
+                    stats = json.loads(r.read())
+                if nb.node_id in stats:
+                    break
+                time.sleep(0.3)
+            assert nb.node_id in stats, stats.keys()
+            row = stats[nb.node_id]
+            assert row["mem_total"] > 0
+            assert row["pid"] == nb.proc.pid
+            assert "head" in stats            # head self-sample
+            # The HTML index renders the node table.
+            with urllib.request.urlopen(dash.url + "/",
+                                        timeout=10) as r:
+                html = r.read().decode()
+            assert "Nodes" in html and nb.node_id in html
+        finally:
+            dash.stop()
+    finally:
+        cluster.shutdown()
