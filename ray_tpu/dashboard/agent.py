@@ -12,13 +12,18 @@ import time
 
 
 def _read_proc_stat() -> tuple[float, float]:
-    """(busy_jiffies, total_jiffies) summed over all cpus."""
-    with open("/proc/stat") as f:
-        for line in f:
-            if line.startswith("cpu "):
-                vals = [float(v) for v in line.split()[1:]]
-                idle = vals[3] + (vals[4] if len(vals) > 4 else 0.0)
-                return sum(vals) - idle, sum(vals)
+    """(busy_jiffies, total_jiffies) summed over all cpus; zeros on
+    hosts without /proc."""
+    try:
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("cpu "):
+                    vals = [float(v) for v in line.split()[1:]]
+                    idle = vals[3] + (vals[4] if len(vals) > 4
+                                      else 0.0)
+                    return sum(vals) - idle, sum(vals)
+    except OSError:
+        pass
     return 0.0, 0.0
 
 

@@ -69,12 +69,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # Per-node agent samples (reference: the reporter
                 # module feeding dashboard node cards). The head node
                 # samples itself on demand.
-                stats = dict(getattr(rt, "_agent_stats", {}))
-                if self.head_agent is not None:
-                    head_row = self.head_agent.sample()
-                    head_row["node_id"] = "head"
-                    stats["head"] = head_row
-                self._send_json(stats)
+                self._send_json(self._agent_stats())
             elif path == "/api/timeline":
                 self._send_json(rt.timeline())
             elif path == "/api/spans":
@@ -90,12 +85,18 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send(500, json.dumps({"error": str(e)}).encode())
 
-    def _node_rows(self) -> str:
+    def _agent_stats(self) -> dict:
+        """Daemon-reported samples + an on-demand head self-sample
+        (one merge for both the JSON API and the HTML table)."""
         stats = dict(getattr(self.runtime, "_agent_stats", {}))
         if self.head_agent is not None:
             row = self.head_agent.sample()
             row["node_id"] = "head"
             stats["head"] = row
+        return stats
+
+    def _node_rows(self) -> str:
+        stats = self._agent_stats()
         gb = 1024 ** 3
         return "".join(
             f"<tr><td>{nid}</td><td>{s.get('cpu_percent', 0)}</td>"

@@ -112,6 +112,12 @@ class LearnerGroup:
     def update(self, batch: dict) -> list[dict]:
         n = self.num_learners
         size = len(next(iter(batch.values())))
+        if size < n:
+            # An empty shard means NaN means over zero rows, and the
+            # allreduce would poison EVERY replica with them.
+            raise ValueError(
+                f"batch of {size} rows cannot shard across {n} "
+                f"learners")
         per = size // n
         shards = []
         for i in range(n):

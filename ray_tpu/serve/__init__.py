@@ -9,6 +9,7 @@ model; batching (@serve.batch) aggregates requests into one device
 program call.
 """
 
+from ray_tpu.serve.asgi import ingress
 from ray_tpu.serve.api import (
     deployment,
     run,
@@ -26,6 +27,7 @@ from ray_tpu.serve.multiplex import (
 )
 
 __all__ = [
+    "ingress",
     "deployment", "run", "shutdown", "get_deployment_handle", "batch",
     "Application", "Deployment", "DeploymentHandle",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",

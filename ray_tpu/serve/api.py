@@ -178,6 +178,9 @@ def run(app: Application, *, route_prefix: str = "/",
         if replicas:
             break
         time.sleep(0.1)
+    from ray_tpu.serve.asgi import ASGI_MARKER
+    is_asgi = bool(getattr(app.deployment.cls, ASGI_MARKER, False))
+    route_entry = {"name": name, "asgi": is_asgi}
     if http_port is not None:
         if _proxy is None or _proxy_port != http_port:
             from ray_tpu.serve.proxy import ProxyActor
@@ -185,7 +188,7 @@ def run(app: Application, *, route_prefix: str = "/",
                 num_cpus=0, max_concurrency=32).remote(http_port)
             _proxy_port = http_port
             ray_tpu.get(_proxy.ready.remote(), timeout=30)
-        routes = {route_prefix: name}
+        routes = {route_prefix: route_entry}
         ray_tpu.get(_proxy.set_routes.remote(routes))
     if grpc_port is not None:
         # gRPC ingress (reference: gRPCProxy, proxy.py:545) sharing

@@ -1,0 +1,152 @@
+"""ASGI app mounting (reference: serve.ingress + HTTPProxy's ASGI
+path, serve/_private/proxy.py:766 and api.py ingress decorator).
+
+``@serve.deployment`` + ``@serve.ingress(app)`` mounts ANY ASGI-3
+application (FastAPI/Starlette when available — neither is required)
+behind the serve HTTP proxy: the proxy ships the raw request
+(method/path/headers/query/body) to the replica, which drives the
+ASGI app with a minimal in-replica ASGI driver and returns the
+status/headers/body. Routing, pow-2 replica choice, autoscaling and
+draining are untouched — ASGI is just a different replica callable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable
+
+ASGI_MARKER = "__serve_asgi__"
+
+
+async def run_asgi(app, request: dict) -> dict:
+    """Drive one HTTP request through an ASGI-3 app."""
+    body = request.get("body") or b""
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.get("method", "GET"),
+        "scheme": "http",
+        "path": request.get("path", "/"),
+        "raw_path": request.get("path", "/").encode(),
+        "query_string": request.get("query_string", b"") or b"",
+        "root_path": request.get("root_path", ""),
+        "headers": [(k.lower().encode() if isinstance(k, str) else k,
+                     v.encode() if isinstance(v, str) else v)
+                    for k, v in request.get("headers", [])],
+        "client": ("127.0.0.1", 0),
+        "server": ("127.0.0.1", 80),
+    }
+    sent_body = False
+    out = {"status": 500, "headers": [], "body": b""}
+    chunks: list[bytes] = []
+
+    async def receive():
+        nonlocal sent_body
+        if sent_body:
+            return {"type": "http.disconnect"}
+        sent_body = True
+        return {"type": "http.request", "body": body,
+                "more_body": False}
+
+    async def send(message):
+        if message["type"] == "http.response.start":
+            out["status"] = message["status"]
+            out["headers"] = [
+                (k.decode() if isinstance(k, bytes) else k,
+                 v.decode() if isinstance(v, bytes) else v)
+                for k, v in message.get("headers", [])]
+        elif message["type"] == "http.response.body":
+            chunks.append(bytes(message.get("body", b"")))
+
+    await app(scope, receive, send)
+    out["body"] = b"".join(chunks)
+    return out
+
+
+async def run_lifespan(app, phase: str) -> bool:
+    """Best-effort lifespan startup/shutdown. Returns True when the
+    app completed the phase (apps that don't speak the protocol raise
+    on the lifespan scope immediately — no timeout stall)."""
+    done = asyncio.Event()
+
+    async def receive():
+        return {"type": f"lifespan.{phase}"}
+
+    async def send(message):
+        if message["type"].startswith(f"lifespan.{phase}"):
+            done.set()
+
+    task = asyncio.ensure_future(
+        app({"type": "lifespan", "asgi": {"version": "3.0"}},
+            receive, send))
+    waiter = asyncio.ensure_future(done.wait())
+    try:
+        # Race the app against phase completion: an app that rejects
+        # the lifespan scope finishes (with an exception) instantly
+        # instead of stalling a 10s timeout.
+        await asyncio.wait({task, waiter},
+                           return_when=asyncio.FIRST_COMPLETED,
+                           timeout=10)
+        ok = done.is_set()
+    finally:
+        for t in (task, waiter):
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+    return ok
+
+
+def ingress(app_or_factory) -> Callable:
+    """Class decorator mounting an ASGI app on a deployment
+    (reference: serve.ingress). Accepts the app object itself or a
+    zero-arg factory (built once per replica)."""
+
+    def decorate(cls):
+        class ASGIWrapped(cls):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                app = app_or_factory
+                if not hasattr(app, "__call__"):
+                    raise TypeError("ingress() needs an ASGI app")
+                # Zero-arg factory vs app instance: an ASGI app
+                # called with () would TypeError, so probe the
+                # signature cheaply.
+                import inspect
+                try:
+                    sig = inspect.signature(app)
+                    is_factory = len(sig.parameters) == 0
+                except (TypeError, ValueError):
+                    is_factory = False
+                self._asgi_app = app() if is_factory else app
+                # Remember whether startup ran: ASGI forbids a bare
+                # shutdown message without a prior startup.
+                self._lifespan_ok = asyncio.run(
+                    run_lifespan(self._asgi_app, "startup"))
+
+            def __call__(self, request: Any):
+                if not (isinstance(request, dict)
+                        and request.get("__asgi__")):
+                    raise TypeError(
+                        "ASGI deployments take HTTP requests via the "
+                        "serve proxy (or a dict with '__asgi__': "
+                        "True)")
+                return asyncio.run(run_asgi(self._asgi_app, request))
+
+            def __del__(self):
+                if not getattr(self, "_lifespan_ok", False):
+                    return
+                try:
+                    asyncio.run(run_lifespan(self._asgi_app,
+                                             "shutdown"))
+                except Exception:  # noqa: BLE001
+                    pass
+
+        ASGIWrapped.__name__ = cls.__name__
+        ASGIWrapped.__qualname__ = cls.__qualname__
+        setattr(ASGIWrapped, ASGI_MARKER, True)
+        return ASGIWrapped
+
+    return decorate

@@ -75,18 +75,24 @@ class GRPCProxyActor:
                 self._controller, deployment)
         return self._routers[deployment]
 
+    @staticmethod
+    def _route_name(entry) -> str:
+        return entry["name"] if isinstance(entry, dict) else entry
+
     def _target_for(self, metadata: dict) -> str | None:
+        names = {p: self._route_name(e)
+                 for p, e in self.routes.items()}
         app = metadata.get("application")
         if app:
             # Accept either a deployment name or a route prefix.
-            if app in self.routes:
-                return self.routes[app]
-            if app in self.routes.values():
+            if app in names:
+                return names[app]
+            if app in names.values():
                 return app
             return None
-        if len(self.routes) == 1:
-            return next(iter(self.routes.values()))
-        return self.routes.get("/")
+        if len(names) == 1:
+            return next(iter(names.values()))
+        return names.get("/")
 
     def _serve_forever(self):
         import asyncio

@@ -52,16 +52,63 @@ class ProxyActor:
         async def handler(request: "web.Request"):
             path = request.path
             target = None
+            matched_prefix = "/"
             # longest-prefix route match
             for prefix in sorted(self.routes, key=len, reverse=True):
                 if path == prefix or path.startswith(
                         prefix.rstrip("/") + "/") or prefix == "/":
                     target = self.routes[prefix]
+                    matched_prefix = prefix
                     break
             if target is None:
                 return web.json_response(
                     {"error": f"no route for {path}"}, status=404)
+            # Route entries are {"name", "asgi"} dicts (legacy plain
+            # strings still accepted).
+            if isinstance(target, dict):
+                name, is_asgi = target["name"], target.get("asgi")
+            else:
+                name, is_asgi = target, False
             body = await request.read()
+            router = self._router_for(name)
+            loop = asyncio.get_running_loop()
+
+            if is_asgi:
+                # ASGI mount (reference: HTTPProxy ASGI path,
+                # proxy.py:766): ship the raw request; the replica
+                # drives the app and returns status/headers/body.
+                sub = path[len(matched_prefix.rstrip("/")):] or "/"
+                asgi_req = {
+                    "__asgi__": True,
+                    "method": request.method,
+                    "path": sub,
+                    "root_path": matched_prefix.rstrip("/"),
+                    "query_string":
+                        request.query_string.encode(),
+                    "headers": [(k, v) for k, v
+                                in request.headers.items()],
+                    "body": body,
+                }
+
+                def call_asgi():
+                    ref = router.assign("__call__", (asgi_req,), {})
+                    return ray_tpu.get(ref, timeout=120)
+
+                try:
+                    out = await loop.run_in_executor(None, call_asgi)
+                except Exception as e:  # noqa: BLE001
+                    return web.json_response(
+                        {"error": str(e)[:500]}, status=500)
+                resp = web.Response(status=out.get("status", 200),
+                                    body=out.get("body", b""))
+                for k, v in out.get("headers", []):
+                    if k.lower() not in ("content-length",
+                                         "transfer-encoding"):
+                        # add(), not assignment: duplicate headers
+                        # (multiple Set-Cookie) must all survive.
+                        resp.headers.add(k, v)
+                return resp
+
             if body:
                 try:
                     payload = json.loads(body)
@@ -69,8 +116,6 @@ class ProxyActor:
                     payload = body.decode("utf-8", "replace")
             else:
                 payload = dict(request.query)
-            router = self._router_for(target)
-            loop = asyncio.get_running_loop()
 
             def call():
                 ref = router.assign("__call__", (payload,), {})
