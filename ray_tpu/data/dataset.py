@@ -311,7 +311,8 @@ class Dataset:
         if max_in_flight is None:
             from ray_tpu.data.context import DataContext
             max_in_flight = DataContext.get_current().max_in_flight
-        stages = _split_stages(self._plan)
+        from ray_tpu.data.optimizer import optimize
+        stages = _split_stages(optimize(self._plan))
         refs = None
 
         # Bind stage payloads BY VALUE: these generators evaluate

@@ -1,0 +1,103 @@
+"""Rule-based logical-plan optimizer.
+
+Reference analog: python/ray/data/_internal/logical/optimizers.py:59
+— an ordered list of rewrite rules applied to the logical plan before
+physical planning. The fusion of transform chains into one task per
+block (the reference's biggest win) lives in the stage splitter;
+these rules normalize the plan ahead of it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ray_tpu.data.dataset import (
+    _Limit,
+    _MapRows,
+    _RandomShuffle,
+    _Repartition,
+)
+
+
+class Rule:
+    """One plan -> plan rewrite."""
+
+    def apply(self, plan: list) -> list:
+        raise NotImplementedError
+
+
+class MergeLimits(Rule):
+    """limit(a).limit(b) == limit(min(a, b)) — also across
+    row-count-preserving ops between them."""
+
+    def apply(self, plan: list) -> list:
+        out: list = []
+        for op in plan:
+            if isinstance(op, _Limit):
+                for prev in reversed(out):
+                    if isinstance(prev, _Limit):
+                        prev.n = min(prev.n, op.n)
+                        break
+                    if not isinstance(prev, _MapRows):
+                        out.append(op)
+                        break
+                else:
+                    out.append(op)
+                continue
+            out.append(op)
+        return out
+
+
+class LimitPushdown(Rule):
+    """Push limit BEFORE row-count-preserving transforms (map): the
+    truncated rows are never transformed (reference:
+    LimitPushdownRule)."""
+
+    def apply(self, plan: list) -> list:
+        out = list(plan)
+        changed = True
+        while changed:
+            changed = False
+            for i in range(1, len(out)):
+                if isinstance(out[i], _Limit) and isinstance(
+                        out[i - 1], _MapRows):
+                    out[i - 1], out[i] = out[i], out[i - 1]
+                    changed = True
+        return out
+
+
+class DropRedundantRepartition(Rule):
+    """repartition(a).repartition(b) == repartition(b); a shuffle
+    immediately followed by repartition keeps both (different
+    semantics), but back-to-back shuffles collapse to the LAST one
+    (each is a full row permutation)."""
+
+    def apply(self, plan: list) -> list:
+        out: list = []
+        for op in plan:
+            if out and isinstance(op, _Repartition) and isinstance(
+                    out[-1], _Repartition):
+                out[-1] = op
+                continue
+            if out and isinstance(op, _RandomShuffle) and isinstance(
+                    out[-1], _RandomShuffle):
+                out[-1] = op
+                continue
+            out.append(op)
+        return out
+
+
+DEFAULT_RULES: list[Callable[[], Rule]] = [
+    MergeLimits, LimitPushdown, DropRedundantRepartition,
+]
+
+
+def optimize(plan: list, rules=None) -> list:
+    import copy
+
+    # Rules mutate op fields (MergeLimits): operate on copies so the
+    # lazy Dataset's recorded plan is untouched and re-executable.
+    plan = [copy.copy(op) for op in plan]
+    for rule_cls in (rules or DEFAULT_RULES):
+        plan = rule_cls().apply(plan)
+    return plan

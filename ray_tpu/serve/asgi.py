@@ -52,9 +52,11 @@ async def run_asgi(app, request: dict) -> dict:
     async def send(message):
         if message["type"] == "http.response.start":
             out["status"] = message["status"]
+            # latin-1, per the HTTP/ASGI spec: header bytes are not
+            # necessarily valid UTF-8.
             out["headers"] = [
-                (k.decode() if isinstance(k, bytes) else k,
-                 v.decode() if isinstance(v, bytes) else v)
+                (k.decode("latin-1") if isinstance(k, bytes) else k,
+                 v.decode("latin-1") if isinstance(v, bytes) else v)
                 for k, v in message.get("headers", [])]
         elif message["type"] == "http.response.body":
             chunks.append(bytes(message.get("body", b"")))
@@ -64,39 +66,69 @@ async def run_asgi(app, request: dict) -> dict:
     return out
 
 
-async def run_lifespan(app, phase: str) -> bool:
-    """Best-effort lifespan startup/shutdown. Returns True when the
-    app completed the phase (apps that don't speak the protocol raise
-    on the lifespan scope immediately — no timeout stall)."""
-    done = asyncio.Event()
+class LifespanRunner:
+    """One long-lived lifespan invocation per replica, as the spec
+    requires: the SAME app coroutine receives startup, then (much
+    later) shutdown — per-phase invocations would make stateful apps
+    run their shutdown handlers right after startup."""
 
-    async def receive():
-        return {"type": f"lifespan.{phase}"}
+    def __init__(self, app):
+        import queue
+        import threading
 
-    async def send(message):
-        if message["type"].startswith(f"lifespan.{phase}"):
-            done.set()
+        self._app = app
+        self._to_app: "queue.Queue" = queue.Queue()
+        self._waiters: dict = {}
+        self._dead = threading.Event()
+        threading.Thread(target=self._thread_main, daemon=True,
+                         name="asgi_lifespan").start()
 
-    task = asyncio.ensure_future(
-        app({"type": "lifespan", "asgi": {"version": "3.0"}},
-            receive, send))
-    waiter = asyncio.ensure_future(done.wait())
-    try:
-        # Race the app against phase completion: an app that rejects
-        # the lifespan scope finishes (with an exception) instantly
-        # instead of stalling a 10s timeout.
-        await asyncio.wait({task, waiter},
-                           return_when=asyncio.FIRST_COMPLETED,
-                           timeout=10)
-        ok = done.is_set()
-    finally:
-        for t in (task, waiter):
-            t.cancel()
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-    return ok
+    def _thread_main(self) -> None:
+        try:
+            asyncio.run(self._main())
+        finally:
+            self._dead.set()
+            for ev, box in list(self._waiters.values()):
+                if not ev.is_set():
+                    box.append(False)
+                    ev.set()
+
+    async def _main(self) -> None:
+        loop = asyncio.get_running_loop()
+
+        async def receive():
+            return await loop.run_in_executor(None, self._to_app.get)
+
+        async def send(message):
+            t = message.get("type", "")
+            for phase in ("startup", "shutdown"):
+                if t.startswith(f"lifespan.{phase}."):
+                    entry = self._waiters.get(phase)
+                    if entry is not None:
+                        ev, box = entry
+                        box.append(t == f"lifespan.{phase}.complete")
+                        ev.set()
+
+        await self._app({"type": "lifespan",
+                         "asgi": {"version": "3.0",
+                                  "spec_version": "2.0"}},
+                        receive, send)
+
+    def phase(self, name: str, timeout: float = 10.0) -> bool:
+        """Run one lifespan phase; False = failed or unsupported
+        (an app that rejects the lifespan scope dies instantly, so
+        there is no timeout stall)."""
+        import threading
+
+        if self._dead.is_set():
+            return False
+        ev = threading.Event()
+        box: list = []
+        self._waiters[name] = (ev, box)
+        self._to_app.put({"type": f"lifespan.{name}"})
+        if not ev.wait(timeout):
+            return False
+        return bool(box and box[0])
 
 
 def ingress(app_or_factory) -> Callable:
@@ -123,8 +155,8 @@ def ingress(app_or_factory) -> Callable:
                 self._asgi_app = app() if is_factory else app
                 # Remember whether startup ran: ASGI forbids a bare
                 # shutdown message without a prior startup.
-                self._lifespan_ok = asyncio.run(
-                    run_lifespan(self._asgi_app, "startup"))
+                self._lifespan = LifespanRunner(self._asgi_app)
+                self._lifespan_ok = self._lifespan.phase("startup")
 
             def __call__(self, request: Any):
                 if not (isinstance(request, dict)
@@ -139,8 +171,7 @@ def ingress(app_or_factory) -> Callable:
                 if not getattr(self, "_lifespan_ok", False):
                     return
                 try:
-                    asyncio.run(run_lifespan(self._asgi_app,
-                                             "shutdown"))
+                    self._lifespan.phase("shutdown")
                 except Exception:  # noqa: BLE001
                     pass
 

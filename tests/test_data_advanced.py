@@ -162,3 +162,44 @@ def test_random_shuffle_is_all_to_all(rt):
     again = [r["id"] for r in ds.random_shuffle(seed=3).take_all()]
     first = [r["id"] for r in shuffled.take_all()]
     assert again == first
+
+
+def test_optimizer_rules():
+    """Rule-based logical optimizer (reference:
+    logical/optimizers.py:59): limit merge + pushdown, redundant
+    repartition/shuffle elimination — and the recorded lazy plan is
+    untouched (datasets stay re-executable)."""
+    from ray_tpu.data.dataset import (
+        _Limit, _MapRows, _RandomShuffle, _Repartition, _Source,
+    )
+    from ray_tpu.data.optimizer import optimize
+
+    f = lambda r: r                                   # noqa: E731
+    plan = [_Source([lambda: None]), _MapRows(f), _Limit(100),
+            _MapRows(f), _Limit(10),
+            _Repartition(4), _Repartition(8),
+            _RandomShuffle(0), _RandomShuffle(1)]
+    out = optimize(plan)
+    # limits merged to min(100, 10)=10 and pushed before both maps
+    limits = [op for op in out if isinstance(op, _Limit)]
+    assert [op.n for op in limits] == [10]
+    assert isinstance(out[1], _Limit)          # before the maps
+    reps = [op for op in out if isinstance(op, _Repartition)]
+    assert [op.num_blocks for op in reps] == [8]
+    shuffles = [op for op in out if isinstance(op, _RandomShuffle)]
+    assert [op.seed for op in shuffles] == [1]
+    # source plan unmutated
+    assert [op.n for op in plan if isinstance(op, _Limit)] \
+        == [100, 10]
+
+
+def test_optimized_pipeline_matches_unoptimized(rt):
+    from ray_tpu import data as rdata
+    ds = (rdata.range(50, parallelism=5)
+          .map(lambda r: {"id": r["id"] + 1})
+          .limit(30)
+          .map(lambda r: {"id": r["id"] * 2})
+          .limit(12))
+    out = sorted(r["id"] for r in ds.take_all())
+    assert len(out) == 12
+    assert all(v % 2 == 0 for v in out)

@@ -212,3 +212,45 @@ def test_asgi_ingress_mounts_app(rt):
             assert e.read() == b"short and stout"
     finally:
         serve.shutdown()
+
+
+def test_asgi_lifespan_protocol():
+    """One long-lived lifespan invocation per replica: startup and
+    shutdown reach the SAME app coroutine in order; a failed startup
+    reports False; a lifespan-less app fails fast without stalls."""
+    import time
+
+    from ray_tpu.serve.asgi import LifespanRunner
+
+    events = []
+
+    async def app(scope, receive, send):
+        assert scope["type"] == "lifespan"
+        msg = await receive()
+        assert msg["type"] == "lifespan.startup"
+        events.append("startup")
+        await send({"type": "lifespan.startup.complete"})
+        msg = await receive()
+        assert msg["type"] == "lifespan.shutdown"
+        events.append("shutdown")
+        await send({"type": "lifespan.shutdown.complete"})
+
+    r = LifespanRunner(app)
+    assert r.phase("startup") is True
+    assert events == ["startup"]       # no premature shutdown
+    assert r.phase("shutdown") is True
+    assert events == ["startup", "shutdown"]
+
+    async def failing(scope, receive, send):
+        await receive()
+        await send({"type": "lifespan.startup.failed",
+                    "message": "db down"})
+
+    assert LifespanRunner(failing).phase("startup") is False
+
+    async def no_lifespan(scope, receive, send):
+        raise AssertionError("http only")
+
+    t0 = time.time()
+    assert LifespanRunner(no_lifespan).phase("startup") is False
+    assert time.time() - t0 < 2.0      # fails fast, no 10s stall
