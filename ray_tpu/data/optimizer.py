@@ -80,7 +80,11 @@ class DropRedundantRepartition(Rule):
                 out[-1] = op
                 continue
             if out and isinstance(op, _RandomShuffle) and isinstance(
-                    out[-1], _RandomShuffle):
+                    out[-1], _RandomShuffle) \
+                    and out[-1].seed is None:
+                # Only collapse an UNSEEDED earlier shuffle: seeded
+                # pipelines promise a deterministic row order, and
+                # P1(P0(X)) != P1(X) concretely.
                 out[-1] = op
                 continue
             out.append(op)

@@ -67,10 +67,15 @@ async def run_asgi(app, request: dict) -> dict:
 
 
 class LifespanRunner:
-    """One long-lived lifespan invocation per replica, as the spec
-    requires: the SAME app coroutine receives startup, then (much
-    later) shutdown — per-phase invocations would make stateful apps
-    run their shutdown handlers right after startup."""
+    """One persistent event loop per replica serving BOTH the
+    long-lived lifespan invocation and every request coroutine.
+
+    The spec requires the SAME app coroutine to receive startup and,
+    much later, shutdown (per-phase invocations make stateful apps
+    run shutdown handlers right after startup). Requests must run on
+    the SAME loop: resources a startup handler binds to its loop
+    (async clients, db pools) would raise 'attached to a different
+    event loop' from any other one."""
 
     def __init__(self, app):
         import queue
@@ -79,21 +84,39 @@ class LifespanRunner:
         self._app = app
         self._to_app: "queue.Queue" = queue.Queue()
         self._waiters: dict = {}
-        self._dead = threading.Event()
+        self._lifespan_done = threading.Event()
+        self._loop_ready = threading.Event()
+        self._loop = None
         threading.Thread(target=self._thread_main, daemon=True,
-                         name="asgi_lifespan").start()
+                         name="asgi_app_loop").start()
 
     def _thread_main(self) -> None:
-        try:
-            asyncio.run(self._main())
-        finally:
-            self._dead.set()
-            for ev, box in list(self._waiters.values()):
-                if not ev.is_set():
-                    box.append(False)
-                    ev.set()
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
 
-    async def _main(self) -> None:
+        def _start():
+            task = loop.create_task(self._lifespan_main())
+            task.add_done_callback(self._on_lifespan_done)
+
+        loop.call_soon(_start)
+        self._loop_ready.set()
+        loop.run_forever()
+
+    def _on_lifespan_done(self, task) -> None:
+        # Retrieve the exception: a lifespan-less app REJECTS the
+        # scope by raising — normal per spec, not stderr noise.
+        try:
+            task.exception()
+        except asyncio.CancelledError:
+            pass
+        self._lifespan_done.set()
+        for ev, box in list(self._waiters.values()):
+            if not ev.is_set():
+                box.append(False)
+                ev.set()
+
+    async def _lifespan_main(self) -> None:
         loop = asyncio.get_running_loop()
 
         async def receive():
@@ -115,20 +138,35 @@ class LifespanRunner:
                         receive, send)
 
     def phase(self, name: str, timeout: float = 10.0) -> bool:
-        """Run one lifespan phase; False = failed or unsupported
-        (an app that rejects the lifespan scope dies instantly, so
-        there is no timeout stall)."""
+        """Run one lifespan phase; False = failed or unsupported."""
         import threading
 
-        if self._dead.is_set():
-            return False
         ev = threading.Event()
         box: list = []
+        # Register FIRST, then check liveness: the done-callback
+        # snapshots waiters, so this ordering closes the window where
+        # the lifespan task exits between check and registration.
         self._waiters[name] = (ev, box)
+        if self._lifespan_done.is_set():
+            return False
         self._to_app.put({"type": f"lifespan.{name}"})
         if not ev.wait(timeout):
             return False
         return bool(box and box[0])
+
+    def run(self, coro, timeout: float | None = 120.0):
+        """Run a coroutine on the replica's persistent app loop."""
+        if not self._loop_ready.wait(10):
+            raise RuntimeError("ASGI app loop failed to start")
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass
 
 
 def ingress(app_or_factory) -> Callable:
@@ -165,13 +203,16 @@ def ingress(app_or_factory) -> Callable:
                         "ASGI deployments take HTTP requests via the "
                         "serve proxy (or a dict with '__asgi__': "
                         "True)")
-                return asyncio.run(run_asgi(self._asgi_app, request))
+                # Same loop as the lifespan coroutine: startup-bound
+                # async resources stay usable from handlers.
+                return self._lifespan.run(
+                    run_asgi(self._asgi_app, request))
 
             def __del__(self):
-                if not getattr(self, "_lifespan_ok", False):
-                    return
                 try:
-                    self._lifespan.phase("shutdown")
+                    if getattr(self, "_lifespan_ok", False):
+                        self._lifespan.phase("shutdown")
+                    self._lifespan.stop()
                 except Exception:  # noqa: BLE001
                     pass
 

@@ -1,0 +1,104 @@
+"""inspect_serializability: explain WHY an object fails to pickle.
+
+Reference analog: python/ray/util/check_serialize.py — walks the
+object graph (closures, attributes, containers) and reports the leaf
+objects that cloudpickle cannot handle, instead of surfacing one
+opaque error from deep inside a task submission.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class FailureTuple:
+    obj: Any
+    name: str
+    parent: str
+
+
+@dataclass
+class SerializationReport:
+    serializable: bool
+    failures: list[FailureTuple] = field(default_factory=list)
+
+    def __str__(self) -> str:
+        if self.serializable:
+            return "serializable: yes"
+        lines = ["serializable: NO — offending members:"]
+        for f in self.failures:
+            lines.append(f"  {f.parent} -> {f.name}: "
+                         f"{type(f.obj).__name__} ({f.obj!r:.80})")
+        return "\n".join(lines)
+
+
+def _try_pickle(obj) -> bool:
+    import cloudpickle
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def inspect_serializability(obj, name: str | None = None,
+                            depth: int = 3,
+                            _parent: str = "<root>",
+                            _seen: set | None = None
+                            ) -> SerializationReport:
+    """Check cloudpickle-ability and localize failures to the
+    offending closure cells / attributes / container items."""
+    name = name or getattr(obj, "__name__", type(obj).__name__)
+    seen = _seen if _seen is not None else set()
+    if id(obj) in seen:
+        return SerializationReport(True)
+    seen.add(id(obj))
+
+    if _try_pickle(obj):
+        return SerializationReport(True)
+    report = SerializationReport(False)
+    if depth <= 0:
+        report.failures.append(FailureTuple(obj, name, _parent))
+        return report
+
+    children: list[tuple[str, Any]] = []
+    if inspect.isfunction(obj):
+        if obj.__closure__:
+            names = obj.__code__.co_freevars
+            for nm, cell in zip(names, obj.__closure__):
+                try:
+                    children.append((f"closure:{nm}",
+                                     cell.cell_contents))
+                except ValueError:
+                    continue
+        children.extend(("global:" + k, v)
+                        for k, v in (obj.__globals__ or {}).items()
+                        if k in obj.__code__.co_names
+                        and not _try_pickle(v))
+    elif isinstance(obj, dict):
+        children.extend((f"[{k!r}]", v) for k, v in obj.items())
+    elif isinstance(obj, (list, tuple, set)):
+        children.extend((f"[{i}]", v) for i, v in enumerate(obj))
+    elif hasattr(obj, "__dict__"):
+        children.extend(("." + k, v)
+                        for k, v in vars(obj).items())
+
+    found = False
+    for child_name, child in children:
+        if not _try_pickle(child):
+            found = True
+            sub = inspect_serializability(
+                child, child_name, depth - 1,
+                _parent=f"{_parent}.{name}", _seen=seen)
+            if sub.failures:
+                report.failures.extend(sub.failures)
+            else:
+                report.failures.append(
+                    FailureTuple(child, child_name,
+                                 f"{_parent}.{name}"))
+    if not found:
+        report.failures.append(FailureTuple(obj, name, _parent))
+    return report

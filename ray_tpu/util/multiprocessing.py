@@ -1,0 +1,210 @@
+"""multiprocessing.Pool API over ray_tpu actors.
+
+Reference analog: python/ray/util/multiprocessing/ — a drop-in
+``Pool`` whose workers are actors, so ``pool.map`` scales past one
+host and survives in the same resource/scheduling world as everything
+else. Supported surface: apply/apply_async, map/map_async,
+imap/imap_unordered, starmap/starmap_async, context manager,
+close/terminate/join.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    def __init__(self, initializer=None, initargs: tuple = ()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run(self, fn, args, kwargs):
+        return fn(*args, **(kwargs or {}))
+
+    def run_chunk(self, fn, chunk, star: bool):
+        if star:
+            return [fn(*a) for a in chunk]
+        return [fn(a) for a in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs, collect, callback=None,
+                 error_callback=None):
+        self._refs = refs
+        self._collect = collect
+        if callback is not None or error_callback is not None:
+            # stdlib-Pool semantics (and what joblib relies on): the
+            # callback fires with the result when it completes.
+            import threading
+
+            def waiter():
+                try:
+                    out = self.get()
+                except Exception as e:  # noqa: BLE001
+                    if error_callback is not None:
+                        error_callback(e)
+                    return
+                if callback is not None:
+                    callback(out)
+
+            threading.Thread(target=waiter, daemon=True).start()
+
+    def get(self, timeout: float | None = None):
+        return self._collect(
+            ray_tpu.get(self._refs, timeout=timeout))
+
+    def wait(self, timeout: float | None = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs,
+                               num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result not ready")
+        try:
+            ray_tpu.get(self._refs, timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    def __init__(self, processes: int | None = None,
+                 initializer: Callable | None = None,
+                 initargs: tuple = (), *, num_cpus_per_worker: float = 1):
+        if processes is None:
+            import os
+            processes = max(1, os.cpu_count() or 1)
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._workers = [
+            _PoolWorker.options(num_cpus=num_cpus_per_worker).remote(
+                initializer, initargs)
+            for _ in range(processes)
+        ]
+        self._rr = itertools.count()
+        self._closed = False
+        # In-flight refs: join() must wait for submitted work before
+        # tearing workers down (stdlib close()+join() semantics).
+        self._inflight: list = []
+
+    # -- helpers -------------------------------------------------------
+
+    def _worker(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+        return self._workers[next(self._rr) % len(self._workers)]
+
+    def _chunks(self, iterable: Iterable, chunksize: int | None):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (4 * len(self._workers))
+                            or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _track(self, refs: list) -> list:
+        self._inflight = [r for r in self._inflight
+                          if ray_tpu.wait([r], timeout=0)[1]]
+        self._inflight.extend(refs)
+        return refs
+
+    def _map_refs(self, fn, iterable, chunksize, star: bool):
+        if self._closed or not self._workers:
+            raise ValueError("Pool not running")
+        return self._track(
+            [self._worker().run_chunk.remote(fn, chunk, star)
+             for chunk in self._chunks(iterable, chunksize)])
+
+    @staticmethod
+    def _flatten(chunks: list[list]) -> list:
+        return [x for c in chunks for x in c]
+
+    # -- API -----------------------------------------------------------
+
+    def apply(self, fn, args: tuple = (), kwds: dict | None = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args: tuple = (),
+                    kwds: dict | None = None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        ref = self._worker().run.remote(fn, args, kwds)
+        self._track([ref])
+        return AsyncResult([ref], lambda outs: outs[0],
+                           callback=callback,
+                           error_callback=error_callback)
+
+    def map(self, fn, iterable: Iterable,
+            chunksize: int | None = None) -> list:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable: Iterable,
+                  chunksize: int | None = None) -> AsyncResult:
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+        return AsyncResult(refs, self._flatten)
+
+    def starmap(self, fn, iterable: Iterable,
+                chunksize: int | None = None) -> list:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable: Iterable,
+                      chunksize: int | None = None) -> AsyncResult:
+        refs = self._map_refs(fn, iterable, chunksize, star=True)
+        return AsyncResult(refs, self._flatten)
+
+    def imap(self, fn, iterable: Iterable,
+             chunksize: int | None = None):
+        """Ordered lazy iteration (chunk granularity)."""
+        for ref in self._map_refs(fn, iterable, chunksize,
+                                  star=False):
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn, iterable: Iterable,
+                       chunksize: int | None = None):
+        pending = self._map_refs(fn, iterable, chunksize, star=False)
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in done:
+                yield from ray_tpu.get(ref)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self._workers = []
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+        # Let submitted work finish (errors surface at .get, not
+        # here) before the workers die.
+        if self._inflight:
+            try:
+                ray_tpu.wait(self._inflight,
+                             num_returns=len(self._inflight))
+            except Exception:  # noqa: BLE001
+                pass
+        self.terminate()
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

@@ -178,7 +178,7 @@ def test_optimizer_rules():
     plan = [_Source([lambda: None]), _MapRows(f), _Limit(100),
             _MapRows(f), _Limit(10),
             _Repartition(4), _Repartition(8),
-            _RandomShuffle(0), _RandomShuffle(1)]
+            _RandomShuffle(None), _RandomShuffle(1)]
     out = optimize(plan)
     # limits merged to min(100, 10)=10 and pushed before both maps
     limits = [op for op in out if isinstance(op, _Limit)]
@@ -186,8 +186,15 @@ def test_optimizer_rules():
     assert isinstance(out[1], _Limit)          # before the maps
     reps = [op for op in out if isinstance(op, _Repartition)]
     assert [op.num_blocks for op in reps] == [8]
+    # unseeded earlier shuffle collapses into the later one...
     shuffles = [op for op in out if isinstance(op, _RandomShuffle)]
     assert [op.seed for op in shuffles] == [1]
+    # ...but SEEDED pipelines keep their deterministic double-shuffle
+    plan2 = [_Source([lambda: None]), _RandomShuffle(0),
+             _RandomShuffle(1)]
+    out2 = optimize(plan2)
+    assert [op.seed for op in out2
+            if isinstance(op, _RandomShuffle)] == [0, 1]
     # source plan unmutated
     assert [op.n for op in plan if isinstance(op, _Limit)] \
         == [100, 10]

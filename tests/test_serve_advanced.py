@@ -254,3 +254,54 @@ def test_asgi_lifespan_protocol():
     t0 = time.time()
     assert LifespanRunner(no_lifespan).phase("startup") is False
     assert time.time() - t0 < 2.0      # fails fast, no 10s stall
+
+
+def test_asgi_startup_resources_usable_in_requests(rt):
+    """Lifespan and requests share ONE persistent loop per replica:
+    async resources a startup handler binds to its loop (clients,
+    pools, asyncio primitives) must be usable from request handlers
+    without 'attached to a different event loop' errors."""
+    import socket
+    import urllib.request
+
+    from ray_tpu import serve
+
+    state = {}
+
+    async def app(scope, receive, send):
+        import asyncio as aio
+        if scope["type"] == "lifespan":
+            msg = await receive()
+            if msg["type"] == "lifespan.startup":
+                # Loop-bound resource created at startup.
+                state["lock"] = aio.Lock()
+                state["loop"] = aio.get_running_loop()
+                await send({"type": "lifespan.startup.complete"})
+                msg = await receive()
+                await send({"type": "lifespan.shutdown.complete"})
+            return
+        await receive()
+        # Using the startup-created, loop-bound primitive from a
+        # request handler — raises on a different loop.
+        async with state["lock"]:
+            same = aio.get_running_loop() is state["loop"]
+        body = b"same-loop" if same else b"DIFFERENT-loop"
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": body})
+
+    @serve.deployment(num_replicas=1)
+    @serve.ingress(app)
+    class LoopApp:
+        pass
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    serve.run(LoopApp.bind(), http_port=port)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=30) as r:
+            assert r.read() == b"same-loop"
+    finally:
+        serve.shutdown()

@@ -1,0 +1,85 @@
+"""ray.util extras: multiprocessing.Pool + inspect_serializability
+(reference: python/ray/util/multiprocessing/, check_serialize.py)."""
+
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _sq(x):
+    return x * x
+
+
+def _addmul(a, b):
+    return a + 10 * b
+
+
+def test_pool_map_and_starmap(rt):
+    with Pool(2) as p:
+        assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(_addmul, [(1, 2), (3, 4)]) == [21, 43]
+        assert p.apply(_addmul, (5, 6)) == 65
+
+
+def test_pool_async_and_imap(rt):
+    with Pool(2) as p:
+        r = p.map_async(_sq, range(6))
+        assert r.get(timeout=60) == [0, 1, 4, 9, 16, 25]
+        assert r.ready() and r.successful()
+        assert list(p.imap(_sq, range(5), chunksize=2)) \
+            == [0, 1, 4, 9, 16]
+        assert sorted(p.imap_unordered(_sq, range(5),
+                                       chunksize=2)) \
+            == [0, 1, 4, 9, 16]
+
+
+def test_pool_initializer_and_lifecycle(rt):
+    def init(v):
+        import os
+        os.environ["_POOL_INIT"] = str(v)
+
+    def read(_):
+        import os
+        return os.environ.get("_POOL_INIT")
+
+    p = Pool(2, initializer=init, initargs=(7,))
+    assert p.map(read, range(2)) == ["7", "7"]
+    p.close()
+    p.join()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+
+
+def test_inspect_serializability_localizes_failure():
+    lock = threading.Lock()
+
+    def bad():
+        return lock        # closure over an unpicklable lock
+
+    rep = inspect_serializability(bad)
+    assert not rep.serializable
+    assert any("closure:lock" == f.name for f in rep.failures), [
+        f.name for f in rep.failures]
+    assert "closure:lock" in str(rep)
+
+    def good(x):
+        return x + 1
+
+    assert inspect_serializability(good).serializable
+    rep2 = inspect_serializability({"a": 1, "b": threading.Lock()})
+    assert not rep2.serializable
+    assert any(f.name == "['b']" for f in rep2.failures)
+
+
+def test_joblib_backend(rt):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=2):
+        out = joblib.Parallel()(
+            joblib.delayed(_sq)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
