@@ -27,6 +27,36 @@ PLACEMENT_GROUP_ID_SIZE = 16
 _NIL_TASK = b"\xff" * TASK_ID_SIZE
 
 
+_rand = None
+_rand_lock = threading.Lock()
+
+
+def _reset_rand_after_fork() -> None:
+    # A forked child inherits the parent's PRNG state verbatim — it
+    # would mint byte-identical "unique" ids. Reseed lazily.
+    global _rand
+    _rand = None
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_rand_after_fork)
+
+
+def _fast_random_bytes(n: int) -> bytes:
+    """Cheap unique bytes for id minting: one urandom-seeded PRNG per
+    process instead of a syscall per id (ids only need collision
+    resistance, not cryptographic strength — the ~3 µs/urandom call
+    is measurable on the actor-call hot path). Locked: concurrent
+    getrandbits on one Random could repeat internal state, and a
+    duplicated task id would cross-wire results."""
+    global _rand
+    with _rand_lock:
+        if _rand is None:
+            import random
+            _rand = random.Random(os.urandom(16))
+        return _rand.getrandbits(8 * n).to_bytes(n, "little")
+
+
 class BaseID:
     """Immutable byte-string identifier."""
 
@@ -109,12 +139,12 @@ class TaskID(BaseID):
 
     @classmethod
     def for_normal_task(cls, job_id: JobID) -> "TaskID":
-        unique = os.urandom(TASK_ID_SIZE - JOB_ID_SIZE)
+        unique = _fast_random_bytes(TASK_ID_SIZE - JOB_ID_SIZE)
         return cls(unique + job_id.binary())
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
-        unique = os.urandom(TASK_ID_SIZE - ACTOR_ID_SIZE)
+        unique = _fast_random_bytes(TASK_ID_SIZE - ACTOR_ID_SIZE)
         return cls(unique + actor_id.binary())
 
     def job_id(self) -> JobID:

@@ -799,13 +799,20 @@ class DriverRuntime:
         self._preconsumed_order.append(nonce)
 
     def _delete_object(self, oid: ObjectID) -> None:
-        self.memory_store.delete(oid)
-        self.shm_store.delete(oid)
         self._lineage_release_return(oid)
         with self._obj_cv:
             loc = self._obj_locations.pop(oid, None)
-        with self._obj_cv:
             replica_nodes = self._obj_replicas.pop(oid, set())
+        # Target the store the location names — an unconditional
+        # native-store delete takes the arena's process-shared lock
+        # on EVERY small-object GC (the hot actor-call path).
+        if loc == "shm":
+            self.shm_store.delete(oid)
+        elif loc == "mem":
+            self.memory_store.delete(oid)
+        else:
+            self.memory_store.delete(oid)
+            self.shm_store.delete(oid)
         if isinstance(loc, tuple):
             self._node_objects.get(loc[1], set()).discard(oid)
             replica_nodes.add(loc[1])

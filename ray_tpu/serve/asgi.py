@@ -159,7 +159,13 @@ class LifespanRunner:
         if not self._loop_ready.wait(10):
             raise RuntimeError("ASGI app loop failed to start")
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
-        return fut.result(timeout)
+        try:
+            return fut.result(timeout)
+        except BaseException:
+            # Don't leave an abandoned coroutine running side
+            # effects on the shared loop after its request failed.
+            fut.cancel()
+            raise
 
     def stop(self) -> None:
         if self._loop is not None:

@@ -15,6 +15,17 @@ from typing import Any, Callable, Iterable
 
 import ray_tpu
 
+_cb_pool = None
+
+
+def _callback_pool():
+    global _cb_pool
+    if _cb_pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _cb_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="pool_callbacks")
+    return _cb_pool
+
 
 @ray_tpu.remote
 class _PoolWorker:
@@ -38,9 +49,10 @@ class AsyncResult:
         self._collect = collect
         if callback is not None or error_callback is not None:
             # stdlib-Pool semantics (and what joblib relies on): the
-            # callback fires with the result when it completes.
-            import threading
-
+            # callback fires with the result when it completes —
+            # multiplexed through ONE shared handler thread, like
+            # stdlib's _handle_results (a thread per result would
+            # pile up thousands under joblib).
             def waiter():
                 try:
                     out = self.get()
@@ -51,7 +63,7 @@ class AsyncResult:
                 if callback is not None:
                     callback(out)
 
-            threading.Thread(target=waiter, daemon=True).start()
+            _callback_pool().submit(waiter)
 
     def get(self, timeout: float | None = None):
         return self._collect(
@@ -113,8 +125,13 @@ class Pool:
                 for i in range(0, len(items), chunksize)]
 
     def _track(self, refs: list) -> list:
-        self._inflight = [r for r in self._inflight
-                          if ray_tpu.wait([r], timeout=0)[1]]
+        if self._inflight:
+            # One wait() pass splits done/pending (a per-ref call
+            # here would make dispatch quadratic).
+            _done, pending = ray_tpu.wait(
+                self._inflight, num_returns=len(self._inflight),
+                timeout=0)
+            self._inflight = list(pending)
         self._inflight.extend(refs)
         return refs
 
@@ -163,18 +180,29 @@ class Pool:
 
     def imap(self, fn, iterable: Iterable,
              chunksize: int | None = None):
-        """Ordered lazy iteration (chunk granularity)."""
-        for ref in self._map_refs(fn, iterable, chunksize,
-                                  star=False):
-            yield from ray_tpu.get(ref)
+        """Ordered iteration; dispatch is EAGER (stdlib semantics:
+        computation overlaps whatever the caller does between
+        imap() and iteration)."""
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+
+        def gen():
+            for ref in refs:
+                yield from ray_tpu.get(ref)
+
+        return gen()
 
     def imap_unordered(self, fn, iterable: Iterable,
                        chunksize: int | None = None):
-        pending = self._map_refs(fn, iterable, chunksize, star=False)
-        while pending:
-            done, pending = ray_tpu.wait(pending, num_returns=1)
-            for ref in done:
-                yield from ray_tpu.get(ref)
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+
+        def gen():
+            pending = refs
+            while pending:
+                done, pending = ray_tpu.wait(pending, num_returns=1)
+                for ref in done:
+                    yield from ray_tpu.get(ref)
+
+        return gen()
 
     # -- lifecycle -----------------------------------------------------
 
