@@ -33,9 +33,12 @@ _rand_lock = threading.Lock()
 
 def _reset_rand_after_fork() -> None:
     # A forked child inherits the parent's PRNG state verbatim — it
-    # would mint byte-identical "unique" ids. Reseed lazily.
-    global _rand
+    # would mint byte-identical "unique" ids. Reseed lazily. The
+    # lock is re-created too: a fork taken while another thread held
+    # it would leave the child's copy locked forever.
+    global _rand, _rand_lock
     _rand = None
+    _rand_lock = threading.Lock()
 
 
 if hasattr(os, "register_at_fork"):

@@ -15,16 +15,54 @@ from typing import Any, Callable, Iterable
 
 import ray_tpu
 
-_cb_pool = None
+class _CallbackWatcher:
+    """One daemon thread firing result callbacks in COMPLETION order
+    (stdlib Pool's _handle_results model): per-result waiter threads
+    would head-of-line block — under joblib, two slow batches would
+    stall dispatch of everything behind them."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._entries: dict = {}       # ref -> fire(ref)
+        self._thread = None
+
+    def add(self, refs: list, fire) -> None:
+        import threading
+
+        with self._lock:
+            for r in refs:
+                self._entries[r] = fire
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="pool_callback_watcher")
+                self._thread.start()
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                refs = list(self._entries)
+            if not refs:
+                self._wake.wait(1.0)
+                self._wake.clear()
+                continue
+            done, _rest = ray_tpu.wait(refs, num_returns=1,
+                                       timeout=0.5)
+            for ref in done:
+                with self._lock:
+                    fire = self._entries.pop(ref, None)
+                if fire is not None:
+                    try:
+                        fire(ref)
+                    except Exception:  # noqa: BLE001 — user callback
+                        pass
 
 
-def _callback_pool():
-    global _cb_pool
-    if _cb_pool is None:
-        from concurrent.futures import ThreadPoolExecutor
-        _cb_pool = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="pool_callbacks")
-    return _cb_pool
+_watcher = _CallbackWatcher()
 
 
 @ray_tpu.remote
@@ -49,13 +87,20 @@ class AsyncResult:
         self._collect = collect
         if callback is not None or error_callback is not None:
             # stdlib-Pool semantics (and what joblib relies on): the
-            # callback fires with the result when it completes —
-            # multiplexed through ONE shared handler thread, like
-            # stdlib's _handle_results (a thread per result would
-            # pile up thousands under joblib).
-            def waiter():
+            # callback fires when the LAST constituent ref completes,
+            # dispatched by the completion-ordered watcher.
+            import threading
+
+            remaining = [len(refs)]
+            rlock = threading.Lock()
+
+            def fire(_ref):
+                with rlock:
+                    remaining[0] -= 1
+                    if remaining[0] > 0:
+                        return
                 try:
-                    out = self.get()
+                    out = self.get(timeout=0)
                 except Exception as e:  # noqa: BLE001
                     if error_callback is not None:
                         error_callback(e)
@@ -63,7 +108,7 @@ class AsyncResult:
                 if callback is not None:
                     callback(out)
 
-            _callback_pool().submit(waiter)
+            _watcher.add(list(refs), fire)
 
     def get(self, timeout: float | None = None):
         return self._collect(

@@ -1,0 +1,78 @@
+"""Framework sub-package trainers (reference: python/ray/train/
+huggingface + sklearn sub-packages)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_sklearn_trainer(rt):
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu import data as rdata
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3)).astype(np.float64)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int64)
+    ds = rdata.from_numpy({"a": X[:, 0], "b": X[:, 1],
+                           "c": X[:, 2], "label": y})
+
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(), datasets={"train": ds},
+        label_column="label", cv=3)
+    result = trainer.fit()
+    assert result.metrics["n_samples"] == 200
+    assert result.metrics["cv_mean"] > 0.8
+    est = SklearnTrainer.get_estimator(result.checkpoint)
+    assert est.predict(np.array([[2.0, 1.0, 0.0]]))[0] == 1
+
+
+@pytest.mark.slow
+def test_transformers_trainer(rt, tmp_path):
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.huggingface import TransformersTrainer
+
+    def init_trainer(config):
+        import torch
+        from transformers import (
+            Trainer, TrainingArguments,
+        )
+
+        class TinyModel(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(4, 2)
+
+            def forward(self, x=None, labels=None):
+                logits = self.lin(x)
+                loss = torch.nn.functional.cross_entropy(
+                    logits, labels)
+                return {"loss": loss, "logits": logits}
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int64)
+        data = [{"x": X[i], "labels": int(y[i])}
+                for i in range(len(y))]
+        args = TrainingArguments(
+            output_dir=config["out"], num_train_epochs=2,
+            per_device_train_batch_size=16, logging_steps=2,
+            report_to=[], use_cpu=True, save_strategy="no")
+        return Trainer(model=TinyModel(), args=args,
+                       train_dataset=data)
+
+    trainer = TransformersTrainer(
+        init_trainer,
+        train_loop_config={"out": str(tmp_path / "hf"),
+                           "__ckpt_dir__": str(tmp_path)},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "store")))
+    result = trainer.fit()
+    assert "final_loss" in result.metrics
+    assert result.checkpoint is not None
