@@ -666,6 +666,9 @@ class DriverRuntime:
         # namespaced small-metadata store for libraries.
         self._kv: dict[tuple[str, bytes], bytes] = {}
         self._kv_lock = threading.Lock()
+        # Long-poll pubsub topics (reference: src/ray/pubsub/).
+        self._pubsub: dict[str, dict] = {}
+        self._pubsub_lock = threading.Lock()
 
         # Chunked object transfers in flight (ObjectManager analog):
         # holding the object keeps its bytes/pinned views alive until
@@ -2818,6 +2821,71 @@ class DriverRuntime:
 
     # ---------------- internal KV (GCS KV analog) ----------------
 
+    # ---------------- pubsub (long-poll channels) ----------------
+    # Reference: src/ray/pubsub/ publisher/subscriber — a bounded
+    # per-topic ring; subscribers long-poll from their cursor.
+
+    _PUBSUB_RING = 1024
+
+    def _pubsub_topic(self, topic: str):
+        with self._pubsub_lock:
+            ent = self._pubsub.get(topic)
+            if ent is None:
+                ent = self._pubsub[topic] = {
+                    "buf": deque(maxlen=self._PUBSUB_RING),
+                    "seq": 0,
+                    # Epoch detects head restarts: seq resets with
+                    # the process, and a stale high cursor would
+                    # otherwise filter everything out forever.
+                    "epoch": os.urandom(8).hex(),
+                    "cv": threading.Condition(),
+                }
+            return ent
+
+    def pubsub_publish(self, topic: str, blob: bytes) -> int:
+        ent = self._pubsub_topic(topic)
+        with ent["cv"]:
+            ent["seq"] += 1
+            ent["buf"].append((ent["seq"], bytes(blob)))
+            ent["cv"].notify_all()
+            return ent["seq"]
+
+    def pubsub_cursor(self, topic: str):
+        ent = self._pubsub_topic(topic)
+        with ent["cv"]:
+            return ent["epoch"], ent["seq"]
+
+    def pubsub_poll(self, topic: str, epoch: str, cursor: int,
+                    timeout: float | None = 1.0,
+                    max_messages: int = 256):
+        """-> (epoch, cursor, [blobs]). An epoch mismatch (head
+        restarted; this topic's seqs restarted with it) rewinds the
+        cursor to the ring's start: at-least-once beats a subscriber
+        going silently deaf behind a stale high cursor."""
+        ent = self._pubsub_topic(topic)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with ent["cv"]:
+            if epoch != ent["epoch"]:
+                cursor = 0
+            while True:
+                buf = ent["buf"]
+                # Seqs are contiguous: the unseen tail length is
+                # arithmetic, not an O(ring) scan under the lock.
+                n_new = min(len(buf), max(ent["seq"] - cursor, 0))
+                if n_new:
+                    n = min(n_new, max_messages)
+                    start = len(buf) - n_new
+                    out = list(itertools.islice(buf, start,
+                                                start + n))
+                    return (ent["epoch"], out[-1][0],
+                            [b for _s, b in out])
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return ent["epoch"], cursor, []
+                ent["cv"].wait(remaining)
+
     def kv_put(self, key: bytes, value: bytes,
                namespace: str = "", overwrite: bool = True) -> bool:
         """Atomic put; with overwrite=False this is the GCS KV's
@@ -3688,6 +3756,17 @@ class DriverRuntime:
             from ray_tpu.util.tracing import get_tracer
             get_tracer().add_spans(payload)
             return None
+        if op == P.OP_PUBSUB:
+            action = payload[0]
+            if action == "publish":
+                return self.pubsub_publish(payload[1], payload[2])
+            if action == "poll":
+                _a, topic, epoch, cursor, timeout, mx = payload
+                return self.pubsub_poll(topic, epoch, cursor,
+                                        timeout, mx)
+            if action == "cursor":
+                return self.pubsub_cursor(payload[1])
+            raise ValueError(f"unknown pubsub action {action!r}")
         if op == P.OP_KV:
             action, key, value, namespace = payload
             if action == "put":

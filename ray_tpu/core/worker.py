@@ -176,8 +176,13 @@ class ClientRuntime:
     def _needs_dd(self, op: str, payload) -> bool:
         if op in self._MUTATING_OPS:
             return True
-        return (op == P.OP_KV and isinstance(payload, tuple)
-                and payload and payload[0] in self._MUTATING_KV_ACTIONS)
+        if (op == P.OP_KV and isinstance(payload, tuple)
+                and payload
+                and payload[0] in self._MUTATING_KV_ACTIONS):
+            return True
+        # A replayed publish would duplicate the message.
+        return (op == P.OP_PUBSUB and isinstance(payload, tuple)
+                and payload and payload[0] == "publish")
 
     def _call(self, op: str, payload, timeout: float | None = None,
               _retried: bool = False, _dd: str | None = None):
@@ -360,6 +365,23 @@ class ClientRuntime:
 
     def drop_stream(self, task_id_bytes: bytes) -> None:
         self._call(P.OP_STREAM_DROP, task_id_bytes)
+
+    # -- pubsub --
+
+    def pubsub_publish(self, topic: str, blob: bytes) -> int:
+        return self._call(P.OP_PUBSUB, ("publish", topic, blob))
+
+    def pubsub_cursor(self, topic: str) -> int:
+        return self._call(P.OP_PUBSUB, ("cursor", topic))
+
+    def pubsub_poll(self, topic: str, epoch: str, cursor: int,
+                    timeout: float | None = 1.0,
+                    max_messages: int = 256):
+        # No client-side _call timeout: the long poll's own timeout
+        # bounds the wait server-side.
+        return self._call(
+            P.OP_PUBSUB, ("poll", topic, epoch, cursor, timeout,
+                          max_messages))
 
     # -- internal KV --
 

@@ -1,0 +1,69 @@
+"""General pub/sub (reference: src/ray/pubsub/ long-poll channels)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import pubsub
+
+
+def test_publish_subscribe_roundtrip(rt):
+    sub = pubsub.subscribe("t1")
+    pubsub.publish("t1", {"a": 1})
+    pubsub.publish("t1", {"a": 2})
+    got = list(sub.poll(timeout=5))
+    assert got == [{"a": 1}, {"a": 2}]
+    # cursor advanced: nothing new
+    assert list(sub.poll(timeout=0.1)) == []
+    pubsub.publish("t1", 3)
+    assert list(sub.poll(timeout=5)) == [3]
+
+
+def test_from_latest_skips_history(rt):
+    pubsub.publish("t2", "old")
+    sub = pubsub.subscribe("t2", from_latest=True)
+    assert list(sub.poll(timeout=0.1)) == []
+    pubsub.publish("t2", "new")
+    assert list(sub.poll(timeout=5)) == ["new"]
+    sub_all = pubsub.subscribe("t2", from_latest=False)
+    assert list(sub_all.poll(timeout=5)) == ["old", "new"]
+
+
+def test_long_poll_blocks_until_publish(rt):
+    sub = pubsub.subscribe("t3")
+    out = []
+
+    def poller():
+        out.extend(sub.poll(timeout=10))
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.3)
+    pubsub.publish("t3", "wake")
+    t.join(timeout=10)
+    assert out == ["wake"]
+
+
+def test_workers_publish_driver_receives(rt):
+    sub = pubsub.subscribe("t4")
+
+    @ray_tpu.remote(num_cpus=0)
+    def announce(i):
+        from ray_tpu.experimental import pubsub as ps
+        return ps.publish("t4", f"from-{i}")
+
+    ray_tpu.get([announce.remote(i) for i in range(3)], timeout=60)
+    got = sorted(sub.poll(timeout=10))
+    assert got == ["from-0", "from-1", "from-2"]
+
+
+def test_ring_bound(rt):
+    sub = pubsub.subscribe("t5", from_latest=False)
+    for i in range(2000):
+        pubsub.publish("t5", i)
+    got = list(sub.poll(timeout=5, max_messages=5000))
+    # Bounded ring: only the newest window survives.
+    assert len(got) <= 1024
+    assert got[-1] == 1999
