@@ -142,6 +142,14 @@ def orchestrate() -> None:
     gpt2, gerr = _run_child("--gpt2", max(budget(bench_timeout), 60.0))
     if gpt2 and "error" in gpt2:
         gpt2, gerr = None, gpt2["error"]
+    if gpt2 is None and budget(bench_timeout) > 120:
+        # One retry: the probe proved the backend alive, so a single
+        # child failure is plausibly a transient tunnel hiccup — a
+        # red headline artifact is the costliest outcome.
+        extra["gpt2_first_error"] = str(gerr)[:200]
+        gpt2, gerr = _run_child("--gpt2", budget(bench_timeout))
+        if gpt2 and "error" in gpt2:
+            gpt2, gerr = None, gpt2["error"]
 
     # Secondary benches run serially AFTER the headline (no host
     # contention in its timed region) and are skipped rather than

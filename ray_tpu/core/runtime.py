@@ -2826,9 +2826,23 @@ class DriverRuntime:
     # per-topic ring; subscribers long-poll from their cursor.
 
     _PUBSUB_RING = 1024
+    _PUBSUB_TOPIC_TTL_S = 600.0
+    # One poll round parks a handler thread at most this long — an
+    # abandoned long poll (client died mid-wait) can't pin a head
+    # thread forever; live subscribers simply re-poll.
+    _PUBSUB_MAX_WAIT_S = 60.0
 
     def _pubsub_topic(self, topic: str):
+        now = time.monotonic()
         with self._pubsub_lock:
+            # Reap idle topics: first-touch creation means typo'd or
+            # ephemeral names would otherwise accumulate forever,
+            # each pinning up to a full ring of payloads.
+            if len(self._pubsub) > 64:
+                for name in [n for n, e in self._pubsub.items()
+                             if now - e["last_used"]
+                             > self._PUBSUB_TOPIC_TTL_S]:
+                    self._pubsub.pop(name, None)
             ent = self._pubsub.get(topic)
             if ent is None:
                 ent = self._pubsub[topic] = {
@@ -2839,7 +2853,9 @@ class DriverRuntime:
                     # otherwise filter everything out forever.
                     "epoch": os.urandom(8).hex(),
                     "cv": threading.Condition(),
+                    "last_used": now,
                 }
+            ent["last_used"] = now
             return ent
 
     def pubsub_publish(self, topic: str, blob: bytes) -> int:
@@ -2863,8 +2879,9 @@ class DriverRuntime:
         cursor to the ring's start: at-least-once beats a subscriber
         going silently deaf behind a stale high cursor."""
         ent = self._pubsub_topic(topic)
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
+        timeout = (self._PUBSUB_MAX_WAIT_S if timeout is None
+                   else min(timeout, self._PUBSUB_MAX_WAIT_S))
+        deadline = time.monotonic() + timeout
         with ent["cv"]:
             if epoch != ent["epoch"]:
                 cursor = 0
@@ -2880,9 +2897,8 @@ class DriverRuntime:
                                                 start + n))
                     return (ent["epoch"], out[-1][0],
                             [b for _s, b in out])
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     return ent["epoch"], cursor, []
                 ent["cv"].wait(remaining)
 

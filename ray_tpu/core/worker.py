@@ -371,7 +371,8 @@ class ClientRuntime:
     def pubsub_publish(self, topic: str, blob: bytes) -> int:
         return self._call(P.OP_PUBSUB, ("publish", topic, blob))
 
-    def pubsub_cursor(self, topic: str) -> int:
+    def pubsub_cursor(self, topic: str) -> tuple:
+        """(epoch, seq) — pass both back into pubsub_poll."""
         return self._call(P.OP_PUBSUB, ("cursor", topic))
 
     def pubsub_poll(self, topic: str, epoch: str, cursor: int,

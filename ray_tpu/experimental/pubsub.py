@@ -45,7 +45,9 @@ class Subscriber:
              max_messages: int = 256) -> list[Any]:
         """EAGER list of new messages (a lazy generator would drop
         the rest of a batch when the caller breaks mid-iteration —
-        the cursor covers the whole delivery)."""
+        the cursor covers the whole delivery). One poll round waits
+        at most ~60 s server-side even with timeout=None; loop to
+        wait indefinitely."""
         from ray_tpu.core import serialization as ser
 
         self._epoch, self._cursor, blobs = self._rt.pubsub_poll(
