@@ -1077,8 +1077,12 @@ class NodeDaemon:
                     pass
                 raise
             return oid_bytes
-        self._direct_pending.pop(oid_bytes, None)       # "abort"
-        store.delete(ObjectID(oid_bytes))
+        if self._direct_pending.pop(oid_bytes, None) is None:
+            # "abort" for a put that is not in flight: the commit may
+            # have executed with only the worker's view of it failing
+            # — deleting would free committed bytes (advisor r3).
+            return None
+        store.delete(ObjectID(oid_bytes))               # "abort"
         return None
 
     def _handle_worker_object_op(self, op: str, payload):

@@ -285,7 +285,18 @@ class NativeSharedMemoryStore:
                 # directly (fallback allocation analog).
                 self._spill_record_locked(object_id, self._encode(obj))
                 return
-            write_record(view, obj)
+            ok = False
+            try:
+                write_record(view, obj)
+                ok = True
+            finally:
+                self._store.reserve_done()
+                if not ok:
+                    # Free the half-written slot: it never enters
+                    # _lru, so no eviction path would ever reclaim it
+                    # (the direct-put path compensates with an abort
+                    # RPC; this in-process path must clean up itself).
+                    self._store.delete(object_id.binary())
             self._lru[object_id] = total
 
     def _maybe_spill_locked(self, incoming: int = 0) -> None:

@@ -20,6 +20,7 @@ import json
 import os
 import re
 import threading
+import time as _time
 
 _SEG_RE = re.compile(r"^oplog\.(\d{8})\.jsonl$")
 
@@ -69,16 +70,51 @@ class OpLog:
         err: list = []
         with self._cv:
             if self._closed:
-                def closed_wait(timeout: float = 10.0) -> None:
+                def closed_wait(timeout: float | None = None) -> None:
                     raise RuntimeError(
                         "op log closed — mutation not durable")
                 return closed_wait
             self._pending.append((data, ev, err))
             self._cv.notify()
 
-        def wait(timeout: float = 10.0) -> None:
-            if not ev.wait(timeout):
-                raise TimeoutError("op log fsync stalled")
+        def wait(timeout: float | None = None) -> None:
+            # Block until the fsync actually happens (the group-commit
+            # writer bounds latency). A deadline here would be a lie:
+            # callers apply the in-memory mutation BEFORE waiting, and
+            # the queued entry still reaches disk after the deadline —
+            # raising would report failure for a mutation that is both
+            # applied and (eventually) durable (advisor r3). Only a
+            # dead writer thread makes the entry truly lost.
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+            waited = 0.0
+            while True:
+                step = 2.0
+                if deadline is not None:
+                    step = min(step, max(deadline - _time.monotonic(),
+                                         0.05))
+                if ev.wait(step):
+                    break
+                if not self._writer.is_alive():
+                    # Re-check before concluding loss: the writer may
+                    # have fsynced this entry and exited (close())
+                    # between our timed wait and the liveness check.
+                    if ev.is_set():
+                        break
+                    raise RuntimeError(
+                        "op log writer died — mutation not durable")
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise TimeoutError("op log fsync stalled")
+                waited += 2.0
+                if waited % 10.0 < 2.0:
+                    # An unbounded silent hang on the head's mutation
+                    # hot path would be undiagnosable — shout while
+                    # blocking (the disk, not this code, is stuck).
+                    import logging
+                    logging.getLogger("ray_tpu.oplog").warning(
+                        "op log fsync stalled for %.0f s (disk slow "
+                        "or hung); mutation is applied in memory and "
+                        "will ack when the write lands", waited)
             if err:
                 raise RuntimeError(
                     f"op log write failed: {err[0]}")

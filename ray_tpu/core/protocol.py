@@ -53,9 +53,10 @@ OP_STREAM_DROP = "stream_drop"  # task_id_bytes
 OP_SPANS = "spans"              # list of finished span dicts (tracing)
 OP_KV = "kv"                    # (action, key, value, namespace)
 OP_PUBSUB = "pubsub"            # ("publish", topic, blob) -> seq;
-                                # ("poll", topic, cursor, timeout,
-                                #  max) -> (cursor, [blobs]);
-                                # ("cursor", topic) -> seq
+                                # ("poll", topic, epoch, cursor,
+                                #  timeout, max) -> (epoch, cursor,
+                                #  [blobs], dropped);
+                                # ("cursor", topic) -> (epoch, seq)
 OP_PUT_DIRECT = "put_direct"    # plasma-style same-host put: worker
                                 # writes the arena itself.
                                 # ("start", total, refs)->(oid, name)

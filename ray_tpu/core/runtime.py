@@ -2874,32 +2874,45 @@ class DriverRuntime:
     def pubsub_poll(self, topic: str, epoch: str, cursor: int,
                     timeout: float | None = 1.0,
                     max_messages: int = 256):
-        """-> (epoch, cursor, [blobs]). An epoch mismatch (head
-        restarted; this topic's seqs restarted with it) rewinds the
-        cursor to the ring's start: at-least-once beats a subscriber
-        going silently deaf behind a stale high cursor."""
+        """-> (epoch, cursor, [blobs], dropped). An epoch mismatch
+        (head restarted; this topic's seqs restarted with it) rewinds
+        the cursor to the ring's start: at-least-once beats a
+        subscriber going silently deaf behind a stale high cursor.
+
+        ``dropped`` is the discontinuity indicator at-least-once
+        consumers use to resync state instead of assuming continuity
+        (advisor r3; reference subscribers surface publisher
+        restarts/gaps the same way): >0 = that many seqs were evicted
+        from the ring before this subscriber saw them; -1 = epoch
+        changed under the subscriber (head restart or topic reaped by
+        the idle-TTL sweep), so an UNKNOWN number of old-epoch
+        messages is gone and ring re-delivery may duplicate."""
         ent = self._pubsub_topic(topic)
         timeout = (self._PUBSUB_MAX_WAIT_S if timeout is None
                    else min(timeout, self._PUBSUB_MAX_WAIT_S))
         deadline = time.monotonic() + timeout
         with ent["cv"]:
-            if epoch != ent["epoch"]:
+            rewound = epoch != ent["epoch"]
+            if rewound:
                 cursor = 0
             while True:
                 buf = ent["buf"]
                 # Seqs are contiguous: the unseen tail length is
                 # arithmetic, not an O(ring) scan under the lock.
-                n_new = min(len(buf), max(ent["seq"] - cursor, 0))
+                behind = max(ent["seq"] - cursor, 0)
+                n_new = min(len(buf), behind)
                 if n_new:
+                    dropped = -1 if rewound else behind - n_new
                     n = min(n_new, max_messages)
                     start = len(buf) - n_new
                     out = list(itertools.islice(buf, start,
                                                 start + n))
                     return (ent["epoch"], out[-1][0],
-                            [b for _s, b in out])
+                            [b for _s, b in out], dropped)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return ent["epoch"], cursor, []
+                    return (ent["epoch"], cursor, [],
+                            -1 if rewound else 0)
                 ent["cv"].wait(remaining)
 
     def kv_put(self, key: bytes, value: bytes,
@@ -3629,7 +3642,14 @@ class DriverRuntime:
 
     def direct_put_abort(self, oid_bytes: bytes) -> None:
         oid = ObjectID(oid_bytes)
-        self._pending_direct.pop(oid, None)
+        if self._pending_direct.pop(oid, None) is None:
+            # Not in flight: either already aborted, or the commit
+            # actually executed server-side and only the client's view
+            # of it failed (reply lost after reconnect-replay gave up,
+            # or its event.wait timed out). Deleting here would tear
+            # committed — and possibly pinned — bytes out from under
+            # the directory entry (advisor r3).
+            return
         self.shm_store.delete(oid)
 
     def _handle_direct_put(self, payload, conn_pending: set):

@@ -281,7 +281,10 @@ class ClientRuntime:
             if view is None:
                 self._call(P.OP_PUT_DIRECT, ("abort", oid_bytes))
                 return None
-            write_record(view, obj)
+            try:
+                write_record(view, obj)
+            finally:
+                store.reserve_done()
             self._call(P.OP_PUT_DIRECT, ("commit", oid_bytes))
             return ObjectRef(ObjectID(oid_bytes))
         except Exception:  # noqa: BLE001

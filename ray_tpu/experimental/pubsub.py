@@ -40,6 +40,12 @@ class Subscriber:
         self._rt = get_runtime()
         self._epoch, seq = self._rt.pubsub_cursor(self._topic)
         self._cursor = seq if from_latest else 0
+        #: discontinuity indicator for the last poll: 0 = contiguous,
+        #: >0 = that many messages evicted unseen, -1 = epoch changed
+        #: (head restart / topic reaped) — unknown loss, possible
+        #: duplicates. Cumulative counted losses in dropped_total.
+        self.last_dropped = 0
+        self.dropped_total = 0
 
     def poll(self, timeout: float | None = 1.0,
              max_messages: int = 256) -> list[Any]:
@@ -47,12 +53,22 @@ class Subscriber:
         the rest of a batch when the caller breaks mid-iteration —
         the cursor covers the whole delivery). One poll round waits
         at most ~60 s server-side even with timeout=None; loop to
-        wait indefinitely."""
+        wait indefinitely.
+
+        After each poll, ``last_dropped`` says whether the stream is
+        contiguous: >0 = that many messages evicted unseen (slow
+        subscriber fell > ring-size behind), -1 = epoch changed under
+        us (unknown loss, possible re-delivery). Any nonzero value
+        means stateful consumers should resync."""
         from ray_tpu.core import serialization as ser
 
-        self._epoch, self._cursor, blobs = self._rt.pubsub_poll(
-            self._topic, self._epoch, self._cursor, timeout,
-            max_messages)
+        self._epoch, self._cursor, blobs, dropped = \
+            self._rt.pubsub_poll(
+                self._topic, self._epoch, self._cursor, timeout,
+                max_messages)
+        self.last_dropped = int(dropped)
+        if self.last_dropped > 0:
+            self.dropped_total += self.last_dropped
         return [ser.loads(b) for b in blobs]
 
 

@@ -92,6 +92,10 @@ class NativeStore:
         # and the native call must be atomic, else a concurrent
         # close() (stale-arena eviction) frees the handle mid-call.
         self._guard = threading.Lock()
+        # Writable views handed out by reserve() that the caller is
+        # still filling (writes happen OUTSIDE _guard). close() must
+        # not munmap while any exist — see close().
+        self._live_reserves = 0
 
     def _check_id(self, object_id: bytes) -> bytes:
         if len(object_id) != _ID_SIZE:
@@ -113,7 +117,13 @@ class NativeStore:
         """Allocate an arena slot and return a WRITABLE view over it —
         the zero-extra-copy put path (caller writes payload segments
         straight from their source buffers). None when the arena is
-        full (caller should spill)."""
+        full (caller should spill).
+
+        The caller MUST call ``reserve_done()`` when finished writing
+        (success or abort): the view is written outside ``_guard``, so
+        an outstanding reserve is what keeps a concurrent close()
+        (attach-cache eviction of a vanished arena) from munmapping
+        the pages mid-write (advisor r3)."""
         with self._guard:
             if self._closed:
                 return None
@@ -126,7 +136,15 @@ class NativeStore:
             base = self._lib.rts_data_ptr(self._h)
             addr = ctypes.addressof(base.contents) + off
             buf = (ctypes.c_uint8 * size).from_address(addr)
+            self._live_reserves += 1
             return memoryview(buf).cast("B")
+
+    def reserve_done(self) -> None:
+        """Balance one reserve() after the caller finished (or gave
+        up) writing its view."""
+        with self._guard:
+            if self._live_reserves > 0:
+                self._live_reserves -= 1
 
     def get(self, object_id: bytes) -> memoryview | None:
         """Zero-copy view over the mapped bytes (valid until delete)."""
@@ -229,10 +247,12 @@ class NativeStore:
                 return
             self._closed = True
             # If this process still holds pinned zero-copy views
-            # (numpy arrays alive after shutdown), munmap would turn
+            # (numpy arrays alive after shutdown) or a writer is mid
+            # write_record on a reserve() view, munmap would turn
             # their next access into a segfault — keep the mapping and
             # let the kernel reclaim it at process exit.
-            if self._lib.rts_self_pin_count(self._h) > 0:
+            if (self._lib.rts_self_pin_count(self._h) > 0
+                    or self._live_reserves > 0):
                 self._lib.rts_close_keep_map(self._h)
             else:
                 self._lib.rts_close(self._h)

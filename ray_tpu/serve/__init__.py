@@ -15,6 +15,7 @@ from ray_tpu.serve.api import (
     run,
     shutdown,
     get_deployment_handle,
+    grpc_ingress_token,
     batch,
     Application,
     Deployment,
@@ -29,6 +30,7 @@ from ray_tpu.serve.multiplex import (
 __all__ = [
     "ingress",
     "deployment", "run", "shutdown", "get_deployment_handle", "batch",
+    "grpc_ingress_token",
     "Application", "Deployment", "DeploymentHandle",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]

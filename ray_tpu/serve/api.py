@@ -23,6 +23,47 @@ _proxy = None
 _proxy_port: int | None = None
 _grpc_proxy = None
 _grpc_proxy_port: int | None = None
+_GRPC_TOKEN_KV = (b"grpc_ingress_token", "_ray_tpu_serve")
+
+
+def grpc_ingress_token() -> str:
+    """Token gRPC clients must present (``ray-auth-token`` metadata)
+    to send pickle payloads through the ingress.
+
+    One-way HMAC derivation from the cluster token: any cluster
+    member can compute it, but handing it to a semi-trusted gRPC
+    client does NOT disclose the cluster authkey itself (which would
+    let the holder join the cluster as a full member). Recomputed on
+    every call so a shutdown/re-init cycle with a new cluster token
+    yields the new ingress token, not a stale cache.
+
+    Worker processes dial the head over a unix socket with no
+    authkey, so they can't derive the token locally — ``serve.run``
+    publishes it to internal KV and they read it from there. JSON
+    payloads need no token."""
+    import hmac as _hmac
+
+    from ray_tpu.core.api import get_runtime_or_none
+    rt = get_runtime_or_none()
+    tok = (getattr(rt, "cluster_token", None)
+           or getattr(rt, "_token", None))
+    if isinstance(tok, str):
+        tok = tok.encode()
+    if tok:
+        return _hmac.new(bytes(tok), b"ray-tpu-grpc-ingress",
+                         "sha256").hexdigest()
+    if rt is not None:
+        from ray_tpu.experimental import internal_kv
+        v = internal_kv._kv_get(_GRPC_TOKEN_KV[0],
+                                namespace=_GRPC_TOKEN_KV[1])
+        if v:
+            return v.decode()
+    raise RuntimeError(
+        "grpc_ingress_token() needs a cluster token: call it after "
+        "ray_tpu.init() on the driver, or after serve.run(grpc_port=…) "
+        "from any cluster process (the token is published to internal "
+        "KV then). Returning a made-up token would just fail "
+        "UNAUTHENTICATED at the proxy.")
 
 
 @dataclass
@@ -195,8 +236,15 @@ def run(app: Application, *, route_prefix: str = "/",
         # the router/replica path with HTTP.
         if _grpc_proxy is None or _grpc_proxy_port != grpc_port:
             from ray_tpu.serve.grpc_proxy import GRPCProxyActor
+            token = grpc_ingress_token()
+            # Publish for worker/replica processes, which can't
+            # derive it (no authkey on the unix-socket path).
+            from ray_tpu.experimental import internal_kv
+            internal_kv._kv_put(_GRPC_TOKEN_KV[0], token.encode(),
+                                namespace=_GRPC_TOKEN_KV[1])
             _grpc_proxy = GRPCProxyActor.options(
-                num_cpus=0, max_concurrency=32).remote(grpc_port)
+                num_cpus=0, max_concurrency=32).remote(
+                    grpc_port, auth_token=token)
             _grpc_proxy_port = grpc_port
             ray_tpu.get(_grpc_proxy.ready.remote(), timeout=30)
         routes = {route_prefix: name}

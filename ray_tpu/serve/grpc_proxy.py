@@ -13,21 +13,36 @@ reference's generic gRPC ingress:
   falls back to the sole registered route;
 - ``multiplexed_model_id`` metadata routes to model-multiplexed
   replicas exactly like the handle API;
-- bodies are cloudpickled payloads (request: the single argument;
-  response: the return value); server-streaming is selected by the
-  path suffix ``Streaming`` (``/…/countsStreaming`` dispatches the
-  replica method ``counts`` as a generator) — gRPC's generic handler
-  cannot see the client's call type, so the suffix IS the contract.
+- bodies are JSON by default (metadata ``ray-content-type:
+  application/json``, also the assumed type when absent) — request:
+  the single argument; response: the return value. Pickle payloads
+  (``ray-content-type: application/x-pickle``) carry arbitrary objects
+  but are ONLY deserialized when the call presents the cluster's
+  ingress token as ``ray-auth-token`` metadata: an ingress proxy is
+  the component meant to face external clients, and unpickling
+  untrusted bytes is arbitrary code execution (the reference's
+  gRPCProxy exchanges protobuf, never pickles of client bytes).
+  The port additionally binds 127.0.0.1 only and must never be
+  exposed or port-forwarded to untrusted networks;
+- server-streaming is selected by the path suffix ``Streaming``
+  (``/…/countsStreaming`` dispatches the replica method ``counts``
+  as a generator) — gRPC's generic handler cannot see the client's
+  call type, so the suffix IS the contract.
 """
 
 from __future__ import annotations
 
+import hmac
+import json
 import threading
 
 import ray_tpu
 
+PICKLE_CTYPE = "application/x-pickle"
+JSON_CTYPE = "application/json"
 
-def _loads(b: bytes):
+
+def _pickle_loads(b: bytes):
     import cloudpickle
     import pickle
     try:
@@ -36,15 +51,16 @@ def _loads(b: bytes):
         return cloudpickle.loads(b)
 
 
-def _dumps(v) -> bytes:
+def _pickle_dumps(v) -> bytes:
     import cloudpickle
     return cloudpickle.dumps(v)
 
 
 @ray_tpu.remote
 class GRPCProxyActor:
-    def __init__(self, port: int):
+    def __init__(self, port: int, auth_token: str = ""):
         self.port = port
+        self.auth_token = auth_token
         self.routes: dict[str, str] = {}     # route_prefix -> deployment
         self._routers: dict[str, object] = {}
         self._controller = None
@@ -120,6 +136,43 @@ class GRPCProxyActor:
             return {k: v for k, v in (context.invocation_metadata()
                                       or ())}
 
+        async def _decode(request: bytes, md: dict, context):
+            """Deserialize a request body; returns (value, ctype).
+
+            Pickle is gated on the ingress token — unpickling bytes
+            from an unauthenticated peer is arbitrary code execution
+            (advisor r3 medium). JSON needs no token.
+            """
+            ctype = md.get("ray-content-type", JSON_CTYPE)
+            if ctype == PICKLE_CTYPE:
+                tok = md.get("ray-auth-token", "")
+                if not (proxy.auth_token and
+                        hmac.compare_digest(tok, proxy.auth_token)):
+                    await context.abort(
+                        grpc.StatusCode.UNAUTHENTICATED,
+                        "pickle payloads require the ingress token "
+                        "as ray-auth-token metadata "
+                        "(serve.grpc_ingress_token())")
+                return ((_pickle_loads(request) if request else None),
+                        ctype)
+            if ctype != JSON_CTYPE:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"unsupported content-type {ctype!r}; use "
+                    f"{JSON_CTYPE} or authenticated {PICKLE_CTYPE}")
+            if not request:
+                return None, ctype
+            try:
+                return json.loads(request.decode()), ctype
+            except (ValueError, UnicodeDecodeError) as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                    f"bad JSON body: {e}"[:300])
+
+        def _encode(v, ctype: str) -> bytes:
+            if ctype == PICKLE_CTYPE:
+                return _pickle_dumps(v)
+            return json.dumps(v).encode()
+
         def _make_unary(method_name: str):
             async def unary(request: bytes, context):
                 md = _md(context)
@@ -128,7 +181,7 @@ class GRPCProxyActor:
                     await context.abort(
                         grpc.StatusCode.NOT_FOUND,
                         "no matching application")
-                arg = _loads(request) if request else None
+                arg, ctype = await _decode(request, md, context)
                 router = proxy._router_for(target)
                 loop = asyncio.get_running_loop()
 
@@ -141,10 +194,10 @@ class GRPCProxyActor:
 
                 try:
                     result = await loop.run_in_executor(None, call)
+                    return _encode(result, ctype)
                 except Exception as e:  # noqa: BLE001
                     await context.abort(grpc.StatusCode.INTERNAL,
                                         str(e)[:500])
-                return _dumps(result)
             return unary
 
         def _make_stream(method_name: str):
@@ -155,7 +208,7 @@ class GRPCProxyActor:
                     await context.abort(
                         grpc.StatusCode.NOT_FOUND,
                         "no matching application")
-                arg = _loads(request) if request else None
+                arg, ctype = await _decode(request, md, context)
                 router = proxy._router_for(target)
                 loop = asyncio.get_running_loop()
                 # Bounded queue = backpressure: a slow client can't
@@ -198,7 +251,17 @@ class GRPCProxyActor:
                             await context.abort(
                                 grpc.StatusCode.INTERNAL,
                                 str(item)[:500])
-                        yield _dumps(item)
+                        try:
+                            body = _encode(item, ctype)
+                        except (TypeError, ValueError) as e:
+                            # JSON-unserializable yield: surface
+                            # INTERNAL + message like the unary path,
+                            # not an opaque UNKNOWN.
+                            await context.abort(
+                                grpc.StatusCode.INTERNAL,
+                                f"unserializable stream item: "
+                                f"{e}"[:500])
+                        yield body
                 finally:
                     # Cancellation/disconnect: stop the pump instead
                     # of draining the whole replica stream; unblock a

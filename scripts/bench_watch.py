@@ -1,0 +1,134 @@
+"""Opportunistic on-chip benchmark capture.
+
+The axon TPU tunnel can be dead for hours at a stretch (round 3: 3.5+ h
+of consecutive dead probes at capture time, `VERDICT.md` missing #1).
+This watcher makes the capture *opportunistic and in-repo*: it polls the
+backend probe on an interval and, the moment the tunnel is alive, runs
+the full `bench.py` harness and records a verified artifact.
+
+Run for the whole round, in the background, from the repo root:
+
+    nohup python scripts/bench_watch.py >/dev/null 2>&1 &
+
+Artifacts (all in-repo, all append-only except BENCH_verified.json):
+  WATCH_r04.log        one line per probe attempt (ts, alive, detail)
+  BENCH_verified.json  latest successful full-bench JSON (+ capture ts)
+  BENCH_history.jsonl  every successful capture, appended
+
+Design notes:
+  - The parent never imports jax (same contract as bench.py — a dead
+    tunnel hangs jax init rather than raising; everything runs in
+    killable child process groups).
+  - After a successful capture the probe interval stretches (re-verify
+    cadence) so the watcher doesn't hog the single chip or churn the
+    host CPU while other work is being benchmarked. Each probe's jax
+    import costs real CPU; round 3 measured a 33x phantom regression
+    from concurrent probe churn, hence the generous intervals.
+  - Reference analog: release/microbenchmark/run_microbenchmark.py —
+    the artifact is retried until green, not captured once.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+WATCH_LOG = os.path.join(REPO, "WATCH_r04.log")
+VERIFIED = os.path.join(REPO, "BENCH_verified.json")
+HISTORY = os.path.join(REPO, "BENCH_history.jsonl")
+
+PROBE_TIMEOUT_S = 120.0
+PROBE_INTERVAL_S = float(os.environ.get("RAY_TPU_WATCH_INTERVAL", 300))
+# After a verified capture, stretch the cadence: the number is banked;
+# later captures only refresh it after more perf work lands.
+VERIFIED_INTERVAL_S = float(os.environ.get("RAY_TPU_WATCH_VERIFIED_INTERVAL",
+                                           3600))
+BENCH_TIMEOUT_S = 900.0
+
+
+def _log(event: dict) -> None:
+    event["ts"] = round(time.time(), 1)
+    event["iso"] = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
+    with open(WATCH_LOG, "a") as f:
+        f.write(json.dumps(event) + "\n")
+
+
+def _run(args: list[str], timeout: float) -> tuple[dict | None, str]:
+    """Run a child in its own session; parse last JSON stdout line.
+    Kills the whole process group on timeout (wedged jax threads can
+    survive a plain terminate)."""
+    proc = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True, cwd=REPO, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        return None, f"timeout after {timeout:.0f}s"
+    for line in reversed((out or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError:
+                continue
+    tail = (err or out or "").strip().splitlines()[-3:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)[:300]
+
+
+def probe_alive() -> tuple[bool, str]:
+    res, err = _run([sys.executable, BENCH, "--probe"], PROBE_TIMEOUT_S)
+    if res and res.get("ok") and res.get("platform") not in (None, "cpu"):
+        return True, json.dumps(res)
+    if res and res.get("ok"):
+        return False, f"backend up but platform={res.get('platform')}"
+    return False, err or str(res)
+
+
+def capture() -> dict | None:
+    """Run the full bench harness; persist artifacts on success."""
+    env_note = {k: v for k, v in os.environ.items()
+                if k.startswith("RAY_TPU_BENCH")}
+    res, err = _run([sys.executable, BENCH], BENCH_TIMEOUT_S)
+    if not res or res.get("value", 0) <= 0 or res.get("error"):
+        _log({"event": "bench_failed", "err": err,
+              "result": res, "env": env_note})
+        return None
+    record = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+              "result": res}
+    with open(VERIFIED, "w") as f:
+        json.dump(record, f, indent=1)
+    with open(HISTORY, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    _log({"event": "bench_verified", "value": res.get("value"),
+          "extra": res.get("extra", {})})
+    return res
+
+
+def main() -> None:
+    _log({"event": "watch_start", "pid": os.getpid(),
+          "interval_s": PROBE_INTERVAL_S})
+    interval = PROBE_INTERVAL_S
+    while True:
+        alive, detail = probe_alive()
+        _log({"event": "probe", "alive": alive, "detail": detail[:300]})
+        if alive:
+            res = capture()
+            if res:
+                interval = VERIFIED_INTERVAL_S
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main()

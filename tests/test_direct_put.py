@@ -51,9 +51,11 @@ def test_disconnect_mid_direct_put_reclaims_slot(rt):
     assert meta is not None
     oid_bytes, store_name = meta
     from ray_tpu.core.object_store import _attach
-    view = _attach(store_name).reserve(oid_bytes, 4_000_000)
+    store = _attach(store_name)
+    view = store.reserve(oid_bytes, 4_000_000)
     assert view is not None
     del view
+    store.reserve_done()
     used_before = runtime.shm_store._store.used_bytes()
     # Crash before commit: the slot is grace-parked (the writer may
     # still hold a live view — immediate free could corrupt a
@@ -71,6 +73,28 @@ def test_disconnect_mid_direct_put_reclaims_slot(rt):
     assert not runtime._pending_direct
     assert not runtime._orphan_direct
     assert runtime.shm_store._store.used_bytes() < used_before
+
+
+def test_abort_after_commit_is_noop(rt):
+    """A stray abort for an already-committed put (client saw its
+    commit RPC fail though it executed server-side) must NOT delete
+    the committed — and pinned — bytes (advisor r3)."""
+    runtime = get_runtime()
+    from ray_tpu.core.object_store import NativeSharedMemoryStore
+    if not isinstance(runtime.shm_store, NativeSharedMemoryStore):
+        pytest.skip("native arena unavailable")
+    client = ClientRuntime(runtime.client_address)
+    try:
+        arr = np.arange(1_000_000, dtype=np.float64)     # 8 MB
+        ref = client.put(arr)
+        assert runtime._obj_locations.get(ref.id) == "shm"
+        # Replayed/late abort for the committed oid.
+        client._call(P.OP_PUT_DIRECT, ("abort", ref.id.binary()))
+        assert runtime.shm_store._store.contains(ref.id.binary())
+        np.testing.assert_array_equal(
+            ray_tpu.get(ref, timeout=60), arr)
+    finally:
+        client.shutdown()
 
 
 def test_small_puts_skip_direct_path(rt):

@@ -67,3 +67,42 @@ def test_ring_bound(rt):
     # Bounded ring: only the newest window survives.
     assert len(got) <= 1024
     assert got[-1] == 1999
+
+
+def test_slow_subscriber_sees_gap(rt):
+    """A subscriber whose cursor falls > ring-size behind must be told
+    how many messages it lost (advisor r3: a silent skip is
+    indistinguishable from an idle topic)."""
+    sub = pubsub.subscribe("t6", from_latest=True)
+    pubsub.publish("t6", "seen")
+    assert sub.poll(timeout=5) == ["seen"]
+    assert sub.last_dropped == 0
+    for i in range(1500):              # ring is 1024: 476 evicted
+        pubsub.publish("t6", i)
+    got = sub.poll(timeout=5, max_messages=5000)
+    assert got[-1] == 1499
+    assert sub.last_dropped == 1500 - len(got) > 0
+    assert sub.dropped_total == sub.last_dropped
+    # Contiguous again afterwards.
+    pubsub.publish("t6", "tail")
+    assert sub.poll(timeout=5) == ["tail"]
+    assert sub.last_dropped == 0
+
+
+def test_epoch_rewind_surfaces_unknown_gap(rt):
+    """A topic recreated under the subscriber (head restart, or the
+    idle-TTL reap) loses an unknowable number of old-epoch messages —
+    the poll must say so (-1), not pretend continuity."""
+    from ray_tpu.core.api import get_runtime
+    sub = pubsub.subscribe("t7", from_latest=True)
+    pubsub.publish("t7", "a")
+    assert sub.poll(timeout=5) == ["a"]
+    # Simulate restart/reap: drop the topic so the next publish
+    # recreates it with a fresh epoch and restarted seqs.
+    get_runtime()._pubsub.pop("t7", None)
+    pubsub.publish("t7", "b")
+    assert sub.poll(timeout=5) == ["b"]
+    assert sub.last_dropped == -1
+    pubsub.publish("t7", "c")
+    assert sub.poll(timeout=5) == ["c"]
+    assert sub.last_dropped == 0

@@ -1,8 +1,11 @@
 """gRPC ingress (reference analog: gRPCProxy, proxy.py:545): a
 grpc.aio client round-trips proxy -> pow-2 router -> replica,
-including server streaming and application metadata routing."""
+including server streaming, application metadata routing, and the
+wire-format auth contract (pickle only with the ingress token; JSON
+without)."""
 
 import asyncio
+import json
 import pickle
 import socket
 
@@ -11,6 +14,8 @@ import pytest
 import ray_tpu
 
 grpc = pytest.importorskip("grpc")
+
+PICKLE_MD = ("ray-content-type", "application/x-pickle")
 
 
 def _free_port() -> int:
@@ -37,53 +42,88 @@ def serve_grpc(rt):
 
     port = _free_port()
     serve.run(Echo.bind(), grpc_port=port)
-    yield port
+    yield port, serve.grpc_ingress_token()
     serve.shutdown()
 
 
-def _unary(port, method, payload, metadata=()):
+def _unary(port, method, payload, metadata=(), *, token=None,
+           wire="pickle"):
+    if wire == "pickle":
+        body = pickle.dumps(payload)
+        md = metadata + (PICKLE_MD, ("ray-auth-token", token or ""))
+    else:
+        body = json.dumps(payload).encode()
+        md = metadata + (("ray-content-type", "application/json"),)
+
     async def go():
         async with grpc.aio.insecure_channel(
                 f"127.0.0.1:{port}") as ch:
             rpc = ch.unary_unary(
                 f"/ray_tpu.serve.RayServeAPIService/{method}")
-            out = await rpc(pickle.dumps(payload),
-                            metadata=metadata, timeout=60)
-            return pickle.loads(out)
+            out = await rpc(body, metadata=md, timeout=60)
+            return (pickle.loads(out) if wire == "pickle"
+                    else json.loads(out))
     return asyncio.new_event_loop().run_until_complete(go())
 
 
 def test_grpc_unary_roundtrip(serve_grpc):
-    out = _unary(serve_grpc, "__call__", 21)
+    port, token = serve_grpc
+    out = _unary(port, "__call__", 21, token=token)
     assert out == {"echo": 21, "n": 42}
 
 
+def test_grpc_json_needs_no_token(serve_grpc):
+    port, _ = serve_grpc
+    out = _unary(port, "__call__", 21, wire="json")
+    assert out == {"echo": 21, "n": 42}
+
+
+def test_grpc_pickle_without_token_rejected(serve_grpc):
+    """Advisor r3 medium: unauthenticated pickle bodies must never be
+    deserialized (arbitrary code execution on the ingress)."""
+    port, _ = serve_grpc
+    with pytest.raises(Exception) as ei:
+        _unary(port, "__call__", 21, token="")
+    assert "UNAUTHENTICATED" in str(ei.value) \
+        or "ingress token" in str(ei.value)
+    with pytest.raises(Exception):
+        _unary(port, "__call__", 21, token="deadbeef" * 4)
+
+
 def test_grpc_named_method(serve_grpc):
-    assert _unary(serve_grpc, "shout", "quiet") == "QUIET"
+    port, token = serve_grpc
+    assert _unary(port, "shout", "quiet", token=token) == "QUIET"
 
 
 def test_grpc_application_metadata(serve_grpc):
-    out = _unary(serve_grpc, "__call__", 1,
-                 metadata=(("application", "/"),))
+    port, token = serve_grpc
+    out = _unary(port, "__call__", 1,
+                 metadata=(("application", "/"),), token=token)
     assert out["n"] == 2
 
 
 def test_grpc_unknown_application_errors(serve_grpc):
+    port, token = serve_grpc
     with pytest.raises(Exception) as ei:
-        _unary(serve_grpc, "__call__", 1,
-               metadata=(("application", "/nope"),))
+        _unary(port, "__call__", 1,
+               metadata=(("application", "/nope"),), token=token)
     assert "NOT_FOUND" in str(ei.value) or "no matching" in str(
         ei.value)
 
 
 def test_grpc_server_streaming(serve_grpc):
+    port, token = serve_grpc
+
     async def go():
         async with grpc.aio.insecure_channel(
-                f"127.0.0.1:{serve_grpc}") as ch:
+                f"127.0.0.1:{port}") as ch:
             rpc = ch.unary_stream(
                 "/ray_tpu.serve.RayServeAPIService/countsStreaming")
             items = []
-            async for msg in rpc(pickle.dumps(4), timeout=60):
+            async for msg in rpc(
+                    pickle.dumps(4),
+                    metadata=(PICKLE_MD, ("ray-auth-token", token)),
+                    timeout=60):
                 items.append(pickle.loads(msg))
             return items
 
