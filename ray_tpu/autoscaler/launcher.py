@@ -97,6 +97,28 @@ def _build_provider(cfg: dict, runtime):
             head_address=p.get("head_address")
             or f"{p.get('head_host', '')}:{head.get('port', 6380)}",
             setup_commands=list(p.get("setup_commands") or ())))
+    if ptype == "k8s":
+        from ray_tpu.autoscaler.k8s import K8sConfig, K8sNodeProvider
+        p = cfg["provider"]
+        head = cfg.get("head") or {}
+        acc = {name: nt["accelerator_type"]
+               for name, nt in (cfg.get("node_types") or {}).items()
+               if "accelerator_type" in nt}
+        chips = {name: int(nt["tpu_chips"])
+                 for name, nt in (cfg.get("node_types") or {}).items()
+                 if "tpu_chips" in nt}
+        return K8sNodeProvider(K8sConfig(
+            namespace=p.get("namespace", "default"),
+            image=p.get("image", "python:3.12-slim"),
+            name_prefix=p.get("name_prefix", "raytpu"),
+            head_address=p.get("head_address")
+            or f"{p.get('head_host', '')}:{head.get('port', 6380)}",
+            cluster_token=p.get("cluster_token", ""),
+            accelerator_types=acc,
+            tpu_chips=chips,
+            pod_spec_overrides=dict(p.get("pod_spec_overrides") or {}),
+            labels=dict(p.get("labels") or {})),
+            transport=p.get("_transport"))
     raise ValueError(f"unknown provider type {ptype!r}")
 
 

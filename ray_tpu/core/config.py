@@ -154,3 +154,47 @@ def reset_config() -> None:
     global _global
     with _lock:
         _global = None
+
+
+from contextlib import contextmanager  # noqa: E402
+
+
+@contextmanager
+def env_overrides(**flags):
+    """Scoped config injection for an already-running process AND any
+    child processes it spawns inside the scope.
+
+    Sets the ``RAY_TPU_<FLAG>`` env vars (daemons/workers started in
+    the scope inherit them at their own ``Config.from_env``) and
+    atomically swaps this process's cached config; both are restored
+    on exit. This is the supported way for tests to crank timeouts
+    down — reaching into the private cached global is not (reference:
+    per-test ``_system_config`` via conftest,
+    python/ray/tests/conftest.py:131).
+
+        with env_overrides(health_check_period_s=0.2):
+            cluster = Cluster(...)
+    """
+    valid = {f.name for f in fields(Config)}
+    for k in flags:
+        if k not in valid:
+            raise ValueError(f"unknown config flag: {k}")
+    saved_env: dict[str, str | None] = {}
+    for k, v in flags.items():
+        key = _ENV_PREFIX + k.upper()
+        saved_env[key] = os.environ.get(key)
+        os.environ[key] = str(v)
+    global _global
+    with _lock:
+        saved_cfg = _global
+        _global = Config.from_env()
+    try:
+        yield get_config()
+    finally:
+        for key, old in saved_env.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+        with _lock:
+            _global = saved_cfg

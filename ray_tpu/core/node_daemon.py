@@ -969,6 +969,20 @@ class NodeDaemon:
                         target=handle_local,
                         args=(req_id, op, payload),
                         daemon=True).start()
+                elif op == P.OP_GET_MANY:
+                    # Batched get: answer locally only when EVERY ref
+                    # is node-local (one reply message). Any remote
+                    # ref -> tell the client to fall back to per-ref
+                    # OP_GET so the p2p pull path (not a head relay)
+                    # serves it.
+                    if all(self._has_local(ObjectID(b))
+                           for b in payload[0]):
+                        threading.Thread(
+                            target=handle_local,
+                            args=(req_id, op, payload),
+                            daemon=True).start()
+                    else:
+                        down_send((req_id, P.ST_OK, ("fallback",)))
                 elif op == P.OP_GET:
                     oid = ObjectID(payload[0])
                     if self._has_local(oid):
@@ -1051,6 +1065,7 @@ class NodeDaemon:
         oid_bytes = payload[1]
         pending.discard(oid_bytes)
         if action == "commit":
+            nonce = payload[2] if len(payload) > 2 else None
             entry = self._direct_pending.pop(oid_bytes, None)
             if entry is None:
                 raise KeyError("no in-flight direct put")
@@ -1061,7 +1076,8 @@ class NodeDaemon:
                 self._local_oids.add(oid)
                 self._local_obj_meta[oid] = (total, list(refs or ()))
             try:
-                self._head_call("put_loc_at", (oid_bytes, total, refs))
+                self._head_call("put_loc_at",
+                                (oid_bytes, total, refs, nonce))
             except BaseException:
                 # Directory registration failed: roll the local
                 # bookkeeping back AND free the record — the worker
@@ -1089,8 +1105,9 @@ class NodeDaemon:
         if op == P.OP_PUT:
             obj = _wire_to_serialized(payload)
             refs = payload[2] if len(payload) > 2 and payload[2] else []
+            nonce = payload[3] if len(payload) > 3 else None
             oid_bytes = self._head_call(
-                "put_loc", (obj.total_size, refs))
+                "put_loc", (obj.total_size, refs, nonce))
             self._store_local(ObjectID(oid_bytes), obj, refs=refs)
             return oid_bytes
         if op == P.OP_GET:
@@ -1109,6 +1126,11 @@ class NodeDaemon:
                 return self._start_transfer(obj)
             data, bufs = _sendable(obj)
             return ("inline", data, bufs)
+        if op == P.OP_GET_MANY:
+            oid_list, timeout, allow_desc = payload
+            return [self._handle_worker_object_op(
+                        P.OP_GET, (ob, timeout, allow_desc))
+                    for ob in oid_list]
         if op == P.OP_PULL:
             action, tid, *prest = payload
             if action == "chunk":

@@ -38,6 +38,11 @@ OP_CREATE_ACTOR = "create_actor"
 OP_SUBMIT_ACTOR = "submit_actor"
 OP_PUT = "put"
 OP_GET = "get"
+OP_GET_MANY = "get_many"        # ([oid_bytes], timeout, allow_desc)
+                                # -> [per-ref OP_GET-shaped entries];
+                                # ONE round trip for a whole ref list
+                                # (a client get([...]) used to pay one
+                                # blocking RTT per ref)
 OP_WAIT = "wait"
 OP_KILL = "kill"
 OP_CANCEL = "cancel"

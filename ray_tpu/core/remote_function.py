@@ -64,6 +64,10 @@ class RemoteFunction:
         self._default_opts = default_opts
         self._fn_id: str | None = None
         self._fn_blob: bytes | None = None
+        # Options are identical for every .remote() of this handle —
+        # build once and share the instance (nothing mutates it after
+        # construction; the tracing path copies before writing).
+        self._options_template: TaskOptions | None = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -82,20 +86,41 @@ class RemoteFunction:
         rt = get_runtime()
         if self._fn_id is None:
             self._fn_id, self._fn_blob = rt.register_function(self._fn)
-        options = make_task_options(**self._default_opts)
-        if not self._default_opts.get("name"):
-            options.name = self._fn.__name__
+        options = self._options_template
+        if options is None:
+            options = make_task_options(**self._default_opts)
+            if not self._default_opts.get("name"):
+                options.name = self._fn.__name__
+            self._options_template = options
         from ray_tpu.util.tracing import get_tracer
         tracer = get_tracer()
         if tracer.enabled:
             # Reference: _tracing_task_invocation wraps .remote() and
             # serializes the span context into the task
             # (tracing_helper.py:293).
+            import copy
+            template = options
+            options = copy.copy(options)
+            # copy.copy goes through __getstate__, which strips the
+            # runtime caches — carry them over so traced submits
+            # don't recompute env/sched-class on every call.
+            for attr in ("_env_cache", "_sched_cache"):
+                v = getattr(template, attr, None)
+                if v is not None:
+                    setattr(options, attr, v)
             with tracer.span(f"submit::{options.name}"):
                 options.trace_ctx = tracer.current_context()
                 refs = rt.submit_task(
                     self._fn_id, self._fn_blob, self._fn.__name__,
                     args, kwargs, options)
+            # Warm the template from the clone: under always-on
+            # tracing the template itself never submits, so without
+            # this write-back every call recomputes the caches.
+            for attr in ("_env_cache", "_sched_cache"):
+                if getattr(template, attr, None) is None:
+                    v = getattr(options, attr, None)
+                    if v is not None:
+                        setattr(template, attr, v)
         else:
             refs = rt.submit_task(self._fn_id, self._fn_blob,
                                   self._fn.__name__, args, kwargs,

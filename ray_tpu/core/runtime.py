@@ -28,6 +28,7 @@ import hashlib
 import itertools
 import os
 import threading
+import weakref
 import time
 import traceback
 from collections import deque
@@ -95,6 +96,15 @@ class TaskOptions:
     node_id: str = ""              # NODE_AFFINITY target
     soft: bool = False             # NODE_AFFINITY soft fallback
     trace_ctx: tuple | None = None  # (trace_id, span_id) propagation
+
+    def __getstate__(self):
+        # Drop runtime-local caches (_env_cache holds the runtime
+        # itself — unpicklable and meaningless in another process).
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
 
 @dataclass
@@ -172,6 +182,11 @@ class TaskRecord:
     env_key: str = ""
     env_vars: dict[str, str] | None = None
     oom_killed: bool = False       # memory monitor chose this victim
+    # Scheduling class + effective resources, computed once on first
+    # enqueue: the scheduler scan probes these per pending task, and
+    # recomputing them (dict sort) dominated deep-queue scans.
+    sched_class: tuple | None = None
+    need: dict[str, float] | None = None
 
 
 @dataclass
@@ -631,6 +646,16 @@ class DriverRuntime:
         self._done_tasks: deque[TaskRecord] = deque(
             maxlen=config.task_event_buffer_size)
         self._pending: deque[TaskRecord] = deque()
+        # Pending-count per scheduling class (see _sched_class): lets
+        # a scheduling scan stop as soon as every class present has
+        # failed placement this pass.
+        self._pending_classes: dict[tuple, int] = {}
+        # True while any PENDING task might be waiting on arg deps:
+        # gates the per-result-store dispatcher wake. Set on every
+        # dep-carrying enqueue; cleared only by a full dispatcher scan
+        # that saw no dep-carrying task (stale-True costs a spurious
+        # wake; stale-False is impossible — both flips hold _res_cv).
+        self._pending_has_deps = False
         self._task_lock = threading.Lock()
         self._fn_cache: dict[str, bytes] = {}
 
@@ -955,17 +980,24 @@ class DriverRuntime:
         with self._obj_cv:
             self._obj_locations[oid] = loc
             self._obj_cv.notify_all()
-        # Wake the dispatcher: a pending task's dependency may be ready.
-        with self._res_cv:
-            self._res_cv.notify_all()
+        self._wake_dispatcher_for_deps()
+
+    def _wake_dispatcher_for_deps(self) -> None:
+        """Wake the dispatcher only when some pending task might be
+        waiting on arg deps. An unconditional wake per stored result
+        made a deep no-dep queue quadratic: every result triggered a
+        full O(pending) scheduling scan that placed nothing (workers
+        all busy). Resource frees wake via _release, not here."""
+        if self._pending_has_deps:
+            with self._res_cv:
+                self._res_cv.notify_all()
 
     def _store_error(self, oid: ObjectID, err_blob: bytes) -> None:
         with self._obj_cv:
             self._errors[oid] = err_blob
             self._obj_locations[oid] = "err"
             self._obj_cv.notify_all()
-        with self._res_cv:
-            self._res_cv.notify_all()
+        self._wake_dispatcher_for_deps()
 
     def _object_available(self, oid: ObjectID) -> bool:
         return oid in self._obj_locations
@@ -1095,6 +1127,26 @@ class DriverRuntime:
                for r in refs]
         return out[0] if single else out
 
+    def _serve_get_entry(self, oid: ObjectID,
+                         timeout: float | None, allow_desc: bool):
+        """One client-get wire entry — desc | inline | chunked —
+        shared by OP_GET and OP_GET_MANY so the serving policy cannot
+        diverge between the single and batched paths."""
+        if allow_desc:
+            kind, val = self.get_serialized_or_desc(oid, timeout)
+            if kind == "desc":
+                return ("desc", val)
+        else:
+            val = self.get_serialized(oid, timeout)
+        if val.total_size > self.config.object_transfer_inline_max:
+            # Chunked pull (ObjectManager analog): the client fetches
+            # fixed-size chunks as separate req/resp rounds, so other
+            # client ops interleave instead of queueing behind one
+            # multi-GB message.
+            return self._start_transfer(val)
+        data, bufs = _sendable(val)
+        return ("inline", data, bufs)
+
     async def get_async(self, ref: ObjectRef):
         import asyncio
         loop = asyncio.get_running_loop()
@@ -1138,7 +1190,7 @@ class DriverRuntime:
         # Resolve the runtime env now: a broken env (task- OR
         # job-level) fails at .remote() with RuntimeEnvSetupError, and
         # dispatch/retries reuse the resolved result.
-        env_key, env_vars = self._env_for_options(options)
+        env_key, env_vars = self._env_for_options_cached(options)
         task_id = TaskID.for_normal_task(self.job_id)
         streaming = options.num_returns == "streaming"
         return_ids = [] if streaming else [
@@ -1175,7 +1227,7 @@ class DriverRuntime:
             self._execute_local(rec)
         else:
             with self._res_cv:
-                self._pending.append(rec)
+                self._pending_add_locked(rec)
                 self._res_cv.notify_all()
         if streaming:
             return ObjectRefGenerator(task_id.binary(), _owner=True)
@@ -1397,12 +1449,62 @@ class DriverRuntime:
                 rec = self._next_schedulable_locked()
             if self._shutdown:
                 return
-            if rec.state == "FAILED":
-                # dependency/placement error — already propagated
-                self._prune_task(rec)
+        self._dispatch_picked(rec)
+
+    def _try_dispatch_inline(self, limit: int = 4) -> None:
+        """Opportunistic dispatch on the CALLING thread (result-recv
+        or submit): every completed task used to hand off to the
+        dispatcher thread through a condvar — one GIL round-trip per
+        task on the hot path. Dispatching inline where the slot was
+        just freed (or the task just enqueued) removes the handoff;
+        the dispatcher thread remains as the blocking fallback.
+        Bounded so a recv thread never turns into the dispatcher for
+        an entire deep queue."""
+        for _ in range(limit):
+            with self._res_cv:
+                rec = self._next_schedulable_locked()
+            if rec is None:
                 return
-            # _next_schedulable_locked already picked the node/bundle
-            # and acquired the resources.
+            if rec.state != "FAILED" and not self._has_idle_worker(
+                    rec.env_key, rec.node_id):
+                # Dispatching would SPAWN a worker — a synchronous
+                # process boot that must not run on a result-recv
+                # thread (it would stall result processing and every
+                # _pool_lock taker for hundreds of ms). Hand back to
+                # the dispatcher thread.
+                with self._res_cv:
+                    self._pending.appendleft(rec)
+                    self._pending_classes[rec.sched_class] = (
+                        self._pending_classes.get(rec.sched_class, 0)
+                        + 1)
+                    if rec.arg_refs:
+                        self._pending_has_deps = True
+                    self._res_cv.notify_all()
+                self._release(rec.need or {},
+                              rec.options.placement_group,
+                              node_id=rec.node_id,
+                              bundle=rec.pg_bundle)
+                return
+            self._dispatch_picked(rec)
+
+    def _has_idle_worker(self, env_key: str, node_id: str) -> bool:
+        node_id = node_id or self.head_node_id
+        node = self._nodes.get(node_id)
+        if node is not None and node.is_daemon:
+            # Daemon-hosted workers spawn on the daemon, not here —
+            # dispatch is just a channel send either way.
+            return True
+        with self._pool_lock:
+            return any(not w.dead for w in
+                       self._idle.get((node_id, env_key), ()))
+
+    def _dispatch_picked(self, rec: TaskRecord) -> None:
+        """Dispatch a task _next_schedulable_locked returned (node and
+        resources already acquired), with the full failure handling."""
+        if rec.state == "FAILED":
+            # dependency/placement error — already propagated
+            self._prune_task(rec)
+            return
         try:
             self._dispatch(rec)
         except Exception:  # noqa: BLE001
@@ -1419,7 +1521,7 @@ class DriverRuntime:
                 rec.worker = None
                 rec.oom_killed = False
                 with self._res_cv:
-                    self._pending.append(rec)
+                    self._pending_add_locked(rec)
                     self._res_cv.notify_all()
                 return
             if rec.oom_killed:
@@ -1456,13 +1558,60 @@ class DriverRuntime:
                 return "error"
         return "ready"
 
+    @staticmethod
+    def _sched_class(need: dict[str, float], options) -> tuple:
+        """Scheduling-class key: everything _try_place_locked's
+        outcome depends on. Within one scheduling pass the cluster's
+        free resources don't change, so once one task of a class
+        fails to place, every later task of the same class will too —
+        skipping them turns the scan from O(pending) placement
+        attempts into O(distinct classes) (reference: tasks are
+        queued per SchedulingClass, scheduling_class_util.h)."""
+        pg = options.placement_group
+        return (tuple(sorted(need.items())),
+                options.scheduling_strategy or "DEFAULT",
+                pg.id if pg is not None else None,
+                options.placement_group_bundle_index,
+                options.node_id, options.soft)
+
+    def _pending_add_locked(self, rec: TaskRecord) -> None:
+        """Enqueue under _res_cv, keeping the per-class count and the
+        deps flag coherent. Class + need are computed once here."""
+        if rec.sched_class is None:
+            # Options instances are shared across calls of one remote
+            # handle — cache the derived class there so repeat submits
+            # skip the dict sort entirely.
+            cache = getattr(rec.options, "_sched_cache", None)
+            if cache is None:
+                need = self._effective_resources(rec.options)
+                cache = (need, self._sched_class(need, rec.options))
+                rec.options._sched_cache = cache
+            rec.need, rec.sched_class = cache
+        self._pending.append(rec)
+        self._pending_classes[rec.sched_class] = (
+            self._pending_classes.get(rec.sched_class, 0) + 1)
+        if rec.arg_refs:
+            self._pending_has_deps = True
+
+    def _pending_del_locked(self, i: int, rec: TaskRecord) -> None:
+        del self._pending[i]
+        c = self._pending_classes.get(rec.sched_class, 0) - 1
+        if c <= 0:
+            self._pending_classes.pop(rec.sched_class, None)
+        else:
+            self._pending_classes[rec.sched_class] = c
+
     def _next_schedulable_locked(self) -> TaskRecord | None:
+        unplaceable: set[tuple] = set()
+        saw_deps = False
         for i, rec in enumerate(self._pending):
+            if rec.arg_refs:
+                saw_deps = True
             deps = self._deps_state(rec)
             if deps == "error":
                 # Propagate the dependency's error to this task's
                 # returns (reference: error propagation through lineage).
-                del self._pending[i]
+                self._pending_del_locked(i, rec)
                 for r in rec.arg_refs:
                     blob = self._errors.get(r.id)
                     if blob is not None:
@@ -1473,13 +1622,15 @@ class DriverRuntime:
                 return rec
             if deps != "ready":
                 continue
-            need = self._effective_resources(rec.options)
+            klass = rec.sched_class
+            if klass in unplaceable:
+                continue
             try:
-                placed = self._try_place_locked(need, rec.options)
+                placed = self._try_place_locked(rec.need, rec.options)
             except PlacementError as e:
                 # Infeasible forever: fail the task now instead of
                 # leaving it pending (and keep the dispatcher alive).
-                del self._pending[i]
+                self._pending_del_locked(i, rec)
                 blob = ser.dumps(TaskError(rec.name, str(e), e))
                 for oid in rec.return_ids:
                     self._store_error(oid, blob)
@@ -1487,8 +1638,21 @@ class DriverRuntime:
                 return rec
             if placed is not None:
                 rec.node_id, rec.pg_bundle = placed
-                del self._pending[i]
+                self._pending_del_locked(i, rec)
                 return rec
+            unplaceable.add(klass)
+            if (not self._pending_has_deps
+                    and len(unplaceable) >= len(self._pending_classes)):
+                # Every class present in the queue has failed
+                # placement this pass — the rest can't fare better.
+                # Gated on the deps flag: dep-error propagation must
+                # reach tasks deeper in the queue, so dep-carrying
+                # queues always scan fully.
+                return None
+        # FULL fruitless scan: refresh the deps flag (under _res_cv)
+        # so result stores stop waking us when no pending task has
+        # arg deps at all.
+        self._pending_has_deps = saw_deps
         return None
 
     # -- node-aware placement (ClusterResourceScheduler analog,
@@ -2068,7 +2232,7 @@ class DriverRuntime:
             if lost and not self._try_reconstruct(aref.id):
                 return False        # an argument is unrecoverable
         try:
-            env_key, env_vars = self._env_for_options(lin.options)
+            env_key, env_vars = self._env_for_options_cached(lin.options)
         except Exception:  # noqa: BLE001
             return False
         rec = TaskRecord(
@@ -2086,9 +2250,26 @@ class DriverRuntime:
             lin.reconstructions += 1
         self._event(rec, "RECONSTRUCTING")
         with self._res_cv:
-            self._pending.append(rec)
+            self._pending_add_locked(rec)
             self._res_cv.notify_all()
         return True
+
+    def _env_for_options_cached(self, options: TaskOptions
+                                ) -> tuple[str, dict]:
+        """Options instances are shared across the calls of one remote
+        handle (remote_function template) — identical options resolve
+        to identical env, and the sha1-over-env hashing showed up in
+        submit profiles. Keyed on the runtime identity so a template
+        surviving shutdown/init re-resolves."""
+        cache = getattr(options, "_env_cache", None)
+        if cache is None or cache[0]() is not self:
+            ek, ev = self._env_for_options(options)
+            # weakref: options templates outlive runtimes (module
+            # globals) — a strong ref here would pin a dead runtime
+            # after shutdown until the handle's next submit.
+            cache = (weakref.ref(self), ek, ev)
+            options._env_cache = cache
+        return cache[1], cache[2]
 
     def _env_for_options(self, options: TaskOptions) -> tuple[str, dict]:
         from ray_tpu.runtime_env import (
@@ -2179,7 +2360,7 @@ class DriverRuntime:
 
     def _dispatch(self, rec: TaskRecord) -> None:
         if rec.env_vars is None:
-            rec.env_key, rec.env_vars = self._env_for_options(
+            rec.env_key, rec.env_vars = self._env_for_options_cached(
                 rec.options)
         env_key, env_vars = rec.env_key, rec.env_vars
         w = self._take_worker(env_key, env_vars, rec.node_id)
@@ -2291,6 +2472,9 @@ class DriverRuntime:
                       node_id=rec.node_id, bundle=rec.pg_bundle)
         self._return_worker(w)
         self._prune_task(rec)
+        # Fill the slot this completion just freed without a condvar
+        # handoff to the dispatcher thread (see _try_dispatch_inline).
+        self._try_dispatch_inline(limit=1)
 
     def _forget_worker(self, w: WorkerHandle) -> None:
         """Drop a worker from the pools without task-failure handling
@@ -2353,7 +2537,7 @@ class DriverRuntime:
             # crash must not be misreported as OOM.
             victim.oom_killed = False
             with self._res_cv:
-                self._pending.append(victim)
+                self._pending_add_locked(victim)
                 self._res_cv.notify_all()
         else:
             if victim.oom_killed:
@@ -2395,7 +2579,7 @@ class DriverRuntime:
         actor_id = ActorID.of(self.job_id)
         # Resolve eagerly: broken runtime_env raises here, at
         # ``Cls.remote()``, not inside the async start thread.
-        env_key, env_vars = self._env_for_options(options)
+        env_key, env_vars = self._env_for_options_cached(options)
         args_blob, arg_refs = self._pack_args(args, kwargs)
         rec = ActorRecord(
             actor_id=actor_id, name=name, cls_name=cls_name,
@@ -2434,7 +2618,8 @@ class DriverRuntime:
                     f"{self.config.actor_creation_timeout_s}s")
             rec.node_id, rec.pg_bundle = placed
             if rec.env_vars is None:
-                rec.env_key, rec.env_vars = self._env_for_options(
+                rec.env_key, rec.env_vars = \
+                    self._env_for_options_cached(
                     rec.options)
             w = self._make_worker(f"actor_{rec.actor_id.hex()[:8]}",
                                   rec.env_vars, rec.node_id)
@@ -2798,7 +2983,7 @@ class DriverRuntime:
         with self._res_cv:
             for i, rec in enumerate(self._pending):
                 if rec.task_id == task_id:
-                    del self._pending[i]
+                    self._pending_del_locked(i, rec)
                     blob = ser.dumps(TaskCancelledError(rec.name))
                     for oid in rec.return_ids:
                         self._store_error(oid, blob)
@@ -3018,10 +3203,20 @@ class DriverRuntime:
         } for n in recs]
 
     def _event(self, rec: TaskRecord, state: str) -> None:
-        self._events.append({
-            "task_id": rec.task_id.hex(), "name": rec.name,
-            "state": state, "ts": time.time(),
-        })
+        # Raw tuple on the hot path (3 appends per task); formatted
+        # into dicts lazily by task_events() at read time.
+        self._events.append((rec.task_id, rec.name, state, time.time()))
+
+    @staticmethod
+    def _format_event(ev) -> dict:
+        if isinstance(ev, dict):
+            return ev
+        tid, name, state, ts = ev
+        return {"task_id": tid.hex(), "name": name,
+                "state": state, "ts": ts}
+
+    def task_events(self) -> list[dict]:
+        return [self._format_event(e) for e in list(self._events)]
 
     def timeline(self) -> list[dict]:
         # Chrome-trace "X" events derived from task records
@@ -3399,10 +3594,10 @@ class DriverRuntime:
                 result = ObjectID.for_put(
                     next(self._put_counter)).binary()
             elif op == "put_loc_at":
-                oid_bytes, size, refs = payload
+                oid_bytes, size, refs, *pn = payload
                 oid = ObjectID(oid_bytes)
                 self._store_remote(oid, node.node_id, size, refs)
-                self.on_ref_escaped(oid)
+                self.on_ref_escaped(oid, pn[0] if pn else None)
                 result = None
             elif op == "locate":
                 # Directory lookup for a daemon's p2p pull: where does
@@ -3457,10 +3652,10 @@ class DriverRuntime:
                 # local store: assign the id centrally and record the
                 # location (directory entry). The remote holder pins it
                 # like any client put.
-                size, refs = payload
+                size, refs, *pn = payload
                 oid = ObjectID.for_put(next(self._put_counter))
                 self._store_remote(oid, node.node_id, size, refs)
-                self.on_ref_escaped(oid)
+                self.on_ref_escaped(oid, pn[0] if pn else None)
                 result = oid.binary()
             else:
                 raise ValueError(f"unknown node upcall {op!r}")
@@ -3615,7 +3810,8 @@ class DriverRuntime:
                 except Exception:  # noqa: BLE001
                     pass
 
-    def direct_put_commit(self, oid_bytes: bytes) -> bytes:
+    def direct_put_commit(self, oid_bytes: bytes,
+                          nonce: str | None = None) -> bytes:
         oid = ObjectID(oid_bytes)
         entry = self._pending_direct.pop(oid, None)
         if entry is None:
@@ -3635,7 +3831,7 @@ class DriverRuntime:
         with self._obj_cv:
             self._obj_locations[oid] = "shm"
             self._obj_cv.notify_all()
-        self.on_ref_escaped(oid)
+        self.on_ref_escaped(oid, nonce)
         with self._res_cv:
             self._res_cv.notify_all()
         return oid_bytes
@@ -3662,7 +3858,8 @@ class DriverRuntime:
             return out
         if action == "commit":
             conn_pending.discard(payload[1])
-            return self.direct_put_commit(payload[1])
+            return self.direct_put_commit(
+                payload[1], payload[2] if len(payload) > 2 else None)
         conn_pending.discard(payload[1])      # "abort"
         self.direct_put_abort(payload[1])
         return None
@@ -3719,27 +3916,29 @@ class DriverRuntime:
             return [r.id.binary() for r in refs]
         if op == P.OP_PUT:
             ref = self.put_serialized(_wire_to_serialized(payload))
-            self.on_ref_escaped(ref.id)  # a remote process holds it
+            # A remote process holds it; with a nonce (element 3) the
+            # putter registers a borrow that consumes this pin, so the
+            # ref's death reclaims the object. Legacy nonce-less puts
+            # pin permanently.
+            nonce = payload[3] if len(payload) > 3 else None
+            self.on_ref_escaped(ref.id, nonce)
             return ref.id.binary()
         if op == P.OP_GET:
             oid_bytes, timeout, *rest = payload
             allow_desc = rest[0] if rest else True
-            if allow_desc:
-                kind, val = self.get_serialized_or_desc(
-                    ObjectID(oid_bytes), timeout)
-                if kind == "desc":
-                    return ("desc", val)
-            else:
-                val = self.get_serialized(ObjectID(oid_bytes),
-                                          timeout)
-            if val.total_size > self.config.object_transfer_inline_max:
-                # Chunked pull (ObjectManager analog): the client
-                # fetches fixed-size chunks as separate req/resp
-                # rounds, so other client ops interleave instead of
-                # queueing behind one multi-GB message.
-                return self._start_transfer(val)
-            data, bufs = _sendable(val)
-            return ("inline", data, bufs)
+            return self._serve_get_entry(ObjectID(oid_bytes), timeout,
+                                         allow_desc)
+        if op == P.OP_GET_MANY:
+            oid_list, timeout, allow_desc = payload
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            outs = []
+            for ob in oid_list:
+                remaining = (None if deadline is None else
+                             max(deadline - time.monotonic(), 0.0))
+                outs.append(self._serve_get_entry(
+                    ObjectID(ob), remaining, allow_desc))
+            return outs
         if op == P.OP_PULL:
             action, tid, *prest = payload
             if action == "chunk":

@@ -233,19 +233,30 @@ class ClientRuntime:
     _DIRECT_PUT_MIN = 512 * 1024
 
     def put(self, value) -> ObjectRef:
+        from ray_tpu.core.object_ref import _new_nonce
         obj = ser.serialize(value, copy_buffers=False)
+        # The putter's own copy pins the object like any borrower —
+        # nonce-keyed, released by this ref's finalizer (a permanent
+        # owner-side pin leaked every client/worker put until session
+        # end, so looping puts grew the arena without bound and every
+        # put paid cold-page faults instead of reusing freed extents).
+        nonce = _new_nonce()
+        ref = None
         if self._allow_desc and obj.total_size >= self._DIRECT_PUT_MIN:
-            ref = self._try_put_direct(obj)
-            if ref is not None:
-                return ref
-        # Socket path: buffers must be real bytes (the wire pickles
-        # them; live views over the caller's arrays are not safe to
-        # ship asynchronously anyway).
-        obj = ser.materialize(obj)
-        oid_bytes = self._call(P.OP_PUT, ser.to_wire(obj))
-        return ObjectRef(ObjectID(oid_bytes))
+            ref = self._try_put_direct(obj, nonce)
+        if ref is None:
+            # Socket path: buffers must be real bytes (the wire
+            # pickles them; live views over the caller's arrays are
+            # not safe to ship asynchronously anyway).
+            obj = ser.materialize(obj)
+            oid_bytes = self._call(P.OP_PUT,
+                                   ser.to_wire(obj) + (nonce,))
+            ref = ObjectRef(ObjectID(oid_bytes))
+        self.on_ref_deserialized(ref, nonce)
+        return ref
 
-    def _try_put_direct(self, obj: SerializedObject) -> ObjectRef | None:
+    def _try_put_direct(self, obj: SerializedObject,
+                        nonce: str | None = None) -> ObjectRef | None:
         """Plasma-style same-host put: reserve a slot in the owner's
         arena, write the record directly, commit. Returns None when
         the arena isn't mappable from here (remote client, python-shm
@@ -285,7 +296,7 @@ class ClientRuntime:
                 write_record(view, obj)
             finally:
                 store.reserve_done()
-            self._call(P.OP_PUT_DIRECT, ("commit", oid_bytes))
+            self._call(P.OP_PUT_DIRECT, ("commit", oid_bytes, nonce))
             return ObjectRef(ObjectID(oid_bytes))
         except Exception:  # noqa: BLE001
             if oid_bytes is not None:
@@ -312,12 +323,33 @@ class ClientRuntime:
             lambda tid, i: self._call(P.OP_PULL, ("chunk", tid, i)),
             lambda tid: self._call(P.OP_PULL, ("end", tid)))
 
+    def get_serialized_many(self, oids: list[ObjectID],
+                            timeout: float | None = None
+                            ) -> list[SerializedObject]:
+        """ONE round trip for the whole list — the per-ref sequential
+        OP_GET loop paid one blocking RTT per ref, which dominated
+        worker-side get([...]) (multi_client_tasks_async)."""
+        outs = self._call(
+            P.OP_GET_MANY,
+            ([o.binary() for o in oids], timeout, self._allow_desc))
+        if isinstance(outs, tuple) and outs and outs[0] == "fallback":
+            # Daemon-hosted worker with some refs non-local: per-ref
+            # OP_GET keeps the daemon's p2p pull path in charge.
+            return [self.get_serialized(o, timeout) for o in oids]
+        return [self._pull_chunked(o) if o[0] == "chunked"
+                else _resolved_to_serialized(o) for o in outs]
+
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
-        out = [ser.deserialize(self.get_serialized(r.id, timeout))
-               for r in refs]
+        if len(refs) > 1:
+            objs = self.get_serialized_many([r.id for r in refs],
+                                            timeout)
+            out = [ser.deserialize(o) for o in objs]
+        else:
+            out = [ser.deserialize(self.get_serialized(r.id, timeout))
+                   for r in refs]
         return out[0] if single else out
 
     async def get_async(self, ref: ObjectRef):
@@ -592,6 +624,47 @@ def _run_maybe_async(fn, args, kwargs):
     return result
 
 
+_actor_async_loop = None
+_actor_async_loop_lock = threading.Lock()
+
+
+def _ensure_actor_loop():
+    """One persistent event loop per worker process for async actor
+    methods (reference: async actors run on the core worker's single
+    asyncio loop). asyncio.run per call built and tore down a loop
+    every invocation — ~4x slower and no cross-call concurrency:
+    coroutines from different max_concurrency threads never
+    interleaved."""
+    global _actor_async_loop
+    with _actor_async_loop_lock:
+        if _actor_async_loop is None:
+            import asyncio
+            loop = asyncio.new_event_loop()
+            threading.Thread(target=loop.run_forever, daemon=True,
+                             name="actor_async_loop").start()
+            _actor_async_loop = loop
+        return _actor_async_loop
+
+
+def _run_maybe_async_actor(fn, args, kwargs):
+    """Actor-method variant of _run_maybe_async: coroutines are
+    scheduled on the shared persistent loop, so concurrent calls
+    (max_concurrency pool threads) truly interleave their awaits. A
+    blocking call inside an async method stalls the loop — the same
+    documented anti-pattern as the reference's async actors."""
+    import inspect
+    if inspect.iscoroutinefunction(fn):
+        import asyncio
+        return asyncio.run_coroutine_threadsafe(
+            fn(*args, **kwargs), _ensure_actor_loop()).result()
+    result = fn(*args, **kwargs)
+    if inspect.iscoroutine(result):
+        import asyncio
+        return asyncio.run_coroutine_threadsafe(
+            result, _ensure_actor_loop()).result()
+    return result
+
+
 def worker_main(conn, client_address: str) -> None:
     from ray_tpu.core import api
 
@@ -689,7 +762,7 @@ def worker_main(conn, client_address: str) -> None:
                 bound = getattr(actor_instance, method)
 
             def run_and_maybe_stream():
-                result = _run_maybe_async(bound, args, kwargs)
+                result = _run_maybe_async_actor(bound, args, kwargs)
                 if num_returns == "streaming":
                     stream_out(task_id_bytes, result)
                     return None

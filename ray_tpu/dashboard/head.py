@@ -21,6 +21,14 @@ def _json_default(o):
     return str(o)
 
 
+def _spa_html() -> bytes:
+    import os
+    path = os.path.join(os.path.dirname(__file__), "static",
+                        "app.html")
+    with open(path, "rb") as f:
+        return f.read()
+
+
 class _Handler(BaseHTTPRequestHandler):
     runtime = None      # set by Dashboard
     head_agent = None   # NodeAgent sampling the head host
@@ -46,6 +54,11 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?")[0].rstrip("/") or "/"
         try:
             if path == "/":
+                # Single-page UI over the JSON endpoints (reference:
+                # python/ray/dashboard/client/ SPA, scope-reduced to
+                # static no-build JS).
+                self._send(200, _spa_html(), "text/html")
+            elif path == "/simple":
                 self._send(200, self._index(), "text/html")
             elif path == "/api/cluster":
                 self._send_json({

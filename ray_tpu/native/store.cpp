@@ -159,24 +159,34 @@ Entry* find_slot(Header* h, const uint8_t* id) {
   return nullptr;  // table full
 }
 
-// First-fit allocation from the free list.
+// Lowest-address-fit allocation from the free list. Address-ordered
+// placement keeps churny workloads cycling through the SAME arena
+// offsets: page tables populated by earlier writes stay valid in
+// every attached process, so a steady put/free loop pays page-fault
+// cost once instead of on every put. (Plain first-fit over the
+// unsorted list marched through fresh extents of the multi-GB arena —
+// ~12k minor faults per 50 MB put dominated the write path.)
 int64_t arena_alloc(Header* h, uint64_t size) {
   size = align8(size ? size : 8);
+  int64_t best = -1;
   for (uint32_t i = 0; i < h->num_free; ++i) {
     FreeBlock* b = &h->free_list[i];
-    if (b->size >= size) {
-      uint64_t off = b->offset;
-      b->offset += size;
-      b->size -= size;
-      if (b->size == 0) {
-        h->free_list[i] = h->free_list[h->num_free - 1];
-        h->num_free--;
-      }
-      h->used += size;
-      return static_cast<int64_t>(off);
+    if (b->size >= size &&
+        (best < 0 || b->offset < h->free_list[best].offset)) {
+      best = static_cast<int64_t>(i);
     }
   }
-  return -1;
+  if (best < 0) return -1;
+  FreeBlock* b = &h->free_list[best];
+  uint64_t off = b->offset;
+  b->offset += size;
+  b->size -= size;
+  if (b->size == 0) {
+    h->free_list[best] = h->free_list[h->num_free - 1];
+    h->num_free--;
+  }
+  h->used += size;
+  return static_cast<int64_t>(off);
 }
 
 void arena_free(Header* h, uint64_t offset, uint64_t size) {

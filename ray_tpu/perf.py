@@ -24,7 +24,13 @@ def timeit(name: str, fn, batch: int = 1, *, seconds: float = 2.0,
     """Run fn repeatedly for ~seconds, report batch*iters/elapsed."""
     if quick:
         seconds = 0.5
-    fn()                       # warmup (worker boot, fn shipping)
+    # Warm to steady state, not once: the first calls boot workers
+    # asynchronously (pool grows during the batch), and stragglers
+    # booting inside the timed window once cost a 25x phantom slowdown.
+    warm_deadline = time.perf_counter() + min(1.0, seconds)
+    fn()
+    while time.perf_counter() < warm_deadline:
+        fn()
     iters = 0
     start = time.perf_counter()
     deadline = start + seconds
@@ -60,6 +66,26 @@ class _Actor:
 class _AsyncActor:
     async def small_value(self) -> bytes:
         return b"ok"
+
+
+@ray_tpu.remote(num_cpus=0)
+def _client_task_driver(n_batches: int, batch: int):
+    """One 'client' of multi_client_tasks_async: a worker process
+    submitting task batches through its own client channel."""
+    @ray_tpu.remote(num_cpus=1)
+    def _noop():
+        return b"ok"
+
+    # Warm to steady state: the first batches grow the shared worker
+    # pool (boots are async — a straggler booting inside the timed
+    # region reads as a phantom 10x slowdown).
+    warm_deadline = time.perf_counter() + 1.5
+    while time.perf_counter() < warm_deadline:
+        ray_tpu.get([_noop.remote() for _ in range(batch)])
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        ray_tpu.get([_noop.remote() for _ in range(batch)])
+    return n_batches * batch / (time.perf_counter() - t0)
 
 
 def run_all(quick: bool = False) -> list[dict]:
@@ -116,6 +142,39 @@ def _run_benchmarks(rec, quick: bool) -> None:
                    [b.small_value.remote() for b in actors
                     for _ in range(25)]),
                batch=25 * n_actors, quick=quick))
+    async_actors = [_AsyncActor.options(max_concurrency=8).remote()
+                    for _ in range(n_actors)]
+    ray_tpu.get([b.small_value.remote() for b in async_actors])
+    rec(timeit("n_n_async_actor_calls_async",
+               lambda: ray_tpu.get(
+                   [b.small_value.remote() for b in async_actors
+                    for _ in range(25)]),
+               batch=25 * n_actors, quick=quick))
+
+    # Multiple client processes submitting tasks concurrently
+    # (reference: multi_client_tasks_async — each client is its own
+    # process with its own submission channel).
+    n_clients = 2 if quick else 4
+    n_batches, batch = (3, 20) if quick else (10, 50)
+    rates = ray_tpu.get(
+        [_client_task_driver.remote(n_batches, batch)
+         for _ in range(n_clients)], timeout=300)
+    mct = {"metric": "multi_client_tasks_async",
+           "value": round(sum(rates), 1), "unit": "calls/s",
+           "extra": {"clients": n_clients}}
+    print(json.dumps(mct), flush=True)
+    rec(mct)
+
+    # -- ref-heavy ops (reference: wait_1k_refs / 10k-refs get) --
+    refs_1k = [ray_tpu.put(b"x") for _ in range(1000)]
+    rec(timeit("single_client_wait_1k_refs",
+               lambda: ray_tpu.wait(refs_1k, num_returns=1000,
+                                    timeout=60), quick=quick))
+    big_list_ref = ray_tpu.put([ray_tpu.put(b"y")
+                                for _ in range(10_000)])
+    rec(timeit("single_client_get_object_containing_10k_refs",
+               lambda: ray_tpu.get(big_list_ref), quick=quick))
+    del refs_1k, big_list_ref
 
     # -- object store --
     small = b"x" * 1024
@@ -140,33 +199,59 @@ def _run_benchmarks(rec, quick: bool) -> None:
 
     # Multi-client: N workers putting concurrently (reference:
     # multi_client_put_gigabytes, plasma clients writing shm in
-    # parallel). Here worker puts traverse the client channel into
-    # the owner's arena, so this measures the whole ingest path.
+    # parallel; the reference sums per-client rates). Here worker
+    # puts traverse the client channel into the owner's arena, so
+    # this measures the whole ingest path. A barrier actor
+    # synchronizes the measured windows — without it, staggered
+    # warmups (worker boot, first-touch page faults) leak into other
+    # clients' windows and the aggregate reads ~4x low.
     # num_cpus=0: this measures the store's concurrent ingest, not
     # the CPU scheduler — on a 1-core box a CPU gate would serialize
     # the clients.
+    n_clients, n_puts, mb = 4, 3 if quick else 8, 50
+
     @ray_tpu.remote(num_cpus=0)
-    def _put_worker(n_puts: int, mb: int):
+    class _Barrier:
+        def __init__(self, n):
+            import threading
+            self._need = n
+            self._count = 0
+            self._lock = threading.Lock()
+            self._ev = threading.Event()
+
+        def arrive(self) -> bool:
+            with self._lock:
+                self._count += 1
+                if self._count >= self._need:
+                    self._ev.set()
+            return self._ev.wait(60)
+
+    @ray_tpu.remote(num_cpus=0)
+    def _put_worker(barrier, n_puts: int, mb: int):
         arr = np.zeros(mb << 20, dtype=np.uint8)
-        r = ray_tpu.put(arr)   # warm: arena attach + first reserve
-        del r
+        for _ in range(2):     # warm: attach, extents, page tables
+            r = ray_tpu.put(arr)
+            del r
+        if not ray_tpu.get(barrier.arrive.remote(), timeout=90):
+            raise RuntimeError(
+                "put barrier timed out — windows unsynchronized, the "
+                "aggregate would be wrong")
         t0 = time.perf_counter()
         for _ in range(n_puts):
             r = ray_tpu.put(arr)
             del r
-        return time.perf_counter() - t0
+        return n_puts * mb / 1024 / (time.perf_counter() - t0)
 
-    n_clients, n_puts, mb = 2, 3 if quick else 8, 50
-    t0 = time.perf_counter()
-    walls = ray_tpu.get(
-        [_put_worker.remote(n_puts, mb) for _ in range(n_clients)],
+    barrier = _Barrier.options(
+        max_concurrency=n_clients + 1).remote(n_clients)
+    rates = ray_tpu.get(
+        [_put_worker.remote(barrier, n_puts, mb)
+         for _ in range(n_clients)],
         timeout=300)
-    wall = time.perf_counter() - t0
-    total_gib = n_clients * n_puts * mb / 1024
     mc = {"metric": "multi_client_put_gigabytes",
-          "value": round(total_gib / wall, 2), "unit": "GiB/s",
+          "value": round(sum(rates), 2), "unit": "GiB/s",
           "extra": {"clients": n_clients,
-                    "max_client_wall_s": round(max(walls), 2)}}
+                    "per_client": [round(r, 2) for r in rates]}}
     print(json.dumps(mc), flush=True)
     rec(mc)
 

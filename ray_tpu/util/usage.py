@@ -68,7 +68,7 @@ def export_events(path: str, runtime=None) -> int:
         runtime = get_runtime()
     for attempt in range(5):
         try:
-            events = list(runtime._events)
+            events = runtime.task_events()
             break
         except RuntimeError:     # deque mutated during iteration
             if attempt == 4:

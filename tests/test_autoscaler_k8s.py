@@ -1,0 +1,158 @@
+"""Kubernetes node provider (reference:
+python/ray/autoscaler/_private/kuberay/node_provider.py — pods scaled
+through the API server; tested here against a fake API transport, the
+same zero-egress pattern as gce_tpu's MockRunner)."""
+
+import json
+
+import pytest
+
+from ray_tpu.autoscaler.k8s import K8sConfig, K8sNodeProvider
+
+
+class FakeApiServer:
+    """Injectable transport: a dict of pods + a request log."""
+
+    def __init__(self):
+        self.pods: dict[str, dict] = {}
+        self.log: list[tuple[str, str]] = []
+
+    def request(self, method, path, body=None):
+        self.log.append((method, path))
+        if method == "POST" and path.endswith("/pods"):
+            name = body["metadata"]["name"]
+            if name in self.pods:
+                return 409, {"reason": "AlreadyExists"}
+            self.pods[name] = body
+            return 201, body
+        if method == "DELETE":
+            name = path.rsplit("/", 1)[-1]
+            return (200, {}) if self.pods.pop(name, None) \
+                else (404, {})
+        if method == "GET" and "/pods" in path:
+            return 200, {"items": [
+                {"metadata": p["metadata"],
+                 "status": {"phase": "Running"}}
+                for p in self.pods.values()]}
+        return 404, {}
+
+
+def _provider(**cfg):
+    api = FakeApiServer()
+    defaults = dict(namespace="ns", name_prefix="raytpu",
+                    head_address="10.0.0.2:6380",
+                    cluster_token="deadbeef",
+                    accelerator_types={"v5e_8": "v5e-8"},
+                    tpu_chips={"v5e_8": 8})
+    defaults.update(cfg)
+    return K8sNodeProvider(K8sConfig(**defaults), transport=api), api
+
+
+def test_create_node_posts_tpu_pod():
+    p, api = _provider()
+    nid = p.create_node("v5e_8", {"CPU": 8, "TPU": 8})
+    assert nid in api.pods
+    pod = api.pods[nid]
+    assert pod["metadata"]["namespace"] == "ns"
+    assert pod["metadata"]["labels"]["ray-tpu.io/cluster"] == "raytpu"
+    spec = pod["spec"]
+    c = spec["containers"][0]
+    # Device-plugin chips + GKE TPU node selector + gang resource in
+    # the daemon command + head address + token env.
+    assert c["resources"]["limits"]["google.com/tpu"] == 8
+    assert spec["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "v5e-8"
+    cmd = c["command"][-1]
+    assert "TPU-v5e-8-head" in cmd
+    assert "--address 10.0.0.2:6380" in cmd
+    assert c["env"][0]["value"] == "deadbeef"
+    assert len(p.non_terminated_nodes()) == 1
+
+
+def test_cpu_node_type_has_no_tpu_bits():
+    p, api = _provider()
+    nid = p.create_node("cpu", {"CPU": 4})
+    pod = api.pods[nid]
+    assert "nodeSelector" not in pod["spec"]
+    assert "resources" not in pod["spec"]["containers"][0]
+    assert "TPU-" not in pod["spec"]["containers"][0]["command"][-1]
+
+
+def test_terminate_deletes_pod():
+    p, api = _provider()
+    nid = p.create_node("v5e_8", {"CPU": 8})
+    p.terminate_node(nid)
+    assert api.pods == {}
+    assert p.non_terminated_nodes() == []
+    # Deleting an already-gone pod (404) is not an error.
+    p.terminate_node(nid)
+
+
+def test_refresh_adopts_and_drops_pods():
+    p, api = _provider()
+    api.pods["raytpu-v5e_8-zzz"] = {
+        "metadata": {"name": "raytpu-v5e_8-zzz", "namespace": "ns",
+                     "labels": {"ray-tpu.io/cluster": "raytpu",
+                                "ray-tpu.io/node-type": "v5e_8"}},
+        "spec": {}}
+    p.refresh()
+    nodes = p.non_terminated_nodes()
+    assert [n.node_id for n in nodes] == ["raytpu-v5e_8-zzz"]
+    assert nodes[0].node_type == "v5e_8"
+    api.pods.clear()
+    p.refresh()
+    assert p.non_terminated_nodes() == []
+
+
+def test_pod_spec_overrides_merge():
+    p, api = _provider(pod_spec_overrides={
+        "serviceAccountName": "ray-sa",
+        "nodeSelector": {"pool": "tpu-pool"}})
+    nid = p.create_node("v5e_8", {"CPU": 8})
+    spec = api.pods[nid]["spec"]
+    assert spec["serviceAccountName"] == "ray-sa"
+    # Dict overrides merge with generated keys instead of replacing.
+    assert spec["nodeSelector"]["pool"] == "tpu-pool"
+    assert spec["nodeSelector"][
+        "cloud.google.com/gke-tpu-accelerator"] == "v5e-8"
+
+
+def test_create_failure_surfaces():
+    p, api = _provider()
+    nid = p.create_node("v5e_8", {"CPU": 8})
+    # Duplicate name -> 409 -> error (no silent half-created node).
+    api.pods["raytpu-v5e_8-dup"] = {}
+
+    class Dup:
+        def request(self, method, path, body=None):
+            return 409, {"reason": "AlreadyExists"}
+
+    p2 = K8sNodeProvider(K8sConfig(namespace="ns"), transport=Dup())
+    with pytest.raises(RuntimeError):
+        p2.create_node("cpu", {})
+    assert nid  # first provider unaffected
+
+
+def test_launcher_builds_k8s_provider(tmp_path):
+    """launcher YAML with provider: k8s creates/terminates fake pods
+    (VERDICT r3 item 10 done-condition)."""
+    from ray_tpu.autoscaler.launcher import _build_provider
+
+    api = FakeApiServer()
+    cfg = {
+        "cluster_name": "t",
+        "provider": {"type": "k8s", "namespace": "prod",
+                     "head_address": "1.2.3.4:6380",
+                     "_transport": api},
+        "node_types": {
+            "v5e_8": {"resources": {"CPU": 8, "TPU": 8},
+                      "accelerator_type": "v5e-8", "tpu_chips": 8},
+        },
+    }
+    p = _build_provider(cfg, runtime=None)
+    nid = p.create_node("v5e_8", {"CPU": 8, "TPU": 8})
+    assert api.pods[nid]["metadata"]["namespace"] == "prod"
+    assert api.pods[nid]["spec"]["containers"][0]["resources"][
+        "limits"]["google.com/tpu"] == 8
+    p.terminate_node(nid)
+    assert api.pods == {}

@@ -106,10 +106,10 @@ def test_launcher_up_scales_real_daemons_on_demand(tmp_path):
 
     # Pooled workers pin their node as busy until the worker idle TTL
     # reaps them; shorten it so scale-down happens inside the test.
-    import os
-    os.environ["RAY_TPU_IDLE_WORKER_TTL_S"] = "1.5"
-    import ray_tpu.core.config as ccfg
-    ccfg._global = None
+    from ray_tpu.core.config import env_overrides
+    import contextlib
+    scope = contextlib.ExitStack()
+    scope.enter_context(env_overrides(idle_worker_ttl_s=1.5))
 
     launcher = L.up(str(path))
     try:
@@ -136,5 +136,4 @@ def test_launcher_up_scales_real_daemons_on_demand(tmp_path):
         launcher.down()
         import ray_tpu.core.api as api
         api._runtime = None     # head runtime torn down by launcher
-        os.environ.pop("RAY_TPU_IDLE_WORKER_TTL_S", None)
-        ccfg._global = None
+        scope.close()

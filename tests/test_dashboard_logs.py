@@ -45,8 +45,14 @@ def test_dashboard_endpoints(rt):
         status, body = _get(dash.url + "/metrics")
         assert status == 200
 
+        # "/" serves the single-page UI (auto-refreshing tabs over
+        # the JSON endpoints); "/simple" keeps the plain table page.
         status, body = _get(dash.url + "/")
-        assert status == 200 and b"ray_tpu" in body
+        assert status == 200 and b'id="tabs"' in body \
+            and b"setInterval(refresh" in body
+        status, body = _get(dash.url + "/simple")
+        assert status == 200 and b"ray_tpu" in body \
+            and b"<table>" in body
 
         status, _ = _get(dash.url + "/api/timeline")
         assert status == 200

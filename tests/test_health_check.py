@@ -21,15 +21,13 @@ from ray_tpu.util.scheduling_strategies import (
 
 @pytest.fixture
 def fast_health_env():
-    os.environ["RAY_TPU_HEALTH_CHECK_PERIOD_S"] = "0.2"
-    os.environ["RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD"] = "5"
-    # Reset the cached global config so the overrides take effect.
-    import ray_tpu.core.config as cfg
-    cfg._global = None
-    yield
-    os.environ.pop("RAY_TPU_HEALTH_CHECK_PERIOD_S", None)
-    os.environ.pop("RAY_TPU_HEALTH_CHECK_FAILURE_THRESHOLD", None)
-    cfg._global = None
+    # Scoped config injection (VERDICT r3 item 8): env + cached
+    # config swapped atomically, restored on exit — no private-global
+    # poking.
+    from ray_tpu.core.config import env_overrides
+    with env_overrides(health_check_period_s=0.2,
+                       health_check_failure_threshold=5):
+        yield
 
 
 def test_sigstop_daemon_is_declared_dead_and_failed_over(
