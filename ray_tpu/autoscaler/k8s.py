@@ -73,7 +73,14 @@ class KubeApiTransport:
                                         timeout=60) as resp:
                 return resp.status, json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:  # noqa: PERF203
-            return e.code, json.loads(e.read() or b"{}")
+            body = e.read() or b"{}"
+            try:
+                return e.code, json.loads(body)
+            except ValueError:
+                # Proxies/ingresses return text bodies; keep the
+                # status + raw text instead of a decode traceback.
+                return e.code, {"raw": body.decode("utf-8",
+                                                   "replace")[:500]}
 
 
 @dataclass

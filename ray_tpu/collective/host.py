@@ -6,9 +6,9 @@ Reference analog: ``ray.util.collective`` — ring collectives as in
 the gloo backend (gloo_collective_group.py), rendezvous-via-named-
 store as in the NCCL unique-id pattern (nccl_collective_group.py).
 The data path is event-driven peer sockets (collective.mesh); the
-store actor never carries payload bytes. Set
-``RAY_TPU_COLLECTIVE_FUNNEL=1`` to fall back to the legacy
-store-actor funnel (also used for A/B in tests/benchmarks).
+store actor never carries payload bytes. (The legacy store-actor
+funnel data path was deleted after two rounds of ring soak — r3
+introduced the mesh, r4 removed the fallback.)
 
 This plane is for host arrays (control tensors, cross-slice
 coordination, parameter broadcast between gangs) — NOT the training
@@ -37,22 +37,15 @@ _GROUP_PREFIX = "ray_tpu_collective:"
 _local = {}  # group_name -> _GroupState
 
 
-def _use_funnel() -> bool:
-    return os.environ.get("RAY_TPU_COLLECTIVE_FUNNEL", "0") in (
-        "1", "true")
-
-
 @ray_tpu.remote
 class _GroupStore:
-    """Rendezvous (token + address exchange) and the legacy funnel
-    reduce path. In mesh mode no payload ever reaches this actor."""
+    """Rendezvous only (token + address exchange): no payload byte
+    ever reaches this actor."""
 
     def __init__(self, world_size: int, token: bytes):
         self.world_size = world_size
         self.token = token
         self.addrs: dict[int, tuple] = {}
-        self.ops: dict[tuple, dict] = {}     # (op_kind, seq) -> state
-        self.p2p: dict[tuple, Any] = {}      # (src, dst, seq) -> value
 
     def meta(self):
         return self.token, self.world_size
@@ -67,63 +60,6 @@ class _GroupStore:
 
     def num_registered(self) -> int:
         return len(self.addrs)
-
-    # -- legacy funnel ops (RAY_TPU_COLLECTIVE_FUNNEL=1) ---------------
-
-    def _entry(self, key):
-        if key not in self.ops:
-            self.ops[key] = {"parts": {}, "result": None, "fetched": 0}
-        return self.ops[key]
-
-    def contribute(self, op: str, seq: int, rank: int, value,
-                   reduce_op: str):
-        e = self._entry((op, seq))
-        e["parts"][rank] = value
-        if len(e["parts"]) == self.world_size and e["result"] is None:
-            parts = [e["parts"][r] for r in range(self.world_size)]
-            if op == "allreduce":
-                acc = np.asarray(parts[0]).copy()
-                for p in parts[1:]:
-                    if reduce_op == "sum":
-                        acc = acc + np.asarray(p)
-                    elif reduce_op == "max":
-                        acc = np.maximum(acc, p)
-                    elif reduce_op == "min":
-                        acc = np.minimum(acc, p)
-                    else:
-                        raise ValueError(reduce_op)
-                e["result"] = acc
-            elif op == "allgather":
-                e["result"] = parts
-            elif op == "reducescatter":
-                acc = np.asarray(parts[0]).copy()
-                for p in parts[1:]:
-                    acc = acc + np.asarray(p)
-                e["result"] = np.array_split(acc, self.world_size)
-            elif op == "barrier":
-                e["result"] = True
-        return e["result"] is not None
-
-    def fetch(self, op: str, seq: int, rank: int):
-        e = self.ops.get((op, seq))
-        if e is None or e["result"] is None:
-            return None, False
-        if op == "reducescatter":
-            result = e["result"][rank]
-        else:
-            result = e["result"]
-        e["fetched"] += 1
-        if e["fetched"] == self.world_size:
-            del self.ops[(op, seq)]
-        return result, True
-
-    def put_p2p(self, src: int, dst: int, seq: int, value):
-        self.p2p[(src, dst, seq)] = value
-
-    def get_p2p(self, src: int, dst: int, seq: int):
-        if (src, dst, seq) in self.p2p:
-            return self.p2p.pop((src, dst, seq)), True
-        return None, False
 
 
 class _GroupState:
@@ -144,8 +80,8 @@ class _GroupState:
 
 def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default") -> None:
-    """Join (rank 0 creates) the named group; establish the p2p mesh
-    unless the legacy funnel is forced."""
+    """Join (rank 0 creates) the named group; establish the p2p
+    rank-to-rank mesh."""
     name = _GROUP_PREFIX + group_name
     if rank == 0:
         token = os.urandom(16)
@@ -157,30 +93,28 @@ def init_collective_group(world_size: int, rank: int,
         token, ws = ray_tpu.get(handle.meta.remote())
         assert ws == world_size, (ws, world_size)
 
-    mesh = None
-    if not _use_funnel():
-        probe = os.environ.get("RAY_TPU_HEAD_IP", "127.0.0.1")
-        mesh = PeerMesh(rank, world_size, bytes(token),
-                        probe_host=probe)
-        ray_tpu.get(handle.register_addr.remote(rank, mesh.addr))
-        # Rendezvous wait (setup only — the data path never polls).
-        deadline = time.monotonic() + 60.0
-        addrs = None
-        while time.monotonic() < deadline:
-            addrs = ray_tpu.get(handle.addresses.remote())
-            if addrs is not None:
-                break
-            time.sleep(0.02)
-        if addrs is None:
-            try:
-                n_reg = ray_tpu.get(handle.num_registered.remote())
-            except Exception:  # noqa: BLE001
-                n_reg = "?"
-            mesh.close()
-            raise TimeoutError(
-                f"collective group {group_name!r}: only {n_reg}/"
-                f"{world_size} ranks registered within 60s")
-        mesh.set_addresses(addrs)
+    probe = os.environ.get("RAY_TPU_HEAD_IP", "127.0.0.1")
+    mesh = PeerMesh(rank, world_size, bytes(token),
+                    probe_host=probe)
+    ray_tpu.get(handle.register_addr.remote(rank, mesh.addr))
+    # Rendezvous wait (setup only — the data path never polls).
+    deadline = time.monotonic() + 60.0
+    addrs = None
+    while time.monotonic() < deadline:
+        addrs = ray_tpu.get(handle.addresses.remote())
+        if addrs is not None:
+            break
+        time.sleep(0.02)
+    if addrs is None:
+        try:
+            n_reg = ray_tpu.get(handle.num_registered.remote())
+        except Exception:  # noqa: BLE001
+            n_reg = "?"
+        mesh.close()
+        raise TimeoutError(
+            f"collective group {group_name!r}: only {n_reg}/"
+            f"{world_size} ranks registered within 60s")
+    mesh.set_addresses(addrs)
     _local[group_name] = _GroupState(handle, rank, world_size, mesh)
     try:
         barrier(group_name)
@@ -221,26 +155,9 @@ def _group(group_name: str) -> _GroupState:
     return _local[group_name]
 
 
-def _funnel_collective(st: _GroupState, op: str, value,
-                       reduce_op: str = "sum",
-                       timeout: float = 120.0):
-    seq = st.next_seq(op)
-    ray_tpu.get(st.handle.contribute.remote(op, seq, st.rank, value,
-                                            reduce_op))
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        result, ok = ray_tpu.get(st.handle.fetch.remote(op, seq, st.rank))
-        if ok:
-            return result
-        time.sleep(0.005)
-    raise TimeoutError(f"collective {op} timed out")
-
-
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     st = _group(group_name)
     x = np.asarray(tensor)
-    if st.mesh is None:
-        return _funnel_collective(st, "allreduce", x, op)
     return ring_allreduce(st.mesh, ("ar", st.next_seq("allreduce")),
                           x, op)
 
@@ -248,34 +165,24 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
 def allgather(tensor, group_name: str = "default") -> list:
     st = _group(group_name)
     x = np.asarray(tensor)
-    if st.mesh is None:
-        return _funnel_collective(st, "allgather", x)
     return ring_allgather(st.mesh, ("ag", st.next_seq("allgather")), x)
 
 
 def reducescatter(tensor, group_name: str = "default"):
     st = _group(group_name)
     x = np.asarray(tensor)
-    if st.mesh is None:
-        return _funnel_collective(st, "reducescatter", x)
     return ring_reducescatter(
         st.mesh, ("rsc", st.next_seq("reducescatter")), x)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     st = _group(group_name)
-    if st.mesh is None:
-        parts = _funnel_collective(st, "allgather", np.asarray(tensor))
-        return parts[src_rank]
     return ring_broadcast(st.mesh, ("bc", st.next_seq("broadcast")),
                           np.asarray(tensor), src_rank)
 
 
 def barrier(group_name: str = "default") -> None:
     st = _group(group_name)
-    if st.mesh is None:
-        _funnel_collective(st, "barrier", 0)
-        return
     # Distinct tag namespace: concurrent barrier/allreduce with
     # mismatched call order across ranks must never share tags.
     ring_allreduce(st.mesh, ("bar", st.next_seq("barrier")),
@@ -287,10 +194,6 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
     key = (st.rank, dst_rank)
     seq = st.p2p_seq.get(key, 0)
     st.p2p_seq[key] = seq + 1
-    if st.mesh is None:
-        ray_tpu.get(st.handle.put_p2p.remote(st.rank, dst_rank, seq,
-                                             np.asarray(tensor)))
-        return
     st.mesh.send(dst_rank, ("p2p", seq), np.asarray(tensor))
 
 
@@ -300,13 +203,4 @@ def recv(src_rank: int, group_name: str = "default",
     key = (src_rank, st.rank)
     seq = st.p2p_seq.get(key, 0)
     st.p2p_seq[key] = seq + 1
-    if st.mesh is None:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            value, ok = ray_tpu.get(
-                st.handle.get_p2p.remote(src_rank, st.rank, seq))
-            if ok:
-                return value
-            time.sleep(0.005)
-        raise TimeoutError(f"recv from {src_rank} timed out")
     return st.mesh.recv(src_rank, ("p2p", seq), timeout)

@@ -154,6 +154,24 @@ class TaskID(BaseID):
         return JobID(self._bytes[-JOB_ID_SIZE:])
 
 
+# Owner-embedding put ids (reference: ownership model — object ids
+# carry the owner's identity so locations resolve without a central
+# directory read, ownership_based_object_directory.cc). Layout:
+# 4-byte marker + 8-byte owner tag + 12 random bytes + 4-byte zero
+# index. The marker cannot collide with a nil task id (0xff...) and
+# has ~2^-32 collision odds against the random prefix of a real task
+# id per object.
+_OWNED_MARKER = b"\xfdO\xfdP"
+OWNER_TAG_SIZE = 8
+
+
+def owner_tag_of(node_id: str) -> bytes:
+    """Stable 8-byte tag for a node identity (embedded in the object
+    ids that node owns)."""
+    import hashlib
+    return hashlib.sha1(node_id.encode()).digest()[:OWNER_TAG_SIZE]
+
+
 class ObjectID(BaseID):
     """TaskID (24B) + little-endian return index (4B)."""
 
@@ -170,6 +188,15 @@ class ObjectID(BaseID):
         # ray.put objects likewise cannot be reconstructed).
         return cls(_NIL_TASK + index.to_bytes(4, "little"))
 
+    @classmethod
+    def for_owned_put(cls, owner_tag: bytes) -> "ObjectID":
+        """Put id minted BY the owning node: any process can route a
+        location query straight to the owner by parsing the id — no
+        central directory read, no id-minting RPC."""
+        assert len(owner_tag) == OWNER_TAG_SIZE
+        return cls(_OWNED_MARKER + owner_tag
+                   + _fast_random_bytes(12) + b"\x00\x00\x00\x00")
+
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[:TASK_ID_SIZE])
 
@@ -177,7 +204,14 @@ class ObjectID(BaseID):
         return int.from_bytes(self._bytes[TASK_ID_SIZE:], "little")
 
     def is_put_object(self) -> bool:
-        return self._bytes[:TASK_ID_SIZE] == _NIL_TASK
+        return (self._bytes[:TASK_ID_SIZE] == _NIL_TASK
+                or self._bytes[:4] == _OWNED_MARKER)
+
+    def owner_tag(self) -> bytes | None:
+        """The owning node's tag for owner-minted put ids, else None."""
+        if self._bytes[:4] == _OWNED_MARKER:
+            return self._bytes[4:4 + OWNER_TAG_SIZE]
+        return None
 
 
 class NodeID(BaseID):

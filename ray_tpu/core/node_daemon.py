@@ -119,6 +119,13 @@ class NodeDaemon:
                             self._object_listener.address[1])
         self._peer_pools: dict[tuple, list] = {}
         self._peer_lock = threading.Lock()
+        # Owner routing table (tag -> (node_id, obj_addr)), pushed by
+        # the head on membership change (ND_NODEMAP) and seeded right
+        # after registration: owner-minted object ids resolve to
+        # their holder WITHOUT a head directory read (reference:
+        # ownership_based_object_directory.cc).
+        self._owner_map: dict[bytes, tuple] = {}
+        self.owner_tag: bytes = b""
         # One in-flight p2p pull per oid: concurrent consumers of the
         # same remote object coalesce onto a single transfer, then
         # read the cached local copy.
@@ -233,6 +240,8 @@ class NodeDaemon:
             msg = conn.recv()
             if msg[0] == "registered":
                 self.node_id = msg[1]
+                from ray_tpu.core.ids import owner_tag_of
+                self.owner_tag = owner_tag_of(self.node_id)
                 self._pre_msgs = backlog
                 return conn
             if msg[0] == P.ND_PING:
@@ -388,6 +397,8 @@ class NodeDaemon:
                         event, slot = entry
                         slot.append((status, payload))
                         event.set()
+            elif kind == P.ND_NODEMAP:
+                    self._set_owner_map(msg[1])
             elif kind == P.ND_SHUTDOWN:
                     self._shutdown = True
                     return
@@ -808,11 +819,36 @@ class NodeDaemon:
             down_send((req_id, P.ST_OK,
                        self.transfer_plane.start(obj)))
 
+    def _set_owner_map(self, rows) -> None:
+        m: dict[bytes, tuple] = {}
+        for node_id, tag_hex, obj_addr in rows:
+            m[bytes.fromhex(tag_hex)] = (
+                node_id, tuple(obj_addr) if obj_addr else None)
+        self._owner_map = m
+
     def _pull_once(self, req_id: int, oid: ObjectID,
                    deadline: float | None, down_send) -> str:
         """One locate+pull attempt. Returns "served" (replied),
         "pending" (no location yet — caller loops), or "fallback"
-        (let the head relay path serve it)."""
+        (let the head relay path serve it).
+
+        Owner-minted ids resolve against the pushed owner map first —
+        steady-state cross-node gets never read the head's directory
+        (reference: ownership_based_object_directory.cc); the head
+        "locate" remains the bootstrap/failure fallback (owner died,
+        replica promotion, spill recovery)."""
+        tag = oid.owner_tag()
+        if tag is not None:
+            ent = self._owner_map.get(tag)
+            if (ent is not None and ent[0] != self.node_id
+                    and ent[1]):
+                try:
+                    obj = self._pull_from_peer(ent[1], oid, deadline)
+                except Exception:  # noqa: BLE001
+                    obj = None     # owner gone/raced: head fallback
+                if obj is not None:
+                    self._finish_pull(req_id, oid, obj, down_send)
+                    return "served"
         left = (None if deadline is None
                 else deadline - time.monotonic())
         loc = self._head_call(
@@ -825,6 +861,11 @@ class NodeDaemon:
                 and loc[2]):
             return "fallback"
         obj = self._pull_from_peer(tuple(loc[2]), oid, deadline)
+        self._finish_pull(req_id, oid, obj, down_send)
+        return "served"
+
+    def _finish_pull(self, req_id: int, oid: ObjectID, obj,
+                     down_send) -> None:
         # Cache node-locally (plasma caches pulled copies the same
         # way) so sibling consumers hit the _has_local fast path; the
         # head tracks the replica for free/promotion. A "stale"
@@ -843,7 +884,6 @@ class NodeDaemon:
                     self._local_oids.discard(oid)
                     self._local_obj_meta.pop(oid, None)
         self._reply_obj(req_id, obj, down_send)
-        return "served"
 
     # ------------------------------------------------------------------
     # local worker connections (exec attach + client splice)
@@ -1056,7 +1096,9 @@ class NodeDaemon:
                         store.delete(ObjectID(ob))
                     except Exception:  # noqa: BLE001
                         pass
-            oid_bytes = self._head_call("alloc_oid", None)
+            # Owner-minted id: no head RPC, and readers anywhere
+            # route to this daemon by parsing the id.
+            oid_bytes = ObjectID.for_owned_put(self.owner_tag).binary()
             store.direct_prepare(int(total))
             self._direct_pending[oid_bytes] = (int(total),
                                                list(refs or ()))
@@ -1106,10 +1148,26 @@ class NodeDaemon:
             obj = _wire_to_serialized(payload)
             refs = payload[2] if len(payload) > 2 and payload[2] else []
             nonce = payload[3] if len(payload) > 3 else None
-            oid_bytes = self._head_call(
-                "put_loc", (obj.total_size, refs, nonce))
-            self._store_local(ObjectID(oid_bytes), obj, refs=refs)
-            return oid_bytes
+            # Owner-minted id, stored HERE first (the owner is
+            # authoritative; a reader routed by the id's owner tag
+            # finds the bytes even before the head's bootstrap entry
+            # lands), then registered for refcounting/recovery.
+            oid = ObjectID.for_owned_put(self.owner_tag)
+            self._store_local(oid, obj, refs=refs)
+            try:
+                self._head_call(
+                    "put_loc_at",
+                    (oid.binary(), obj.total_size, refs, nonce))
+            except BaseException:
+                # Registration failed: roll the local copy back so a
+                # worker retry cannot leave untracked bytes.
+                self.memory_store.delete(oid)
+                self.shm_store.delete(oid)
+                with self._store_lock:
+                    self._local_oids.discard(oid)
+                    self._local_obj_meta.pop(oid, None)
+                raise
+            return oid.binary()
         if op == P.OP_GET:
             oid_bytes, _timeout, *rest = payload
             allow_desc = rest[0] if rest else True

@@ -109,6 +109,10 @@ ND_UPREPLY = "nd_upreply"     # (ND_UPREPLY, fid, status, payload)
 ND_SHUTDOWN = "nd_shutdown"   # (ND_SHUTDOWN,)
 ND_PING = "nd_ping"           # (ND_PING,) head -> daemon liveness probe
 ND_PONG = "nd_pong"           # (ND_PONG,) daemon -> head reply
+ND_NODEMAP = "nd_nodemap"     # (ND_NODEMAP, [(node_id, tag_hex,
+                              #   obj_addr)]) head -> daemons: owner
+                              #   routing table for owner-minted ids
+                              #   (pushed on membership change)
 
 
 # --- mutating-op dedupe -----------------------------------------------------

@@ -579,6 +579,16 @@ class DriverRuntime:
         # init resources; Cluster.add_node adds more logical nodes.
         self._res_cv = threading.Condition()
         self._nodes: dict[str, NodeRecord] = {}
+        # Owner-based directory (reference:
+        # ownership_based_object_directory.cc): owner-minted put
+        # ids embed an 8-byte node tag; this registry maps tags
+        # back to nodes so ANY process resolves such locations
+        # as a pure function of the id — _obj_locations is only
+        # the bootstrap/fallback for them. locate_calls counts
+        # daemon directory reads against the head (tests assert
+        # it stays flat in steady state).
+        self._owner_tags: dict[bytes, str] = {}
+        self.locate_calls = 0
         self._node_seq = itertools.count()
         self.head_node_id = self._add_node_locked_free(
             head_res, is_head=True)
@@ -1021,6 +1031,22 @@ class DriverRuntime:
                             [o for o in oids if o not in ready_set])
                 self._obj_cv.wait(remaining)
 
+    def _owned_route(self, oid: ObjectID):
+        """Directory-as-a-function-of-the-id: owner-minted put ids
+        resolve to their owner node with NO table read (reference:
+        ownership_based_object_directory.cc — locations come from the
+        owner, not a central store)."""
+        tag = oid.owner_tag()
+        if tag is None:
+            return None
+        nid = self._owner_tags.get(tag)
+        if nid is None:
+            return None
+        node = self._nodes.get(nid)
+        if node is None or not node.alive:
+            return None
+        return ("node", nid)
+
     def _wait_location(self, oid: ObjectID,
                        deadline: float | None) -> str:
         """Block until the object has a location; raises the stored
@@ -1028,6 +1054,9 @@ class DriverRuntime:
         ("node", node_id)."""
         with self._obj_cv:
             while oid not in self._obj_locations:
+                owned = self._owned_route(oid)
+                if owned is not None:
+                    return owned
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
@@ -1451,6 +1480,11 @@ class DriverRuntime:
                 return
         self._dispatch_picked(rec)
 
+    class _InlineNeedsSpawn(Exception):
+        """Raised by a spawn_ok=False dispatch when no pooled worker
+        exists: the recv thread must hand the task to the dispatcher
+        thread instead of forking a worker itself."""
+
     def _try_dispatch_inline(self, limit: int = 4) -> None:
         """Opportunistic dispatch on the CALLING thread (result-recv
         or submit): every completed task used to hand off to the
@@ -1467,25 +1501,34 @@ class DriverRuntime:
                 return
             if rec.state != "FAILED" and not self._has_idle_worker(
                     rec.env_key, rec.node_id):
-                # Dispatching would SPAWN a worker — a synchronous
-                # process boot that must not run on a result-recv
-                # thread (it would stall result processing and every
-                # _pool_lock taker for hundreds of ms). Hand back to
-                # the dispatcher thread.
-                with self._res_cv:
-                    self._pending.appendleft(rec)
-                    self._pending_classes[rec.sched_class] = (
-                        self._pending_classes.get(rec.sched_class, 0)
-                        + 1)
-                    if rec.arg_refs:
-                        self._pending_has_deps = True
-                    self._res_cv.notify_all()
-                self._release(rec.need or {},
-                              rec.options.placement_group,
-                              node_id=rec.node_id,
-                              bundle=rec.pg_bundle)
+                self._inline_hand_back(rec)
                 return
-            self._dispatch_picked(rec)
+            try:
+                self._dispatch_picked(rec, spawn_ok=False)
+            except self._InlineNeedsSpawn:
+                # Race: the idle worker we saw was taken before our
+                # _take_worker ran. Spawning here — on a result-recv
+                # thread, under _pool_lock — is exactly what this
+                # path must never do; hand back instead.
+                self._inline_hand_back(rec)
+                return
+
+    def _inline_hand_back(self, rec: TaskRecord) -> None:
+        """Undo an inline pick: re-enqueue at the FRONT, release the
+        acquired resources, and wake the dispatcher thread (which may
+        spawn a worker — a synchronous process boot that must not run
+        on a result-recv thread)."""
+        with self._res_cv:
+            self._pending.appendleft(rec)
+            self._pending_classes[rec.sched_class] = (
+                self._pending_classes.get(rec.sched_class, 0) + 1)
+            if rec.arg_refs:
+                self._pending_has_deps = True
+            self._res_cv.notify_all()
+        self._release(rec.need or {},
+                      rec.options.placement_group,
+                      node_id=rec.node_id,
+                      bundle=rec.pg_bundle)
 
     def _has_idle_worker(self, env_key: str, node_id: str) -> bool:
         node_id = node_id or self.head_node_id
@@ -1498,7 +1541,8 @@ class DriverRuntime:
             return any(not w.dead for w in
                        self._idle.get((node_id, env_key), ()))
 
-    def _dispatch_picked(self, rec: TaskRecord) -> None:
+    def _dispatch_picked(self, rec: TaskRecord,
+                         spawn_ok: bool = True) -> None:
         """Dispatch a task _next_schedulable_locked returned (node and
         resources already acquired), with the full failure handling."""
         if rec.state == "FAILED":
@@ -1506,7 +1550,9 @@ class DriverRuntime:
             self._prune_task(rec)
             return
         try:
-            self._dispatch(rec)
+            self._dispatch(rec, spawn_ok=spawn_ok)
+        except self._InlineNeedsSpawn:
+            raise
         except Exception:  # noqa: BLE001
             self._release(self._effective_resources(rec.options),
                           rec.options.placement_group,
@@ -1804,6 +1850,8 @@ class DriverRuntime:
         revive) a node-table entry."""
         node_id = node_id or \
             f"node_{next(self._node_seq):04d}_{os.urandom(4).hex()}"
+        from ray_tpu.core.ids import owner_tag_of
+        self._owner_tags[owner_tag_of(node_id)] = node_id
         self._nodes[node_id] = NodeRecord(
             node_id=node_id, resources=dict(resources),
             avail=dict(resources), labels=dict(labels or {}),
@@ -1838,6 +1886,7 @@ class DriverRuntime:
             node.alive = False
             node.avail = {}
             self._res_cv.notify_all()
+        self._broadcast_node_map()
         # Local worker processes pinned to the (logical) node die by
         # signal; daemon-hosted workers are marked dead here and fail
         # over through the same _on_worker_exit path their reader
@@ -2302,7 +2351,8 @@ class DriverRuntime:
         return WorkerHandle(self, env_key, env_vars, node_id=node_id)
 
     def _take_worker(self, env_key: str, env_vars: dict,
-                     node_id: str = "") -> WorkerHandle:
+                     node_id: str = "",
+                     spawn: bool = True) -> WorkerHandle | None:
         node_id = node_id or self.head_node_id
         with self._pool_lock:
             pool = self._idle.get((node_id, env_key), [])
@@ -2311,6 +2361,15 @@ class DriverRuntime:
                 if not w.dead:
                     w.busy = True
                     return w
+            if not spawn:
+                node = self._nodes.get(node_id)
+                if not (node is not None and node.is_daemon):
+                    # A local spawn would fork a process while
+                    # holding _pool_lock — the no-spawn caller (an
+                    # inline dispatch on a recv thread) hands back
+                    # instead. Daemon nodes spawn remotely (a cheap
+                    # channel send), so they are always allowed.
+                    return None
             w = self._make_worker(env_key, env_vars, node_id)
             w.busy = True
             self._workers.append(w)
@@ -2358,12 +2417,16 @@ class DriverRuntime:
                         keep.append(w)
                 self._idle[key] = keep
 
-    def _dispatch(self, rec: TaskRecord) -> None:
+    def _dispatch(self, rec: TaskRecord,
+                  spawn_ok: bool = True) -> None:
         if rec.env_vars is None:
             rec.env_key, rec.env_vars = self._env_for_options_cached(
                 rec.options)
         env_key, env_vars = rec.env_key, rec.env_vars
-        w = self._take_worker(env_key, env_vars, rec.node_id)
+        w = self._take_worker(env_key, env_vars, rec.node_id,
+                              spawn=spawn_ok)
+        if w is None:
+            raise self._InlineNeedsSpawn()
         rec.worker = w
         rec.worker_index = w.index
         rec.state = "RUNNING"
@@ -3408,6 +3471,31 @@ class DriverRuntime:
 
     # ---------------- node daemon channel (raylet link) ---------------
 
+    def _node_map_rows(self) -> list[tuple]:
+        from ray_tpu.core.ids import owner_tag_of
+        return [(n.node_id, owner_tag_of(n.node_id).hex(),
+                 n.object_addr)
+                for n in self._nodes.values()
+                if n.alive and n.is_daemon]
+
+    def _broadcast_node_map(self) -> None:
+        """Push the owner routing table to every daemon (and the
+        pubsub topic for other subscribers) on membership change —
+        the decentralized-resource-view seam (reference: ray_syncer
+        versioned snapshots, ray_syncer.h:88; scope-reduced to the
+        node/owner map daemons need for ownership routing)."""
+        rows = self._node_map_rows()
+        try:
+            self.pubsub_publish("__cluster_nodes__", ser.dumps(rows))
+        except Exception:  # noqa: BLE001
+            pass
+        for n in list(self._nodes.values()):
+            if n.alive and n.is_daemon and n.conn is not None:
+                try:
+                    n.node_send((P.ND_NODEMAP, rows))
+                except Exception:  # noqa: BLE001
+                    pass
+
     def _ensure_health_thread(self) -> None:
         """Active daemon health checking (reference:
         GcsHealthCheckManager, gcs_health_check_manager.h:39 — the
@@ -3502,6 +3590,7 @@ class DriverRuntime:
             # channel — adoption below may emit ND_WKILL, which would
             # otherwise arrive inside the daemon's handshake recv.
             node.node_send(("registered", node_id))
+            self._broadcast_node_map()
             # Re-registration after a head restart: rebuild the
             # directory entries for objects the daemon still stores
             # and re-adopt its surviving workers/actors (raylet
@@ -3588,11 +3677,6 @@ class DriverRuntime:
                 payload["node_id"] = node.node_id
                 self._agent_stats[node.node_id] = payload
                 result = None
-            elif op == "alloc_oid":
-                # Id assignment for a daemon-local direct put; the
-                # directory entry lands at commit via put_loc_at.
-                result = ObjectID.for_put(
-                    next(self._put_counter)).binary()
             elif op == "put_loc_at":
                 oid_bytes, size, refs, *pn = payload
                 oid = ObjectID(oid_bytes)
@@ -3607,6 +3691,7 @@ class DriverRuntime:
                 # ("pending",) tells the asker to re-poll (bounded
                 # wait keeps the upcall thread from parking forever).
                 oid_bytes, timeout = payload
+                self.locate_calls += 1
                 deadline = (None if timeout is None
                             else time.monotonic() + timeout)
                 try:
@@ -3647,16 +3732,6 @@ class DriverRuntime:
                         result = "primary"
                     else:
                         result = "stale"
-            elif op == "put_loc":
-                # A worker on this node put an object into the node's
-                # local store: assign the id centrally and record the
-                # location (directory entry). The remote holder pins it
-                # like any client put.
-                size, refs, *pn = payload
-                oid = ObjectID.for_put(next(self._put_counter))
-                self._store_remote(oid, node.node_id, size, refs)
-                self.on_ref_escaped(oid, pn[0] if pn else None)
-                result = oid.binary()
             else:
                 raise ValueError(f"unknown node upcall {op!r}")
             status, out = P.ST_OK, result

@@ -30,6 +30,7 @@ NCCL channels (torch_tensor_nccl_channel.py).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -109,6 +110,10 @@ class _ActorLoopSpec:
     nodes: list = field(default_factory=list)      # ordered _NodeSpec
     in_channels: dict = field(default_factory=dict)  # key|"__input__" -> Channel
     needs_input_value: bool = False
+    # (group_name, world_size, my_rank) when any DAG edge rides the
+    # cross-slice communicator: the loop joins the comm group before
+    # touching channels.
+    comm_join: tuple | None = None
 
 
 def _dag_actor_loop(actor_self, spec: _ActorLoopSpec):
@@ -126,6 +131,9 @@ def _dag_actor_loop(actor_self, spec: _ActorLoopSpec):
     from ray_tpu.core.exceptions import ActorError
     from ray_tpu.native.channel import ChannelClosedError
 
+    if spec.comm_join is not None:
+        from ray_tpu.dag.comm_channel import join_comm_group
+        join_comm_group(*spec.comm_join)
     for ch in spec.in_channels.values():
         ch.register_reader()
     for ns in spec.nodes:
@@ -208,6 +216,17 @@ def _dag_actor_loop(actor_self, spec: _ActorLoopSpec):
                 break
         if closed:
             break
+    # Cascade the shutdown: poison/close our OUT channels so blocked
+    # downstream readers unblock too. Communicator channels key
+    # receives by the WRITER's rank — only this loop can emit a close
+    # its consumers will actually see (the driver's teardown poison
+    # reaches only the channels the driver writes, i.e. the input).
+    for ns in spec.nodes:
+        if ns.out_channel is not None:
+            try:
+                ns.out_channel.close()
+            except BaseException:  # noqa: BLE001
+                pass
     return "dag-loop-done"
 
 
@@ -457,12 +476,59 @@ class CompiledDAG:
         if any(len(r) > 16 for r in chan_readers.values()):
             raise _ChannelModeIneligible
 
+        # Cross-slice edges (reference: TorchTensorNcclChannel picked
+        # per-edge behind the GPUCommunicator ABC): producer and
+        # consumers on the SAME node share a native shm channel;
+        # an edge crossing nodes — stages on different slices that
+        # cannot map one arena — rides CommChannel over the DCN
+        # communicator seam. Ranks: driver 0, actors 1..N.
+        from ray_tpu.core.api import get_runtime as _get_rt
+        _rt = _get_rt()
+
+        def _node_of(akey: bytes) -> str:
+            if akey == b"__driver__":
+                return getattr(_rt, "head_node_id", "")
+            try:
+                from ray_tpu.core.ids import ActorID
+                rec = _rt._actors.get(ActorID(akey))
+                return rec.node_id if rec is not None else ""
+            except Exception:  # noqa: BLE001
+                return ""
+
+        rank_of = {b"__driver__": 0}
+        for _i, _akey in enumerate(sorted(actor_nodes)):
+            rank_of[_akey] = _i + 1
+        comm_world = 1 + len(actor_nodes)
+        self._comm_group = None
+
+        head_node = getattr(_rt, "head_node_id", "")
+
+        def _edge_channel(tag: str, writer_akey: bytes,
+                          reader_akeys) -> Any:
+            # Native shm only when EVERY endpoint lives on the head
+            # node: the Channel's arena is created in the DRIVER's
+            # /dev/shm, which only head-node processes can map. Any
+            # other placement — cross-node OR same-remote-node —
+            # rides the communicator (reference: NCCL channels
+            # between non-colocated stages).
+            endpoints = [writer_akey, *reader_akeys]
+            if all(_node_of(a) in ("", head_node)
+                   for a in endpoints):
+                return Channel(buffer_size)
+            if self._comm_group is None:
+                self._comm_group = f"cdag_{os.urandom(6).hex()}"
+            from ray_tpu.dag.comm_channel import CommChannel
+            return CommChannel(
+                self._comm_group, tag, rank_of[writer_akey],
+                tuple(rank_of[r] for r in reader_akeys))
+
         # Create channels: one per produced node output with remote
         # consumers; one input channel.
         node_channels: dict[int, Any] = {}
         expected_readers: dict[str, int] = {}
         for pkey, readers in chan_readers.items():
-            ch = Channel(buffer_size)
+            wakey = node_actor[pkey].actor_id.binary()
+            ch = _edge_channel(f"e{pkey}", wakey, readers)
             node_channels[pkey] = ch
             expected_readers[ch.name] = len(readers)
         # Source actors (no inbound channels) use the input channel as
@@ -488,7 +554,8 @@ class CompiledDAG:
             raise _ChannelModeIneligible
         self._input_channel = None
         if input_readers:
-            self._input_channel = Channel(buffer_size)
+            self._input_channel = _edge_channel(
+                "e__input__", b"__driver__", input_readers)
             expected_readers[self._input_channel.name] = len(
                 input_readers)
             for akey in input_readers:
@@ -506,11 +573,20 @@ class CompiledDAG:
         # loops block on channel reads forever and the caller holds no
         # object to call teardown() on (the constructor raised).
         self._loop_refs = []
+        if self._comm_group is not None:
+            # Everyone joins (the group rendezvous is a barrier over
+            # the FULL world, driver included).
+            for akey, spec in specs.items():
+                spec.comm_join = (self._comm_group, comm_world,
+                                  rank_of[akey])
         try:
             for akey, spec in specs.items():
                 h = actor_handle[akey]
                 self._loop_refs.append(
                     h.__ray_call__.remote(_dag_actor_loop, spec))
+            if self._comm_group is not None:
+                from ray_tpu.dag.comm_channel import join_comm_group
+                join_comm_group(self._comm_group, comm_world, 0)
 
             # Handshake: wait until every channel has all its readers
             # registered (loops are up) before allowing the first
@@ -801,6 +877,10 @@ class CompiledDAG:
             self._all_channels = []
             self._out_channels = {}
             self._input_channel = None
+            if getattr(self, "_comm_group", None) is not None:
+                from ray_tpu.dag.comm_channel import leave_comm_group
+                leave_comm_group(self._comm_group)
+                self._comm_group = None
 
     def __del__(self):
         try:

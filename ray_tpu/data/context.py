@@ -16,6 +16,15 @@ from dataclasses import dataclass
 class DataContext:
     # Max block tasks in flight per stage (streaming backpressure).
     max_in_flight: int = 16
+    # Object-store occupancy budget for task launches (bytes; 0 =
+    # unlimited). When set, stages stop launching while the store is
+    # past the budget — see data.backpressure.StoreMemoryPolicy
+    # (reference: resource_manager.py store memory gating).
+    object_store_budget_bytes: int = 0
+    # Full custom policy chain (list of BackpressurePolicy); None =
+    # built from the knobs above (reference: the pluggable
+    # backpressure_policy/ registry).
+    backpressure_policies: list | None = None
     # Default parallelism for range/from_* sources.
     default_parallelism: int = 8
     # Hash-shuffle partition cap for groupby.

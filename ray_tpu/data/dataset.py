@@ -332,10 +332,12 @@ class Dataset:
             if kind == "source":
                 read_fns, fused = payload
                 refs = _bounded_submit(_src_tasks(read_fns, fused),
-                                       max_in_flight)
+                                       max_in_flight,
+                                       op_name="source")
             elif kind == "fused":
                 refs = _bounded_submit(_fused_tasks(refs, payload),
-                                       max_in_flight)
+                                       max_in_flight,
+                                       op_name="map")
             elif kind == "actor_map":
                 refs = _actor_map(refs, payload)
             elif kind == "repartition":
@@ -730,17 +732,55 @@ def _actor_map(upstream, op: _MapBatches):
                 pass
 
 
-def _bounded_submit(task_iter, max_in_flight: int):
-    """Submit lazily, keeping <= max_in_flight outstanding; yield refs
-    in submission order (the backpressure loop)."""
+def _bounded_submit(task_iter, max_in_flight: int,
+                    op_name: str = "map"):
+    """Submit lazily under the backpressure policy chain; yield refs
+    in submission order.
+
+    Reference: the streaming executor consulting its backpressure
+    policies before each task launch
+    (backpressure_policy/concurrency_cap_backpressure_policy.py) with
+    per-operator usage accounting (execution/resource_manager.py).
+    The concurrency cap is always active; a store-memory budget (and
+    any custom policies) come from the DataContext."""
+    import time as _time
+
+    from ray_tpu.data.backpressure import (
+        default_policies,
+        get_resource_manager,
+        ref_nbytes,
+    )
+    policies = default_policies(max_in_flight)
+    manager = get_resource_manager()
+    usage = manager.register(op_name)
     pending: list = []
+
+    def harvest_one():
+        # Wait on the HEAD (not any-of): yields are in submission
+        # order anyway, and a head that is still running must not be
+        # counted as a completed zero-byte block — that would shrink
+        # the operator's average output size and over-admit launches.
+        ray_tpu.wait([pending[0]], num_returns=1)
+        ref = pending.pop(0)
+        usage.in_flight = len(pending)
+        usage.blocks_done += 1
+        usage.bytes_done += ref_nbytes(ref)
+        return ref
+
     for fn, args in task_iter:
-        while len(pending) >= max_in_flight:
-            ray_tpu.wait(pending, num_returns=1)
-            yield pending.pop(0)
+        while not all(p.can_launch(usage, manager) for p in policies):
+            if pending:
+                yield harvest_one()
+            else:
+                # Over budget with nothing of ours in flight: the
+                # bytes belong to neighbors — sample again shortly.
+                # (Policies admit when in_flight == 0, so only a
+                # custom policy can reach here.)
+                _time.sleep(0.01)
         pending.append(fn.remote(*args))
+        usage.in_flight = len(pending)
     while pending:
-        yield pending.pop(0)
+        yield harvest_one()
 
 
 @ray_tpu.remote

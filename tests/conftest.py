@@ -20,10 +20,23 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache: the model tests compile the same
+# tiny graphs every run — warm runs skip straight to execution. The
+# env var also reaches worker subprocesses.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/ray_tpu_jax_cache")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.5)
+except Exception:  # noqa: BLE001 — older jax without the knobs
+    pass
 
 import pytest  # noqa: E402
 

@@ -4,7 +4,7 @@ as in nccl_collective_group.py's unique-id pattern).
 
 The VERDICT r2 "done" bar: a 100 MB fp32 allreduce across 4
 daemon-hosted ranks completes with no polling in the data path and
-beats the legacy store-funnel by >=5x at that size."""
+finishes a 100 MB fp32 allreduce within an absolute wall cap."""
 
 import time
 
@@ -88,7 +88,12 @@ def test_ring_ops_correct(rt):
     assert outs[-1]["p2p"] == [123.0]
 
 
-def test_100mb_allreduce_on_daemon_ranks_beats_funnel():
+def test_100mb_allreduce_on_daemon_ranks():
+    """100 MB fp32 allreduce across 4 daemon-hosted ranks over the
+    ring mesh. (The legacy store-funnel A/B leg was deleted with the
+    funnel itself in r4; the bar is now an absolute wall cap, set ~8x
+    above the typical ~1.3 s so only a pathological regression —
+    e.g. payload bytes relayed through the head again — trips it.)"""
     cluster = Cluster(initialize_head=True,
                       head_node_args={"num_cpus": 0})
     try:
@@ -96,10 +101,9 @@ def test_100mb_allreduce_on_daemon_ranks_beats_funnel():
             cluster.add_node(num_cpus=1)
         n = 4
 
-        def run(group, env, n_elem, get_timeout=300):
-            ranks = [Rank.options(
-                num_cpus=1, runtime_env={"env_vars": env}).remote(r, n)
-                for r in range(n)]
+        def run(group, n_elem, get_timeout=300):
+            ranks = [Rank.options(num_cpus=1).remote(r, n)
+                     for r in range(n)]
             ray_tpu.get([m.join.remote(group) for m in ranks],
                         timeout=120)
             # Warm one small round, then time the big one.
@@ -119,23 +123,9 @@ def test_100mb_allreduce_on_daemon_ranks_beats_funnel():
         n_elem = 25_000_000                   # 100 MB fp32
         # Best of two: on this 1-core box a single run can absorb a
         # scheduler hiccup worth seconds (typical: ~1.3s).
-        mesh_wall = min(run("ring_mesh_a", {}, n_elem),
-                        run("ring_mesh_b", {}, n_elem))
-        # The funnel leg at the same size routinely exceeds any sane
-        # test budget on daemon-hosted ranks (head-relayed actor
-        # args — the pathology this change removes): cap it and use
-        # the cap as a LOWER bound on its wall time.
-        funnel_cap = max(60.0, mesh_wall * 8)
-        try:
-            funnel_wall = run("ring_funnel",
-                              {"RAY_TPU_COLLECTIVE_FUNNEL": "1"},
-                              n_elem, get_timeout=funnel_cap)
-        except (TimeoutError, ray_tpu.GetTimeoutError):
-            funnel_wall = funnel_cap     # timeout => lower bound
-        speedup = funnel_wall / mesh_wall
-        print(f"100MB allreduce x4 daemon ranks: mesh "
-              f"{mesh_wall:.2f}s, funnel {funnel_wall:.2f}s "
-              f"(cap {funnel_cap:.0f}s), speedup >= {speedup:.1f}x")
-        assert speedup >= 5.0, (mesh_wall, funnel_wall)
+        mesh_wall = min(run("ring_mesh_a", n_elem),
+                        run("ring_mesh_b", n_elem))
+        print(f"100MB allreduce x4 daemon ranks: {mesh_wall:.2f}s")
+        assert mesh_wall < 12.0, mesh_wall
     finally:
         cluster.shutdown()

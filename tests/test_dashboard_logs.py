@@ -176,8 +176,9 @@ def test_node_agent_reports_reach_dashboard():
             assert row["mem_total"] > 0
             assert row["pid"] == nb.proc.pid
             assert "head" in stats            # head self-sample
-            # The HTML index renders the node table.
-            with urllib.request.urlopen(dash.url + "/",
+            # The server-rendered node table lives at /simple now
+            # ("/" is the client-rendered SPA).
+            with urllib.request.urlopen(dash.url + "/simple",
                                         timeout=10) as r:
                 html = r.read().decode()
             assert "Nodes" in html and nb.node_id in html

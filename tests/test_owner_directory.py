@@ -1,0 +1,111 @@
+"""Owner-based object directory (reference:
+src/ray/object_manager/ownership_based_object_directory.cc): put ids
+minted by node daemons embed the owner's tag, so any process resolves
+their location as a function of the id — the head's location table is
+bootstrap/fallback only. Steady-state cross-node gets must not read
+the head directory (locate_calls counter-asserted, the same pattern as
+test_p2p_transfer's _relay_chunks)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.ids import ObjectID, owner_tag_of
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+def test_owned_id_roundtrip_and_parse():
+    tag = owner_tag_of("node_0001_abcd1234")
+    oid = ObjectID.for_owned_put(tag)
+    assert oid.owner_tag() == tag
+    assert oid.is_put_object()
+    # Non-owned forms parse as not-owned.
+    assert ObjectID.for_put(7).owner_tag() is None
+    assert ObjectID.for_put(7).is_put_object()
+    # Distinct mints are distinct.
+    assert ObjectID.for_owned_put(tag) != oid
+
+
+@pytest.fixture
+def two_nodes():
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    na = cluster.add_node(num_cpus=1)
+    nb = cluster.add_node(num_cpus=1)
+    yield cluster, na, nb
+    cluster.shutdown()
+
+
+def _affinity(node):
+    return NodeAffinitySchedulingStrategy(node.node_id, soft=False)
+
+
+def test_cross_node_get_skips_head_directory(two_nodes):
+    cluster, na, nb = two_nodes
+    rt = ray_tpu.core.api.get_runtime()
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        arr = np.arange(2_000_000, dtype=np.float64)   # 16 MB
+        return [ray_tpu.put(arr)]      # nested ref: stays node-local
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(box):
+        return float(ray_tpu.get(box[0])[1_234_567])
+
+    [ref] = ray_tpu.get(produce.options(
+        scheduling_strategy=_affinity(nb)).remote(), timeout=60)
+    # The id itself names the owner.
+    assert ref.id.owner_tag() == owner_tag_of(nb.node_id)
+
+    # Steady state: consumer on A pulls from owner B with ZERO head
+    # directory reads (owner map was pushed at registration).
+    locate0 = rt.locate_calls
+    out = ray_tpu.get(consume.options(
+        scheduling_strategy=_affinity(na)).remote([ref]), timeout=60)
+    assert out == 1_234_567.0
+    assert rt.locate_calls == locate0, \
+        "cross-node get read the head directory"
+
+
+def test_head_table_loss_does_not_lose_owned_locations(two_nodes):
+    """The head's _obj_locations entry is only a bootstrap: dropping
+    it (what a head restart loses before owners re-report) must not
+    break resolution — the owner still serves the object."""
+    cluster, na, nb = two_nodes
+    rt = ray_tpu.core.api.get_runtime()
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        arr = np.arange(1_000_000, dtype=np.float64)   # 8 MB
+        return [ray_tpu.put(arr)]
+
+    [ref] = ray_tpu.get(produce.options(
+        scheduling_strategy=_affinity(nb)).remote(), timeout=60)
+    with rt._obj_cv:
+        assert rt._obj_locations.pop(ref.id, None) is not None
+    out = ray_tpu.get(ref, timeout=60)
+    assert float(out[999_999]) == 999_999.0
+
+
+def test_owner_map_updates_on_node_death(two_nodes):
+    cluster, na, nb = two_nodes
+    rt = ray_tpu.core.api.get_runtime()
+    tag_b = owner_tag_of(nb.node_id)
+    assert rt._owner_tags.get(tag_b) == nb.node_id
+    rows = rt._node_map_rows()
+    assert any(r[0] == nb.node_id for r in rows)
+    cluster.remove_node(nb)
+    deadline = time.time() + 15
+    while (any(r[0] == nb.node_id for r in rt._node_map_rows())
+           and time.time() < deadline):
+        time.sleep(0.1)
+    assert not any(r[0] == nb.node_id for r in rt._node_map_rows())
+    # Owned route for a dead owner returns None -> fallback paths.
+    oid = ObjectID.for_owned_put(tag_b)
+    assert rt._owned_route(oid) is None
