@@ -108,6 +108,15 @@ class Config:
     task_event_buffer_size: int = 10000
     log_dir: str = "/tmp/ray_tpu_sessions/logs"
 
+    # --- workers ---
+    # Env vars CLEARED in CPU-only workers' environments (comma
+    # separated). Default: the ambient TPU-plugin sitecustomize
+    # trigger — eagerly importing the device runtime at interpreter
+    # start costs ~0.5 s of boot churn per worker that CPU workers
+    # never need. Deployment images with different plugin hooks
+    # override this flag.
+    cpu_worker_clear_env: str = "PALLAS_AXON_POOL_IPS"
+
     # --- TPU / device ---
     # Treat a multi-host TPU slice as an atomic gang-scheduled unit.
     gang_schedule_slices: bool = True

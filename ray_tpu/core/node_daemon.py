@@ -586,12 +586,7 @@ class NodeDaemon:
                 self.transfer_plane.end(payload)
                 result = None
             elif op == "free":
-                oid = ObjectID(payload)
-                self.memory_store.delete(oid)
-                self.shm_store.delete(oid)
-                with self._store_lock:
-                    self._local_oids.discard(oid)
-                    self._local_obj_meta.pop(oid, None)
+                self._drop_local(ObjectID(payload))
                 result = None
             else:
                 raise ValueError(f"unknown node call {op!r}")
@@ -878,11 +873,7 @@ class NodeDaemon:
             except Exception:  # noqa: BLE001
                 verdict = None
             if verdict not in ("ok", "primary"):
-                self.memory_store.delete(oid)
-                self.shm_store.delete(oid)
-                with self._store_lock:
-                    self._local_oids.discard(oid)
-                    self._local_obj_meta.pop(oid, None)
+                self._drop_local(oid)
         self._reply_obj(req_id, obj, down_send)
 
     # ------------------------------------------------------------------
@@ -1066,6 +1057,16 @@ class NodeDaemon:
             except OSError:
                 pass
 
+    def _drop_local(self, oid: ObjectID) -> None:
+        """Evict one object's local copy + bookkeeping (shared by the
+        stale-replica, pull-cache-rejection, and put-rollback paths —
+        three sites that must never diverge)."""
+        self.memory_store.delete(oid)
+        self.shm_store.delete(oid)
+        with self._store_lock:
+            self._local_oids.discard(oid)
+            self._local_obj_meta.pop(oid, None)
+
     def _has_local(self, oid: ObjectID) -> bool:
         with self._store_lock:
             return oid in self._local_oids
@@ -1161,11 +1162,7 @@ class NodeDaemon:
             except BaseException:
                 # Registration failed: roll the local copy back so a
                 # worker retry cannot leave untracked bytes.
-                self.memory_store.delete(oid)
-                self.shm_store.delete(oid)
-                with self._store_lock:
-                    self._local_oids.discard(oid)
-                    self._local_obj_meta.pop(oid, None)
+                self._drop_local(oid)
                 raise
             return oid.binary()
         if op == P.OP_GET:

@@ -2329,6 +2329,15 @@ class DriverRuntime:
         if need.get("TPU", 0) <= 0:
             # CPU-only workers must not grab the TPU runtime.
             env_vars["JAX_PLATFORMS"] = "cpu"
+            # Also clear the configured TPU-plugin bootstrap vars so
+            # the ambient sitecustomize doesn't eagerly import the
+            # device runtime at interpreter start (~0.5 s of boot
+            # churn per worker that starved running tasks ~25x while
+            # a pool grew). Flag-driven: deployment images with
+            # different plugin hooks set cpu_worker_clear_env.
+            for name in self.config.cpu_worker_clear_env.split(","):
+                if name.strip():
+                    env_vars[name.strip()] = ""
         merged = merge_runtime_envs(self.job_runtime_env,
                                     options.runtime_env)
         # Plugin build happens driver-side (the per-node agent analog,

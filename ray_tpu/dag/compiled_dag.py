@@ -227,6 +227,12 @@ def _dag_actor_loop(actor_self, spec: _ActorLoopSpec):
                 ns.out_channel.close()
             except BaseException:  # noqa: BLE001
                 pass
+    if spec.comm_join is not None:
+        # Leave the per-DAG comm group: without this, every compile/
+        # teardown cycle leaks a joined PeerMesh (sockets + group
+        # state) inside the stage actor for the actor's lifetime.
+        from ray_tpu.dag.comm_channel import leave_comm_group
+        leave_comm_group(spec.comm_join[0])
     return "dag-loop-done"
 
 

@@ -24,13 +24,25 @@ def timeit(name: str, fn, batch: int = 1, *, seconds: float = 2.0,
     """Run fn repeatedly for ~seconds, report batch*iters/elapsed."""
     if quick:
         seconds = 0.5
-    # Warm to steady state, not once: the first calls boot workers
-    # asynchronously (pool grows during the batch), and stragglers
-    # booting inside the timed window once cost a 25x phantom slowdown.
-    warm_deadline = time.perf_counter() + min(1.0, seconds)
+    # Warm to a STABLE state, not a fixed duration: worker boots are
+    # asynchronous, and straggler boots (each importing numpy/jax on
+    # one core) can depress a fixed window by ~25x. Keep warming
+    # until three consecutive calls agree within 25%, or the warmup
+    # budget runs out.
     fn()
+    warm_deadline = time.perf_counter() + (1.0 if quick else 5.0)
+    prev, stable = None, 0
     while time.perf_counter() < warm_deadline:
+        t0 = time.perf_counter()
         fn()
+        dt = time.perf_counter() - t0
+        if prev is not None and 0.75 * prev <= dt <= 1.25 * prev:
+            stable += 1
+            if stable >= 3:
+                break
+        else:
+            stable = 0
+        prev = dt
     iters = 0
     start = time.perf_counter()
     deadline = start + seconds
@@ -302,4 +314,9 @@ def main(argv: list[str] | None = None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    # Route through the importable module: under `python -m`, the
+    # remote functions above would live in __main__ and cloudpickle
+    # them by value per submission — that benchmarks the by-value
+    # serialization path, not the framework's steady-state task path.
+    from ray_tpu import perf as _perf
+    raise SystemExit(_perf.main())

@@ -76,21 +76,29 @@ def test_two_slice_pipeline_over_communicator(two_nodes):
         cdag.teardown()
 
 
-def test_same_node_stages_keep_shm_channels(two_nodes):
+def test_head_colocated_stages_keep_shm_channels(two_nodes):
+    """Per-edge transport selection: stages WITHOUT affinity land on
+    the head node with the driver, so every edge keeps the native shm
+    channel and no comm group is created; daemon-placed stages (the
+    other test) get CommChannels. (Native shm is only valid when all
+    endpoints can map the driver's arena — i.e. the head node.)"""
     cluster, na, nb = two_nodes
+    head_id = ray_tpu.core.api.get_runtime().head_node_id
+    head = NodeAffinitySchedulingStrategy(head_id, soft=False)
     with InputNode() as inp:
-        s1 = Stage.options(scheduling_strategy=_aff(na)).bind(3.0)
-        s2 = Stage.options(scheduling_strategy=_aff(na)).bind(4.0)
+        s1 = Stage.options(num_cpus=0.4,
+                           scheduling_strategy=head).bind(3.0)
+        s2 = Stage.options(num_cpus=0.4,
+                           scheduling_strategy=head).bind(4.0)
         dag = s2.fwd.bind(s1.fwd.bind(inp))
     cdag = dag.experimental_compile()
     try:
         assert cdag._mode == "channels"
-        # Same node end to end... except the driver reads the output
-        # channel from the head node, so ONLY the actor->actor edge
-        # must be shm; cross checks that selection is per-edge.
         from ray_tpu.dag.comm_channel import CommChannel
-        inter_actor = [
-            ch for k, ch in cdag._out_channels.items()]
+        assert cdag._comm_group is None
+        assert not any(isinstance(ch, CommChannel)
+                       for ch in cdag._all_channels), \
+            [type(c).__name__ for c in cdag._all_channels]
         out = cdag.execute(
             np.ones(4, dtype=np.float32)).get(timeout=60)
         np.testing.assert_allclose(out, np.full(4, 12.0))
