@@ -132,13 +132,19 @@ def test_cli_logs_subcommand(rt):
     assert ray_tpu.get(noisy2.remote(), timeout=60) == 1
     import time as _t
     _t.sleep(0.5)
+    # Explicit --address: "auto" picks the NEWEST session on the
+    # host, which under parallel test runs can be another test
+    # process's cluster (no logs yet).
+    addr = ray_tpu.core.api.get_runtime().client_address
     out = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.scripts.cli", "logs"],
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "logs",
+         "--address", addr],
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 0 and ".log" in out.stdout
     first = out.stdout.split()[0]
     out2 = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.scripts.cli", "logs", first],
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "logs", first,
+         "--address", addr],
         capture_output=True, text=True, timeout=60)
     assert out2.returncode == 0
 

@@ -200,6 +200,55 @@ def test_optimizer_rules():
         == [100, 10]
 
 
+def test_new_optimizer_rules():
+    """Round-4 rules: filter pushdown past all-to-all ops, unseeded
+    shuffle deferred past row ops (fusion-friendly), dead shuffle
+    before sort eliminated."""
+    from ray_tpu.data.dataset import (
+        _Filter, _MapRows, _RandomShuffle, _Repartition, _Sort,
+        _Source,
+    )
+    from ray_tpu.data.optimizer import optimize
+
+    f = lambda r: r                                   # noqa: E731
+
+    # filter hops before sort + repartition + unseeded shuffle
+    plan = [_Source([lambda: None]), _Sort("k"), _Repartition(4),
+            _RandomShuffle(None), _Filter(f)]
+    out = optimize(plan)
+    assert isinstance(out[1], _Filter), [type(o).__name__ for o in out]
+    # ...but never before a SEEDED shuffle (deterministic permutation)
+    plan2 = [_Source([lambda: None]), _RandomShuffle(7), _Filter(f)]
+    out2 = optimize(plan2)
+    assert [type(o).__name__ for o in out2[1:]] == [
+        "_RandomShuffle", "_Filter"]
+
+    # unseeded shuffle defers past per-row map (fusable with source)
+    plan3 = [_Source([lambda: None]), _RandomShuffle(None),
+             _MapRows(f)]
+    out3 = optimize(plan3)
+    assert [type(o).__name__ for o in out3[1:]] == [
+        "_MapRows", "_RandomShuffle"]
+
+    # shuffle immediately before sort is dead work
+    plan4 = [_Source([lambda: None]), _RandomShuffle(None),
+             _Sort("k")]
+    out4 = optimize(plan4)
+    assert [type(o).__name__ for o in out4[1:]] == ["_Sort"]
+
+
+def test_new_rules_preserve_results(rt):
+    from ray_tpu import data as rdata
+
+    base = (rdata.range(40, parallelism=4)
+            .random_shuffle()
+            .map(lambda r: {"id": r["id"] * 3})
+            .filter(lambda r: r["id"] % 2 == 0)
+            .sort("id"))
+    out = [r["id"] for r in base.take_all()]
+    assert out == sorted(i * 3 for i in range(40) if (i * 3) % 2 == 0)
+
+
 def test_optimized_pipeline_matches_unoptimized(rt):
     from ray_tpu import data as rdata
     ds = (rdata.range(50, parallelism=5)

@@ -79,7 +79,7 @@ def _spawn_head(port, journal):
         env=env)
 
 
-def _wait_port(port, timeout=30.0):
+def _wait_port(port, timeout=90.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
@@ -119,7 +119,7 @@ def test_sigkill_after_ack_preserves_kv_and_named_actor(tmp_path):
 
         # The acks above are durable: kill -9 NOW.
         os.kill(head.pid, signal.SIGKILL)
-        head.wait(timeout=10)
+        head.wait(timeout=30)
         ray_tpu.shutdown()
 
         # No snapshot tick can have saved us (interval 1h): prove the
@@ -139,7 +139,7 @@ def test_sigkill_after_ack_preserves_kv_and_named_actor(tmp_path):
             == b"durable_v"
         # Named actor restored (fresh incarnation on the restarted
         # head; its registration survived the kill).
-        deadline = time.time() + 60
+        deadline = time.time() + 90
         last_err = None
         while time.time() < deadline:
             try:
