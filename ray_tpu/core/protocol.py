@@ -34,6 +34,21 @@ RESULT_STREAM_END = "stream_end"  # (RESULT_STREAM_END, task_id_bytes, count)
 
 # client channel, worker -> driver: (req_id, op, payload...)
 OP_SUBMIT = "submit"
+OP_SUBMIT_OWNED = "submit_owned"
+                                # ownership-model submit (reference:
+                                # the owner mints object ids and the
+                                # submit RPC is not on the critical
+                                # path): (fn_id, fn_blob, fn_name,
+                                # args_kwargs_blob, opts_blob,
+                                # task_id_bytes, [return_id_bytes],
+                                # [nonces]). Sent with a REAL req_id:
+                                # the caller does not block, but its
+                                # drainer consumes the ST_OK ack
+                                # asynchronously and replays on
+                                # connection death (dd-deduped).
+                                # Failures are stored as errors ON
+                                # the return ids, so get() surfaces
+                                # them.
 OP_CREATE_ACTOR = "create_actor"
 OP_SUBMIT_ACTOR = "submit_actor"
 OP_PUT = "put"

@@ -1213,18 +1213,30 @@ class DriverRuntime:
 
     def submit_task(self, fn_id: str, fn_blob: bytes | None,
                     fn_name: str, args: tuple, kwargs: dict,
-                    options: TaskOptions) -> list[ObjectRef]:
+                    options: TaskOptions,
+                    preminted: tuple | None = None
+                    ) -> list[ObjectRef]:
         if fn_blob is not None:
             self._fn_cache.setdefault(fn_id, fn_blob)
         # Resolve the runtime env now: a broken env (task- OR
         # job-level) fails at .remote() with RuntimeEnvSetupError, and
         # dispatch/retries reuse the resolved result.
         env_key, env_vars = self._env_for_options_cached(options)
-        task_id = TaskID.for_normal_task(self.job_id)
         streaming = options.num_returns == "streaming"
-        return_ids = [] if streaming else [
-            ObjectID.for_return(task_id, i)
-            for i in range(options.num_returns)]
+        if preminted is not None:
+            # Ownership-model submit: the CLIENT minted the ids (and
+            # already holds refs to them) — register, don't re-mint.
+            # Idempotent under dd-replay by task id.
+            task_id, return_ids = preminted
+            with self._task_lock:
+                if task_id in self._tasks:
+                    return [self.register_ref(ObjectRef(o))
+                            for o in return_ids]
+        else:
+            task_id = TaskID.for_normal_task(self.job_id)
+            return_ids = [] if streaming else [
+                ObjectID.for_return(task_id, i)
+                for i in range(options.num_returns)]
         args_blob, arg_refs = self._pack_args(args, kwargs)
         rec = TaskRecord(
             task_id=task_id, fn_id=fn_id, name=fn_name or "task",
@@ -3428,6 +3440,25 @@ class DriverRuntime:
                         self._dd_finish(dd, out)
                     reply(req_id, *out)
                     continue
+                if op == P.OP_SUBMIT_OWNED:
+                    # Ownership-model submit (reference: owner-minted
+                    # object ids; the submit RPC is off the caller's
+                    # critical path). Fire-and-forget: handled INLINE
+                    # so a later get on the same connection cannot
+                    # overtake the registration; failures land as
+                    # errors ON the preminted return ids.
+                    dd, sp = P.unwrap_dd(payload)
+                    if dd is not None and self._dd_begin(dd) \
+                            is not None:
+                        if req_id != -1:  # replay of an applied submit
+                            reply(req_id, P.ST_OK, None)
+                        continue
+                    self._handle_owned_submit(sp)
+                    if dd is not None:
+                        self._dd_finish(dd, (P.ST_OK, None))
+                    if req_id != -1:
+                        reply(req_id, P.ST_OK, None)
+                    continue
                 if op == P.OP_BORROW:
                     # Order-sensitive per connection: handle inline
                     # (a thread-per-message race could run a release
@@ -3931,6 +3962,41 @@ class DriverRuntime:
             # the directory entry (advisor r3).
             return
         self.shm_store.delete(oid)
+
+    def _handle_owned_submit(self, payload) -> None:
+        """Register a client-minted task. Any failure — bad env, bad
+        pickle, unknown options — is stored as the error of every
+        preminted return id: the client already returned refs to its
+        caller and will observe the failure at get()."""
+        (fn_id, fn_blob, fn_name, args_kwargs_blob, opts_blob,
+         tid_bytes, rid_bytes, nonces) = payload
+        return_ids = [ObjectID(b) for b in rid_bytes]
+        with self._task_lock:
+            if TaskID(tid_bytes) in self._tasks:
+                # dd-evicted replay of a live task: the original
+                # execution took the nonce pins; re-pinning here would
+                # leak them forever (the client's borrow registration
+                # consumed each nonce exactly once). Per-client ids +
+                # per-connection inline handling make this the only
+                # duplicate source.
+                return
+        try:
+            args, kwargs = ser.loads(args_kwargs_blob)
+            options = ser.loads(opts_blob)
+            refs = self.submit_task(
+                fn_id, fn_blob, fn_name, args, kwargs, options,
+                preminted=(TaskID(tid_bytes), return_ids))
+            # The remote client holds the only refs: nonce-keyed pins
+            # that its borrow registration consumes (same lifecycle
+            # as client puts — no permanent pin).
+            for r, nonce in zip(refs, nonces):
+                self.on_ref_escaped(r.id, nonce)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, Exception) else \
+                RuntimeError(repr(e))
+            blob = ser.dumps(err)
+            for oid in return_ids:
+                self._store_error(oid, blob)
 
     def _handle_direct_put(self, payload, conn_pending: set):
         action = payload[0]

@@ -75,6 +75,19 @@ class ClientRuntime:
             target=self._notify_loop, daemon=True,
             name="client_notify")
         self._notify_thread.start()
+        # Ownership-model submits: this client mints task/return ids
+        # under its own job tag (reference: the owning worker mints
+        # object ids; submission is not on the critical path). The
+        # drainer thread consumes the acks in order and replays a
+        # submit whose connection died mid-flight (dd-deduped).
+        from ray_tpu.core.ids import JobID
+        self._client_job = JobID(os.urandom(JobID.SIZE))
+        self._async_q: deque = deque()
+        self._async_event = threading.Event()
+        self._async_thread = threading.Thread(
+            target=self._async_drain_loop, daemon=True,
+            name="client_submit_drain")
+        self._async_thread.start()
         self.local_mode = False
 
     def _dial(self):
@@ -168,8 +181,9 @@ class ClientRuntime:
     # they get a dedupe id the head caches replies under. Read-only
     # ops (get/wait/state/resources/...) replay safely without one.
     _MUTATING_OPS = frozenset({
-        P.OP_SUBMIT, P.OP_PUT, P.OP_CREATE_ACTOR, P.OP_SUBMIT_ACTOR,
-        P.OP_PG_CREATE, P.OP_STREAM_NEXT, P.OP_PUT_DIRECT,
+        P.OP_SUBMIT, P.OP_SUBMIT_OWNED, P.OP_PUT, P.OP_CREATE_ACTOR,
+        P.OP_SUBMIT_ACTOR, P.OP_PG_CREATE, P.OP_STREAM_NEXT,
+        P.OP_PUT_DIRECT,
     })
     _MUTATING_KV_ACTIONS = frozenset({"put", "put_if_absent", "del"})
 
@@ -382,13 +396,110 @@ class ClientRuntime:
 
     def submit_task(self, fn_id: str, fn_blob: bytes | None, fn_name: str,
                     args: tuple, kwargs: dict, options):
-        ref_bytes = self._call(P.OP_SUBMIT, (
-            fn_id, fn_blob, fn_name, ser.dumps((args, kwargs)),
-            ser.dumps(options)))
-        if isinstance(ref_bytes, tuple) and ref_bytes[0] == "stream":
+        if options.num_returns == "streaming":
+            # Streaming returns need the head-owned generator state:
+            # keep the synchronous path.
+            ref_bytes = self._call(P.OP_SUBMIT, (
+                fn_id, fn_blob, fn_name, ser.dumps((args, kwargs)),
+                ser.dumps(options)))
             from ray_tpu.core.object_ref import ObjectRefGenerator
             return ObjectRefGenerator(ref_bytes[1], _owner=True)
-        return [ObjectRef(ObjectID(b)) for b in ref_bytes]
+        # Ownership-model submit (reference: the owner mints object
+        # ids and submission is off the critical path): mint task +
+        # return ids HERE, fire the registration without waiting for
+        # its ack, and return refs immediately. Failures surface as
+        # stored errors on the return ids at get(); a connection
+        # death mid-flight is replayed (dd-deduped) by the drainer.
+        from ray_tpu.core.ids import TaskID
+        from ray_tpu.core.object_ref import _new_nonce
+        task_id = TaskID.for_normal_task(self._client_job)
+        return_ids = [ObjectID.for_return(task_id, i)
+                      for i in range(options.num_returns)]
+        nonces = [_new_nonce() for _ in return_ids]
+        self._call_async(P.OP_SUBMIT_OWNED, (
+            fn_id, fn_blob, fn_name, ser.dumps((args, kwargs)),
+            ser.dumps(options), task_id.binary(),
+            [o.binary() for o in return_ids], nonces))
+        refs = []
+        for oid, nonce in zip(return_ids, nonces):
+            ref = ObjectRef(oid)
+            # Borrow registration consumes the nonce-keyed escape pin
+            # the head takes at registration; this ref's finalizer
+            # releases it (no permanent result pins).
+            self.on_ref_deserialized(ref, nonce)
+            refs.append(ref)
+        return refs
+
+    def _call_async(self, op: str, payload,
+                    _dd: str | None = None,
+                    _retried: bool = False) -> None:
+        """Send a mutating op without blocking on its ack. The ack is
+        consumed in order by the drainer thread, which replays the op
+        (same dd — the head dedupes) if the connection died with it
+        in flight."""
+        if self._conn_dead:
+            # A send into a dead TCP buffer can "succeed" locally and
+            # the op would be silently lost: reconnect first (same
+            # guard as _call).
+            if _retried or not self._try_reconnect():
+                raise ConnectionError(
+                    f"head connection lost (op {op})")
+        if _dd is None and self._needs_dd(op, payload):
+            _dd = f"{self._dd_prefix}:{next(self._dd_counter)}"
+        req_id = next(self._req_counter)
+        event = threading.Event()
+        slot: list = []
+        with self._pending_lock:
+            self._pending[req_id] = (event, slot)
+        try:
+            with self._send_lock:
+                self._conn.send((req_id, op, P.wrap_dd(_dd, payload)))
+        except (OSError, BrokenPipeError):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            # One bounded retry, like _call: a flapping head must
+            # surface ConnectionError, not recurse.
+            if _retried or not self._try_reconnect():
+                raise ConnectionError(
+                    f"head connection lost during {op}") from None
+            return self._call_async(op, payload, _dd=_dd,
+                                    _retried=True)
+        self._async_q.append((req_id, event, slot, op, payload, _dd))
+        self._async_event.set()
+
+    def _async_drain_loop(self) -> None:
+        while True:
+            if not self._async_q:
+                self._async_event.wait(5.0)
+                self._async_event.clear()
+                continue
+            (req_id, event, slot, op, payload,
+             dd) = self._async_q.popleft()
+            replay = False
+            if not event.wait(300.0):
+                # No ack in 5 minutes: the submit may or may not have
+                # applied — drop the leaked pending slot and replay
+                # under the SAME dd (the head coalesces/dedupes, so a
+                # merely-slow original still wins).
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+                replay = True
+            else:
+                status, result = slot[0]
+                if status == P.ST_ERR:
+                    try:
+                        err = ser.loads(result)
+                    except Exception:  # noqa: BLE001
+                        err = None
+                    replay = isinstance(err, ConnectionError)
+            if replay and self._try_reconnect():
+                # The in-flight submit died with the old head (or its
+                # ack vanished): replay synchronously — dd-deduped,
+                # so an applied original is not re-executed.
+                try:
+                    self._call(op, payload, _dd=dd)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def stream_next(self, task_id_bytes: bytes,
                     timeout: float | None = None):

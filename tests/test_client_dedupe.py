@@ -90,3 +90,47 @@ def test_read_only_ops_carry_no_dd(rt):
         assert client._needs_dd(P.OP_SUBMIT, ())
     finally:
         client.shutdown()
+
+
+def test_owned_submit_error_lands_on_return_ids(rt):
+    """Ownership-model submits are fire-and-forget: a submission the
+    head cannot register (bad runtime env) must surface as the stored
+    error of the preminted return ids at get()."""
+    import pytest
+
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def outer():
+        @ray_tpu.remote(num_cpus=1,
+                        runtime_env={"pip": ["no-such-package-xyz"]})
+        def bad_env():
+            return 1
+        try:
+            ray_tpu.get(bad_env.remote(), timeout=60)
+            return "no-error"
+        except Exception as e:
+            return type(e).__name__
+
+    name = ray_tpu.get(outer.remote(), timeout=120)
+    assert name != "no-error" and "Timeout" not in name, name
+
+
+def test_owned_submit_ids_are_client_scoped(rt):
+    """Two worker clients minting ids concurrently must never collide
+    (each client mints under its own random job tag)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def spawner(n):
+        @ray_tpu.remote(num_cpus=1)
+        def val(x):
+            return x
+        refs = [val.remote(i) for i in range(n)]
+        out = ray_tpu.get(refs, timeout=120)
+        return out, [r.id.hex() for r in refs]
+
+    (a_vals, a_ids), (b_vals, b_ids) = ray_tpu.get(
+        [spawner.remote(30), spawner.remote(30)], timeout=180)
+    assert a_vals == list(range(30)) and b_vals == list(range(30))
+    assert not (set(a_ids) & set(b_ids))
