@@ -404,9 +404,38 @@ def _attach(name: str):
         return _attached_stores[name]
 
 
+def reap_dead_shm_segments() -> int:
+    """Unlink /dev/shm segments whose owning process died without
+    cleanup (SIGKILLed runs leak their arenas and channel slots —
+    names embed the creator pid: rts_<pid>_... native arenas,
+    rt_<pid>_... python-fallback segments, rtch-<pid>-... channels).
+    The file-level analog of plasma's delete-on-client-disconnect;
+    run at session startup. Live processes' segments are untouched."""
+    import re
+    pat = re.compile(r"rt(?:ch|s)?[-_](\d+)[-_]")
+    n = 0
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return 0
+    for name in names:
+        m = pat.match(name)
+        if m is None:
+            continue
+        if os.path.exists(f"/proc/{m.group(1)}"):
+            continue
+        try:
+            os.unlink(os.path.join("/dev/shm", name))
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
 def make_shared_store(capacity: int, spill_dir: str, threshold: float):
     """Prefer the C++ arena store; fall back to per-segment python shm
     when the native build is unavailable."""
+    reap_dead_shm_segments()
     if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE") != "1":
         try:
             from ray_tpu.native.store import native_store_available

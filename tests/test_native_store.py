@@ -89,3 +89,33 @@ def test_native_store_spills(rt_local):
     # All objects still readable (some from disk).
     for i, r in enumerate(refs):
         assert ray_tpu.get(r) == np.random.default_rng(i).bytes(400_000)
+
+
+def test_reap_dead_shm_segments():
+    """Startup sweep unlinks arena/channel segments whose creator pid
+    is gone (SIGKILLed runs leaked them; 10 GB observed before the
+    sweep existed) and leaves live-owner segments alone."""
+    import os
+
+    from ray_tpu.core.object_store import reap_dead_shm_segments
+
+    dead = "/dev/shm/rts_99999999_deadbeef"
+    live = f"/dev/shm/rts_{os.getpid()}_feedface"
+    other = "/dev/shm/ray_tpu_unrelated_name"
+    for p in (dead, live, other):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    try:
+        # NB assert on file state, not the return count: any
+        # concurrent session's make_shared_store() may sweep the
+        # planted segment first (parallel test shards).
+        reap_dead_shm_segments()
+        assert not os.path.exists(dead)
+        assert os.path.exists(live)
+        assert os.path.exists(other)     # non-matching names untouched
+    finally:
+        for p in (live, other):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
