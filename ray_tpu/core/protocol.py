@@ -51,6 +51,19 @@ OP_SUBMIT_OWNED = "submit_owned"
                                 # them.
 OP_CREATE_ACTOR = "create_actor"
 OP_SUBMIT_ACTOR = "submit_actor"
+OP_SUBMIT_ACTOR_OWNED = "submit_actor_owned"
+                                # ownership-model actor call:
+                                # (actor_id_bytes, method,
+                                # args_kwargs_blob, num_returns,
+                                # trace_ctx, task_id_bytes,
+                                # [return_id_bytes], [nonces]).
+                                # Same contract as OP_SUBMIT_OWNED:
+                                # real req_id, ack drained
+                                # asynchronously, handled INLINE per
+                                # connection (per-caller actor-call
+                                # ORDER is part of the actor
+                                # contract), failures stored on the
+                                # return ids.
 OP_PUT = "put"
 OP_GET = "get"
 OP_GET_MANY = "get_many"        # ([oid_bytes], timeout, allow_desc)

@@ -743,6 +743,12 @@ class DriverRuntime:
         # mid-put) age out on a grace timer before their slot is
         # freed — the writer may still hold a live view.
         self._pending_direct: dict[ObjectID, tuple] = {}
+        # Owned actor-call replay guard (actor tasks have no _tasks
+        # entry keyed by TaskID at submit time — calls queue on the
+        # ActorRecord — so dedupe-by-id needs its own structure).
+        # Insertion-ordered so trimming drops the OLDEST ids.
+        from collections import OrderedDict as _OD
+        self._actor_owned_seen: "_OD" = _OD()
         self._orphan_direct: dict[bytes, float] = {}
         # node_id -> latest per-node agent sample (dashboard).
         self._agent_stats: dict[str, dict] = {}
@@ -2781,15 +2787,19 @@ class DriverRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, method: str,
                           args: tuple, kwargs: dict,
-                          num_returns: int = 1, trace_ctx=None):
+                          num_returns: int = 1, trace_ctx=None,
+                          preminted: tuple | None = None):
         rec = self._actors.get(actor_id)
         if rec is None:
             raise ActorDiedError(actor_id.hex(), "unknown actor")
-        task_id = TaskID.for_actor_task(actor_id)
         streaming = num_returns == "streaming"
-        return_ids = [] if streaming else [
-            ObjectID.for_return(task_id, i)
-            for i in range(num_returns)]
+        if preminted is not None:
+            task_id, return_ids = preminted
+        else:
+            task_id = TaskID.for_actor_task(actor_id)
+            return_ids = [] if streaming else [
+                ObjectID.for_return(task_id, i)
+                for i in range(num_returns)]
         args_blob, arg_refs = self._pack_args(args, kwargs)
         refs = [self.register_ref(ObjectRef(oid)) for oid in return_ids]
         if streaming:
@@ -3440,22 +3450,24 @@ class DriverRuntime:
                         self._dd_finish(dd, out)
                     reply(req_id, *out)
                     continue
-                if op == P.OP_SUBMIT_OWNED:
-                    # Ownership-model submit (reference: owner-minted
+                if op in (P.OP_SUBMIT_OWNED,
+                          P.OP_SUBMIT_ACTOR_OWNED):
+                    # Ownership-model submits (reference: owner-minted
                     # object ids; the submit RPC is off the caller's
-                    # critical path). Fire-and-forget: handled INLINE
-                    # so a later get on the same connection cannot
-                    # overtake the registration; failures land as
-                    # errors ON the preminted return ids.
+                    # critical path). Fire-and-forget, handled INLINE:
+                    # a later get on this connection cannot overtake
+                    # the registration, and per-caller actor-call
+                    # ORDER (part of the actor contract) follows
+                    # connection order. Failures land as errors ON
+                    # the preminted return ids.
+                    handler = (self._handle_owned_submit
+                               if op == P.OP_SUBMIT_OWNED
+                               else self._handle_owned_actor_submit)
                     dd, sp = P.unwrap_dd(payload)
-                    if dd is not None and self._dd_begin(dd) \
-                            is not None:
-                        if req_id != -1:  # replay of an applied submit
-                            reply(req_id, P.ST_OK, None)
-                        continue
-                    self._handle_owned_submit(sp)
-                    if dd is not None:
-                        self._dd_finish(dd, (P.ST_OK, None))
+                    if dd is None or self._dd_begin(dd) is None:
+                        handler(sp)
+                        if dd is not None:
+                            self._dd_finish(dd, (P.ST_OK, None))
                     if req_id != -1:
                         reply(req_id, P.ST_OK, None)
                     continue
@@ -3989,6 +4001,37 @@ class DriverRuntime:
             # The remote client holds the only refs: nonce-keyed pins
             # that its borrow registration consumes (same lifecycle
             # as client puts — no permanent pin).
+            for r, nonce in zip(refs, nonces):
+                self.on_ref_escaped(r.id, nonce)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, Exception) else \
+                RuntimeError(repr(e))
+            blob = ser.dumps(err)
+            for oid in return_ids:
+                self._store_error(oid, blob)
+
+    def _handle_owned_actor_submit(self, payload) -> None:
+        """Register a client-minted actor call; failures (dead/unknown
+        actor, bad pickle) land as errors on the preminted return ids
+        — the caller observes them at get()."""
+        (actor_id_bytes, method, args_kwargs_blob, num_returns,
+         trace_ctx, tid_bytes, rid_bytes, nonces) = payload
+        return_ids = [ObjectID(b) for b in rid_bytes]
+        task_id = TaskID(tid_bytes)
+        with self._task_lock:
+            if task_id in self._actor_owned_seen:
+                return          # dd-evicted replay: pins already taken
+            self._actor_owned_seen[task_id] = None
+            while len(self._actor_owned_seen) > 65536:
+                # Bounded memory: evict the OLDEST ids (insertion
+                # order), which are far outside any replay window.
+                self._actor_owned_seen.popitem(last=False)
+        try:
+            args, kwargs = ser.loads(args_kwargs_blob)
+            refs = self.submit_actor_task(
+                ActorID(actor_id_bytes), method, args, kwargs,
+                num_returns, trace_ctx,
+                preminted=(task_id, return_ids))
             for r, nonce in zip(refs, nonces):
                 self.on_ref_escaped(r.id, nonce)
         except BaseException as e:  # noqa: BLE001

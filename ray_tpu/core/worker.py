@@ -84,6 +84,11 @@ class ClientRuntime:
         self._client_job = JobID(os.urandom(JobID.SIZE))
         self._async_q: deque = deque()
         self._async_event = threading.Event()
+        # Async ops whose connection died before their ack: replayed
+        # IN ORDER by the reconnect fence (never by the drainer — a
+        # late replay behind newer sends would reorder actor calls).
+        self._lost_async: list = []
+        self._replay_lock = threading.Lock()
         self._async_thread = threading.Thread(
             target=self._async_drain_loop, daemon=True,
             name="client_submit_drain")
@@ -123,8 +128,28 @@ class ClientRuntime:
                 self._conn_dead = False
             threading.Thread(target=self._recv_loop, daemon=True,
                              name="client_recv").start()
+            self._replay_async_after_reconnect()
             return True
         return False
+
+    def _replay_async_after_reconnect(self) -> None:
+        """Ordering fence: re-send every unacked fire-and-forget op
+        (oldest first) on the fresh connection BEFORE any new traffic
+        from this thread. Per-caller actor-call order survives a head
+        restart because a newer call only reaches the new connection
+        through a path that runs this fence first; dd-dedup makes
+        re-sending an already-applied op a no-op."""
+        with self._replay_lock:
+            items = self._lost_async
+            self._lost_async = []
+            while self._async_q:
+                it = self._async_q.popleft()
+                items.append(it[3:])      # (op, payload, dd)
+        for op, payload, dd in items:
+            try:
+                self._call(op, payload, _dd=dd)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _recv_loop(self):
         conn = self._conn
@@ -182,8 +207,8 @@ class ClientRuntime:
     # ops (get/wait/state/resources/...) replay safely without one.
     _MUTATING_OPS = frozenset({
         P.OP_SUBMIT, P.OP_SUBMIT_OWNED, P.OP_PUT, P.OP_CREATE_ACTOR,
-        P.OP_SUBMIT_ACTOR, P.OP_PG_CREATE, P.OP_STREAM_NEXT,
-        P.OP_PUT_DIRECT,
+        P.OP_SUBMIT_ACTOR, P.OP_SUBMIT_ACTOR_OWNED, P.OP_PG_CREATE,
+        P.OP_STREAM_NEXT, P.OP_PUT_DIRECT,
     })
     _MUTATING_KV_ACTIONS = frozenset({"put", "put_if_absent", "del"})
 
@@ -492,14 +517,16 @@ class ClientRuntime:
                     except Exception:  # noqa: BLE001
                         err = None
                     replay = isinstance(err, ConnectionError)
-            if replay and self._try_reconnect():
-                # The in-flight submit died with the old head (or its
-                # ack vanished): replay synchronously — dd-deduped,
-                # so an applied original is not re-executed.
-                try:
-                    self._call(op, payload, _dd=dd)
-                except Exception:  # noqa: BLE001
-                    pass
+            if replay:
+                # Never replay from here: the drainer runs BEHIND the
+                # app threads, and a direct re-send would order this
+                # op after newer calls (actor-call order is part of
+                # the contract). Stash it for the reconnect fence,
+                # which replays oldest-first before new traffic.
+                with self._replay_lock:
+                    self._lost_async.append((op, payload, dd))
+                if self._conn_dead:
+                    self._try_reconnect()   # fence runs inside
 
     def stream_next(self, task_id_bytes: bytes,
                     timeout: float | None = None):
@@ -568,13 +595,34 @@ class ClientRuntime:
     def submit_actor_task(self, actor_id: ActorID, method: str,
                           args: tuple, kwargs: dict,
                           num_returns: int = 1, trace_ctx=None):
-        ref_bytes = self._call(P.OP_SUBMIT_ACTOR, (
-            actor_id.binary(), method, ser.dumps((args, kwargs)),
-            num_returns, trace_ctx))
-        if isinstance(ref_bytes, tuple) and ref_bytes[0] == "stream":
+        if num_returns == "streaming":
+            # Streaming needs the head-owned generator: sync path.
+            ref_bytes = self._call(P.OP_SUBMIT_ACTOR, (
+                actor_id.binary(), method, ser.dumps((args, kwargs)),
+                num_returns, trace_ctx))
             from ray_tpu.core.object_ref import ObjectRefGenerator
             return ObjectRefGenerator(ref_bytes[1], _owner=True)
-        return [ObjectRef(ObjectID(b)) for b in ref_bytes]
+        # Ownership-model actor call (same contract as owned task
+        # submits): mint ids here, fire the registration, return refs
+        # immediately. Per-caller call ORDER holds because the head
+        # handles the op inline in connection order. Dead-actor and
+        # registration failures surface at get().
+        from ray_tpu.core.ids import TaskID
+        from ray_tpu.core.object_ref import _new_nonce
+        task_id = TaskID.for_actor_task(actor_id)
+        return_ids = [ObjectID.for_return(task_id, i)
+                      for i in range(num_returns)]
+        nonces = [_new_nonce() for _ in return_ids]
+        self._call_async(P.OP_SUBMIT_ACTOR_OWNED, (
+            actor_id.binary(), method, ser.dumps((args, kwargs)),
+            num_returns, trace_ctx, task_id.binary(),
+            [o.binary() for o in return_ids], nonces))
+        refs = []
+        for oid, nonce in zip(return_ids, nonces):
+            ref = ObjectRef(oid)
+            self.on_ref_deserialized(ref, nonce)
+            refs.append(ref)
+        return refs
 
     def get_named_actor(self, name: str) -> ActorID:
         return ActorID(self._call(P.OP_GET_ACTOR, name))
