@@ -120,14 +120,39 @@ def _resolve_address(address: str) -> str:
         return address
     import glob
     import os
-    candidates = sorted(
-        glob.glob("/tmp/ray_tpu_sessions/*/runtime.sock"),
-        key=os.path.getmtime, reverse=True)
-    for sock in candidates:
+    # Explicit override first (reference: RAY_ADDRESS).
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    live: list[tuple[str, int]] = []
+    for sock in sorted(glob.glob("/tmp/ray_tpu_sessions/*/runtime.sock"),
+                       key=os.path.getmtime, reverse=True):
         # Liveness: the session dir is named by the head's pid.
         pid = os.path.basename(os.path.dirname(sock))
         if pid.isdigit() and os.path.exists(f"/proc/{pid}"):
+            live.append((sock, int(pid)))
+    # Prefer a session whose head is an ANCESTOR of this process: a
+    # script spawned by a driver must find THAT driver, not whichever
+    # concurrent session on the host touched its socket last.
+    ancestors = set()
+    pid = os.getpid()
+    for _ in range(64):
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                stat = f.read()
+            # field 4 (ppid) sits after the parenthesized comm, which
+            # may itself contain spaces.
+            pid = int(stat[stat.rindex(b")") + 2:].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+        if pid <= 1:
+            break
+        ancestors.add(pid)
+    for sock, pid in live:
+        if pid in ancestors:
             return sock
+    if live:
+        return live[0][0]
     raise ConnectionError(
         "address='auto': no live ray_tpu session found on this host")
 

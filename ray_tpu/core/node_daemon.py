@@ -460,18 +460,61 @@ class NodeDaemon:
             ev.wait(1.0)
             ev.clear()
             while q:
-                msg = q.popleft()
-                if msg is None:
-                    return   # sentinel from _on_worker_exit
+                batch: list = []
+                done = False
+                while q and len(batch) < 128:
+                    msg = q.popleft()
+                    if msg is None:   # sentinel from _on_worker_exit
+                        done = True
+                        break
+                    batch.append(msg)
                 try:
-                    w.send(msg)
+                    if len(batch) == 1:
+                        w.send(batch[0])
+                    elif batch:
+                        w.send((P.EXEC_BATCH, batch))
+                except ValueError:
+                    # Aggregate frame refused (oversized) — the
+                    # worker is alive; retry messages individually so
+                    # one unsendable frame can't kill the pump for
+                    # every later task. An individually-refused
+                    # message was always fatal for its own call.
+                    for m in batch:
+                        try:
+                            w.send(m)
+                        except ValueError:
+                            continue
+                        except Exception:  # noqa: BLE001
+                            return
                 except Exception:  # noqa: BLE001
                     return   # death is reported via _on_worker_exit
+                if done:
+                    return
 
     def _on_worker_message(self, w: WorkerHandle, msg: tuple) -> None:
         widx = self._widx_of.get(w)
         if widx is None:
             return
+        if msg[0] == P.EXEC_BATCH:
+            # Keep the coalescing across the node channel: intercepted
+            # results (ND_STORED) ship individually, everything else
+            # re-batches into one ND_WMSG frame.
+            fwd = []
+            for m in msg[1]:
+                out = self._intercept_worker_msg(widx, m)
+                if out is not None:
+                    fwd.append(out)
+            if len(fwd) == 1:
+                self.head_send((P.ND_WMSG, widx, fwd[0]))
+            elif fwd:
+                self.head_send((P.ND_WMSG, widx, (P.EXEC_BATCH, fwd)))
+            return
+        if self._intercept_worker_msg(widx, msg) is not None:
+            self.head_send((P.ND_WMSG, widx, msg))
+
+    def _intercept_worker_msg(self, widx: int, msg: tuple):
+        """Large-result interception (ND_STORED): returns None when the
+        message was fully handled here, else the message to forward."""
         if msg[0] == P.RESULT_OK:
             _, task_id_bytes, results = msg
             with self._task_meta_lock:
@@ -482,11 +525,11 @@ class NodeDaemon:
                 if any(e[0] == "stored" for e in entries):
                     self.head_send((P.ND_STORED, widx, task_id_bytes,
                                     entries))
-                    return
+                    return None
         elif msg[0] in (P.RESULT_ERR, P.RESULT_STREAM_END):
             with self._task_meta_lock:
                 self._task_meta.pop(msg[1], None)
-        self.head_send((P.ND_WMSG, widx, msg))
+        return msg
 
     def _intern_results(self, return_oids: list[ObjectID],
                         results: list) -> list:

@@ -23,6 +23,16 @@ EXEC_TASK = "task"            # (EXEC_TASK, task_id_bytes, fn_id, fn_blob|None,
 EXEC_ACTOR_INIT = "actor_init"  # (.., actor_id_bytes, cls_blob, args_blob, arg_objects)
 EXEC_ACTOR_CALL = "actor_call"  # (.., task_id_bytes, method, args_blob, arg_objects, num_returns)
 EXEC_SHUTDOWN = "shutdown"    # (EXEC_SHUTDOWN,)
+EXEC_BATCH = "exec_batch"     # (EXEC_BATCH, [msg, ...]) — coalesced
+                              # frame, either direction. Senders batch
+                              # only what is ALREADY queued (never
+                              # wait), so an idle channel keeps
+                              # single-message latency while a burst
+                              # amortizes pickling + syscalls + reader
+                              # wakeups across the batch (reference:
+                              # gRPC streams batch task pushes and
+                              # replies; on one host the win is fewer
+                              # context switches per call).
 
 # exec channel, worker -> driver
 RESULT_OK = "ok"              # (RESULT_OK, task_id_bytes, results_blob_list)
@@ -76,6 +86,20 @@ OP_KILL = "kill"
 OP_CANCEL = "cancel"
 OP_GET_ACTOR = "get_actor"
 OP_BORROW = "borrow"            # (action, oid): escape | add | release
+OP_NOTIFY_BATCH = "notify_batch"  # (-1, OP_NOTIFY_BATCH,
+                                # [(op, payload), ...]) — coalesced
+                                # fire-and-forget notifies (borrow
+                                # add/release bursts); handled inline
+                                # in arrival order, no replies.
+OP_REQ_BATCH = "req_batch"      # (-1, OP_REQ_BATCH,
+                                # [(req_id, op, payload), ...]) —
+                                # coalesced client requests. The head
+                                # processes each triple exactly as if
+                                # it had arrived alone (inline ops
+                                # inline, blocking ops on their own
+                                # threads); replies stay per-req_id.
+                                # A 100-submit burst from one client
+                                # costs one pickle+send+reader wakeup.
 OP_RESOURCES = "resources"
 OP_STATE = "state"            # (kind, filters) -> list[dict] | dict
 OP_PG_CREATE = "pg_create"

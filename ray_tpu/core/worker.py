@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 import traceback
+from collections import deque
 from multiprocessing import connection as mpc
 
 from ray_tpu.core import protocol as P
@@ -75,6 +77,19 @@ class ClientRuntime:
             target=self._notify_loop, daemon=True,
             name="client_notify")
         self._notify_thread.start()
+        # Request outbox: every (req_id, op, payload) wire triple goes
+        # through here. An idle connection takes the inline fast path
+        # (zero added latency); a burst — 100 fire-and-forget submits
+        # from a `[f.remote() for _ in range(100)]` comprehension —
+        # coalesces into ONE P.OP_REQ_BATCH frame: one pickle, one
+        # syscall, one head-side reader wakeup. Order is global FIFO
+        # across sync and async ops, which preserves the per-caller
+        # actor-call ordering contract AND keeps a get() behind the
+        # submits it depends on.
+        self._outbox: deque = deque()
+        self._out_ev = threading.Event()
+        threading.Thread(target=self._wire_sender_loop, daemon=True,
+                         name="client_wire_sender").start()
         # Ownership-model submits: this client mints task/return ids
         # under its own job tag (reference: the owning worker mints
         # object ids; submission is not on the critical path). The
@@ -186,15 +201,133 @@ class ClientRuntime:
         self._notify_buf.append((op, payload))
         self._notify_event.set()
 
+    def _enqueue_wire(self, triple) -> None:
+        """Ship a wire triple through the outbox. Inline fast path
+        when nothing is queued — a sync caller keeps its direct-send
+        latency and, crucially, its direct-send EXCEPTION (the _call
+        reconnect logic keys off OSError/BrokenPipeError from the
+        send). Otherwise append tagged with the CURRENT connection
+        generation; the sender thread coalesces and drops triples
+        from a previous generation — after a reconnect, only the
+        fence's replays (enqueued under the new generation) reach the
+        fresh connection, never stale pre-death traffic that would
+        land ahead of them and invert per-caller order."""
+        if not self._outbox and self._send_lock.acquire(blocking=False):
+            try:
+                if not self._outbox:
+                    self._conn.send(triple)
+                    return
+            finally:
+                self._send_lock.release()
+        # Tag under _conn_lock so the (gen, conn) pairing is
+        # consistent with any concurrent reconnect swap — a triple
+        # tagged N can never be destined for conn N+1.
+        with self._conn_lock:
+            self._outbox.append((self._conn_gen, triple))
+            dead = self._conn_dead
+        self._out_ev.set()
+        if dead:
+            # The connection died before (or as) we enqueued: the
+            # sender will drop this stale-generation triple and the
+            # recv-EOF handler may have already swept pending — fail
+            # fast so the caller's reconnect/retry path runs instead
+            # of waiting on a reply that can never come.
+            raise OSError("head connection lost (enqueue)")
+
+    def _wire_sender_loop(self) -> None:
+        while True:
+            self._out_ev.wait(2.0)
+            self._out_ev.clear()
+            while self._outbox:
+                with self._send_lock:
+                    batch = []
+                    with self._conn_lock:
+                        conn = self._conn
+                        cur_gen = self._conn_gen
+                    while self._outbox and len(batch) < 256:
+                        gen, t = self._outbox.popleft()
+                        if gen == cur_gen:
+                            batch.append(t)
+                        elif gen > cur_gen:
+                            # Tagged for a connection newer than our
+                            # snapshot (reconnect raced this drain):
+                            # put it back and flush what we have —
+                            # the next iteration re-reads the pair.
+                            self._outbox.appendleft((gen, t))
+                            self._out_ev.set()
+                            break
+                        # else gen < cur_gen: a dead connection's
+                        # triple — its pending entry was failed by
+                        # the recv-EOF handler and the caller
+                        # retried / the fence replayed it under the
+                        # new generation. Drop.
+                    if not batch:
+                        break
+                    try:
+                        conn.send(batch[0] if len(batch) == 1
+                                  else (-1, P.OP_REQ_BATCH, batch))
+                    except ValueError as e:
+                        # Not a transport death — a payload the
+                        # connection refuses (e.g. an oversized
+                        # frame). Isolate it: retry triples one by
+                        # one, failing only the offender's pending
+                        # entry so its caller raises instead of
+                        # hanging.
+                        for t in batch:
+                            try:
+                                conn.send(t)
+                            except ValueError:
+                                with self._pending_lock:
+                                    entry = self._pending.pop(
+                                        t[0], None)
+                                if entry is not None:
+                                    ev, slot = entry
+                                    slot.append((P.ST_ERR,
+                                                 ser.dumps(e)))
+                                    ev.set()
+                            except (OSError, BrokenPipeError):
+                                with self._conn_lock:
+                                    if conn is self._conn:
+                                        self._conn_dead = True
+                                break
+                        continue
+                    except (OSError, BrokenPipeError):
+                        # Connection died with these unsent: DISCARD
+                        # them — do NOT requeue. A requeued (newer)
+                        # triple flushed on the fresh connection would
+                        # land ahead of the reconnect fence's replays
+                        # of OLDER unacked ops, inverting per-caller
+                        # order. Every async op is already in
+                        # _async_q (its drainer sees the recv-EOF
+                        # ConnectionError and hands it to the fence);
+                        # every sync caller's pending entry fails the
+                        # same way and the caller retries; notifies
+                        # are droppable on a dead head by the same
+                        # rule _notify_loop always used.
+                        with self._conn_lock:
+                            if conn is self._conn:
+                                self._conn_dead = True
+                        break
+
     def _notify_loop(self) -> None:
         while True:
             self._notify_event.wait()
             self._notify_event.clear()
             while self._notify_buf:
-                op, payload = self._notify_buf.popleft()
+                # Coalesce everything queued into one frame: a burst
+                # of borrow add/release finalizers (every task submit
+                # registers its return refs; every GC sweep releases
+                # a pile) pays one pickle+send instead of N.
+                batch = []
+                while self._notify_buf and len(batch) < 512:
+                    batch.append(self._notify_buf.popleft())
+                msg = ((-1,) + batch[0]) if len(batch) == 1 else \
+                    (-1, P.OP_NOTIFY_BATCH, batch)
                 try:
-                    with self._send_lock:
-                        self._conn.send((-1, op, payload))
+                    # Through the shared outbox: a borrow-add must
+                    # never overtake the queued submit that registers
+                    # its nonce (global FIFO keeps them ordered).
+                    self._enqueue_wire(msg)
                 except (OSError, BrokenPipeError, ValueError):
                     # Head gone: drop the notification (a restarted
                     # head rebuilds borrow bookkeeping from scratch)
@@ -237,8 +370,7 @@ class ClientRuntime:
         with self._pending_lock:
             self._pending[req_id] = (event, slot)
         try:
-            with self._send_lock:
-                self._conn.send((req_id, op, P.wrap_dd(_dd, payload)))
+            self._enqueue_wire((req_id, op, P.wrap_dd(_dd, payload)))
         except (OSError, BrokenPipeError) as e:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
@@ -477,8 +609,7 @@ class ClientRuntime:
         with self._pending_lock:
             self._pending[req_id] = (event, slot)
         try:
-            with self._send_lock:
-                self._conn.send((req_id, op, P.wrap_dd(_dd, payload)))
+            self._enqueue_wire((req_id, op, P.wrap_dd(_dd, payload)))
         except (OSError, BrokenPipeError):
             with self._pending_lock:
                 self._pending.pop(req_id, None)
@@ -835,9 +966,93 @@ def worker_main(conn, client_address: str) -> None:
     actor_lock = threading.Lock()
     send_lock = threading.Lock()
 
+    # Result sends go through a coalescing outbox: whatever is queued
+    # when the sender thread gets the lock ships as ONE wire frame
+    # (P.EXEC_BATCH). An idle channel takes the inline fast path —
+    # zero added latency for sync callers; a burst (100 queued actor
+    # replies) collapses 100 pickled sends + 100 head-side reader
+    # wakeups into one.
+    outbox: deque = deque()
+    out_ev = threading.Event()
+    sender_dead = threading.Event()
+
     def send(msg):
-        with send_lock:
-            conn.send(msg)
+        if not outbox and send_lock.acquire(blocking=False):
+            try:
+                if not outbox:
+                    conn.send(msg)
+                    return
+            finally:
+                send_lock.release()
+        outbox.append(msg)
+        out_ev.set()
+
+    def _sender_loop():
+        try:
+            _sender_loop_inner()
+        finally:
+            sender_dead.set()
+
+    def _sender_loop_inner():
+        while True:
+            out_ev.wait()
+            out_ev.clear()
+            while outbox:
+                with send_lock:
+                    batch = []
+                    while outbox and len(batch) < 256:
+                        batch.append(outbox.popleft())
+                    if not batch:
+                        break
+                    try:
+                        conn.send(batch[0] if len(batch) == 1
+                                  else (P.EXEC_BATCH, batch))
+                    except ValueError:
+                        # A payload the connection refuses (e.g. an
+                        # oversized frame) — not transport death.
+                        # Isolate per message: convert an unsendable
+                        # result into a RESULT_ERR for its task so
+                        # the head doesn't hang, and keep serving.
+                        for m in batch:
+                            try:
+                                conn.send(m)
+                            except ValueError:
+                                if m[0] in (P.RESULT_OK,
+                                            P.RESULT_STREAM):
+                                    # Fail the task rather than drop
+                                    # the frame: a silently missing
+                                    # stream item would hang its
+                                    # consumer at that index forever.
+                                    err = TaskError(
+                                        "result", "result frame "
+                                        "rejected by exec channel "
+                                        "(too large to send?)", None)
+                                    try:
+                                        conn.send((P.RESULT_ERR, m[1],
+                                                   ser.dumps(err)))
+                                    except (OSError, BrokenPipeError,
+                                            ValueError):
+                                        pass
+                            except (OSError, BrokenPipeError):
+                                return
+                    except (OSError, BrokenPipeError):
+                        return   # head gone; exec loop sees EOF too
+
+    threading.Thread(target=_sender_loop, daemon=True,
+                     name="worker_sender").start()
+
+    def _flush_outbox(timeout: float = 5.0):
+        deadline = time.monotonic() + timeout
+        while outbox and time.monotonic() < deadline \
+                and not sender_dead.is_set():
+            out_ev.set()
+            time.sleep(0.005)
+        # The sender pops a frame and ships it while HOLDING
+        # send_lock — an empty deque can mean "last frame still on
+        # the wire". Taking the lock once waits that send out.
+        if send_lock.acquire(timeout=max(
+                0.0, deadline - time.monotonic())):
+            send_lock.release()
 
     def stream_out(task_id_bytes, result):
         """Iterate a generator result, shipping each item as its own
@@ -899,6 +1114,24 @@ def worker_main(conn, client_address: str) -> None:
 
     def exec_actor_call(task_id_bytes, method, args_blob, resolved,
                         num_returns, trace_ctx=None):
+        gated = loop_sem is not None and not serialize_calls
+        if gated:
+            # Borrow a slot from the shared budget: blocking this
+            # pool thread keeps the TOTAL concurrent calls (pool +
+            # direct-to-loop) under max_concurrency.
+            import asyncio
+            loop = _ensure_actor_loop()
+            asyncio.run_coroutine_threadsafe(
+                loop_sem.acquire(), loop).result()
+        try:
+            _exec_actor_call_inner(task_id_bytes, method, args_blob,
+                                   resolved, num_returns, trace_ctx)
+        finally:
+            if gated:
+                loop.call_soon_threadsafe(loop_sem.release)
+
+    def _exec_actor_call_inner(task_id_bytes, method, args_blob,
+                               resolved, num_returns, trace_ctx=None):
         from ray_tpu.util.tracing import get_tracer
         tr = get_tracer()
         if trace_ctx is not None:
@@ -946,49 +1179,138 @@ def worker_main(conn, client_address: str) -> None:
                 _flush_spans()
 
     executor = None  # thread pool for max_concurrency > 1
+    # ONE budget for BOTH actor-call routes on actors with coroutine
+    # methods (two disjoint gates would let 2x max_concurrency calls
+    # run): an asyncio.Semaphore, so the direct route's excess calls
+    # queue CHEAPLY on the loop instead of occupying pool threads.
+    # Pure-sync threaded actors keep the pool's max_workers as their
+    # cap, exactly as before, and never pay a loop hop.
+    loop_sem = None
 
-    try:
-        while True:
-            msg = conn.recv()
-            kind = msg[0]
-            if kind == P.EXEC_SHUTDOWN:
-                break
-            elif kind == P.EXEC_TASK:
-                (_, task_id_bytes, fn_id, fn_blob, args_blob, resolved,
-                 num_returns, trace_ctx) = msg
-                exec_task(task_id_bytes, fn_id, fn_blob, args_blob,
-                          resolved, num_returns, trace_ctx)
-            elif kind == P.EXEC_ACTOR_INIT:
-                (_, actor_id_bytes, cls_blob, args_blob, resolved,
-                 max_concurrency) = msg
+    def send_from_loop(msg):
+        """Outbox-only send for the asyncio loop thread: the inline
+        fast path's blocking conn.send would stall every coroutine
+        on the shared loop while a large frame drains into a slow
+        pipe."""
+        outbox.append(msg)
+        out_ev.set()
+
+    def try_exec_on_loop(task_id_bytes, method, args_blob, resolved,
+                         num_returns, trace_ctx) -> bool:
+        """Direct-to-loop fast path for coroutine actor methods: the
+        threadpool route costs two thread handoffs per call (pool
+        thread -> loop -> pool thread blocked in Future.result()); on
+        one core that dominates a no-op call. Scheduling straight on
+        the persistent loop with a completing coroutine that ships its
+        own reply removes both hops. Falls back (False) whenever the
+        slow path's semantics are needed: tracing, streaming,
+        __ray_call__, non-coroutine methods, args that may block or
+        take real time to materialize on the recv thread, or no free
+        concurrency slot."""
+        import inspect
+        if (trace_ctx is not None or num_returns == "streaming"
+                or method == "__ray_call__" or resolved
+                or loop_sem is None or len(args_blob) > 65536):
+            return False
+        bound = getattr(actor_instance, method, None)
+        if bound is None or not inspect.iscoroutinefunction(bound):
+            return False
+        import asyncio
+        try:
+            # On the recv thread by design: the 64 KiB cap bounds the
+            # typical unpickle cost to microseconds. An arg whose
+            # __setstate__ does blocking I/O stalls the pump — the
+            # same anti-pattern class as blocking the actor loop, and
+            # out of scope for the fast path's guard.
+            args, kwargs = _materialize_args(args_blob, {})
+        except BaseException:  # noqa: BLE001
+            # Bad args must produce a RESULT_ERR for this one call,
+            # not unwind the recv loop — the slow path owns that.
+            return False
+
+        async def _acall():
+            async with loop_sem:
                 try:
-                    cls = ser.loads(cls_blob)
-                    args, kwargs = _materialize_args(args_blob, resolved)
-                    actor_instance = cls(*args, **kwargs)
-                    api._set_actor_context(ActorID(actor_id_bytes))
-                    if max_concurrency > 1:
-                        from concurrent.futures import ThreadPoolExecutor
-                        executor = ThreadPoolExecutor(
-                            max_workers=max_concurrency)
-                        serialize_calls = False
-                    send((P.RESULT_READY, actor_id_bytes, None))
+                    result = await bound(*args, **kwargs)
+                    send_from_loop((P.RESULT_OK, task_id_bytes,
+                                    _serialize_returns(result,
+                                                       num_returns)))
                 except BaseException:  # noqa: BLE001
-                    err = ActorError("__init__", traceback.format_exc())
-                    send((P.RESULT_ERR, actor_id_bytes, ser.dumps(err)))
-                    break
-            elif kind == P.EXEC_ACTOR_CALL:
-                (_, task_id_bytes, method, args_blob, resolved,
-                 num_returns, trace_ctx) = msg
-                if executor is not None:
+                    err = ActorError(method, traceback.format_exc(),
+                                     None)
+                    send_from_loop((P.RESULT_ERR, task_id_bytes,
+                                    ser.dumps(err)))
+
+        asyncio.run_coroutine_threadsafe(_acall(), _ensure_actor_loop())
+        return True
+
+    def handle_msg(msg) -> bool:
+        """Returns False to exit the exec loop."""
+        nonlocal actor_instance, executor, serialize_calls, loop_sem
+        kind = msg[0]
+        if kind == P.EXEC_SHUTDOWN:
+            return False
+        elif kind == P.EXEC_BATCH:
+            for m in msg[1]:
+                if not handle_msg(m):
+                    return False
+        elif kind == P.EXEC_TASK:
+            (_, task_id_bytes, fn_id, fn_blob, args_blob, resolved,
+             num_returns, trace_ctx) = msg
+            exec_task(task_id_bytes, fn_id, fn_blob, args_blob,
+                      resolved, num_returns, trace_ctx)
+        elif kind == P.EXEC_ACTOR_INIT:
+            (_, actor_id_bytes, cls_blob, args_blob, resolved,
+             max_concurrency) = msg
+            try:
+                cls = ser.loads(cls_blob)
+                args, kwargs = _materialize_args(args_blob, resolved)
+                actor_instance = cls(*args, **kwargs)
+                api._set_actor_context(ActorID(actor_id_bytes))
+                if max_concurrency > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+                    executor = ThreadPoolExecutor(
+                        max_workers=max_concurrency)
+                    serialize_calls = False
+                    import inspect
+                    # Scan the CLASS (instance getattr would fire
+                    # property getters mid-__init__).
+                    if any(inspect.iscoroutinefunction(
+                            getattr(cls, n, None))
+                           for n in dir(cls)
+                           if not n.startswith("__")):
+                        import asyncio
+                        loop_sem = asyncio.Semaphore(max_concurrency)
+                send((P.RESULT_READY, actor_id_bytes, None))
+            except BaseException:  # noqa: BLE001
+                err = ActorError("__init__", traceback.format_exc())
+                send((P.RESULT_ERR, actor_id_bytes, ser.dumps(err)))
+                return False
+        elif kind == P.EXEC_ACTOR_CALL:
+            (_, task_id_bytes, method, args_blob, resolved,
+             num_returns, trace_ctx) = msg
+            if executor is not None:
+                if not try_exec_on_loop(task_id_bytes, method,
+                                        args_blob, resolved,
+                                        num_returns, trace_ctx):
                     executor.submit(exec_actor_call, task_id_bytes,
                                     method, args_blob, resolved,
                                     num_returns, trace_ctx)
-                else:
-                    exec_actor_call(task_id_bytes, method, args_blob,
-                                    resolved, num_returns, trace_ctx)
+            else:
+                exec_actor_call(task_id_bytes, method, args_blob,
+                                resolved, num_returns, trace_ctx)
+        return True
+
+    try:
+        while True:
+            if not handle_msg(conn.recv()):
+                break
     except (EOFError, OSError, KeyboardInterrupt):
         pass
     finally:
+        # Results produced by executor/loop threads in the last instant
+        # must reach the wire before the process exits.
+        _flush_outbox()
         # Give the actor a chance to clean up (reference: atexit handlers
         # + __ray_terminate__).
         if actor_instance is not None:
