@@ -51,7 +51,10 @@ BENCH_TIMEOUT_S = 600.0
 SCALING_TIMEOUT_S = 420.0
 # Global wall-clock target for the whole orchestration. The driver's
 # own timeout was observed near ~570 s; finishing (with whatever
-# completed) beats being killed holding an unprinted result.
+# completed) beats being killed holding an unprinted result. Callers
+# with a known larger budget (scripts/bench_watch.py grants 780 s
+# under its 900 s hard kill) raise it via RAY_TPU_BENCH_DEADLINE —
+# the bare default stays driver-safe.
 DEADLINE_S = 540.0
 
 
@@ -155,7 +158,11 @@ def orchestrate() -> None:
     # contention in its timed region) and are skipped rather than
     # allowed to push total wall time past the driver's budget.
     if not os.environ.get("RAY_TPU_BENCH_SKIP_RESNET"):
-        t = budget(bench_timeout)
+        # Leave scaling a floor: resnet must not eat the whole
+        # remaining budget (it has its own history of hanging on a
+        # sick tunnel).
+        t = min(budget(bench_timeout),
+                max(budget(bench_timeout) - 200.0, 120.0))
         if t > 45:
             resnet, rerr = _run_child("--resnet50", t)
             if resnet and "error" not in resnet:
@@ -310,15 +317,12 @@ def _maybe_cpu_smoke() -> bool:
 def resnet50_main() -> None:
     smoke = _maybe_cpu_smoke()
     import jax
-    import numpy as np
     import optax
 
     from ray_tpu.models import ResNet, ResNet50Config
     from ray_tpu.models.resnet import resnet_loss_fn
     from ray_tpu.parallel import make_mesh
-    from ray_tpu.train import (
-        init_train_state, make_multi_train_step, shard_batch,
-    )
+    from ray_tpu.train import init_train_state, make_multi_train_step
 
     n_dev = len(jax.devices())
     mesh = make_mesh({"dp": n_dev})
@@ -339,23 +343,43 @@ def resnet50_main() -> None:
                                  has_extra=True, grad_norm=False)
 
     bsz = batch_per_chip * n_dev
-    rng = np.random.default_rng(0)
 
-    def fresh_stack():
-        imgs = rng.standard_normal(
-            (k_steps, bsz, image_size, image_size, 3),
-            dtype=np.float32)
-        labels = rng.integers(0, cfg.num_classes,
-                              (k_steps, bsz)).astype(np.int32)
-        return shard_batch({"image": imgs, "label": labels}, mesh,
-                           batch_dim=1)
+    # Synthetic inputs are generated ON DEVICE: a (k_steps, bsz, 224,
+    # 224, 3) float32 stack is ~770 MB — host RNG + an H2D push over
+    # the remote-chip tunnel per stack used to cost minutes and timed
+    # the whole child out. Content doesn't matter for a throughput
+    # bench; a real input pipeline overlaps transfers (data/iter_
+    # device_batches), which is a separate measurement.
+    from jax.sharding import NamedSharding
+    from ray_tpu.train.step import batch_spec
 
-    for _ in range(2):
-        state, metrics = step(state, fresh_stack())
+    stack_sh = NamedSharding(mesh, batch_spec(mesh, batch_dim=1))
+
+    import functools
+
+    @functools.partial(jax.jit,
+                       out_shardings={"image": stack_sh,
+                                      "label": stack_sh})
+    def device_stack(key):
+        import jax.numpy as jnp
+        k1, k2 = jax.random.split(key)
+        return {
+            "image": jax.random.normal(
+                k1, (k_steps, bsz, image_size, image_size, 3),
+                dtype=jnp.float32),
+            "label": jax.random.randint(
+                k2, (k_steps, bsz), 0, cfg.num_classes,
+                dtype=jnp.int32),
+        }
+
+    for i in range(2):
+        state, metrics = step(state, device_stack(jax.random.key(i)))
     float(metrics["loss"])
 
     n_calls = 2
-    stacks = [fresh_stack() for _ in range(n_calls)]
+    stacks = [device_stack(jax.random.key(10 + i))
+              for i in range(n_calls)]
+    jax.block_until_ready(stacks)
     t0 = time.perf_counter()
     for b in stacks:
         state, metrics = step(state, b)
@@ -410,11 +434,10 @@ def scaling_main() -> None:
         init_train_state, make_train_step, shard_batch,
     )
 
-    cfg = GPT2Config.tiny()
-    global_batch = 8
     rng = np.random.default_rng(0)
 
-    def bench_mesh(dp: int) -> float:
+    def bench_mesh(cfg, global_batch: int, dp: int,
+                   n_timed: int) -> float:
         mesh = make_mesh({"dp": dp})
         model = GPT2(cfg, mesh=mesh)
         params = model.init_params(jax.random.key(0))
@@ -430,32 +453,54 @@ def scaling_main() -> None:
             return shard_batch(
                 {"tokens": toks, "targets": np.roll(toks, -1, 1)}, mesh)
 
-        for _ in range(3):
+        for _ in range(2):
             state, m = step(state, batch())
         float(m["loss"])
-        n = 10
-        bs = [batch() for _ in range(n)]
+        bs = [batch() for _ in range(n_timed)]
         t0 = time.perf_counter()
         for b in bs:
             state, m = step(state, b)
         float(m["loss"])
-        return (time.perf_counter() - t0) / n
+        return (time.perf_counter() - t0) / n_timed
 
-    t1 = bench_mesh(1)
-    t8 = bench_mesh(8)
-    eff = t1 / t8
-    toks = global_batch * cfg.seq_len
+    # Two sizes. The tiny config measures pure partition/dispatch
+    # overhead (a step is microseconds of math, so the ratio is
+    # pessimistic by construction); the compute config gives each
+    # virtual device enough work per step to amortize it — that is
+    # the number that stands in for real weak scaling (round-3
+    # review: at gpt2-tiny/batch-8 the proxy measured dispatch, not
+    # sharding quality).
+    tiny = GPT2Config.tiny()
+    compute = GPT2Config.tiny(n_embd=128, n_layer=4, n_head=4,
+                              seq_len=256, vocab_size=512)
+    t1_tiny = bench_mesh(tiny, 8, 1, 10)
+    t8_tiny = bench_mesh(tiny, 8, 8, 10)
+    t1_c = bench_mesh(compute, 16, 1, 4)
+    t8_c = bench_mesh(compute, 16, 8, 4)
+    eff_tiny = t1_tiny / t8_tiny
+    eff = t1_c / t8_c
     print(json.dumps({
         "metric": "dp8_scaling_efficiency_proxy",
         "value": round(eff, 4),
         "unit": "t_dp1/t_dp8 at fixed global batch",
         "vs_baseline": round(eff, 4),
         "extra": {
-            "dp1_tokens_per_s": round(toks / t1, 1),
-            "dp8_tokens_per_s": round(toks / t8, 1),
-            "global_batch": global_batch,
-            "seq_len": cfg.seq_len,
-            "model": "gpt2-tiny",
+            # Definition changed in round 4: the headline ratio is
+            # the compute-amortizing config; rounds <=3 reported the
+            # tiny config (which measures dispatch overhead — see
+            # tiny_cfg.efficiency for the comparable number).
+            "proxy_rev": 2,
+            "compute_cfg": {
+                "model": "gpt2 d128 L4 seq256", "global_batch": 16,
+                "dp1_step_ms": round(t1_c * 1e3, 2),
+                "dp8_step_ms": round(t8_c * 1e3, 2),
+            },
+            "tiny_cfg": {
+                "model": "gpt2-tiny d64 L2 seq64", "global_batch": 8,
+                "efficiency": round(eff_tiny, 4),
+                "dp1_step_ms": round(t1_tiny * 1e3, 2),
+                "dp8_step_ms": round(t8_tiny * 1e3, 2),
+            },
             "n_virtual_devices": 8,
         },
     }), flush=True)

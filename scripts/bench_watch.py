@@ -59,13 +59,18 @@ def _log(event: dict) -> None:
         f.write(json.dumps(event) + "\n")
 
 
-def _run(args: list[str], timeout: float) -> tuple[dict | None, str]:
+def _run(args: list[str], timeout: float,
+         extra_env: dict | None = None) -> tuple[dict | None, str]:
     """Run a child in its own session; parse last JSON stdout line.
     Kills the whole process group on timeout (wedged jax threads can
     survive a plain terminate)."""
+    env = None
+    if extra_env:
+        env = dict(os.environ)
+        env.update(extra_env)
     proc = subprocess.Popen(
         args, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        start_new_session=True, cwd=REPO, text=True)
+        start_new_session=True, cwd=REPO, text=True, env=env)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -99,7 +104,11 @@ def capture() -> dict | None:
     """Run the full bench harness; persist artifacts on success."""
     env_note = {k: v for k, v in os.environ.items()
                 if k.startswith("RAY_TPU_BENCH")}
-    res, err = _run([sys.executable, BENCH], BENCH_TIMEOUT_S)
+    # The watcher knows its own kill budget, so it grants bench.py a
+    # longer orchestration deadline than the driver-safe default —
+    # enough for gpt2 + resnet50 + the two-config scaling proxy.
+    res, err = _run([sys.executable, BENCH], BENCH_TIMEOUT_S,
+                    extra_env={"RAY_TPU_BENCH_DEADLINE": "780"})
     if not res or res.get("value", 0) <= 0 or res.get("error"):
         _log({"event": "bench_failed", "err": err,
               "result": res, "env": env_note})
