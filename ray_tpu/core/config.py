@@ -89,6 +89,12 @@ class Config:
     # Missed health checks before a process is declared dead
     # (reference: GcsHealthCheckManager thresholds, ray_config_def.h:847).
     health_check_failure_threshold: int = 5
+    # Resource-view sync period: how often the head checks for (and,
+    # only on change, broadcasts) the versioned cluster resource
+    # snapshot daemons serve resource queries from; also the daemons'
+    # load-report cadence (reference: ray_syncer periodic snapshots,
+    # ray_syncer.h:88).
+    rview_period_s: float = 1.0
 
     # --- memory monitor / OOM killer (reference: MemoryMonitor
     # memory_monitor.h:52 + worker_killing_policy_retriable_fifo) ---

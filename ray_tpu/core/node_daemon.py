@@ -196,12 +196,34 @@ class NodeDaemon:
                 (P.ND_UPCALL, -1, "agent_report", stats)),
             node_id="", worker_pids_fn=_pids).start()
 
+        # Resource-view sync (ray_syncer analog, ray_syncer.h:88):
+        # the head broadcasts a versioned cluster snapshot (ND_RVIEW)
+        # this daemon serves resource queries from locally, and this
+        # daemon pushes versioned load reports up (ND_RSYNC) only
+        # when its observation changes. (State fields are initialized
+        # by _dial_and_register, which also RESETS them on every
+        # reconnect: a restarted head's version counter starts over,
+        # and its fresh NodeRecord needs a fresh first report.)
+        threading.Thread(target=self._rsync_report_loop, daemon=True,
+                         name="nd_rsync").start()
+
     # ------------------------------------------------------------------
     # head channel
     # ------------------------------------------------------------------
 
     def _dial_and_register(self):
         import socket
+        # (Re)set resource-sync state for THIS head incarnation: a
+        # restarted head restarts its ND_RVIEW version counter (so a
+        # kept high-water mark would reject every new broadcast and
+        # serve a stale view forever), and rebuilds NodeRecords with
+        # empty Observed state (so the unchanged-report suppression
+        # must be cleared to guarantee a fresh first ND_RSYNC).
+        self._rview: dict | None = None
+        self._rview_version = -1
+        self.rview_serves = getattr(self, "rview_serves", 0)
+        self._rsync_version = itertools.count()
+        self._rsync_last = None
         conn = mpc.Client(self.head_addr, family="AF_INET",
                           authkey=self.token)
         conn.send(("hello", "node", ""))
@@ -399,9 +421,54 @@ class NodeDaemon:
                         event.set()
             elif kind == P.ND_NODEMAP:
                     self._set_owner_map(msg[1])
+            elif kind == P.ND_RVIEW:
+                    _, version, view = msg
+                    if version > self._rview_version:
+                        self._rview_version = version
+                        self._rview = view
             elif kind == P.ND_SHUTDOWN:
                     self._shutdown = True
                     return
+
+    # ------------------------------------------------------------------
+    # resource-view sync (ray_syncer analog)
+    # ------------------------------------------------------------------
+
+    def _rview_totals(self) -> tuple[dict, dict]:
+        """(available, total) summed over alive nodes, served from
+        the head's last ND_RVIEW broadcast — the OP_RESOURCES reply
+        shape, with no head round trip."""
+        avail: dict[str, float] = {}
+        total: dict[str, float] = {}
+        self.rview_serves += 1
+        for rec in (self._rview or {}).values():
+            if not rec.get("alive", True):
+                continue
+            for k, v in rec.get("avail", {}).items():
+                avail[k] = avail.get(k, 0.0) + v
+            for k, v in rec.get("total", {}).items():
+                total[k] = total.get(k, 0.0) + v
+        return avail, total
+
+    def _rsync_report_loop(self) -> None:
+        from ray_tpu.core.config import get_config
+        period = get_config().rview_period_s
+        while not self._shutdown:
+            time.sleep(period)
+            try:
+                with self._pool_lock:
+                    running = sum(1 for w in self._workers.values()
+                                  if not w.dead)
+                with self._store_lock:
+                    n_local = len(self._local_oids)
+                report = {"workers": running, "objects": n_local}
+                if report == self._rsync_last:
+                    continue       # delta suppression
+                self._rsync_last = report
+                self.head_send((P.ND_RSYNC,
+                                next(self._rsync_version), report))
+            except Exception:  # noqa: BLE001
+                pass
 
     # ------------------------------------------------------------------
     # worker pool (the WorkerHandle "runtime" surface)
@@ -1011,80 +1078,115 @@ class NodeDaemon:
                 down_send((req_id, P.ST_ERR, ser.dumps(e)))
 
         conn_direct: set = set()
-        try:
-            while True:
-                req_id, op, payload = conn.recv()
-                if op == P.OP_PUT_DIRECT:
-                    # Same-host plasma-style put into THIS daemon's
-                    # arena. Dispatched on a thread: start/commit do
-                    # blocking head upcalls, and a head outage must
-                    # not stall this connection's daemon-local gets.
-                    # The dedupe envelope protects the client↔head
-                    # leg only — strip it here.
-                    _dd, dp = P.unwrap_dd(payload)
 
-                    def _dp(req_id=req_id, dp=dp):
-                        try:
-                            down_send((req_id, P.ST_OK,
-                                       self._worker_direct_put(
-                                           dp, conn_direct)))
-                        except BaseException as e:  # noqa: BLE001
-                            down_send((req_id, P.ST_ERR,
-                                       ser.dumps(e)))
+        def route_one(req_id, op, payload):
+            """Dispatch one client triple: serve locally where the
+            daemon owns the data, else return the triple for
+            forwarding to the head."""
+            if op == P.OP_PUT_DIRECT:
+                # Same-host plasma-style put into THIS daemon's
+                # arena. Dispatched on a thread: start/commit do
+                # blocking head upcalls, and a head outage must
+                # not stall this connection's daemon-local gets.
+                # The dedupe envelope protects the client↔head
+                # leg only — strip it here.
+                _dd, dp = P.unwrap_dd(payload)
 
-                    threading.Thread(target=_dp, daemon=True).start()
-                elif op == P.OP_PUT:
-                    # Served from the node-local store: strip the
-                    # dedupe envelope (it protects the client↔head
-                    # leg; the worker↔daemon leg is same-host and
-                    # dies only with the daemon, store included).
-                    _dd, payload = P.unwrap_dd(payload)
-                    threading.Thread(
-                        target=handle_local,
-                        args=(req_id, op, payload),
-                        daemon=True).start()
-                elif op == P.OP_GET_MANY:
-                    # Batched get: answer locally only when EVERY ref
-                    # is node-local (one reply message). Any remote
-                    # ref -> tell the client to fall back to per-ref
-                    # OP_GET so the p2p pull path (not a head relay)
-                    # serves it.
-                    if all(self._has_local(ObjectID(b))
-                           for b in payload[0]):
-                        threading.Thread(
-                            target=handle_local,
-                            args=(req_id, op, payload),
-                            daemon=True).start()
-                    else:
-                        down_send((req_id, P.ST_OK, ("fallback",)))
-                elif op == P.OP_GET:
-                    oid = ObjectID(payload[0])
-                    if self._has_local(oid):
-                        threading.Thread(
-                            target=handle_local,
-                            args=(req_id, op, payload),
-                            daemon=True).start()
-                    else:
-                        # Pull peer-to-peer where possible; the
-                        # fallback forwards to the head with
-                        # allow_desc forced off (the head must never
-                        # hand a same-host arena descriptor to a
-                        # conceptually remote worker).
-                        threading.Thread(
-                            target=self._p2p_get,
-                            args=(req_id, payload, forward_up,
-                                  down_send),
-                            daemon=True).start()
-                elif op == P.OP_PULL and isinstance(payload, tuple) \
-                        and len(payload) >= 2 \
-                        and isinstance(payload[1], str) \
-                        and self.transfer_plane.owns(payload[1]):
+                def _dp(req_id=req_id, dp=dp):
+                    try:
+                        down_send((req_id, P.ST_OK,
+                                   self._worker_direct_put(
+                                       dp, conn_direct)))
+                    except BaseException as e:  # noqa: BLE001
+                        down_send((req_id, P.ST_ERR,
+                                   ser.dumps(e)))
+
+                threading.Thread(target=_dp, daemon=True).start()
+                return None
+            if op == P.OP_PUT:
+                # Served from the node-local store: strip the
+                # dedupe envelope (it protects the client↔head
+                # leg; the worker↔daemon leg is same-host and
+                # dies only with the daemon, store included).
+                _dd, payload = P.unwrap_dd(payload)
+                threading.Thread(
+                    target=handle_local,
+                    args=(req_id, op, payload),
+                    daemon=True).start()
+                return None
+            if op == P.OP_GET_MANY:
+                # Batched get: answer locally only when EVERY ref
+                # is node-local (one reply message). Any remote
+                # ref -> tell the client to fall back to per-ref
+                # OP_GET so the p2p pull path (not a head relay)
+                # serves it.
+                if all(self._has_local(ObjectID(b))
+                       for b in payload[0]):
                     threading.Thread(
                         target=handle_local,
                         args=(req_id, op, payload),
                         daemon=True).start()
                 else:
-                    forward_up((req_id, op, payload))
+                    down_send((req_id, P.ST_OK, ("fallback",)))
+                return None
+            if op == P.OP_GET:
+                oid = ObjectID(payload[0])
+                if self._has_local(oid):
+                    threading.Thread(
+                        target=handle_local,
+                        args=(req_id, op, payload),
+                        daemon=True).start()
+                else:
+                    # Pull peer-to-peer where possible; the
+                    # fallback forwards to the head with
+                    # allow_desc forced off (the head must never
+                    # hand a same-host arena descriptor to a
+                    # conceptually remote worker).
+                    threading.Thread(
+                        target=self._p2p_get,
+                        args=(req_id, payload, forward_up,
+                              down_send),
+                        daemon=True).start()
+                return None
+            if op == P.OP_PULL and isinstance(payload, tuple) \
+                    and len(payload) >= 2 \
+                    and isinstance(payload[1], str) \
+                    and self.transfer_plane.owns(payload[1]):
+                threading.Thread(
+                    target=handle_local,
+                    args=(req_id, op, payload),
+                    daemon=True).start()
+                return None
+            if op == P.OP_RESOURCES and self._rview is not None:
+                # Served from the gossiped cluster resource view —
+                # an eventually-consistent read with no head hop
+                # (reference: ray_syncer distributes NodeResourceInfo
+                # so consumers don't poll the GCS).
+                down_send((req_id, P.ST_OK, self._rview_totals()))
+                return None
+            return (req_id, op, payload)
+
+        try:
+            while True:
+                req_id, op, payload = conn.recv()
+                if op == P.OP_REQ_BATCH:
+                    # A client outbox frame: the local-serve
+                    # intercepts above must see every triple — a
+                    # forwarded-whole batch would silently reroute
+                    # daemon-local gets/puts through the head.
+                    fwd = []
+                    for trip in payload:
+                        out = route_one(*trip)
+                        if out is not None:
+                            fwd.append(out)
+                    if len(fwd) == 1:
+                        forward_up(fwd[0])
+                    elif fwd:
+                        forward_up((-1, P.OP_REQ_BATCH, fwd))
+                    continue
+                out = route_one(req_id, op, payload)
+                if out is not None:
+                    forward_up(out)
         except (EOFError, OSError):
             pass
         finally:

@@ -165,6 +165,19 @@ ND_NODEMAP = "nd_nodemap"     # (ND_NODEMAP, [(node_id, tag_hex,
                               #   obj_addr)]) head -> daemons: owner
                               #   routing table for owner-minted ids
                               #   (pushed on membership change)
+ND_RSYNC = "nd_rsync"         # (ND_RSYNC, version, report) daemon ->
+                              #   head: versioned node load report
+                              #   (observed worker count etc.), sent
+                              #   only on change — the ray_syncer
+                              #   node-report leg (ray_syncer.h:88)
+ND_RVIEW = "nd_rview"         # (ND_RVIEW, version, {node_id:
+                              #   {alive,total,avail,observed}})
+                              #   head -> daemons: versioned cluster
+                              #   resource snapshot, broadcast only
+                              #   when changed (delta suppression);
+                              #   daemons serve resource queries from
+                              #   it locally — the syncer's broadcast
+                              #   leg, with the head as the hub
 
 
 # --- mutating-op dedupe -----------------------------------------------------

@@ -149,6 +149,11 @@ class NodeRecord:
     # sender on its full socket).
     last_pong: float = 0.0
     ping_inflight: bool = False
+    # Versioned load report pushed by the daemon (ND_RSYNC): what the
+    # node OBSERVES about itself (running workers, ...), as opposed to
+    # the head's authoritative allocation view in resources/avail.
+    observed: dict = field(default_factory=dict)
+    report_version: int = -1
 
     @property
     def is_daemon(self) -> bool:
@@ -687,6 +692,15 @@ class DriverRuntime:
         self._idle: dict[str, list[WorkerHandle]] = {}
         self._pool_lock = threading.Lock()
         self._last_reap_ts = 0.0
+        self._rview_version = 0
+        self._rview_broadcasts = 0
+        # Serializes version increment + snapshot + send across the
+        # periodic loop and the membership-change seed: without it,
+        # two threads can stamp different snapshots with the same
+        # version (daemons drop one) or an older snapshot with a
+        # higher version (transiently resurrecting a dead node).
+        self._rview_lock = threading.Lock()
+        self._rview_last = None
         self.max_workers = config.max_workers or max(2, ncpu)
 
         # Actor plane
@@ -3377,6 +3391,7 @@ class DriverRuntime:
             "Available": dict(n.avail),
             "Labels": dict(n.labels),
             "alive_workers": per_node.get(n.node_id, 0),
+            "Observed": dict(n.observed),
         } for n in recs]
 
     def _event(self, rec: TaskRecord, state: str) -> None:
@@ -3649,6 +3664,10 @@ class DriverRuntime:
                     n.node_send((P.ND_NODEMAP, rows))
                 except Exception:  # noqa: BLE001
                     pass
+        # Seed the resource view alongside membership changes so a
+        # fresh daemon can serve resource queries locally right away
+        # instead of waiting out the first sync period.
+        self._rview_broadcast(force=True)
 
     def _ensure_health_thread(self) -> None:
         """Active daemon health checking (reference:
@@ -3663,7 +3682,11 @@ class DriverRuntime:
             self._health_thread = threading.Thread(
                 target=self._health_loop, daemon=True,
                 name="node_health")
+            self._rview_thread = threading.Thread(
+                target=self._rview_loop, daemon=True,
+                name="rview_sync")
         self._health_thread.start()
+        self._rview_thread.start()
 
     def _safe_ping(self, node: NodeRecord) -> None:
         try:
@@ -3709,6 +3732,57 @@ class DriverRuntime:
                     threading.Thread(target=self._safe_ping,
                                      args=(node,),
                                      daemon=True).start()
+
+    # ---------------- resource-view sync (ray_syncer analog) ----------
+
+    def _rview_snapshot(self) -> dict:
+        with self._res_cv:
+            return {
+                n.node_id: {
+                    "alive": n.alive,
+                    "total": dict(n.resources),
+                    "avail": dict(n.avail),
+                    "observed": dict(n.observed),
+                }
+                for n in self._nodes.values() if n.alive
+            }
+
+    def _rview_broadcast(self, force: bool = False) -> None:
+        """Snapshot + version + send, atomically vs other callers.
+        ``force`` skips delta suppression (membership seeds must
+        reach a just-registered daemon even if the totals happen to
+        match the previous snapshot)."""
+        with self._rview_lock:
+            try:
+                view = self._rview_snapshot()
+            except Exception:  # noqa: BLE001
+                return
+            if not force and view == self._rview_last:
+                return
+            self._rview_last = view
+            self._rview_version += 1
+            self._rview_broadcasts += 1
+            msg = (P.ND_RVIEW, self._rview_version, view)
+            for node in list(self._nodes.values()):
+                if node.alive and node.is_daemon \
+                        and node.conn is not None:
+                    # Per-node: one dead connection must not abort
+                    # seeding for the daemons after it.
+                    try:
+                        node.node_send(msg)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def _rview_loop(self) -> None:
+        """Versioned cluster-resource broadcast (reference: RaySyncer
+        bidirectional versioned streams, ray_syncer.h:88 — scoped to
+        a hub-and-spoke topology since the head is the allocator).
+        Daemons serve resource queries from the received view with no
+        head round trip; unchanged snapshots are suppressed."""
+        period = self.config.rview_period_s
+        while not self._shutdown:
+            time.sleep(period)
+            self._rview_broadcast()
 
     def _serve_node(self, conn) -> None:
         """Serve one node daemon's control channel for its lifetime.
@@ -3769,6 +3843,14 @@ class DriverRuntime:
                 kind = msg[0]
                 if kind == P.ND_PONG:
                     node.last_pong = time.monotonic()
+                elif kind == P.ND_RSYNC:
+                    _, version, report = msg
+                    # Stale reports (reordered behind a reconnect)
+                    # must not regress the view (reference: syncer
+                    # version checks).
+                    if version > node.report_version:
+                        node.report_version = version
+                        node.observed = dict(report)
                 elif kind == P.ND_WMSG:
                     _, widx, wmsg = msg
                     w = self._remote_workers.get(widx)

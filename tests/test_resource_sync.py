@@ -1,0 +1,147 @@
+"""Resource-view sync (ray_syncer analog — reference:
+src/ray/common/ray_syncer/ray_syncer.h:88 versioned snapshots).
+
+The head broadcasts a versioned cluster resource snapshot (ND_RVIEW)
+with delta suppression; daemons serve resource queries from it with
+no head round trip and push versioned load reports up (ND_RSYNC).
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import api
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+@pytest.fixture()
+def cluster():
+    from ray_tpu.core.config import env_overrides
+    with env_overrides(rview_period_s=0.2):
+        c = Cluster()
+        daemon_node = c.add_node(num_cpus=3)
+        c.connect()
+        yield c, daemon_node
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_daemon_serves_resources_locally(cluster):
+    """A daemon-hosted worker's available/cluster_resources() is
+    answered from the gossiped view — counter-asserted: the head's
+    OP_RESOURCES handler is not hit once the view is warm."""
+    cluster, daemon_node = cluster
+    rt = api.get_runtime()
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+    def query(expect_cpu):
+        # Wait for the synced view to converge to the full cluster.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            total = ray_tpu.cluster_resources()
+            if total.get("CPU", 0) >= expect_cpu:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"view never converged: {total}")
+        for _ in range(5):
+            avail, total = (ray_tpu.available_resources(),
+                            ray_tpu.cluster_resources())
+        return avail.get("CPU", 0), total.get("CPU", 0)
+
+    expect = rt.cluster_resources()["CPU"]
+
+    # Count head-side OP_RESOURCES serves while the worker queries.
+    import ray_tpu.core.protocol as P
+    orig = rt._handle_client_op
+    counts = {"resources": 0}
+
+    def counting(op, payload):
+        if op == P.OP_RESOURCES:
+            counts["resources"] += 1
+        return orig(op, payload)
+
+    rt._handle_client_op = counting
+    try:
+        # Force the task onto the daemon node (head workers would hit
+        # the head handler legitimately).
+        avail_cpu, total_cpu = ray_tpu.get(
+            query.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    daemon_node.node_id, soft=False)
+            ).remote(expect), timeout=120)
+    finally:
+        rt._handle_client_op = orig
+    assert total_cpu == expect
+    assert avail_cpu >= 1          # the querying task holds 1 CPU
+    assert counts["resources"] == 0, (
+        "daemon-hosted resource queries must be served from the "
+        "synced view, not the head")
+
+
+def test_rview_delta_suppression_and_rsync(cluster):
+    """No cluster change -> no broadcast; daemon load reports land as
+    versioned Observed state on the node record."""
+    cluster, daemon_node = cluster
+    rt = api.get_runtime()
+
+    # Run a task on the daemon so it observes a live worker.
+    node_id = daemon_node.node_id
+
+    @ray_tpu.remote(num_cpus=1)
+    def touch():
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    strat = NodeAffinitySchedulingStrategy(node_id, soft=False)
+    assert ray_tpu.get(touch.options(scheduling_strategy=strat)
+                       .remote(), timeout=120) == node_id
+
+    # ND_RSYNC: the daemon's observed worker count reaches the head,
+    # version-stamped.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rec = next(n for n in rt.nodes() if n["NodeID"] == node_id)
+        if rec["Observed"].get("workers", 0) >= 1:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"no ND_RSYNC report landed: {rec}")
+    node = rt._nodes[node_id]
+    assert node.report_version >= 0
+
+    # Delta suppression: with the cluster idle and resources settled,
+    # the broadcast counter stops growing (<=1 tick of slack for the
+    # release of the task's CPU propagating).
+    time.sleep(0.6)
+    before = rt._rview_broadcasts
+    time.sleep(1.0)                # 5 sync periods
+    assert rt._rview_broadcasts - before <= 1, (
+        "unchanged snapshots must be suppressed")
+
+
+def test_rview_converges_on_membership_change(cluster):
+    """A node joining is visible in the daemon-served view without
+    any head query from the worker."""
+    cluster, daemon_node = cluster
+    rt = api.get_runtime()
+    base = rt.cluster_resources()["CPU"]
+    cluster.add_node(num_cpus=2)
+    node_id = daemon_node.node_id
+
+    @ray_tpu.remote(num_cpus=1)
+    def see_total(expect):
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= expect:
+                return True
+            time.sleep(0.1)
+        return False
+
+    strat = NodeAffinitySchedulingStrategy(node_id, soft=False)
+    assert ray_tpu.get(
+        see_total.options(scheduling_strategy=strat).remote(base + 2),
+        timeout=120)
