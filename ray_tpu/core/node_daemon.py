@@ -434,14 +434,14 @@ class NodeDaemon:
     # resource-view sync (ray_syncer analog)
     # ------------------------------------------------------------------
 
-    def _rview_totals(self) -> tuple[dict, dict]:
+    def _rview_totals(self, view: dict) -> tuple[dict, dict]:
         """(available, total) summed over alive nodes, served from
         the head's last ND_RVIEW broadcast — the OP_RESOURCES reply
         shape, with no head round trip."""
         avail: dict[str, float] = {}
         total: dict[str, float] = {}
         self.rview_serves += 1
-        for rec in (self._rview or {}).values():
+        for rec in view.values():
             if not rec.get("alive", True):
                 continue
             for k, v in rec.get("avail", {}).items():
@@ -1157,12 +1157,15 @@ class NodeDaemon:
                     args=(req_id, op, payload),
                     daemon=True).start()
                 return None
-            if op == P.OP_RESOURCES and self._rview is not None:
+            view = self._rview
+            if op == P.OP_RESOURCES and view is not None:
                 # Served from the gossiped cluster resource view —
                 # an eventually-consistent read with no head hop
                 # (reference: ray_syncer distributes NodeResourceInfo
-                # so consumers don't poll the GCS).
-                down_send((req_id, P.ST_OK, self._rview_totals()))
+                # so consumers don't poll the GCS). Snapshot first: a
+                # concurrent reconnect resets self._rview to None and
+                # must not turn this into an empty reply.
+                down_send((req_id, P.ST_OK, self._rview_totals(view)))
                 return None
             return (req_id, op, payload)
 

@@ -15,8 +15,11 @@ from ray_tpu.data.dataset import (
 )
 from ray_tpu.data.io import (
     from_arrow,
+    from_huggingface,
     read_numpy,
+    read_sql,
     read_text,
+    read_tfrecords,
     from_items,
     from_numpy,
     from_pandas,
@@ -40,4 +43,5 @@ __all__ = [
     "read_numpy",
     "from_numpy", "from_pandas", "read_parquet", "read_csv",
     "read_json", "read_images", "read_binary_files",
+    "read_tfrecords", "read_sql", "from_huggingface",
 ]

@@ -459,6 +459,22 @@ class Dataset:
                         {k: (v.tolist() if hasattr(v, "tolist")
                              else v) for k, v in row.items()}) + "\n")
 
+    def write_tfrecords(self, path: str) -> None:
+        """One .tfrecord file per block, rows as tf.train.Example
+        (reference: Dataset.write_tfrecords; framing + Example codec
+        in ray_tpu.data.tfrecord — no TF dependency)."""
+        import os
+
+        from ray_tpu.data.tfrecord import build_example, write_records
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            write_records(
+                f"{path}/part-{i:05d}.tfrecord",
+                (build_example(
+                    {k: (v.tolist() if hasattr(v, "tolist") else v)
+                     for k, v in row.items()})
+                 for row in block_rows(block)))
+
     def iter_torch_batches(self, batch_size: int | None = None,
                            drop_last: bool = False,
                            device: str | None = None):

@@ -240,3 +240,103 @@ def from_arrow(tables: list) -> Dataset:
     if not isinstance(tables, list):
         tables = [tables]
     return Dataset([_Source([(lambda t=t: t) for t in tables])])
+
+
+def read_tfrecords(paths: str | list[str], *,
+                   raw_bytes: bool = False,
+                   verify_crc: bool = False) -> Dataset:
+    """TFRecord files of tf.train.Example protos -> one block per
+    file, one column per feature (reference:
+    _internal/datasource/tfrecords_datasource.py — re-based: TF isn't
+    a dependency, so framing + the Example wire format are decoded by
+    ray_tpu.data.tfrecord directly). ``raw_bytes=True`` skips Example
+    parsing and yields a single "bytes" column."""
+    files = _expand(paths, ".tfrecord")
+
+    def make(f):
+        def read():
+            from ray_tpu.data.tfrecord import parse_example, read_records
+            if raw_bytes:
+                recs = list(read_records(f, verify=verify_crc))
+                return to_block({"bytes": np.asarray(recs,
+                                                     dtype=object)})
+            cols: dict[str, list] = {}
+            n = 0
+            for rec in read_records(f, verify=verify_crc):
+                row = parse_example(rec)
+                for k, vals in row.items():
+                    cols.setdefault(k, [None] * n).append(
+                        vals[0] if len(vals) == 1 else vals)
+                n += 1
+                for k in cols:
+                    if len(cols[k]) < n:
+                        cols[k].append(None)
+            return to_block({k: np.asarray(v, dtype=object)
+                             if any(x is None for x in v)
+                             or isinstance(v[0], (bytes, list))
+                             else np.asarray(v)
+                             for k, v in cols.items()})
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
+
+
+def read_sql(sql: str | list[str], connection_factory, *,
+             columns: list[str] | None = None) -> Dataset:
+    """DB-API 2.0 datasource (reference: ray.data.read_sql). One read
+    task per query: pass a LIST of shard queries (e.g. partitioned by
+    key range) to read in parallel — arbitrary single statements
+    cannot be split safely, matching the reference's sharding
+    contract. ``connection_factory`` must be picklable (executes in
+    workers)."""
+    queries = [sql] if isinstance(sql, str) else list(sql)
+
+    def make(q):
+        def read():
+            conn = connection_factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(q)
+                names = columns or [d[0] for d in cur.description]
+                rows = cur.fetchall()
+            finally:
+                conn.close()
+            cols = {name: [r[i] for r in rows]
+                    for i, name in enumerate(names)}
+            return to_block({k: np.asarray(v) for k, v in cols.items()})
+        return read
+
+    return Dataset([_Source([make(q) for q in queries])])
+
+
+def from_huggingface(hf_dataset, *,
+                     parallelism: int | None = None) -> Dataset:
+    """A (map-style) huggingface ``datasets.Dataset`` -> Dataset
+    (reference: ray.data.from_huggingface). The arrow shards convert
+    zero-copy; parallelism slices the table row-wise."""
+    if getattr(hf_dataset, "_indices", None) is not None:
+        # select()/shuffle()/filter() record an indices mapping over
+        # an unchanged arrow table — reading .data directly would
+        # silently yield the wrong rows.
+        hf_dataset = hf_dataset.flatten_indices()
+    try:
+        table = hf_dataset.data.table     # pyarrow.Table
+    except AttributeError as e:
+        raise TypeError(
+            "from_huggingface expects a datasets.Dataset (map-style); "
+            f"got {type(hf_dataset).__name__}") from e
+    parallelism = _default_parallelism(parallelism)
+    n = table.num_rows
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    fns = []
+    for i in builtins.range(parallelism):
+        lo, hi = i * per, min(n, (i + 1) * per)
+        if lo >= hi:
+            break
+        # Slice EAGERLY so each read closure captures only its shard;
+        # a closure over (table, lo, hi) would ship the entire table
+        # to every read task.
+        shard = table.slice(lo, hi - lo)
+        fns.append(lambda s=shard: s)
+    return Dataset([_Source(fns)])
