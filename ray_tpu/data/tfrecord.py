@@ -35,11 +35,21 @@ for _n in range(256):
     _CRC_TABLE.append(_c)
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
     crc ^= 0xFFFFFFFF
     for b in data:
         crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """crc32c, preferring the native SSE4.2 path (~30x the table
+    loop); the pure-Python table is the no-toolchain fallback."""
+    from ray_tpu.native.tfrec import get_lib
+    lib = get_lib()
+    if lib is not None:
+        return lib.rtf_crc32c(data, len(data), crc)
+    return _crc32c_py(data, crc)
 
 
 def _masked_crc(data: bytes) -> int:
@@ -67,6 +77,10 @@ def write_records(path: str, records) -> int:
 
 
 def read_records(path: str, *, verify: bool = False) -> Iterator[bytes]:
+    from ray_tpu.native.tfrec import get_lib
+    if get_lib() is not None:
+        yield from _read_records_native(path, verify)
+        return
     with open(path, "rb") as f:
         while True:
             hdr = f.read(8)
@@ -75,17 +89,51 @@ def read_records(path: str, *, verify: bool = False) -> Iterator[bytes]:
             if len(hdr) != 8:
                 raise ValueError(f"{path}: truncated length header")
             (length,) = struct.unpack("<Q", hdr)
-            (hcrc,) = struct.unpack("<I", f.read(4))
+            hcrc_b = f.read(4)
+            if len(hcrc_b) != 4:
+                raise ValueError(f"{path}: truncated length crc")
+            (hcrc,) = struct.unpack("<I", hcrc_b)
             payload = f.read(length)
             if len(payload) != length:
                 raise ValueError(f"{path}: truncated record")
-            (pcrc,) = struct.unpack("<I", f.read(4))
+            pcrc_b = f.read(4)
+            if len(pcrc_b) != 4:
+                raise ValueError(f"{path}: truncated payload crc")
+            (pcrc,) = struct.unpack("<I", pcrc_b)
             if verify:
                 if _masked_crc(hdr) != hcrc:
                     raise ValueError(f"{path}: length crc mismatch")
                 if _masked_crc(payload) != pcrc:
                     raise ValueError(f"{path}: payload crc mismatch")
             yield payload
+
+
+def _read_records_native(path: str, verify: bool) -> Iterator[bytes]:
+    """Native frame walk + hardware CRC (ray_tpu/native/tfrec.cpp)
+    over an mmap of the file: constant resident memory like the
+    streaming Python reader (pages are clean/evictable), one scan
+    pass, per-record slices out. Error surface matches the Python
+    reader (ValueError on truncation/crc)."""
+    import ctypes
+    import mmap
+    import os
+
+    from ray_tpu.native.tfrec import scan_addr
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        if size == 0:
+            return
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+    view = ctypes.c_char.from_buffer(mm)
+    try:
+        base = ctypes.addressof(view)
+        for off, ln in scan_addr(base, size, verify):
+            yield mm[off:off + ln]
+    except ValueError as e:
+        raise ValueError(f"{path}: {e}") from None
+    finally:
+        del view            # release the buffer export before close
+        mm.close()
 
 
 # ---------------------------------------------------------------------------

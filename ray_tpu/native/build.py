@@ -13,7 +13,7 @@ import tempfile
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_SRC_DIR, "_build")
-_SOURCES = ["store.cpp", "channel.cpp"]
+_SOURCES = ["store.cpp", "channel.cpp", "tfrec.cpp"]
 _LIB = "libraytpu_native.so"
 
 

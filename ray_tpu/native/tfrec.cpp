@@ -1,0 +1,125 @@
+// TFRecord framing scanner + crc32c (Castagnoli).
+//
+// The data-loader's hot loop: pure-Python crc32c caps TFRecord reads
+// at ~50 MB/s/core; the SSE4.2 crc32 instruction runs it at memory
+// speed. ctypes ABI like the rest of ray_tpu/native (no pybind11 in
+// the image). Reference analog: the reference reads TFRecords through
+// TensorFlow's C++ RecordReader; here the native layer is scoped to
+// exactly the two costs Python can't amortize — CRC and frame walking.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+uint32_t table_[256];
+bool table_ready_ = false;
+
+void init_table() {
+  if (table_ready_) return;
+  for (uint32_t n = 0; n < 256; n++) {
+    uint32_t c = n;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+    table_[n] = c;
+  }
+  table_ready_ = true;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+uint32_t crc_hw(const uint8_t* p, size_t n, uint32_t crc) {
+  crc = ~crc;
+  while (n >= 8) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    crc = (uint32_t)__builtin_ia32_crc32di(crc, v);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = __builtin_ia32_crc32qi(crc, *p++);
+  return ~crc;
+}
+bool have_hw() { return __builtin_cpu_supports("sse4.2"); }
+#else
+uint32_t crc_hw(const uint8_t*, size_t, uint32_t) { return 0; }
+bool have_hw() { return false; }
+#endif
+
+uint32_t crc_sw(const uint8_t* p, size_t n, uint32_t crc) {
+  init_table();
+  crc = ~crc;
+  while (n--) crc = table_[(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t crc32c(const uint8_t* p, size_t n, uint32_t crc) {
+  static const bool hw = have_hw();
+  return hw ? crc_hw(p, n, crc) : crc_sw(p, n, crc);
+}
+
+uint32_t masked(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+uint32_t rd32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t rd64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t rtf_crc32c(const uint8_t* data, size_t n, uint32_t crc) {
+  return crc32c(data, n, crc);
+}
+
+uint32_t rtf_masked_crc(const uint8_t* data, size_t n) {
+  return masked(crc32c(data, n, 0));
+}
+
+// Walk TFRecord frames in [buf, buf+n). Writes up to max_records
+// (offset, length) pairs of the PAYLOADS into out_off/out_len.
+// Returns the number of records found; -1 on a malformed/truncated
+// frame; -2 on a CRC mismatch (verify != 0 checks both CRCs).
+// Scanning resumes at *resume_pos (byte offset), which is updated to
+// the position after the last returned record — call again for files
+// with more than max_records records.
+long rtf_scan(const uint8_t* buf, size_t n, int verify,
+              size_t* out_off, size_t* out_len, long max_records,
+              size_t* resume_pos) {
+  size_t pos = resume_pos ? *resume_pos : 0;
+  long count = 0;
+  while (pos < n && count < max_records) {
+    if (n - pos < 16) return -1;
+    uint64_t len = rd64(buf + pos);
+    uint32_t len_crc = rd32(buf + pos + 8);
+    // Guard the addition: a corrupt length near UINT64_MAX would
+    // wrap `16 + len` past the check and read out of bounds (or,
+    // unverified, freeze pos and spin the caller forever).
+    if (len > n - pos - 16) return -1;
+    if (verify) {
+      if (masked(crc32c(buf + pos, 8, 0)) != len_crc) return -2;
+      uint32_t data_crc = rd32(buf + pos + 12 + len);
+      if (masked(crc32c(buf + pos + 12, len, 0)) != data_crc)
+        return -2;
+    }
+    out_off[count] = pos + 12;
+    out_len[count] = (size_t)len;
+    count++;
+    pos += 16 + len;
+  }
+  if (resume_pos) *resume_pos = pos;
+  return count;
+}
+
+}  // extern "C"

@@ -117,3 +117,43 @@ def test_tfrecord_numpy_scalars():
     parsed = parse_example(build_example(row))
     assert parsed["f32"] == pytest.approx([1.5])
     assert parsed["i32"] == [-4]
+
+
+def test_tfrecord_python_fallback(tmp_path, monkeypatch):
+    """The no-toolchain pure-Python codec must stay exercised: force
+    get_lib() to None and roundtrip + corrupt-crc through it."""
+    import ray_tpu.data.tfrecord as tfr
+    import ray_tpu.native.tfrec as ntfr
+    monkeypatch.setattr(ntfr, "_lib", None)
+    monkeypatch.setattr(ntfr, "_tried", True)
+    p = str(tmp_path / "py.tfrecord")
+    recs = [b"one", b"two" * 100]
+    tfr.write_records(p, recs)
+    assert list(tfr.read_records(p, verify=True)) == recs
+    raw = bytearray(open(p, "rb").read())
+    raw[12] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="crc"):
+        list(tfr.read_records(p, verify=True))
+
+
+def test_tfrecord_corrupt_length_field(tmp_path):
+    """A corrupted 64-bit length (huge value) must raise, not scan
+    out of bounds or spin (native path) — and truncation anywhere
+    raises ValueError on both paths."""
+    from ray_tpu.data.tfrecord import read_records, write_records
+    p = str(tmp_path / "c.tfrecord")
+    write_records(p, [b"payload-one", b"payload-two"])
+    raw = bytearray(open(p, "rb").read())
+    raw[0:8] = (0xFFFFFFFFFFFFFFF0).to_bytes(8, "little")
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError):
+        list(read_records(p))
+    with pytest.raises(ValueError):
+        list(read_records(p, verify=True))
+    # truncation mid-crc
+    write_records(p, [b"abc"])
+    good = open(p, "rb").read()
+    open(p, "wb").write(good[:-2])
+    with pytest.raises(ValueError):
+        list(read_records(p, verify=True))
