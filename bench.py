@@ -234,7 +234,8 @@ def gpt2_main() -> None:
     mesh = make_mesh({"dp": n_dev})
 
     cfg = GPT2Config.tiny() if smoke else GPT2Config.small()  # 124M
-    batch_per_chip = 2 if smoke else 8
+    batch_per_chip = 2 if smoke else int(
+        os.environ.get("RAY_TPU_BENCH_BATCH", 8))
     model = GPT2(cfg, mesh=mesh)
     params = model.init_params(jax.random.key(0))
     # bf16 first moment: halves Adam's mu HBM traffic; second moment
