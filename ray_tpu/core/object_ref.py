@@ -48,11 +48,25 @@ def _escape_for_pickle(ref: "ObjectRef") -> str | None:
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_hint", "__weakref__")
+    # _del_cb: release callback invoked with the ObjectID when this
+    # instance dies (refcount pin / borrow release). A plain __del__
+    # slot instead of weakref.finalize: finalize allocates a tracked
+    # object and a global registry entry per ref, which dominated a
+    # get() of a 10k-ref container.
+    __slots__ = ("_id", "_owner_hint", "_del_cb", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_hint: str | None = None):
         self._id = object_id
         self._owner_hint = owner_hint
+        self._del_cb = None
+
+    def __del__(self):
+        cb = self._del_cb
+        if cb is not None:
+            try:
+                cb(self._id)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
 
     @property
     def id(self) -> ObjectID:

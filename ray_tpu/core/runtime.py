@@ -814,8 +814,13 @@ class DriverRuntime:
     def register_ref(self, ref: ObjectRef) -> ObjectRef:
         with self._ref_lock:
             self._refcounts[ref.id] = self._refcounts.get(ref.id, 0) + 1
-        import weakref
-        weakref.finalize(ref, self._dec_ref, ref.id)
+        if ref._del_cb is None:
+            ref._del_cb = self._dec_ref
+        else:
+            # Same instance registered twice (rare): the __del__ slot
+            # fires once, so the extra count needs its own finalizer.
+            import weakref
+            weakref.finalize(ref, self._dec_ref, ref.id)
         return ref
 
     def _pinned_locked(self, oid: ObjectID) -> bool:
