@@ -79,13 +79,26 @@ def broadcast(x, axis: str, root: int = 0):
     return lax.psum(jnp.where(mine, x, jnp.zeros_like(x)), axis)
 
 
-def barrier(axis) -> None:
+def barrier(axis, x=None):
     """Synchronization point (reference: collective.barrier). Under
-    XLA a collective IS the barrier; a scalar psum is the cheapest
-    one. Returns nothing — the data dependency is the fence, so for
-    effect it must order AGAINST something; prefer making your next
-    op consume a collective result instead."""
-    lax.psum(jnp.zeros((), jnp.int32), axis)
+    XLA a collective IS the barrier — but ONLY if its result is
+    consumed: a psum with an unused result is dead-code-eliminated,
+    silently compiling the barrier to a no-op. So this returns a
+    value the caller must thread through. With ``x``, returns ``x``
+    fenced on the collective completing (``optimization_barrier``
+    ties them, so neither can be elided or hoisted across); without,
+    returns the scalar token — consume it (add it to a loss, pass it
+    onward) or the barrier does not exist."""
+    t = lax.psum(jnp.ones((), jnp.int32), axis)
+    if x is None:
+        return t
+    # A genuine data dependence: the select's predicate is the psum
+    # result, unknown at compile time, so XLA must run the collective
+    # before producing x. (optimization_barrier is NOT enough — an
+    # opt-barrier output that goes unused is pruned together with the
+    # collective feeding it; measured on the CPU backend.)
+    return jax.tree_util.tree_map(
+        lambda a: jnp.where(t > 0, a, jnp.zeros_like(a)), x)
 
 
 def axis_index(axis: str):
@@ -209,8 +222,8 @@ class DeviceCollectiveGroup:
     def broadcast(self, x, root: int = 0):
         return broadcast(x, self._one("broadcast"), root)
 
-    def barrier(self) -> None:
-        barrier(self.axes)
+    def barrier(self, x=None):
+        return barrier(self.axes, x)
 
     def hierarchical_allreduce(self, x, scatter_dimension: int = 0):
         if len(self.axes) != 2:

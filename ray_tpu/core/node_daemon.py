@@ -549,7 +549,26 @@ class NodeDaemon:
                     for m in batch:
                         try:
                             w.send(m)
-                        except ValueError:
+                        except ValueError as e:
+                            # The refused message is lost for good —
+                            # report the failure upstream instead of
+                            # silently dropping it (the caller would
+                            # hang forever waiting for a result). The
+                            # wire id at m[1] is a task id for
+                            # EXEC_TASK/EXEC_ACTOR_CALL and the actor
+                            # id for EXEC_ACTOR_INIT; the head's
+                            # RESULT_ERR handler accepts both.
+                            if m and m[0] in (P.EXEC_TASK,
+                                              P.EXEC_ACTOR_CALL,
+                                              P.EXEC_ACTOR_INIT):
+                                try:
+                                    self._on_worker_message(
+                                        w, (P.RESULT_ERR, m[1],
+                                            ser.dumps(RuntimeError(
+                                                "task message refused "
+                                                f"by wire: {e}"))))
+                                except Exception:  # noqa: BLE001
+                                    pass
                             continue
                         except Exception:  # noqa: BLE001
                             return

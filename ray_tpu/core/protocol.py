@@ -74,6 +74,15 @@ OP_SUBMIT_ACTOR_OWNED = "submit_actor_owned"
                                 # ORDER is part of the actor
                                 # contract), failures stored on the
                                 # return ids.
+OP_OWNED_FAILED = "owned_failed"
+                                # ([return_id_bytes], err_blob) — the
+                                # client's wire layer refused an owned
+                                # submit (e.g. oversized frame) so the
+                                # head never saw it; store the error
+                                # on the preminted return ids so get()
+                                # raises instead of hanging. Idempotent
+                                # (store_error on an existing entry is
+                                # a no-op).
 OP_PUT = "put"
 OP_GET = "get"
 OP_GET_MANY = "get_many"        # ([oid_bytes], timeout, allow_desc)

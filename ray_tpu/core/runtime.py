@@ -4296,6 +4296,19 @@ class DriverRuntime:
             for r in refs:
                 self.on_ref_escaped(r.id)
             return [r.id.binary() for r in refs]
+        if op == P.OP_OWNED_FAILED:
+            # The client's wire layer refused an owned submit (e.g.
+            # oversized frame): the registration never arrived, so the
+            # preminted return ids would dangle forever. Store the
+            # client-reported error on each id — unless something is
+            # already there (paranoia against a replay racing a real
+            # registration).
+            rid_bytes, err_blob = payload
+            for b in rid_bytes:
+                oid = ObjectID(b)
+                if not self._object_available(oid):
+                    self._store_error(oid, err_blob)
+            return None
         if op == P.OP_PUT:
             ref = self.put_serialized(_wire_to_serialized(payload))
             # A remote process holds it; with a nonce (element 3) the

@@ -648,6 +648,14 @@ class ClientRuntime:
                     except Exception:  # noqa: BLE001
                         err = None
                     replay = isinstance(err, ConnectionError)
+                    if not replay and op in (P.OP_SUBMIT_OWNED,
+                                             P.OP_SUBMIT_ACTOR_OWNED):
+                        # The wire refused this submit outright (e.g.
+                        # oversized frame → ValueError from the
+                        # sender's isolation path) — the head never
+                        # saw it, so the preminted return ids would
+                        # hang get() forever. Mark them errored.
+                        self._fail_owned_returns(payload, result)
             if replay:
                 # Never replay from here: the drainer runs BEHIND the
                 # app threads, and a direct re-send would order this
@@ -658,6 +666,17 @@ class ClientRuntime:
                     self._lost_async.append((op, payload, dd))
                 if self._conn_dead:
                     self._try_reconnect()   # fence runs inside
+
+    def _fail_owned_returns(self, payload, err_blob: bytes) -> None:
+        """A refused owned submit never reached the head: report its
+        preminted return ids as errored so get() raises instead of
+        hanging (advisor r4 finding). Both owned-submit payload shapes
+        carry [return_id_bytes] at index 6."""
+        try:
+            rid_bytes = list(payload[6])
+            self._call_async(P.OP_OWNED_FAILED, (rid_bytes, err_blob))
+        except Exception:  # noqa: BLE001
+            pass           # head unreachable: reconnect paths own it
 
     def stream_next(self, task_id_bytes: bytes,
                     timeout: float | None = None):

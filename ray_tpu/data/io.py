@@ -265,17 +265,33 @@ def read_tfrecords(paths: str | list[str], *,
             for rec in read_records(f, verify=verify_crc):
                 row = parse_example(rec)
                 for k, vals in row.items():
-                    cols.setdefault(k, [None] * n).append(
-                        vals[0] if len(vals) == 1 else vals)
+                    cols.setdefault(k, [None] * n).append(list(vals))
                 n += 1
                 for k in cols:
                     if len(cols[k]) < n:
                         cols[k].append(None)
-            return to_block({k: np.asarray(v, dtype=object)
-                             if any(x is None for x in v)
-                             or isinstance(v[0], (bytes, list))
-                             else np.asarray(v)
-                             for k, v in cols.items()})
+
+            def col_array(v: list) -> np.ndarray:
+                # Scalar column only when EVERY row has exactly one
+                # value; a column with any multi-value (ragged) row
+                # keeps per-row lists in a dtype=object array —
+                # np.asarray on mixed scalars/lists raises
+                # "inhomogeneous shape" (advisor r4 finding).
+                if all(x is None or len(x) == 1 for x in v):
+                    scalars = [x[0] if x else None for x in v]
+                    if any(x is None for x in scalars) or \
+                            isinstance(scalars[0], bytes):
+                        arr = np.empty(len(scalars), dtype=object)
+                        for i, x in enumerate(scalars):
+                            arr[i] = x
+                        return arr
+                    return np.asarray(scalars)
+                arr = np.empty(len(v), dtype=object)
+                for i, x in enumerate(v):
+                    arr[i] = x
+                return arr
+
+            return to_block({k: col_array(v) for k, v in cols.items()})
         return read
 
     return Dataset([_Source([make(f) for f in files])])

@@ -29,6 +29,69 @@ def test_put_replay_same_dd_returns_same_object(rt):
         client.shutdown()
 
 
+def test_refused_owned_submit_errors_return_refs(rt):
+    """A wire-refused owned submit (ValueError from the sender — e.g.
+    an oversized frame) must surface as an error on the preminted
+    return refs, not hang get() forever (advisor r4: the drainer used
+    to discard non-ConnectionError ST_ERR)."""
+    import pytest
+
+    import ray_tpu
+    from ray_tpu.core import serialization as ser
+    from ray_tpu.core.remote_function import make_task_options
+
+    runtime = get_runtime()
+
+    @ray_tpu.remote
+    def seven():
+        return 7
+
+    fn_id, fn_blob = runtime.register_function(seven._fn)
+    client = ClientRuntime(runtime.client_address)
+    try:
+        real = client._conn
+
+        class RefusingConn:
+            """Refuses any frame carrying an owned submit; passes
+            everything else (incl. the OP_OWNED_FAILED report)."""
+
+            def __init__(self, inner):
+                object.__setattr__(self, "_inner", inner)
+
+            def _has_owned(self, frame):
+                if frame[1] == P.OP_SUBMIT_OWNED:
+                    return True
+                if frame[1] == P.OP_REQ_BATCH:
+                    return any(t[1] == P.OP_SUBMIT_OWNED
+                               for t in frame[2])
+                return False
+
+            def send(self, frame):
+                if self._has_owned(frame):
+                    raise ValueError("injected: frame refused")
+                return self._inner.send(frame)
+
+            def __getattr__(self, k):
+                return getattr(self._inner, k)
+
+        client._conn = RefusingConn(real)
+        # Hold _send_lock so the submit takes the outbox path (the
+        # inline fast path would raise synchronously — fine, but not
+        # the silent-loss path under test).
+        client._send_lock.acquire()
+        try:
+            refs = client.submit_task(
+                fn_id, fn_blob, "seven", (), {}, make_task_options())
+        finally:
+            client._send_lock.release()
+        with pytest.raises(Exception, match="refused"):
+            client.get(refs[0], timeout=30)
+        client._conn = real
+    finally:
+        client._conn = real
+        client.shutdown()
+
+
 def test_submit_replay_runs_task_once(rt):
     runtime = get_runtime()
 

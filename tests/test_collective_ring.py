@@ -129,3 +129,35 @@ def test_100mb_allreduce_on_daemon_ranks():
         assert mesh_wall < 12.0, mesh_wall
     finally:
         cluster.shutdown()
+
+
+def test_peer_mesh_close_protocol_clean():
+    """close() must be an explicit handshake: _BYE to peers, socket
+    shutdown, reader threads JOINED — never a reader dying on an
+    exception from a half-closed Connection (VERDICT r4 weak #6)."""
+    import threading
+
+    from ray_tpu.collective.mesh import PeerMesh
+
+    thread_errors = []
+    old_hook = threading.excepthook
+    threading.excepthook = lambda args: thread_errors.append(args)
+    try:
+        m0 = PeerMesh(0, 2, b"tok-close")
+        m1 = PeerMesh(1, 2, b"tok-close")
+        addrs = {0: m0.addr, 1: m1.addr}
+        m0.set_addresses(addrs)
+        m1.set_addresses(addrs)
+        m0.send(1, ("t", 0), np.arange(4.0))
+        out = m1.recv(0, ("t", 0), timeout=10)
+        assert (out == np.arange(4.0)).all()
+        threads = list(m0._threads) + list(m1._threads)
+        m0.close()
+        m1.close()
+        deadline = time.time() + 5.0
+        for t in threads:
+            t.join(timeout=max(deadline - time.time(), 0.1))
+            assert not t.is_alive(), f"mesh thread leaked: {t.name}"
+    finally:
+        threading.excepthook = old_hook
+    assert not thread_errors, [str(a.exc_value) for a in thread_errors]

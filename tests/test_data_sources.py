@@ -62,6 +62,39 @@ def test_write_read_tfrecords_dataset(rt, tmp_path):
     assert raw.count() == 20
 
 
+def test_read_tfrecords_ragged_columns(rt, tmp_path):
+    """A feature whose value count varies across rows (including
+    rows where it is a single value) must come back as a
+    dtype=object column of per-row lists — not crash np.asarray
+    with an inhomogeneous-shape error (advisor r4 finding)."""
+    from ray_tpu.data.tfrecord import build_example, write_records
+    p = str(tmp_path / "ragged.tfrecord")
+    write_records(p, [
+        build_example({"toks": [5], "tag": b"a"}),
+        build_example({"toks": [1, 2], "tag": b"b"}),
+        build_example({"toks": [7, 8, 9]}),       # tag missing
+    ])
+    rows = rdata.read_tfrecords(p).take_all()
+    assert [list(r["toks"]) for r in rows] == [[5], [1, 2], [7, 8, 9]]
+    assert [r["tag"] for r in rows] == [b"a", b"b", None]
+    # All-single-value numeric columns still come back scalar.
+    p2 = str(tmp_path / "flat.tfrecord")
+    write_records(p2, [build_example({"x": i}) for i in range(3)])
+    flat = rdata.read_tfrecords(p2).take_all()
+    assert [r["x"] for r in flat] == [0, 1, 2]
+    # A single-value column with a MISSING row stays scalar-per-row
+    # (None for the gap) — not demoted to per-row lists.
+    p3 = str(tmp_path / "gap.tfrecord")
+    write_records(p3, [build_example({"y": 5}), build_example({}),
+                       build_example({"y": 7, "z": 1})])
+    gap = rdata.read_tfrecords(p3).take_all()
+    # Scalars per row (block storage renders the gap as NaN), never
+    # demoted to per-row lists by the ragged path.
+    assert gap[0]["y"] == 5 and gap[2]["y"] == 7
+    assert np.isnan(gap[1]["y"])
+    assert not isinstance(gap[0]["y"], (list, np.ndarray))
+
+
 def test_read_sql_sharded(rt, tmp_path):
     db = str(tmp_path / "t.db")
     conn = sqlite3.connect(db)
