@@ -44,6 +44,15 @@ class Config:
     # (reference: RAY_scheduler_spread_threshold = 0.5,
     # hybrid_scheduling_policy.cc).
     scheduler_spread_threshold: float = 0.5
+    # Lease pipelining: when a worker receives a task, up to depth-1
+    # additional SAME-sched-class, dependency-free, DEFAULT-scheduled
+    # pending tasks are queued onto it under the same resource
+    # acquisition; the worker runs them serially and the lease's
+    # resources release when the last one finishes (reference: one
+    # lease executes many same-shape tasks,
+    # normal_task_submitter.cc lease reuse by SchedulingKey). 1
+    # disables pipelining.
+    worker_pipeline_depth: int = 4
 
     # --- objects ---
     # Objects at or above this size go to the shared-memory store instead

@@ -192,6 +192,9 @@ class TaskRecord:
     # recomputing them (dict sort) dominated deep-queue scans.
     sched_class: tuple | None = None
     need: dict[str, float] | None = None
+    # Lease pipelining: True when this task rides a worker's existing
+    # resource acquisition (no acquire ran; finish must not release).
+    leased: bool = False
 
 
 @dataclass
@@ -324,6 +327,61 @@ class TransferPlane:
         return self._table
 
 
+class _CachedThreadPool:
+    """Cached-thread executor for blocking ops: submit() reuses an
+    idle worker or spawns a fresh daemon thread — it NEVER queues, so
+    a pool full of parked long-blocking ops (client gets waiting on
+    results) cannot deadlock work that would unblock them. Idle
+    workers expire after ``idle_ttl``.
+
+    vs ThreadPoolExecutor: a bounded executor queues past max_workers
+    (deadlock-prone for blocking ops); unbounded spawn-per-message is
+    what this replaces (~100 us of thread start per op on the client
+    hot path)."""
+
+    def __init__(self, name: str, idle_ttl: float = 10.0):
+        self._name = name
+        self._ttl = idle_ttl
+        self._idle: deque = deque()   # (event, box) parked workers
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+
+    def submit(self, fn, *args) -> None:
+        with self._lock:
+            while self._idle:
+                ev, box = self._idle.pop()
+                box.append((fn, args))
+                ev.set()
+                return
+        threading.Thread(
+            target=self._worker, args=(fn, args), daemon=True,
+            name=f"{self._name}_{next(self._seq)}").start()
+
+    def _worker(self, fn, args) -> None:
+        while True:
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            ev = threading.Event()
+            box: list = []
+            entry = (ev, box)
+            with self._lock:
+                self._idle.append(entry)
+            if not ev.wait(self._ttl):
+                with self._lock:
+                    try:
+                        self._idle.remove(entry)
+                    except ValueError:
+                        # submit() popped us between timeout and
+                        # remove: the job in the box MUST run.
+                        ev.wait()
+                        fn, args = box[0]
+                        continue
+                return
+            fn, args = box[0]
+
+
 class WorkerDiedBeforeConnectError(RuntimeError):
     """The worker process exited before its exec channel attached."""
 
@@ -360,6 +418,12 @@ class WorkerHandle:
         self.sent_fn_ids: set[str] = set()
         self._runtime = runtime
         self.send_lock = threading.Lock()
+        # Lease pipeline: tasks queued on this worker (FIFO, executed
+        # serially) under ONE resource acquisition. Guarded by
+        # lease_lock (appends from dispatch threads race pops from
+        # the result-reader thread).
+        self.lease_queue: deque = deque()
+        self.lease_lock = threading.Lock()
         self.token = os.urandom(8).hex()
         self.conn = None
         self._conn_ready = threading.Event()
@@ -529,6 +593,8 @@ class RemoteWorkerHandle:
         self.sent_fn_ids: set[str] = set()
         self.log_path = None
         self._runtime = runtime
+        self.lease_queue: deque = deque()
+        self.lease_lock = threading.Lock()
         self.proc = _RemoteProc(self)
         # Non-None => post-attach death handling is owned by the node
         # channel (ND_WEXIT -> _on_worker_exit), matching the local
@@ -773,6 +839,12 @@ class DriverRuntime:
         self._dd_lock = threading.Lock()
         self._dd_results: "OrderedDict[str, tuple]" = OrderedDict()
         self._dd_inflight: dict[str, threading.Event] = {}
+        # Wire TaskOptions blobs -> shared deserialized instance
+        # (_loads_options_cached).
+        self._opts_blob_cache: dict[bytes, TaskOptions] = {}
+        # Cached threads for blocking client ops (thread-per-message
+        # spawn was ~12% of head CPU in the task-storm profile).
+        self._client_op_pool = _CachedThreadPool("client_op")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="client_accept")
         self._accept_thread.start()
@@ -2182,6 +2254,8 @@ class DriverRuntime:
         w.env_key = env_key or "adopted"
         w.node_id = node.node_id
         w.node = node
+        w.lease_queue = deque()
+        w.lease_lock = threading.Lock()
         w.busy = True
         w.is_actor = bool(is_actor)
         w.actor_id = (ActorID(actor_id_bytes)
@@ -2340,6 +2414,21 @@ class DriverRuntime:
             self._pending_add_locked(rec)
             self._res_cv.notify_all()
         return True
+
+    def _loads_options_cached(self, opts_blob: bytes) -> TaskOptions:
+        """Wire submits carry a pickled TaskOptions per call; a remote
+        handle sends the IDENTICAL blob every time. Deserializing it
+        per task both burned CPU and defeated the per-instance
+        _env_cache (every call got a fresh instance). Cache by blob
+        bytes so repeat calls share one instance — and its env/sched
+        caches. submit_task never mutates options."""
+        cached = self._opts_blob_cache.get(opts_blob)
+        if cached is None:
+            cached = ser.loads(opts_blob)
+            if len(self._opts_blob_cache) >= 512:
+                self._opts_blob_cache.clear()
+            self._opts_blob_cache[opts_blob] = cached
+        return cached
 
     def _env_for_options_cached(self, options: TaskOptions
                                 ) -> tuple[str, dict]:
@@ -2502,9 +2591,127 @@ class DriverRuntime:
             w.node.node_send((P.ND_TASK_META, w.index,
                               rec.task_id.binary(),
                               [o.binary() for o in rec.return_ids]))
-        w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id, fn_blob,
-                rec.args_blob, resolved, rec.options.num_returns,
-                getattr(rec.options, "trace_ctx", None)))
+        with w.lease_lock:
+            w.lease_queue.append(rec)
+        try:
+            w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id,
+                    fn_blob, rec.args_blob, resolved,
+                    rec.options.num_returns,
+                    getattr(rec.options, "trace_ctx", None)))
+        except BaseException:
+            # The rec never reached the worker: it must not occupy
+            # the lease queue (a live worker would otherwise never
+            # drain back to the pool). Failure handling is the
+            # caller's (_dispatch_picked retry/fail).
+            with w.lease_lock:
+                try:
+                    w.lease_queue.remove(rec)
+                except ValueError:
+                    pass
+            raise
+        self._event(rec, "RUNNING")
+        self._try_pipeline_extras(rec, w)
+
+    @staticmethod
+    def _pipelineable(rec: TaskRecord) -> bool:
+        return (rec.options.placement_group is None
+                and rec.options.scheduling_strategy == "DEFAULT"
+                and rec.options.num_returns != "streaming")
+
+    def _try_pipeline_extras(self, rec: TaskRecord,
+                             w: WorkerHandle) -> None:
+        """Lease pipelining (reference: one lease executes many
+        same-shape tasks, normal_task_submitter.cc lease reuse):
+        queue up to depth-1 additional same-sched-class pending tasks
+        onto the worker just dispatched to. They run serially under
+        the SAME resource acquisition (leased=True skips acquire and
+        release), so per-message head/worker overhead amortizes
+        without over-subscribing resources."""
+        depth = self.config.worker_pipeline_depth
+        if depth <= 1 or w.is_actor or not self._pipelineable(rec):
+            return
+        extras: list[TaskRecord] = []
+        with self._res_cv:
+            with w.lease_lock:
+                room = depth - len(w.lease_queue)
+            if room <= 0:
+                return
+            # Pipeline ONLY under saturation: if any node could still
+            # place this class, the task belongs on a fresh worker in
+            # PARALLEL — queueing it here would serialize work the
+            # cluster has capacity to spread (the reference pipelines
+            # onto a lease only past the backlog point).
+            need = rec.need or self._effective_resources(rec.options)
+            if any(self._fits_pool(n.avail, need)
+                   and self._fits_pool(n.resources, need)
+                   for n in self._alive_nodes()):
+                return
+            i = 0
+            while i < len(self._pending) and len(extras) < room:
+                cand = self._pending[i]
+                if (cand.sched_class == rec.sched_class
+                        and not cand.arg_refs
+                        and cand.state != "FAILED"
+                        and self._pipelineable(cand)):
+                    self._pending_del_locked(i, cand)
+                    cand.node_id = rec.node_id
+                    cand.pg_bundle = -1
+                    cand.leased = True
+                    extras.append(cand)
+                    continue       # i now indexes the next element
+                i += 1
+        for i, cand in enumerate(extras):
+            try:
+                self._dispatch_leased(cand, w)
+            except Exception:  # noqa: BLE001
+                # Worker died mid-append: EVERY not-yet-dispatched
+                # extra goes back to the pending queue (they were
+                # already popped from it — dropping any would strand
+                # its caller forever); the normal dispatch path owns
+                # them from here.
+                with self._res_cv:
+                    for c in extras[i:]:
+                        c.leased = False
+                        c.state = "PENDING"
+                        c.worker = None
+                        self._pending_add_locked(c)
+                    self._res_cv.notify_all()
+                return
+
+    def _dispatch_leased(self, rec: TaskRecord, w: WorkerHandle) -> None:
+        if rec.env_vars is None:
+            rec.env_key, rec.env_vars = self._env_for_options_cached(
+                rec.options)
+        rec.worker = w
+        rec.worker_index = w.index
+        rec.state = "RUNNING"
+        rec.started_at = time.time()
+        rec.attempts += 1
+        fn_blob = None
+        if rec.fn_id not in w.sent_fn_ids:
+            fn_blob = self._fn_cache[rec.fn_id]
+            w.sent_fn_ids.add(rec.fn_id)
+        is_remote = isinstance(w, RemoteWorkerHandle)
+        resolved = self._resolve_args_payload(
+            rec.args_blob, rec.arg_refs, remote=is_remote)
+        if is_remote and rec.return_ids:
+            w.node.node_send((P.ND_TASK_META, w.index,
+                              rec.task_id.binary(),
+                              [o.binary() for o in rec.return_ids]))
+        with w.lease_lock:
+            w.lease_queue.append(rec)
+        try:
+            w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id,
+                    fn_blob, rec.args_blob, resolved,
+                    rec.options.num_returns,
+                    getattr(rec.options, "trace_ctx", None)))
+        except BaseException:
+            with w.lease_lock:
+                try:
+                    w.lease_queue.remove(rec)
+                except ValueError:
+                    pass
+            raise
         self._event(rec, "RUNNING")
 
     # ---------------- worker message handling ----------------
@@ -2592,14 +2799,34 @@ class DriverRuntime:
             rec.state = "FAILED"
         rec.finished_at = time.time()
         self._event(rec, rec.state)
-        self._release(self._effective_resources(rec.options),
-                      rec.options.placement_group,
-                      node_id=rec.node_id, bundle=rec.pg_bundle)
-        self._return_worker(w)
-        self._prune_task(rec)
-        # Fill the slot this completion just freed without a condvar
-        # handoff to the dispatcher thread (see _try_dispatch_inline).
-        self._try_dispatch_inline(limit=1)
+        # Lease pipelining: the worker's queue holds every task riding
+        # this lease. Resources release (and the worker returns to the
+        # pool) only when the LAST queued task finishes — all queue
+        # members share one acquisition and one sched class, so
+        # releasing with the final rec's params frees exactly what the
+        # first acquisition took.
+        with w.lease_lock:
+            try:
+                w.lease_queue.remove(rec)
+            except ValueError:
+                pass
+            lease_live = bool(w.lease_queue)
+        if not lease_live:
+            self._release(self._effective_resources(rec.options),
+                          rec.options.placement_group,
+                          node_id=rec.node_id, bundle=rec.pg_bundle)
+            self._return_worker(w)
+            self._prune_task(rec)
+            # Fill the slot this completion just freed without a
+            # condvar handoff to the dispatcher thread (see
+            # _try_dispatch_inline).
+            self._try_dispatch_inline(limit=1)
+        else:
+            self._prune_task(rec)
+            # Keep the live lease's pipeline full: top up from the
+            # pending queue (same class as the task that just left).
+            if not w.dead and self._pipelineable(rec):
+                self._try_pipeline_extras(rec, w)
 
     def _forget_worker(self, w: WorkerHandle) -> None:
         """Drop a worker from the pools without task-failure handling
@@ -2624,20 +2851,29 @@ class DriverRuntime:
         if w.is_actor and w.actor_id is not None:
             self._on_actor_death(w.actor_id, worker=w)
             return
-        # A pooled worker died mid-task: retry or fail the task
-        # (reference: owner-side TaskManager retries, task_manager.cc).
+        # A pooled worker died mid-task: retry or fail every task it
+        # held (reference: owner-side TaskManager retries,
+        # task_manager.cc). With lease pipelining a worker can hold
+        # several queued tasks under ONE resource acquisition, so the
+        # release runs once for the whole set.
         with self._task_lock:
-            victim = None
-            for rec in self._tasks.values():
-                if rec.worker is w and rec.state in ("RUNNING",
-                                                     "CANCELLED"):
-                    victim = rec
-                    break
-        if victim is None:
+            victims = [rec for rec in self._tasks.values()
+                       if rec.worker is w and rec.state in (
+                           "RUNNING", "CANCELLED")]
+        with w.lease_lock:
+            w.lease_queue.clear()
+        if not victims:
             return
-        self._release(self._effective_resources(victim.options),
-                      victim.options.placement_group,
-                      node_id=victim.node_id, bundle=victim.pg_bundle)
+        self._release(self._effective_resources(victims[0].options),
+                      victims[0].options.placement_group,
+                      node_id=victims[0].node_id,
+                      bundle=victims[0].pg_bundle)
+        for victim in victims:
+            self._handle_worker_victim(w, victim)
+
+    def _handle_worker_victim(self, w: WorkerHandle,
+                              victim: TaskRecord) -> None:
+        victim.leased = False
         if victim.state == "CANCELLED":
             # cancel(force=True): error already stored; never retry.
             self._prune_task(victim)
@@ -3354,6 +3590,21 @@ class DriverRuntime:
         with self._res_cv:
             for rec in self._pending:
                 out.append(dict(self._effective_resources(rec.options)))
+        # Lease backlogs: tasks queued on a worker beyond the one
+        # executing are demand the cluster could not spread — without
+        # this the pipeline would HIDE load from the autoscaler
+        # (reference: NormalTaskSubmitter backlog reporting feeding
+        # the resource demand view).
+        with self._pool_lock:
+            workers = list(self._workers)
+        for w in workers:
+            lq = getattr(w, "lease_queue", None)
+            if lq is None:
+                continue
+            with w.lease_lock:
+                queued = list(lq)[1:]
+            for rec in queued:
+                out.append(dict(self._effective_resources(rec.options)))
         with self._actor_lock:
             for arec in self._actors.values():
                 if arec.state == "PENDING" and not arec.node_id:
@@ -3535,6 +3786,13 @@ class DriverRuntime:
         # reserved arena slots.
         conn_direct: set = set()
 
+        def record_conn_borrow(oid: ObjectID) -> None:
+            # Implicit borrow taken during an owned submit (the head
+            # registers the client's copy itself — one wire message
+            # instead of submit + borrow-add): still owed by THIS
+            # connection, so disconnect cleanup releases it.
+            conn_borrows[oid] = conn_borrows.get(oid, 0) + 1
+
         def do_borrow(req_id, payload):
             try:
                 if isinstance(payload, tuple):
@@ -3589,7 +3847,7 @@ class DriverRuntime:
                            else self._handle_owned_actor_submit)
                 dd, sp = P.unwrap_dd(payload)
                 if dd is None or self._dd_begin(dd) is None:
-                    handler(sp)
+                    handler(sp, on_borrowed=record_conn_borrow)
                     if dd is not None:
                         self._dd_finish(dd, (P.ST_OK, None))
                 if req_id != -1:
@@ -3610,9 +3868,7 @@ class DriverRuntime:
                     if sub_op == P.OP_BORROW:
                         do_borrow(-1, sub_payload)
                 return
-            threading.Thread(target=handle,
-                             args=(req_id, op, payload),
-                             daemon=True).start()
+            self._client_op_pool.submit(handle, req_id, op, payload)
 
         try:
             while True:
@@ -4164,11 +4420,18 @@ class DriverRuntime:
             return
         self.shm_store.delete(oid)
 
-    def _handle_owned_submit(self, payload) -> None:
+    def _handle_owned_submit(self, payload, on_borrowed=None) -> None:
         """Register a client-minted task. Any failure — bad env, bad
         pickle, unknown options — is stored as the error of every
         preminted return id: the client already returned refs to its
-        caller and will observe the failure at get()."""
+        caller and will observe the failure at get().
+
+        ``on_borrowed``: the head registers the client's borrow of
+        each return ref AT SUBMISSION (escape pin taken and consumed
+        in one step) instead of waiting for a separate borrow-add
+        notify — one wire message per task saved; the callback lets
+        the serving connection record the borrow for disconnect
+        cleanup."""
         (fn_id, fn_blob, fn_name, args_kwargs_blob, opts_blob,
          tid_bytes, rid_bytes, nonces) = payload
         return_ids = [ObjectID(b) for b in rid_bytes]
@@ -4183,15 +4446,19 @@ class DriverRuntime:
                 return
         try:
             args, kwargs = ser.loads(args_kwargs_blob)
-            options = ser.loads(opts_blob)
+            options = self._loads_options_cached(opts_blob)
             refs = self.submit_task(
                 fn_id, fn_blob, fn_name, args, kwargs, options,
                 preminted=(TaskID(tid_bytes), return_ids))
-            # The remote client holds the only refs: nonce-keyed pins
-            # that its borrow registration consumes (same lifecycle
-            # as client puts — no permanent pin).
+            # The remote client holds the only refs. The escape pin
+            # and its consuming borrow-add are registered HERE in one
+            # step (the client registers only the release finalizer):
+            # same lifecycle as before, minus one notify per task.
             for r, nonce in zip(refs, nonces):
                 self.on_ref_escaped(r.id, nonce)
+                self.on_borrow_add(r.id, nonce)
+                if on_borrowed is not None:
+                    on_borrowed(r.id)
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, Exception) else \
                 RuntimeError(repr(e))
@@ -4199,10 +4466,12 @@ class DriverRuntime:
             for oid in return_ids:
                 self._store_error(oid, blob)
 
-    def _handle_owned_actor_submit(self, payload) -> None:
+    def _handle_owned_actor_submit(self, payload,
+                                   on_borrowed=None) -> None:
         """Register a client-minted actor call; failures (dead/unknown
         actor, bad pickle) land as errors on the preminted return ids
-        — the caller observes them at get()."""
+        — the caller observes them at get(). ``on_borrowed``: see
+        _handle_owned_submit (implicit borrow registration)."""
         (actor_id_bytes, method, args_kwargs_blob, num_returns,
          trace_ctx, tid_bytes, rid_bytes, nonces) = payload
         return_ids = [ObjectID(b) for b in rid_bytes]
@@ -4223,6 +4492,9 @@ class DriverRuntime:
                 preminted=(task_id, return_ids))
             for r, nonce in zip(refs, nonces):
                 self.on_ref_escaped(r.id, nonce)
+                self.on_borrow_add(r.id, nonce)
+                if on_borrowed is not None:
+                    on_borrowed(r.id)
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, Exception) else \
                 RuntimeError(repr(e))
@@ -4279,7 +4551,7 @@ class DriverRuntime:
         if op == P.OP_SUBMIT:
             fn_id, fn_blob, fn_name, args_kwargs_blob, opts_blob = payload
             args, kwargs = ser.loads(args_kwargs_blob)
-            options = ser.loads(opts_blob)
+            options = self._loads_options_cached(opts_blob)
             refs = self.submit_task(fn_id, fn_blob, fn_name, args,
                                     kwargs, options)
             if isinstance(refs, ObjectRefGenerator):
