@@ -142,27 +142,36 @@ def orchestrate() -> None:
     extra["n_chips"] = probe.get("n_devices")
 
     bench_timeout = _env_f("RAY_TPU_BENCH_TIMEOUT", BENCH_TIMEOUT_S)
-    gpt2, gerr = _run_child("--gpt2", max(budget(bench_timeout), 60.0))
+    # ResNet gets a RESERVED slice of the deadline (VERDICT r4 weak
+    # #2: it ran on gpt2's leftovers and timed out in 4/5 captures).
+    # gpt2's budget is capped so the reservation survives even a slow
+    # headline run + retry.
+    skip_resnet = bool(os.environ.get("RAY_TPU_BENCH_SKIP_RESNET"))
+    # 260 s: measured r5 on-chip — 214 s cold compile over the
+    # tunnel, 148 s with a warm persistent compilation cache.
+    resnet_reserve = 0.0 if skip_resnet else _env_f(
+        "RAY_TPU_BENCH_RESNET_RESERVE", 260.0)
+
+    def gpt2_budget() -> float:
+        return max(budget(bench_timeout) - resnet_reserve, 60.0)
+
+    gpt2, gerr = _run_child("--gpt2", gpt2_budget())
     if gpt2 and "error" in gpt2:
         gpt2, gerr = None, gpt2["error"]
-    if gpt2 is None and budget(bench_timeout) > 120:
+    if gpt2 is None and budget(bench_timeout) - resnet_reserve > 120:
         # One retry: the probe proved the backend alive, so a single
         # child failure is plausibly a transient tunnel hiccup — a
         # red headline artifact is the costliest outcome.
         extra["gpt2_first_error"] = str(gerr)[:200]
-        gpt2, gerr = _run_child("--gpt2", budget(bench_timeout))
+        gpt2, gerr = _run_child("--gpt2", gpt2_budget())
         if gpt2 and "error" in gpt2:
             gpt2, gerr = None, gpt2["error"]
 
     # Secondary benches run serially AFTER the headline (no host
-    # contention in its timed region) and are skipped rather than
-    # allowed to push total wall time past the driver's budget.
-    if not os.environ.get("RAY_TPU_BENCH_SKIP_RESNET"):
-        # Leave scaling a floor: resnet must not eat the whole
-        # remaining budget (it has its own history of hanging on a
-        # sick tunnel).
-        t = min(budget(bench_timeout),
-                max(budget(bench_timeout) - 200.0, 120.0))
+    # contention in its timed region); ResNet spends its reserved
+    # slice first, the scaling proxy runs on true leftovers.
+    if not skip_resnet:
+        t = budget(bench_timeout)
         if t > 45:
             resnet, rerr = _run_child("--resnet50", t)
             if resnet and "error" not in resnet:
@@ -234,8 +243,11 @@ def gpt2_main() -> None:
     mesh = make_mesh({"dp": n_dev})
 
     cfg = GPT2Config.tiny() if smoke else GPT2Config.small()  # 124M
+    # Default 32: the r5 on-chip sweep measured 8→122.9k, 16→122.8k,
+    # 32→127.1k, 48→121.9k tok/s/chip (HBM fits 32 at seq 1024; the
+    # MXU prefers the bigger GEMMs).
     batch_per_chip = 2 if smoke else int(
-        os.environ.get("RAY_TPU_BENCH_BATCH", 8))
+        os.environ.get("RAY_TPU_BENCH_BATCH", 32))
     model = GPT2(cfg, mesh=mesh)
     params = model.init_params(jax.random.key(0))
     # bf16 first moment: halves Adam's mu HBM traffic; second moment
@@ -246,8 +258,9 @@ def gpt2_main() -> None:
     # stack): same math as K single steps, amortizing per-dispatch
     # overhead. grad_norm off: the benchmark recipe does not clip.
     k_steps = 20
-    step = make_multi_train_step(gpt2_loss_fn(model), opt,
-                                 grad_norm=False)
+    ce_chunk = int(os.environ.get("RAY_TPU_CE_CHUNK", 2048))
+    step = make_multi_train_step(
+        gpt2_loss_fn(model, ce_chunk=ce_chunk), opt, grad_norm=False)
 
     bsz = batch_per_chip * n_dev
     rng = np.random.default_rng(0)
@@ -287,6 +300,24 @@ def gpt2_main() -> None:
     n_params = cfg.num_params()
     mfu = 6 * n_params * per_chip / 197e12
 
+    # Which attention impl actually ran (VERDICT r4 task 1: assert the
+    # Pallas kernel is engaged at bench shapes, don't trust "auto").
+    # Mirrors the model's actual dispatch: single-device routes
+    # through causal_attention's flash branch; a multi-device mesh
+    # routes through make_sharded_causal_attention, whose per-device
+    # local block uses the same kernel under the same shape
+    # predicate — so shape-eligibility alone decides engagement.
+    from ray_tpu.ops.attention import _flash_ok
+    probe = jnp.zeros((2, cfg.seq_len, cfg.n_head, cfg.head_dim),
+                      jnp.bfloat16)
+    flash_engaged = bool(_flash_ok(probe, probe, probe)
+                         and not os.environ.get("RAY_TPU_ATTN_KERNEL"))
+    if not smoke and not flash_engaged and \
+            not os.environ.get("RAY_TPU_ATTN_KERNEL"):
+        raise RuntimeError(
+            "flash kernel not engaged at bench shapes — the headline "
+            "would silently measure the XLA fallback")
+
     print(json.dumps({
         "metric": HEADLINE,
         "value": round(per_chip, 1),
@@ -299,6 +330,16 @@ def gpt2_main() -> None:
             "loss": round(final_loss, 4),
             "step_time_ms": round(dt / n_steps * 1e3, 2),
             "mfu_vs_v5e_peak": round(mfu, 4),
+            # MFU formula disclosure (VERDICT r4 weak #8): counts
+            # 6*N_total FLOPs/token (N incl. the 38M embedding rows,
+            # whose bwd is a scatter) and EXCLUDES attention
+            # score/value FLOPs; at seq 1024 the two roughly offset.
+            # Peak figure: 197e12 bf16 FLOP/s (v5e).
+            "mfu_formula": "6*N_total*tok_per_s/197e12",
+            "attn_impl": (os.environ.get("RAY_TPU_ATTN_KERNEL")
+                          or ("pallas_flash" if flash_engaged
+                              else "xla_dense")),
+            "ce_impl": f"chunked_fused(chunk={ce_chunk})",
         },
     }), flush=True)
 
@@ -306,6 +347,7 @@ def gpt2_main() -> None:
 def _maybe_cpu_smoke() -> bool:
     """RAY_TPU_BENCH_CPU=1 pins the child to the virtual CPU backend —
     a correctness smoke for environments without the chip."""
+    _enable_compile_cache()
     if not os.environ.get("RAY_TPU_BENCH_CPU"):
         return False
     import jax
@@ -313,6 +355,25 @@ def _maybe_cpu_smoke() -> bool:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 1)
     return True
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for every bench child: the
+    ResNet child's full-model compile over the remote-compile tunnel
+    was the top cause of its timeouts (VERDICT r4 weak #2) — warm
+    captures skip straight to execution. No-op if the backend can't
+    serialize executables."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/ray_tpu_jax_cache")
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — older jax without the knobs
+        pass
 
 
 def resnet50_main() -> None:
@@ -406,16 +467,37 @@ def resnet50_main() -> None:
 
 
 def scaling_main() -> None:
-    """dp=1 vs dp=8 at the SAME global batch on 8 virtual CPU devices.
+    """Iso-resource dp8 sharding-overhead proxy on 8 virtual devices.
 
-    Total FLOPs and total cores are identical in both runs, so the
-    step-time ratio t(dp=1)/t(dp=8) isolates the cost the sharded
-    program adds (partitioning, gradient psum). ~1.0 means the dp
-    sharding is overhead-free at this scale; this is the stand-in for
-    real 8-chip weak scaling that a single-chip environment allows.
+    Round-4 review: comparing a dp=1 mesh (one virtual device) against
+    dp=8 is NOT iso-resource on a shared-core host — the dp=1 run
+    doesn't use the same cores/thread pools, so the ratio measured
+    resource allocation (and reported an impossible efficiency > 1).
+
+    Revision 3 runs the SAME dp8-sharded training step twice over the
+    SAME 8-device mesh in ONE process, differing ONLY in the
+    communication machinery:
+    - no-collective: the step body shard_mapped with an (unchecked)
+      replicated out-spec — each device updates its own param copy,
+      zero collectives. (Numerically divergent, which is irrelevant
+      for a timing probe; shapes/FLOPs identical.)
+    - with-collective: the production pjit step — sharding
+      propagation inserts the gradient psum (and activation
+      constraints), exactly what a real dp job pays.
+
+        efficiency = t(no-collective) / t(with-collective)  <= 1
+        by construction: the numerator's program is the
+        denominator's minus its collectives.
+
+    1 - efficiency is the fraction of the sharded step spent on
+    partition + collective machinery. Interleaved step-by-step
+    timing with medians, because serial A-then-B runs on this
+    shared-core host drift ~20% with background load (the other
+    root of round 4's >1 readings).
     """
     import jax
 
+    _enable_compile_cache()
     # jax.config (not env vars): the ambient sitecustomize registers
     # the axon PJRT plugin in every interpreter, and with the tunnel
     # down, backend discovery hangs unless the platform is pinned via
@@ -428,79 +510,95 @@ def scaling_main() -> None:
     import numpy as np
     import optax
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from ray_tpu.models import GPT2, GPT2Config
     from ray_tpu.models.gpt2 import gpt2_loss_fn
     from ray_tpu.parallel import make_mesh
-    from ray_tpu.train import (
-        init_train_state, make_train_step, shard_batch,
-    )
+    from ray_tpu.train import init_train_state, make_train_step
+
+    import statistics
+
+    from ray_tpu.train.step import _step_body
 
     rng = np.random.default_rng(0)
-
-    def bench_mesh(cfg, global_batch: int, dp: int,
-                   n_timed: int) -> float:
-        mesh = make_mesh({"dp": dp})
-        model = GPT2(cfg, mesh=mesh)
-        params = model.init_params(jax.random.key(0))
-        opt = optax.adamw(3e-4)
-        state = init_train_state(params, opt, mesh)
-        step = make_train_step(gpt2_loss_fn(model), opt,
-                               grad_norm=False)
-
-        def batch():
-            toks = rng.integers(
-                0, cfg.vocab_size,
-                (global_batch, cfg.seq_len)).astype(np.int32)
-            return shard_batch(
-                {"tokens": toks, "targets": np.roll(toks, -1, 1)}, mesh)
-
-        for _ in range(2):
-            state, m = step(state, batch())
-        float(m["loss"])
-        bs = [batch() for _ in range(n_timed)]
-        t0 = time.perf_counter()
-        for b in bs:
-            state, m = step(state, b)
-        float(m["loss"])
-        return (time.perf_counter() - t0) / n_timed
-
-    # Two sizes. The tiny config measures pure partition/dispatch
-    # overhead (a step is microseconds of math, so the ratio is
-    # pessimistic by construction); the compute config gives each
-    # virtual device enough work per step to amortize it — that is
-    # the number that stands in for real weak scaling (round-3
-    # review: at gpt2-tiny/batch-8 the proxy measured dispatch, not
-    # sharding quality).
-    tiny = GPT2Config.tiny()
+    mesh = make_mesh({"dp": 8})
     compute = GPT2Config.tiny(n_embd=128, n_layer=4, n_head=4,
                               seq_len=256, vocab_size=512)
-    t1_tiny = bench_mesh(tiny, 8, 1, 10)
-    t8_tiny = bench_mesh(tiny, 8, 8, 10)
-    t1_c = bench_mesh(compute, 16, 1, 4)
-    t8_c = bench_mesh(compute, 16, 8, 4)
-    eff_tiny = t1_tiny / t8_tiny
-    eff = t1_c / t8_c
+    global_batch = 8
+    opt = optax.adamw(3e-4)
+    sh = NamedSharding(mesh, P("dp"))
+
+    def batch():
+        toks = rng.integers(
+            0, compute.vocab_size,
+            (global_batch, compute.seq_len)).astype(np.int32)
+        return {
+            "tokens": jax.device_put(toks, sh),
+            "targets": jax.device_put(np.roll(toks, -1, 1), sh),
+        }
+
+    def build(collective: bool):
+        model = GPT2(compute, mesh=mesh if collective else None)
+        params = model.init_params(jax.random.key(0))
+        state = init_train_state(params, opt, mesh)
+        loss_fn = gpt2_loss_fn(model)
+        if collective:
+            step = make_train_step(loss_fn, opt, grad_norm=False)
+        else:
+            body = _step_body(loss_fn, opt, False, False)
+            local = jax.shard_map(
+                body, mesh=mesh, in_specs=(P(), P("dp")),
+                out_specs=(P(), P()), check_vma=False)
+            step = jax.jit(local, donate_argnums=(0,))
+        return [state], step
+
+    local_run = build(collective=False)
+    psum_run = build(collective=True)
+    for box, step in (local_run, psum_run):     # warm: 2 compiles
+        for _ in range(2):
+            box[0], m = step(box[0], batch())
+        float(np.asarray(m["loss"]).ravel()[0])
+
+    def timed_step(box, step) -> float:
+        b = batch()
+        t0 = time.perf_counter()
+        box[0], m = step(box[0], b)
+        float(np.asarray(m["loss"]).ravel()[0])   # sync
+        return time.perf_counter() - t0
+
+    # INTERLEAVED rounds: serial A-then-B runs on this shared-core
+    # host drift ~20% with background load (the other root of round
+    # 4's >1 readings); alternating step-by-step exposes both
+    # programs to the same load profile, medians kill stragglers.
+    ts_local: list[float] = []
+    ts_psum: list[float] = []
+    for _ in range(7):
+        ts_psum.append(timed_step(*psum_run))
+        ts_local.append(timed_step(*local_run))
+    t_local = statistics.median(ts_local)
+    t_psum = statistics.median(ts_psum)
+    eff = t_local / t_psum
     print(json.dumps({
         "metric": "dp8_scaling_efficiency_proxy",
         "value": round(eff, 4),
-        "unit": "t_dp1/t_dp8 at fixed global batch",
+        "unit": "median t(dp8 no-collective) / t(dp8 with-psum)",
         "vs_baseline": round(eff, 4),
         "extra": {
-            # Definition changed in round 4: the headline ratio is
-            # the compute-amortizing config; rounds <=3 reported the
-            # tiny config (which measures dispatch overhead — see
-            # tiny_cfg.efficiency for the comparable number).
-            "proxy_rev": 2,
+            # rev 3 (see scaling_main docstring): same program, same
+            # 8-device mesh, same process -- the numerator strips
+            # ONLY the collectives, so the ratio is <= 1 by
+            # construction and 1-eff is the collective+partition
+            # share of the sharded step. (rev 2, rounds <=4,
+            # compared a dp=1 mesh from a separate serial run -- not
+            # iso-resource, reported an impossible 1.16.)
+            "proxy_rev": 3,
             "compute_cfg": {
-                "model": "gpt2 d128 L4 seq256", "global_batch": 16,
-                "dp1_step_ms": round(t1_c * 1e3, 2),
-                "dp8_step_ms": round(t8_c * 1e3, 2),
-            },
-            "tiny_cfg": {
-                "model": "gpt2-tiny d64 L2 seq64", "global_batch": 8,
-                "efficiency": round(eff_tiny, 4),
-                "dp1_step_ms": round(t1_tiny * 1e3, 2),
-                "dp8_step_ms": round(t8_tiny * 1e3, 2),
+                "model": "gpt2 d128 L4 seq256",
+                "global_batch": global_batch,
+                "no_collective_step_ms": round(t_local * 1e3, 2),
+                "with_psum_step_ms": round(t_psum * 1e3, 2),
+                "samples": len(ts_local),
             },
             "n_virtual_devices": 8,
         },

@@ -251,6 +251,17 @@ def _chunk_logits(x_c, emb):
                       preferred_element_type=jnp.float32)
 
 
+def _ce_unroll() -> int:
+    """Chunks are independent (the carry is two scalar adds): a small
+    unroll lets XLA overlap chunk matmuls with the previous chunk's
+    VPU softmax work instead of serializing on the scan boundary."""
+    import os
+    try:
+        return max(1, int(os.environ.get("RAY_TPU_CE_UNROLL", 1)))
+    except ValueError:
+        return 1
+
+
 def _chunked_ce_fwd_scan(rows_c, emb, tgt_c, ignore_index):
     def one(carry, xt):
         x_c, t_c = xt
@@ -265,7 +276,7 @@ def _chunked_ce_fwd_scan(rows_c, emb, tgt_c, ignore_index):
 
     return jax.lax.scan(
         one, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-        (rows_c, tgt_c))
+        (rows_c, tgt_c), unroll=_ce_unroll())
 
 
 def _chunked_ce_core_fwd(rows_c, emb, tgt_c, ignore_index):
@@ -301,7 +312,8 @@ def _chunked_ce_core_bwd(ignore_index, res, g):
         return demb, dx
 
     demb0 = jnp.zeros(emb.shape, jnp.float32)
-    demb, dx_c = jax.lax.scan(one, demb0, (rows_c, tgt_c, lse_c))
+    demb, dx_c = jax.lax.scan(one, demb0, (rows_c, tgt_c, lse_c),
+                              unroll=_ce_unroll())
     return dx_c, demb.astype(emb.dtype), None
 
 
