@@ -30,6 +30,42 @@ from ray_tpu.core.serialization import SerializedObject
 from ray_tpu.core.exceptions import ObjectLostError
 
 
+def _spill_write(spill_dir: str, oid: ObjectID, record: bytes) -> str:
+    """Write one spill record; returns the path/URI to read it back.
+    A ``scheme://`` spill_dir routes through the external-storage seam
+    (reference: external_storage.py:72 — filesystem or S3 backends
+    behind one interface); plain paths take the direct-file path."""
+    from ray_tpu.util.storage import is_uri, storage_for_uri, uri_join
+    if is_uri(spill_dir):
+        uri = uri_join(spill_dir, oid.hex())
+        storage_for_uri(uri).write_bytes(uri, record)
+        return uri
+    os.makedirs(spill_dir, exist_ok=True)
+    path = os.path.join(spill_dir, oid.hex())
+    with open(path, "wb") as f:
+        f.write(record)
+    return path
+
+
+def _spill_read(path: str) -> bytes:
+    from ray_tpu.util.storage import is_uri, storage_for_uri
+    if is_uri(path):
+        return storage_for_uri(path).read_bytes(path)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _spill_delete(path: str) -> None:
+    from ray_tpu.util.storage import is_uri, storage_for_uri
+    try:
+        if is_uri(path):
+            storage_for_uri(path).delete(path)
+        else:
+            os.unlink(path)
+    except OSError:
+        pass
+
+
 @dataclass
 class _Entry:
     obj: SerializedObject | None
@@ -139,20 +175,41 @@ class SharedMemoryStore:
             self._spill_locked(oid, entry)
 
     def _spill_locked(self, oid: ObjectID, entry: _Entry) -> None:
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, oid.hex())
-        with open(path, "wb") as f:
-            f.write(len(entry.data).to_bytes(8, "little"))
-            f.write(entry.data)
-            f.write(len(entry.shm_sizes).to_bytes(8, "little"))
+        from ray_tpu.util.storage import is_uri
+        if is_uri(self._spill_dir):
+            # URI backends take one bytes blob (their transport is a
+            # byte-copy API anyway).
+            parts = [len(entry.data).to_bytes(8, "little"),
+                     entry.data,
+                     len(entry.shm_sizes).to_bytes(8, "little")]
             for name, size in zip(entry.shm_names, entry.shm_sizes):
                 seg = shared_memory.SharedMemory(name=name)
-                f.write(size.to_bytes(8, "little"))
-                f.write(bytes(seg.buf[:size]))
+                parts.append(size.to_bytes(8, "little"))
+                parts.append(bytes(seg.buf[:size]))
                 seg.close()
                 seg.unlink()
+            entry.spilled_path = _spill_write(self._spill_dir, oid,
+                                              b"".join(parts))
+        else:
+            # Local disk streams segment-by-segment: spill happens
+            # under memory PRESSURE — materializing a multi-GB record
+            # in host RAM at that moment is the one thing this path
+            # must not do.
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(len(entry.data).to_bytes(8, "little"))
+                f.write(entry.data)
+                f.write(len(entry.shm_sizes).to_bytes(8, "little"))
+                for name, size in zip(entry.shm_names,
+                                      entry.shm_sizes):
+                    seg = shared_memory.SharedMemory(name=name)
+                    f.write(size.to_bytes(8, "little"))
+                    f.write(bytes(seg.buf[:size]))
+                    seg.close()
+                    seg.unlink()
+            entry.spilled_path = path
         self._used -= entry.size
-        entry.spilled_path = path
         entry.shm_names = []
         entry.shm_sizes = []
         entry.data = b""
@@ -313,11 +370,7 @@ class NativeSharedMemoryStore:
             self._lru.pop(oid, None)
 
     def _spill_record_locked(self, oid: ObjectID, record: bytes) -> None:
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, oid.hex())
-        with open(path, "wb") as f:
-            f.write(record)
-        self._spilled[oid] = path
+        self._spilled[oid] = _spill_write(self._spill_dir, oid, record)
 
     def get_descriptor(self, object_id: ObjectID):
         with self._lock:
@@ -341,8 +394,7 @@ class NativeSharedMemoryStore:
             return self.decode(payload)
         path = self._spilled.get(object_id)
         if path is not None:
-            with open(path, "rb") as f:
-                return self.decode(f.read())
+            return self.decode(_spill_read(path))
         return None
 
     def contains(self, object_id: ObjectID) -> bool:
@@ -355,10 +407,7 @@ class NativeSharedMemoryStore:
             self._store.delete(object_id.binary())
             path = self._spilled.pop(object_id, None)
         if path:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            _spill_delete(path)
 
     def used_bytes(self) -> int:
         return self._store.used_bytes()
@@ -368,10 +417,7 @@ class NativeSharedMemoryStore:
 
     def shutdown(self) -> None:
         for path in self._spilled.values():
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            _spill_delete(path)
         self._store.close()
 
 
@@ -537,8 +583,8 @@ def read_descriptor(desc) -> SerializedObject:
         _tag, store_name, id_bytes, spilled_path = desc
         if spilled_path is not None:
             try:
-                with open(spilled_path, "rb") as f:
-                    return NativeSharedMemoryStore.decode(f.read())
+                return NativeSharedMemoryStore.decode(
+                    _spill_read(spilled_path))
             except FileNotFoundError:
                 raise ObjectLostError(spilled_path)
         store = _attach(store_name)
@@ -553,16 +599,20 @@ def read_descriptor(desc) -> SerializedObject:
     data, names, sizes, spilled_path = desc
     if spilled_path is not None:
         try:
-            with open(spilled_path, "rb") as f:
-                dlen = int.from_bytes(f.read(8), "little")
-                data = f.read(dlen)
-                nbuf = int.from_bytes(f.read(8), "little")
-                buffers = []
-                for _ in range(nbuf):
-                    blen = int.from_bytes(f.read(8), "little")
-                    buffers.append(f.read(blen))
+            raw = memoryview(_spill_read(spilled_path))
         except FileNotFoundError:
             raise ObjectLostError(spilled_path)
+        dlen = int.from_bytes(raw[:8], "little")
+        data = bytes(raw[8:8 + dlen])
+        pos = 8 + dlen
+        nbuf = int.from_bytes(raw[pos:pos + 8], "little")
+        pos += 8
+        buffers = []
+        for _ in range(nbuf):
+            blen = int.from_bytes(raw[pos:pos + 8], "little")
+            pos += 8
+            buffers.append(bytes(raw[pos:pos + blen]))
+            pos += blen
         return SerializedObject(data=data, buffers=buffers)
     buffers = []
     for name, size in zip(names, sizes):

@@ -1,0 +1,149 @@
+"""External storage seam: scheme-keyed backends for object spill and
+checkpoints (reference: python/ray/_private/external_storage.py:72
+pluggable spill backends; python/ray/train/_internal/storage.py:352
+StorageContext persisting checkpoints to fs/S3/GS URIs)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.util.storage import (
+    LocalStorage,
+    MockS3Storage,
+    Storage,
+    register_storage,
+    storage_for_uri,
+    uri_join,
+)
+
+
+@pytest.fixture()
+def s3root(tmp_path, monkeypatch):
+    root = str(tmp_path / "bucketroot")
+    monkeypatch.setenv("RAY_TPU_MOCK_S3_DIR", root)
+    # Re-register so the cached instance picks up the new root.
+    register_storage("mock-s3", MockS3Storage)
+    yield root
+    register_storage("mock-s3", MockS3Storage)
+
+
+def test_mock_s3_bytes_roundtrip(s3root):
+    st = storage_for_uri("mock-s3://b/k")
+    assert isinstance(st, MockS3Storage)
+    st.write_bytes("mock-s3://b/a/one.bin", b"payload-1")
+    st.write_bytes("mock-s3://b/a/two.bin", b"payload-2")
+    assert st.read_bytes("mock-s3://b/a/one.bin") == b"payload-1"
+    assert st.exists("mock-s3://b/a/two.bin")
+    assert sorted(st.list_keys("mock-s3://b/a")) == ["one.bin",
+                                                     "two.bin"]
+    st.delete("mock-s3://b/a/one.bin")
+    assert not st.exists("mock-s3://b/a/one.bin")
+    with pytest.raises(FileNotFoundError):
+        st.read_bytes("mock-s3://b/a/one.bin")
+
+
+def test_dir_upload_download(s3root, tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "top.txt").write_bytes(b"t")
+    (src / "sub" / "deep.txt").write_bytes(b"d")
+    st = storage_for_uri("mock-s3://ckpt/run1")
+    st.upload_dir(str(src), "mock-s3://ckpt/run1")
+    dst = tmp_path / "dst"
+    st.download_dir("mock-s3://ckpt/run1", str(dst))
+    assert (dst / "top.txt").read_bytes() == b"t"
+    assert (dst / "sub" / "deep.txt").read_bytes() == b"d"
+
+
+def test_unknown_scheme_raises():
+    with pytest.raises(ValueError, match="no storage backend"):
+        storage_for_uri("s4://nope/x")
+
+
+def test_injectable_transport(s3root):
+    """Tests (and deployments) can swap a scheme's transport — the
+    reference's pluggable external-storage seam."""
+    calls = []
+
+    class Counting(MockS3Storage):
+        def write_bytes(self, uri, data):
+            calls.append(("w", uri))
+            super().write_bytes(uri, data)
+
+        def read_bytes(self, uri):
+            calls.append(("r", uri))
+            return super().read_bytes(uri)
+
+    register_storage("mock-s3", Counting)
+    st = storage_for_uri("mock-s3://b/x")
+    st.write_bytes("mock-s3://b/x", b"v")
+    assert st.read_bytes("mock-s3://b/x") == b"v"
+    assert calls == [("w", "mock-s3://b/x"), ("r", "mock-s3://b/x")]
+
+
+def test_spill_restore_through_mock_remote(s3root, tmp_path):
+    """LRU spill writes through the storage seam when spill_dir is a
+    URI; reads transparently restore; delete removes the remote
+    object (reference: spill/restore/delete IO worker flow,
+    local_object_manager.h:41)."""
+    from ray_tpu.core.object_store import make_shared_store
+
+    store = make_shared_store(
+        1 << 20, "mock-s3://spill/ns1", 0.5)
+    try:
+        from ray_tpu.core.serialization import serialize
+
+        blobs = {}
+        for i in range(6):                      # 6 x 256 KiB > cap/2
+            arr = np.full(1 << 16, i, dtype=np.uint32)
+            oid = ObjectID(os.urandom(ObjectID.SIZE))
+            store.put(oid, serialize(arr))
+            blobs[oid] = arr
+        spilled = [p for p in getattr(store, "_spilled", {}).values()]
+        assert spilled, "nothing spilled despite 3x capacity pressure"
+        assert all(p.startswith("mock-s3://") for p in spilled)
+        # Remote objects materialized under the backing root.
+        assert storage_for_uri("mock-s3://spill/ns1").list_keys(
+            "mock-s3://spill/ns1")
+        # Every object — resident or spilled — reads back intact.
+        for oid, arr in blobs.items():
+            obj = store.read_local(oid)
+            assert obj is not None, "object lost"
+            from ray_tpu.core.serialization import deserialize
+            got = deserialize(obj)
+            np.testing.assert_array_equal(got, arr)
+        # Deleting a spilled object removes the remote copy.
+        victim = next(o for o in blobs
+                      if o in getattr(store, "_spilled", {}))
+        remote = store._spilled[victim]
+        store.delete(victim)
+        assert not storage_for_uri(remote).exists(remote)
+    finally:
+        store.shutdown()
+
+
+def test_checkpoint_roundtrip_through_mock_remote(s3root):
+    import jax
+    import numpy as np
+
+    from ray_tpu.train.checkpoint import restore_pytree, save_pytree
+
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(4, dtype=np.float32),
+            "step": np.int32(7)}
+    uri = "mock-s3://ckpts/exp0/epoch3"
+    save_pytree(tree, uri)
+    # The checkpoint lives remotely, not in cwd.
+    assert storage_for_uri(uri).list_keys(uri)
+    back = restore_pytree(uri)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, tree, back)
+
+
+def test_local_storage_paths(tmp_path):
+    st = LocalStorage()
+    p = str(tmp_path / "f.bin")
+    st.write_bytes(p, b"x")
+    assert st.read_bytes("file://" + p) == b"x"
+    assert isinstance(storage_for_uri(p), LocalStorage)
