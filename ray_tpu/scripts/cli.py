@@ -212,6 +212,26 @@ def _cmd_up(args) -> int:
     return 0
 
 
+def _cmd_serve_deploy(args) -> int:
+    import ray_tpu
+    ray_tpu.init(address=_discover_address(args.address))
+    from ray_tpu import serve
+    handles = serve.deploy_config(args.config)
+    print(f"deployed {len(handles)} application(s): "
+          f"{', '.join(sorted(handles))}")
+    return 0
+
+
+def _cmd_serve_status(args) -> int:
+    import json as _json
+
+    import ray_tpu
+    ray_tpu.init(address=_discover_address(args.address))
+    from ray_tpu import serve
+    print(_json.dumps(serve.status(), indent=1, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="ray-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -261,6 +281,20 @@ def main(argv: list[str] | None = None) -> int:
                    help="bring the cluster up, then immediately "
                         "down (config smoke test)")
     p.set_defaults(fn=_cmd_up)
+
+    pserve = sub.add_parser(
+        "serve", help="declarative Serve ops (reference: serve "
+                      "deploy/status, serve/scripts.py)")
+    ssub = pserve.add_subparsers(dest="servecmd", required=True)
+    p = ssub.add_parser("deploy", help="reconcile apps to a YAML "
+                                       "config")
+    p.add_argument("config", help="serve config YAML path")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_serve_deploy)
+    p = ssub.add_parser("status", help="per-deployment replica "
+                                       "health")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_serve_status)
 
     pjob = sub.add_parser("job", help="job submission")
     jsub = pjob.add_subparsers(dest="jobcmd", required=True)

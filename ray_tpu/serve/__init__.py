@@ -11,8 +11,10 @@ program call.
 
 from ray_tpu.serve.asgi import ingress
 from ray_tpu.serve.api import (
+    deploy_config,
     deployment,
     run,
+    status,
     shutdown,
     get_deployment_handle,
     grpc_ingress_token,
@@ -30,6 +32,7 @@ from ray_tpu.serve.multiplex import (
 __all__ = [
     "ingress",
     "deployment", "run", "shutdown", "get_deployment_handle", "batch",
+    "deploy_config", "status",
     "grpc_ingress_token",
     "Application", "Deployment", "DeploymentHandle",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",

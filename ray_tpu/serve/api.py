@@ -260,6 +260,127 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
 
+_CONFIG_APPS_KV = (b"serve:config_apps", "serve")
+
+
+def deploy_config(config, *, _import_override: Callable | None = None):
+    """Declarative deploy (reference: ``serve deploy config.yaml`` —
+    serve/scripts.py + schema.py): reconcile the cluster's Serve apps
+    to a config. Apps present in the config are (re)deployed with
+    their overrides; apps deployed by a PREVIOUS config but absent
+    from this one are deleted — their replicas drain through the
+    controller's normal reconciliation.
+
+    ``config``: a path to a YAML file, a dict, or a
+    ``ServeDeploySchema``. ``_import_override(app_schema)`` lets
+    tests supply bound Applications without real module imports.
+    Returns {app_name: DeploymentHandle}.
+    """
+    from ray_tpu.experimental import internal_kv
+    from ray_tpu.serve.schema import (
+        ServeDeploySchema, load_config, parse_config,
+    )
+
+    if isinstance(config, str):
+        schema = load_config(config)
+    elif isinstance(config, dict):
+        schema = parse_config(config)
+    elif isinstance(config, ServeDeploySchema):
+        schema = config
+    else:
+        raise TypeError(f"deploy_config: unsupported {type(config)}")
+
+    http_port = schema.http_options.get("port")
+    grpc_port = schema.grpc_options.get("port")
+    handles: dict[str, DeploymentHandle] = {}
+    deployed_names: set[str] = set()
+    for app_schema in schema.applications:
+        target = (_import_override(app_schema)
+                  if _import_override is not None
+                  else app_schema.import_target())
+        if isinstance(target, Deployment):
+            target = target.bind()
+        if not isinstance(target, Application):
+            raise ValueError(
+                f"applications[{app_schema.name}].import_path "
+                f"{app_schema.import_path!r} resolved to "
+                f"{type(target).__name__}; expected a bound "
+                f"Application (Deployment.bind(...)) or a Deployment")
+        target = _apply_overrides(target, app_schema)
+        handles[app_schema.name] = run(
+            target, route_prefix=app_schema.route_prefix,
+            http_port=http_port, grpc_port=grpc_port)
+        deployed_names.update(_tree_names(target))
+
+    # Reconcile deletions: deployments owned by the previous config
+    # that this config no longer mentions drain away.
+    prev_raw = internal_kv._kv_get(_CONFIG_APPS_KV[0],
+                                   namespace=_CONFIG_APPS_KV[1])
+    if prev_raw:
+        import json as _json
+        stale = set(_json.loads(prev_raw)) - deployed_names
+        if stale:
+            controller = _ensure_controller()
+            for name in sorted(stale):
+                ray_tpu.get(
+                    controller.delete_deployment.remote(name),
+                    timeout=60)
+    import json as _json
+    internal_kv._kv_put(
+        _CONFIG_APPS_KV[0],
+        _json.dumps(sorted(deployed_names)).encode(),
+        namespace=_CONFIG_APPS_KV[1])
+    return handles
+
+
+def _tree_names(app: Application) -> set[str]:
+    out = {app.deployment.name}
+    for v in list(app.init_args) + list(app.init_kwargs.values()):
+        if isinstance(v, Application):
+            out |= _tree_names(v)
+    return out
+
+
+def _apply_overrides(app: Application, app_schema) -> Application:
+    """Apply per-deployment config overrides through the whole
+    composition tree."""
+    by_name = {o.name: o for o in app_schema.deployments}
+
+    def walk(a: Application) -> Application:
+        args = tuple(walk(v) if isinstance(v, Application) else v
+                     for v in a.init_args)
+        kwargs = {k: walk(v) if isinstance(v, Application) else v
+                  for k, v in a.init_kwargs.items()}
+        d = a.deployment
+        o = by_name.get(d.name)
+        if o is not None:
+            d = d.options(
+                num_replicas=o.num_replicas,
+                ray_actor_options=o.ray_actor_options,
+                autoscaling_config=o.autoscaling_config)
+            if o.user_config is not None:
+                d.user_config = o.user_config
+        return Application(d, args, kwargs)
+
+    return walk(app)
+
+
+def status() -> dict:
+    """Cluster Serve status (reference: ``serve status``): per
+    deployment, live vs desired replica counts."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return {"deployments": {}, "controller": "NOT_RUNNING"}
+    deployments = ray_tpu.get(controller.list_deployments.remote(),
+                              timeout=30)
+    for name, info in deployments.items():
+        info["status"] = ("HEALTHY"
+                         if info["num_replicas"] >= info["desired"]
+                         else "UPDATING")
+    return {"deployments": deployments, "controller": "RUNNING"}
+
+
 def shutdown() -> None:
     global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
     from ray_tpu.serve.router import LongPollClient, Router
