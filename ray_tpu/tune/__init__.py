@@ -11,6 +11,7 @@ from ray_tpu.tune.search import (
     BayesOptSearcher, BOHBSearcher,
     ConcurrencyLimiter, Searcher,
 )
+from ray_tpu.tune.optuna import OptunaSearch
 from ray_tpu.tune.schedulers import (
     FIFOScheduler, ASHAScheduler, HyperBandScheduler,
     MedianStoppingRule, PopulationBasedTraining,
@@ -23,7 +24,7 @@ __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "BasicVariantGenerator", "RandomSearcher", "TPESearcher",
     "BayesOptSearcher", "BOHBSearcher",
-    "ConcurrencyLimiter", "Searcher",
+    "ConcurrencyLimiter", "Searcher", "OptunaSearch",
     "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
     "Tuner", "TuneConfig", "Trial", "ResultGrid", "TrialResult",
