@@ -1,0 +1,166 @@
+"""Model catalog: architectures decoupled from algorithms.
+
+Reference analog: ``rllib/core/models/catalog.py`` — the Catalog
+builds encoder + head components from the observation/action spec and
+a model config, so EVERY algorithm consumes the same factory instead
+of hand-rolling its network. Here the same seam in flax: a registry
+of encoder builders ("mlp", "cnn", user-registered customs) and
+factory functions (`build_actor_critic`, `build_q_network`) that
+compose encoder + policy/value/Q heads. All in-tree algorithms
+construct through this module, so swapping an architecture is a
+``policy_config`` change — no algorithm edits.
+
+policy_config keys (superset of the legacy dict):
+- ``obs_dim`` (int) or ``obs_shape`` (tuple, e.g. (84, 84, 4))
+- ``num_actions`` (int)
+- ``hidden``: tuple of dense widths (default (64, 64))
+- ``encoder``: registry name, default "mlp" ("cnn" for obs_shape)
+- ``activation``: "tanh" | "relu" | "gelu" (default tanh for pi,
+  relu for Q — the legacy behavior)
+- ``conv_filters``: for cnn — ((features, kernel, stride), ...)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_ACTIVATIONS = {"tanh": nn.tanh, "relu": nn.relu, "gelu": nn.gelu}
+
+_ENCODERS: dict[str, Callable[[dict], nn.Module]] = {}
+
+
+def register_encoder(name: str,
+                     builder: Callable[[dict], nn.Module]) -> None:
+    """Register a custom encoder builder: ``builder(policy_config)``
+    returns a flax Module mapping obs -> feature vector."""
+    _ENCODERS[name] = builder
+
+
+class MLPEncoder(nn.Module):
+    hidden: tuple = (64, 64)
+    activation: str = "tanh"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        act = _ACTIVATIONS[self.activation]
+        x = obs.astype(self.dtype)
+        if x.ndim > 2:                     # flat features expected
+            x = x.reshape(x.shape[0], -1)
+        for i, h in enumerate(self.hidden):
+            x = act(nn.Dense(h, name=f"fc{i}", dtype=self.dtype)(x))
+        return x
+
+
+class CNNEncoder(nn.Module):
+    """Conv stack for image observations (reference: the catalog's
+    CNN encoder defaults), flattened then densed."""
+    conv_filters: tuple = ((16, 4, 2), (32, 3, 2))
+    hidden: tuple = (64,)
+    activation: str = "relu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        act = _ACTIVATIONS[self.activation]
+        x = obs.astype(self.dtype)
+        for i, (feat, kern, stride) in enumerate(self.conv_filters):
+            x = act(nn.Conv(feat, (kern, kern), (stride, stride),
+                            name=f"conv{i}", dtype=self.dtype)(x))
+        x = x.reshape(x.shape[0], -1)
+        for i, h in enumerate(self.hidden):
+            x = act(nn.Dense(h, name=f"fc{i}", dtype=self.dtype)(x))
+        return x
+
+
+def build_encoder(policy_config: dict) -> nn.Module:
+    cfg = dict(policy_config)
+    name = cfg.get("encoder") or (
+        "cnn" if cfg.get("obs_shape") is not None
+        and len(cfg["obs_shape"]) >= 2 else "mlp")
+    custom = _ENCODERS.get(name)
+    if custom is not None:
+        return custom(cfg)
+    dtype = cfg.get("dtype", jnp.float32)
+    if name == "mlp":
+        return MLPEncoder(hidden=tuple(cfg.get("hidden", (64, 64))),
+                          activation=cfg.get("activation", "tanh"),
+                          dtype=dtype)
+    if name == "cnn":
+        return CNNEncoder(
+            conv_filters=tuple(cfg.get("conv_filters",
+                                       ((16, 4, 2), (32, 3, 2)))),
+            hidden=tuple(cfg.get("hidden", (64,))),
+            activation=cfg.get("activation", "relu"),
+            dtype=dtype)
+    raise ValueError(
+        f"unknown encoder {name!r}; registered: "
+        f"{['mlp', 'cnn'] + sorted(_ENCODERS)}")
+
+
+def _obs_example(policy_config: dict):
+    shape = policy_config.get("obs_shape")
+    if shape is None:
+        shape = (policy_config["obs_dim"],)
+    return jnp.zeros((1, *shape))
+
+
+class CatalogActorCritic(nn.Module):
+    """Encoder + discrete policy/value heads, catalog-assembled."""
+    encoder: nn.Module
+    num_actions: int
+    obs_example: Any = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        x = self.encoder(obs)
+        logits = nn.Dense(self.num_actions, name="pi",
+                          kernel_init=nn.initializers.orthogonal(0.01),
+                          dtype=self.dtype)(x)
+        value = nn.Dense(1, name="vf",
+                         kernel_init=nn.initializers.orthogonal(1.0),
+                         dtype=self.dtype)(x)[..., 0]
+        return logits, value
+
+    def init_params(self, rng):
+        return self.init(rng, self.obs_example)["params"]
+
+
+class CatalogQNetwork(nn.Module):
+    """Encoder + Q head, catalog-assembled."""
+    encoder: nn.Module
+    num_actions: int
+    obs_example: Any = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        x = self.encoder(obs)
+        return nn.Dense(self.num_actions, name="q",
+                        dtype=self.dtype)(x)
+
+    def init_params(self, rng):
+        return self.init(rng, self.obs_example)["params"]
+
+
+def build_actor_critic(policy_config: dict) -> nn.Module:
+    cfg = dict(policy_config)
+    return CatalogActorCritic(
+        encoder=build_encoder(cfg),
+        num_actions=cfg["num_actions"],
+        obs_example=_obs_example(cfg),
+        dtype=cfg.get("dtype", jnp.float32))
+
+
+def build_q_network(policy_config: dict) -> nn.Module:
+    cfg = dict(policy_config)
+    cfg.setdefault("activation", "relu")   # legacy QNetwork default
+    return CatalogQNetwork(
+        encoder=build_encoder(cfg),
+        num_actions=cfg["num_actions"],
+        obs_example=_obs_example(cfg),
+        dtype=cfg.get("dtype", jnp.float32))

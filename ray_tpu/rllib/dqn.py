@@ -20,7 +20,10 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
-from ray_tpu.rllib.models import ActorCriticConfig, QNetwork
+from ray_tpu.rllib.catalog import (
+    build_actor_critic,
+    build_q_network,
+)
 
 
 @dataclass
@@ -82,7 +85,7 @@ class DQNLearner:
     def __init__(self, policy_config: dict, hp: DQNHyperparams,
                  seed: int = 0):
         self.hp = hp
-        self.model = QNetwork(ActorCriticConfig(**policy_config))
+        self.model = build_q_network(policy_config)
         self.params = self.model.init_params(jax.random.key(seed))
         self.target_params = jax.tree.map(jnp.copy, self.params)
         self.opt = optax.adam(hp.lr)

@@ -64,15 +64,11 @@ class EnvRunner:
         self.epsilon = 1.0          # epsilon_greedy only
         self._key = jax.random.key(seed)
         if policy == "categorical":
-            from ray_tpu.rllib.models import (
-                ActorCritic, ActorCriticConfig,
-            )
-            self.model = ActorCritic(ActorCriticConfig(**policy_config))
+            from ray_tpu.rllib.catalog import build_actor_critic
+            self.model = build_actor_critic(policy_config)
         elif policy == "epsilon_greedy":
-            from ray_tpu.rllib.models import (
-                ActorCriticConfig, QNetwork,
-            )
-            self.model = QNetwork(ActorCriticConfig(**policy_config))
+            from ray_tpu.rllib.catalog import build_q_network
+            self.model = build_q_network(policy_config)
         elif policy == "gaussian":
             from ray_tpu.rllib.models import (
                 ContinuousConfig, SquashedGaussianActor,

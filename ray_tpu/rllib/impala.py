@@ -22,7 +22,7 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
-from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+from ray_tpu.rllib.catalog import build_actor_critic
 
 
 @dataclass
@@ -46,7 +46,7 @@ class ImpalaLearner:
                  max_seq_len: int, seed: int = 0):
         self.hp = hp
         self.T = max_seq_len
-        self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        self.model = build_actor_critic(policy_config)
         self.params = self.model.init_params(jax.random.key(seed))
         inner = (optax.adam(hp.lr) if hp.optimizer == "adam"
                  else optax.rmsprop(hp.lr, decay=0.99,

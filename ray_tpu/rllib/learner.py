@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+from ray_tpu.rllib.catalog import build_actor_critic
 
 
 @dataclass
@@ -39,7 +39,7 @@ class JaxLearner:
                  hparams: PPOHyperparams | None = None,
                  mesh=None, seed: int = 0):
         self.hp = hparams or PPOHyperparams()
-        self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        self.model = build_actor_critic(policy_config)
         self.params = self.model.init_params(jax.random.key(seed))
         self.opt = optax.chain(
             optax.clip_by_global_norm(self.hp.max_grad_norm),

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+from ray_tpu.rllib.catalog import build_actor_critic
 
 
 @dataclass
@@ -53,7 +53,7 @@ class MARWILLearner:
     def __init__(self, policy_config: dict, hp: MARWILHyperparams,
                  seed: int = 0):
         self.hp = hp
-        self.model = ActorCritic(ActorCriticConfig(**policy_config))
+        self.model = build_actor_critic(policy_config)
         self.params = self.model.init_params(jax.random.key(seed))
         self.opt = optax.adam(hp.lr)
         self.opt_state = self.opt.init(self.params)

@@ -34,13 +34,13 @@ class MultiAgentEnvRunner:
                  policy_mapping: Callable[[str], str], seed: int = 0):
         import jax
 
-        from ray_tpu.rllib.models import ActorCritic, ActorCriticConfig
+        from ray_tpu.rllib.catalog import build_actor_critic
 
         self.env = env_maker()
         self.mapping = policy_mapping
         self.rng = np.random.default_rng(seed)
         self.models = {
-            pid: ActorCritic(ActorCriticConfig(**cfg))
+            pid: build_actor_critic(cfg)
             for pid, cfg in policy_configs.items()}
         self.params = {
             pid: m.init_params(jax.random.key(seed + i))

@@ -1,0 +1,82 @@
+"""Model catalog (reference: rllib/core/models/catalog.py) —
+architectures decoupled from algorithms: encoder registry, CNN path,
+custom encoders, and algorithm construction through the factory."""
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.catalog import (
+    CNNEncoder,
+    MLPEncoder,
+    build_actor_critic,
+    build_encoder,
+    build_q_network,
+    register_encoder,
+)
+
+
+def test_mlp_actor_critic_default():
+    m = build_actor_critic({"obs_dim": 6, "num_actions": 3,
+                            "hidden": (16, 16)})
+    params = m.init_params(jax.random.key(0))
+    logits, value = m.apply({"params": params}, np.zeros((4, 6)))
+    assert logits.shape == (4, 3) and value.shape == (4,)
+
+
+def test_cnn_encoder_via_obs_shape():
+    cfg = {"obs_shape": (16, 16, 3), "num_actions": 4,
+           "conv_filters": ((8, 3, 2), (16, 3, 2)), "hidden": (32,)}
+    enc = build_encoder(cfg)
+    assert isinstance(enc, CNNEncoder)
+    m = build_actor_critic(cfg)
+    params = m.init_params(jax.random.key(0))
+    logits, value = m.apply({"params": params},
+                            np.zeros((2, 16, 16, 3)))
+    assert logits.shape == (2, 4) and value.shape == (2,)
+
+
+def test_q_network_through_catalog():
+    m = build_q_network({"obs_dim": 5, "num_actions": 2,
+                         "hidden": (8,)})
+    params = m.init_params(jax.random.key(1))
+    q = m.apply({"params": params}, np.zeros((3, 5)))
+    assert q.shape == (3, 2)
+
+
+def test_custom_encoder_registration():
+    calls = []
+
+    def build_tiny(cfg):
+        calls.append(cfg["obs_dim"])
+        return MLPEncoder(hidden=(4,), activation="relu")
+
+    register_encoder("tiny", build_tiny)
+    m = build_actor_critic({"obs_dim": 7, "num_actions": 2,
+                            "encoder": "tiny"})
+    params = m.init_params(jax.random.key(0))
+    logits, _ = m.apply({"params": params}, np.zeros((1, 7)))
+    assert logits.shape == (1, 2)
+    assert calls == [7]
+
+
+def test_unknown_encoder_raises():
+    with pytest.raises(ValueError, match="unknown encoder"):
+        build_encoder({"obs_dim": 3, "encoder": "nope"})
+
+
+def test_ppo_trains_through_catalog(rt):
+    """An algorithm run constructs every network through the catalog
+    — the same smoke the legacy path had, now factory-routed."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1", obs_dim=4, num_actions=2,
+                         hidden=(32, 32))
+            .env_runners(1)
+            .build())
+    try:
+        result = algo.train()
+        assert np.isfinite(result["total_loss"])
+    finally:
+        algo.stop()
