@@ -13,6 +13,7 @@ Endpoints (reference: dashboard modules python/ray/dashboard/modules/):
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -175,6 +176,30 @@ class Dashboard:
             target=self._server.serve_forever, daemon=True,
             name="dashboard")
         self._thread.start()
+        # Core system metrics into the /metrics registry (reference:
+        # the native stat defs surfaced through the metrics agent).
+        from ray_tpu.dashboard.system_metrics import (
+            start_system_metrics,
+        )
+        self._system_metrics = start_system_metrics(runtime)
+        self._system_metrics.sample_once()
+        # Prometheus + Grafana provisioning for THIS cluster
+        # (reference: dashboard/modules/metrics generated configs).
+        try:
+            from ray_tpu.dashboard.metrics_config import (
+                generate_metrics_configs,
+            )
+            log_dir = getattr(runtime, "log_dir", None)
+            if log_dir:
+                # SIBLING of logs/, not inside it: log consumers
+                # (log monitor, CLI logs, user scripts) iterate
+                # log_dir expecting plain files.
+                self.metrics_config_paths = generate_metrics_configs(
+                    os.path.join(os.path.dirname(
+                        os.path.abspath(log_dir)), "metrics"),
+                    [f"{host}:{self.port}"])
+        except Exception:  # noqa: BLE001 — observability config
+            pass           # generation must never block the server
 
     @property
     def url(self) -> str:

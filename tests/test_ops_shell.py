@@ -160,3 +160,38 @@ def test_cli_doctor_runs():
         capture_output=True, text=True, env=env, timeout=180)
     assert out.returncode == 0, out.stderr
     assert "ray_tpu" in out.stdout
+
+
+def test_dashboard_metrics_autoconfig(rt):
+    """System metrics registered + Prometheus/Grafana configs
+    generated on dashboard start (reference:
+    dashboard/modules/metrics generated provisioning)."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard.head import start_dashboard
+
+    dash = start_dashboard(port=0)
+    try:
+        text = urllib.request.urlopen(
+            dash.url + "/metrics", timeout=10).read().decode()
+        for metric in ("ray_tpu_nodes_alive", "ray_tpu_workers_total",
+                       "ray_tpu_object_store_bytes",
+                       "ray_tpu_tasks_pending"):
+            assert metric in text, f"{metric} missing from /metrics"
+        paths = getattr(dash, "metrics_config_paths", None)
+        assert paths, "metrics configs not generated"
+        import os as _os
+        for key in ("prometheus", "targets", "datasource",
+                    "dashboard"):
+            assert _os.path.exists(paths[key]), (key, paths)
+        with open(paths["dashboard"]) as f:
+            board = _json.load(f)
+        exprs = {t["expr"] for p in board["panels"]
+                 for t in p["targets"]}
+        assert "ray_tpu_tasks_running" in exprs
+        with open(paths["targets"]) as f:
+            targets = _json.load(f)
+        assert targets[0]["targets"] == [f"{dash.host}:{dash.port}"]
+    finally:
+        dash.stop()
