@@ -363,6 +363,8 @@ def _enable_compile_cache() -> None:
     was the top cause of its timeouts (VERDICT r4 weak #2) — warm
     captures skip straight to execution. No-op if the backend can't
     serialize executables."""
+    if os.environ.get("RAY_TPU_BENCH_NO_COMPILE_CACHE"):
+        return
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           "/tmp/ray_tpu_jax_cache")
     import jax

@@ -20,6 +20,7 @@ from ray_tpu.data.io import (
     read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
     from_items,
     from_numpy,
     from_pandas,
@@ -44,4 +45,5 @@ __all__ = [
     "from_numpy", "from_pandas", "read_parquet", "read_csv",
     "read_json", "read_images", "read_binary_files",
     "read_tfrecords", "read_sql", "from_huggingface",
+    "read_webdataset",
 ]

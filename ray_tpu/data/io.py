@@ -297,6 +297,79 @@ def read_tfrecords(paths: str | list[str], *,
     return Dataset([_Source([make(f) for f in files])])
 
 
+def read_webdataset(paths: str | list[str], *,
+                    suffixes: list[str] | None = None) -> Dataset:
+    """WebDataset tar shards -> one block per shard (reference:
+    ray.data.read_webdataset — re-based on stdlib tarfile: samples
+    are consecutive tar members sharing a basename key, one column
+    per extension, values raw bytes except ``.cls``/``.id``/
+    ``.index`` (int) and ``.json`` (parsed). ``suffixes`` filters the
+    loaded extensions."""
+    files = _expand(paths, ".tar")
+
+    def make(f):
+        def read():
+            import json as _json
+            import os
+            import tarfile
+
+            want = set(s.lstrip(".") for s in suffixes) \
+                if suffixes else None
+            rows: list[dict] = []
+            cur_key: str | None = None
+            cur: dict = {}
+            with tarfile.open(f) as tf:
+                for m in tf:
+                    if not m.isfile():
+                        continue
+                    # Key = full path up to the first dot AFTER the
+                    # last slash (webdataset convention): samples in
+                    # different subdirectories sharing a basename
+                    # must NOT collide.
+                    base = os.path.basename(m.name)
+                    if "." not in base:
+                        continue
+                    stem, ext = base.split(".", 1)
+                    dirname = os.path.dirname(m.name)
+                    key = (f"{dirname}/{stem}" if dirname else stem)
+                    if want is not None and ext not in want:
+                        continue
+                    if key != cur_key and cur:
+                        rows.append(cur)
+                        cur = {}
+                    cur_key = key
+                    data = tf.extractfile(m).read()
+                    if ext in ("cls", "id", "index"):
+                        cur[ext] = int(data)
+                    elif ext == "json":
+                        cur[ext] = _json.loads(data)
+                    else:
+                        cur[ext] = data
+                    cur["__key__"] = key
+            if cur:
+                rows.append(cur)
+            cols: dict[str, list] = {}
+            for i, row in enumerate(rows):
+                for k, v in row.items():
+                    cols.setdefault(k, [None] * i).append(v)
+                for k in cols:
+                    if len(cols[k]) < i + 1:
+                        cols[k].append(None)
+
+            def arr(v):
+                if all(isinstance(x, int) for x in v):
+                    return np.asarray(v)
+                out = np.empty(len(v), dtype=object)
+                for i, x in enumerate(v):
+                    out[i] = x
+                return out
+
+            return to_block({k: arr(v) for k, v in cols.items()})
+        return read
+
+    return Dataset([_Source([make(f) for f in files])])
+
+
 def read_sql(sql: str | list[str], connection_factory, *,
              columns: list[str] | None = None) -> Dataset:
     """DB-API 2.0 datasource (reference: ray.data.read_sql). One read

@@ -39,8 +39,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
-WATCH_LOG = os.path.join(REPO, "WATCH_r04.log")
+WATCH_LOG = os.path.join(REPO, "WATCH_r05.log")
 VERIFIED = os.path.join(REPO, "BENCH_verified.json")
+BEST = os.path.join(REPO, "BENCH_best.json")
 HISTORY = os.path.join(REPO, "BENCH_history.jsonl")
 
 PROBE_TIMEOUT_S = 120.0
@@ -118,6 +119,17 @@ def capture() -> dict | None:
               "result": res}
     with open(VERIFIED, "w") as f:
         json.dump(record, f, indent=1)
+    # Best-of record: the chip's throughput swings ~10% between
+    # windows (r5: identical code measured 127.1k at 14:40 and
+    # 112.7k at 17:30); BENCH_best.json keeps the strongest verified
+    # capture while BENCH_verified.json stays "latest".
+    try:
+        prev = json.load(open(BEST))["result"].get("value", 0)
+    except Exception:  # noqa: BLE001
+        prev = 0
+    if res.get("value", 0) > prev:
+        with open(BEST, "w") as f:
+            json.dump(record, f, indent=1)
     with open(HISTORY, "a") as f:
         f.write(json.dumps(record) + "\n")
     _log({"event": "bench_verified", "value": res.get("value"),
