@@ -90,12 +90,38 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.util.tracing import get_tracer
                 self._send_json(
                     [s.to_dict() for s in get_tracer().get_spans()])
+            elif path == "/api/serve/applications":
+                from ray_tpu import serve
+                self._send_json(serve.status())
             elif path == "/metrics":
                 from ray_tpu.util.metrics import prometheus_text
                 self._send(200, prometheus_text().encode(),
                            "text/plain; version=0.0.4")
             else:
                 self._send(404, b'{"error": "not found"}')
+        except Exception as e:  # noqa: BLE001
+            self._send(500, json.dumps({"error": str(e)}).encode())
+
+    def do_PUT(self):  # noqa: N802 — http.server API
+        """REST deploy (reference: the Serve REST API's
+        PUT /api/serve/applications/ consuming ServeDeploySchema):
+        body = the declarative config JSON; reconciles apps exactly
+        like `serve deploy config.yaml`."""
+        path = self.path.split("?")[0].rstrip("/")
+        if path != "/api/serve/applications":
+            self._send(404, b'{"error": "not found"}')
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            from ray_tpu import serve
+            handles = serve.deploy_config(body)
+            self._send_json({"deployed": sorted(handles)})
+        except (ValueError, TypeError) as e:
+            # Both are client-input errors: schema violations raise
+            # ValueError, a non-mapping body (JSON array/string)
+            # raises TypeError from deploy_config.
+            self._send(400, json.dumps({"error": str(e)}).encode())
         except Exception as e:  # noqa: BLE001
             self._send(500, json.dumps({"error": str(e)}).encode())
 

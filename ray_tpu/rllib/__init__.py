@@ -16,7 +16,7 @@ from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup, Episode
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
-from ray_tpu.rllib.learner import JaxLearner
+from ray_tpu.rllib.learner import JaxLearner, RecurrentJaxLearner
 from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.multi_agent import (
@@ -36,5 +36,6 @@ __all__ = [
     "LearnerGroup",
     "SAC", "SACConfig",
     "EnvRunner", "EnvRunnerGroup", "Episode", "JaxLearner",
+    "RecurrentJaxLearner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentEnvRunner",
 ]

@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 _ACTIVATIONS = {"tanh": nn.tanh, "relu": nn.relu, "gelu": nn.gelu}
@@ -145,6 +146,95 @@ class CatalogQNetwork(nn.Module):
 
     def init_params(self, rng):
         return self.init(rng, self.obs_example)["params"]
+
+
+class RecurrentActorCritic(nn.Module):
+    """GRU policy+value with EXPLICIT carry (reference: the catalog's
+    recurrent encoders + DreamerV3-class recurrent paths the Learner
+    must handle). Two entry points:
+
+    - ``step``:  (obs [B, obs], carry [B, H]) ->
+                 (logits [B, A], value [B], carry) — rollouts.
+    - ``seq``:   (obs [B, T, obs], carry0 [B, H]) ->
+                 (logits [B, T, A], values [B, T]) — BPTT training,
+                 scanned over T inside the program.
+
+    The pre-GRU featurizer comes from the encoder registry, so cnn/
+    custom encoders compose with recurrence."""
+
+    encoder: nn.Module
+    num_actions: int
+    hidden_state: int = 64
+    obs_example: Any = None
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.cell = nn.GRUCell(self.hidden_state, name="gru",
+                               dtype=self.dtype)
+        self.pi = nn.Dense(self.num_actions, name="pi",
+                           kernel_init=nn.initializers.orthogonal(
+                               0.01), dtype=self.dtype)
+        self.vf = nn.Dense(1, name="vf",
+                           kernel_init=nn.initializers.orthogonal(
+                               1.0), dtype=self.dtype)
+
+    def initial_state(self, batch_size: int):
+        return jnp.zeros((batch_size, self.hidden_state), self.dtype)
+
+    def _heads(self, x):
+        return self.pi(x), self.vf(x)[..., 0]
+
+    def __call__(self, obs, carry):            # step
+        feat = self.encoder(obs)
+        carry, x = self.cell(carry, feat)
+        logits, value = self._heads(x)
+        return logits, value, carry
+
+    def step(self, obs, carry):
+        return self(obs, carry)
+
+    def seq(self, obs_seq, carry0):
+        logits, value, _carries = self.seq_with_carries(obs_seq,
+                                                        carry0)
+        return logits, value
+
+    def seq_with_carries(self, obs_seq, carry0):
+        """Like ``seq`` but also returns the carry AFTER each step
+        ([B, T, H]) — the learner slices these at segment boundaries
+        so truncated-BPTT segments replay from their true rollout
+        state instead of zeros."""
+        B, T = obs_seq.shape[:2]
+        flat = obs_seq.reshape(B * T, *obs_seq.shape[2:])
+        feat = self.encoder(flat).reshape(B, T, -1)
+
+        def one(carry, x_t):
+            carry, y = self.cell(carry, x_t)
+            return carry, (y, carry)
+
+        # scan over time; cell wants batch leading, so feed [T, B, F].
+        _, (ys, cs) = jax.lax.scan(one, carry0,
+                                   feat.transpose(1, 0, 2))
+        x = ys.transpose(1, 0, 2)              # [B, T, H]
+        logits, value = self._heads(x)
+        return logits, value, cs.transpose(1, 0, 2)
+
+    def init_params(self, rng):
+        obs = self.obs_example
+        carry = self.initial_state(obs.shape[0])
+        return self.init(rng, obs, carry)["params"]
+
+
+def build_recurrent_actor_critic(policy_config: dict) -> nn.Module:
+    """Recurrent variant: ``policy_config`` additionally takes
+    ``hidden_state`` (GRU width, default 64). step/seq share params —
+    rollouts use step, the learner BPTTs with seq."""
+    cfg = dict(policy_config)
+    return RecurrentActorCritic(
+        encoder=build_encoder(cfg),
+        num_actions=cfg["num_actions"],
+        hidden_state=int(cfg.get("hidden_state", 64)),
+        obs_example=_obs_example(cfg),
+        dtype=cfg.get("dtype", jnp.float32))
 
 
 def build_actor_critic(policy_config: dict) -> nn.Module:

@@ -28,6 +28,11 @@ class Episode:
     truncated: bool = False
     last_value: float = 0.0
     final_obs: Any = None     # obs after the last step (off-policy)
+    # Recurrent policies: the module carry at this episode chunk's
+    # FIRST step (zeros right after a reset; the live carry when a
+    # chunk continues across sample() calls). The learner replays
+    # from it so BPTT segments see their true rollout state.
+    state_in: Any = None
 
     @property
     def length(self) -> int:
@@ -66,6 +71,11 @@ class EnvRunner:
         if policy == "categorical":
             from ray_tpu.rllib.catalog import build_actor_critic
             self.model = build_actor_critic(policy_config)
+        elif policy == "recurrent":
+            from ray_tpu.rllib.catalog import (
+                build_recurrent_actor_critic,
+            )
+            self.model = build_recurrent_actor_critic(policy_config)
         elif policy == "epsilon_greedy":
             from ray_tpu.rllib.catalog import build_q_network
             self.model = build_q_network(policy_config)
@@ -78,8 +88,15 @@ class EnvRunner:
         else:
             raise ValueError(f"unknown policy {policy!r}")
         self.params = self.model.init_params(jax.random.key(seed))
-        self._fwd = jax.jit(
-            lambda p, o: self.model.apply({"params": p}, o))
+        if policy == "recurrent":
+            # Stateful rollout: the GRU carry advances per step and
+            # resets at episode boundaries.
+            self._carry = self.model.initial_state(1)
+            self._fwd = jax.jit(
+                lambda p, o, c: self.model.apply({"params": p}, o, c))
+        else:
+            self._fwd = jax.jit(
+                lambda p, o: self.model.apply({"params": p}, o))
         self._obs, _ = self.env.reset(seed=seed)
         # Transformed current obs: each observation passes through the
         # (possibly stateful) env_to_module pipeline EXACTLY once —
@@ -109,6 +126,13 @@ class EnvRunner:
             action = int(self.rng.choice(len(probs), p=probs))
             logp = float(np.log(probs[action] + 1e-9))
             return action, action, logp, float(value[0])
+        if self.policy == "recurrent":
+            logits, value, self._carry = self._fwd(
+                self.params, obs[None], self._carry)
+            probs = np.asarray(jnn.softmax(logits[0]))
+            action = int(self.rng.choice(len(probs), p=probs))
+            logp = float(np.log(probs[action] + 1e-9))
+            return action, action, logp, float(value[0])
         if self.policy == "epsilon_greedy":
             q = np.asarray(self._fwd(self.params, obs[None])[0])
             if self.rng.random() < self.epsilon:
@@ -124,10 +148,16 @@ class EnvRunner:
         a = np.asarray(a[0], dtype=np.float32)
         return a, a, float(logp[0]), 0.0
 
+    def _new_episode(self) -> Episode:
+        ep = Episode()
+        if self.policy == "recurrent":
+            ep.state_in = np.asarray(self._carry[0])
+        return ep
+
     def sample(self, num_steps: int) -> list:
         """Collect ~num_steps of experience as Episode chunks."""
         episodes: list[Episode] = []
-        ep = Episode()
+        ep = self._new_episode()
         for _ in range(num_steps):
             obs = self._tobs
             env_action, action, logp, value = self._act(obs)
@@ -149,7 +179,9 @@ class EnvRunner:
                 # ep.obs — off-policy consumers concatenate them.
                 ep.final_obs = self._tobs
                 episodes.append(ep)
-                ep = Episode()
+                if self.policy == "recurrent":
+                    self._carry = self.model.initial_state(1)
+                ep = self._new_episode()
                 self._obs, _ = self.env.reset()
                 self._tobs = np.asarray(self.env_to_module(
                     np.asarray(self._obs, np.float32),
@@ -157,6 +189,11 @@ class EnvRunner:
         if ep.length:
             if self.policy == "categorical":
                 _, last_v = self._fwd(self.params, self._tobs[None])
+                ep.last_value = float(last_v[0])
+            elif self.policy == "recurrent":
+                _, last_v, _c = self._fwd(self.params,
+                                          self._tobs[None],
+                                          self._carry)
                 ep.last_value = float(last_v[0])
             ep.final_obs = self._tobs
             episodes.append(ep)

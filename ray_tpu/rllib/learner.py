@@ -35,11 +35,14 @@ class PPOHyperparams:
 
 
 class JaxLearner:
+    #: model factory hook — subclasses (recurrent) override.
+    _build_model = staticmethod(build_actor_critic)
+
     def __init__(self, policy_config: dict,
                  hparams: PPOHyperparams | None = None,
                  mesh=None, seed: int = 0):
         self.hp = hparams or PPOHyperparams()
-        self.model = build_actor_critic(policy_config)
+        self.model = self._build_model(policy_config)
         self.params = self.model.init_params(jax.random.key(seed))
         self.opt = optax.chain(
             optax.clip_by_global_norm(self.hp.max_grad_norm),
@@ -97,18 +100,25 @@ class JaxLearner:
 
     # -- GAE --
 
-    def compute_advantages(self, episodes) -> dict[str, np.ndarray]:
+    def _gae(self, ep) -> np.ndarray:
+        """Per-episode unnormalized GAE (shared by the flat and the
+        recurrent learners)."""
         hp = self.hp
+        r = np.asarray(ep.rewards, np.float32)
+        v = np.asarray(ep.values + [ep.last_value], np.float32)
+        deltas = r + hp.gamma * v[1:] - v[:-1]
+        adv = np.zeros_like(deltas)
+        acc = 0.0
+        for t in range(len(deltas) - 1, -1, -1):
+            acc = deltas[t] + hp.gamma * hp.gae_lambda * acc
+            adv[t] = acc
+        return adv
+
+    def compute_advantages(self, episodes) -> dict[str, np.ndarray]:
         obs, actions, logps, advs, rets = [], [], [], [], []
         for ep in episodes:
-            r = np.asarray(ep.rewards, np.float32)
             v = np.asarray(ep.values + [ep.last_value], np.float32)
-            deltas = r + hp.gamma * v[1:] - v[:-1]
-            adv = np.zeros_like(deltas)
-            acc = 0.0
-            for t in range(len(deltas) - 1, -1, -1):
-                acc = deltas[t] + hp.gamma * hp.gae_lambda * acc
-                adv[t] = acc
+            adv = self._gae(ep)
             ret = adv + v[:-1]
             obs.append(np.stack(ep.obs))
             actions.append(np.asarray(ep.actions, np.int32))
@@ -132,13 +142,15 @@ class JaxLearner:
         hp = self.hp
         batch = self.compute_advantages(episodes)
         n = len(batch["obs"])
+        # Clamp: a rollout smaller than one minibatch must still
+        # produce an update, not silently skip every epoch.
+        mb_size = max(1, min(hp.minibatch_size, n))
         rng = np.random.default_rng(0)
         metrics = {}
         for _ in range(hp.num_epochs):
             perm = rng.permutation(n)
-            for s in range(0, n - hp.minibatch_size + 1,
-                           hp.minibatch_size):
-                idx = perm[s:s + hp.minibatch_size]
+            for s in range(0, n - mb_size + 1, mb_size):
+                idx = perm[s:s + mb_size]
                 mb = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
                 self.params, self.opt_state, metrics = self._update(
                     self.params, self.opt_state, mb)
@@ -149,3 +161,111 @@ class JaxLearner:
 
     def set_weights(self, params) -> None:
         self.params = jax.device_put(params)
+
+
+class RecurrentJaxLearner(JaxLearner):
+    """Sequence-BPTT PPO for recurrent modules (reference: the
+    Learner's recurrent/stateful-module path — DreamerV3-class models
+    train through sequences, not flat rows). Episodes become padded
+    [B, T] segments; each segment replays from its TRUE rollout carry
+    (the episode's recorded ``state_in`` advanced through the module
+    once per rollout batch), so logp_old stays consistent with the
+    replayed logits at epoch 0 — gradients are truncated at segment
+    boundaries (truncated BPTT) but the PPO ratio is not corrupted by
+    a zero-state restart. The loss runs the module's ``seq`` method —
+    a lax.scan over time INSIDE the jitted program — with
+    mask-weighted PPO terms, so padding contributes nothing."""
+
+    @staticmethod
+    def _build_model(policy_config: dict):
+        from ray_tpu.rllib.catalog import (
+            build_recurrent_actor_critic,
+        )
+        return build_recurrent_actor_critic(policy_config)
+
+    def __init__(self, policy_config: dict,
+                 hparams: PPOHyperparams | None = None,
+                 mesh=None, seed: int = 0, max_seq_len: int = 32):
+        self.max_seq_len = max_seq_len
+        super().__init__(policy_config, hparams, mesh, seed)
+        self._carries_jit = jax.jit(
+            lambda p, o, c: self.model.apply(
+                {"params": p}, o, c, method="seq_with_carries")[2])
+
+    def _loss_with_aux(self, p, batch):
+        hp = self.hp
+        logits, values = self.model.apply(
+            {"params": p}, batch["obs"], batch["carry0"],
+            method="seq")
+        logp_all = jax.nn.log_softmax(logits)           # [B, T, A]
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        mask = batch["mask"]
+        msum = mask.sum() + 1e-8
+        ratio = jnp.exp(logp - batch["logp_old"])
+        adv = batch["advantages"]
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - hp.clip_eps, 1 + hp.clip_eps) * adv)
+        pi_loss = -(surr * mask).sum() / msum
+        vf_loss = (((values - batch["returns"]) ** 2) * mask
+                   ).sum() / msum
+        ent_t = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        entropy = (ent_t * mask).sum() / msum
+        total = (pi_loss + hp.vf_coeff * vf_loss
+                 - hp.entropy_coeff * entropy)
+        return total, (pi_loss, vf_loss, entropy)
+
+    def _segment_carries(self, ep, obs: np.ndarray) -> list:
+        """Carry at each max_seq_len boundary, replayed ONCE from the
+        episode's rollout state_in with the current (= rollout-time)
+        params."""
+        T = self.max_seq_len
+        H = self.model.hidden_state
+        c0 = (np.asarray(ep.state_in, np.float32)
+              if getattr(ep, "state_in", None) is not None
+              else np.zeros(H, np.float32))
+        if len(obs) <= T:
+            return [c0]
+        carries = np.asarray(self._carries_jit(
+            self.params, obs[None], c0[None].astype(obs.dtype)))[0]
+        return [c0] + [carries[s - 1] for s in
+                       range(T, len(obs), T)]
+
+    def compute_advantages(self, episodes) -> dict[str, np.ndarray]:
+        T = self.max_seq_len
+        segs: dict[str, list] = {k: [] for k in (
+            "obs", "actions", "logp_old", "advantages", "returns",
+            "mask", "carry0")}
+        per_ep = [self._gae(ep) for ep in episodes]
+        flat = np.concatenate(per_ep)
+        mean, std = flat.mean(), flat.std() + 1e-8
+        for ep, adv_raw in zip(episodes, per_ep):
+            adv = (adv_raw - mean) / std
+            ret = adv_raw + np.asarray(ep.values, np.float32)
+            obs = np.stack(ep.obs).astype(np.float32)
+            acts = np.asarray(ep.actions, np.int32)
+            logps = np.asarray(ep.logps, np.float32)
+            carries = self._segment_carries(ep, obs)
+            for i, s in enumerate(range(0, len(obs), T)):
+                sl = slice(s, s + T)
+                n = len(obs[sl])
+                pad = T - n
+
+                def p0(x, pad=pad):
+                    if pad == 0:
+                        return x
+                    width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+                    return np.pad(x, width)
+
+                segs["obs"].append(p0(obs[sl]))
+                segs["actions"].append(p0(acts[sl]))
+                segs["logp_old"].append(p0(logps[sl]))
+                segs["advantages"].append(
+                    p0(adv[sl].astype(np.float32)))
+                segs["returns"].append(
+                    p0(ret[sl].astype(np.float32)))
+                segs["mask"].append(p0(np.ones(n, np.float32)))
+                segs["carry0"].append(
+                    np.asarray(carries[i], np.float32))
+        return {k: np.stack(v) for k, v in segs.items()}

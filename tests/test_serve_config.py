@@ -154,3 +154,42 @@ def test_deploy_config_dict_with_override_injection(serve_rt):
         _import_override=lambda schema: local.bind())
     out = ray_tpu.get(handles["app"].remote(3), timeout=60)
     assert out == {"ok": 3}
+
+
+def test_rest_deploy_api(serve_rt, tmp_path):
+    """REST deploy (reference: Serve REST API PUT
+    /api/serve/applications): JSON config in, apps reconciled,
+    status served back on GET; invalid configs -> 400."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu.dashboard.head import start_dashboard
+
+    dash = start_dashboard(port=0)
+    try:
+        cfg = {"applications": [
+            {"name": "echo", "route_prefix": "/echo",
+             "import_path": f"{__name__}:echo_app"}]}
+        req = urllib.request.Request(
+            dash.url + "/api/serve/applications",
+            data=_json.dumps(cfg).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        out = _json.loads(urllib.request.urlopen(
+            req, timeout=60).read())
+        assert out["deployed"] == ["echo"]
+        handle = serve.get_deployment_handle("Echo")
+        assert ray_tpu.get(handle.remote(5), timeout=60) == {"echo": 5}
+        st = _json.loads(urllib.request.urlopen(
+            dash.url + "/api/serve/applications", timeout=30).read())
+        assert "Echo" in st["deployments"]
+        # invalid config -> 400 with the field path in the error
+        bad = urllib.request.Request(
+            dash.url + "/api/serve/applications",
+            data=b'{"applications": []}', method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=30)
+        assert ei.value.code == 400
+        assert "applications" in ei.value.read().decode()
+    finally:
+        dash.stop()
