@@ -93,6 +93,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/api/serve/applications":
                 from ray_tpu import serve
                 self._send_json(serve.status())
+            elif path == "/api/logs":
+                self._send_json(self._logs())
             elif path == "/metrics":
                 from ray_tpu.util.metrics import prometheus_text
                 self._send(200, prometheus_text().encode(),
@@ -124,6 +126,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, json.dumps({"error": str(e)}).encode())
         except Exception as e:  # noqa: BLE001
             self._send(500, json.dumps({"error": str(e)}).encode())
+
+    def _logs(self) -> dict:
+        """Worker log files (list, or ?file=<name> tail) — the SPA's
+        log viewer (reference: the dashboard log module). Shares the
+        list/tail implementation with the CLI's ``logs`` command."""
+        from urllib.parse import parse_qs, urlparse
+
+        from ray_tpu.util.logdir import list_log_files, tail_log_file
+
+        log_dir = getattr(self.runtime, "log_dir", None)
+        q = parse_qs(urlparse(self.path).query)
+        fname = q.get("file", [None])[0]
+        if not fname:
+            return {"files": list_log_files(log_dir)}
+        return tail_log_file(log_dir, fname,
+                             int(q.get("tail", ["65536"])[0]))
 
     def _agent_stats(self) -> dict:
         """Daemon-reported samples + an on-demand head self-sample

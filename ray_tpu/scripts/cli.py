@@ -110,29 +110,27 @@ def _cmd_metrics(args) -> int:
 
 
 def _cmd_logs(args) -> int:
-    """List or tail worker log files of the target session."""
+    """List or tail worker log files of the target session (shares
+    the list/tail implementation with the dashboard's /api/logs)."""
+    from ray_tpu.util.logdir import list_log_files, tail_log_file
+
     address = _discover_address(args.address)
     log_dir = os.path.join(os.path.dirname(address), "logs")
     if not os.path.isdir(log_dir):
         print("no logs directory for this session")
         return 1
-    names = sorted(n for n in os.listdir(log_dir)
-                   if n.endswith(".log"))
     if args.file:
-        path = os.path.join(log_dir, args.file)
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-        except FileNotFoundError:
+        out = tail_log_file(log_dir, args.file,
+                            args.tail_bytes or (1 << 20))
+        if out.get("error"):
             print(f"no such log file: {args.file} "
                   f"(run `logs` with no argument to list)")
             return 1
-        tail = data[-args.tail_bytes:] if args.tail_bytes else data
-        sys.stdout.write(tail.decode(errors="replace"))
+        sys.stdout.write(out["content"])
         return 0
-    for n in names:
+    for n in list_log_files(log_dir):
         size = os.path.getsize(os.path.join(log_dir, n))
-        print(f"{n}	{size} bytes")
+        print(f"{n}\t{size} bytes")
     return 0
 
 

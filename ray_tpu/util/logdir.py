@@ -1,0 +1,38 @@
+"""Shared session log-dir helpers (one implementation for the CLI's
+``logs`` command and the dashboard's ``/api/logs`` viewer — the two
+had started to diverge on filtering and traversal clamping)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["list_log_files", "tail_log_file"]
+
+
+def list_log_files(log_dir: str) -> list[str]:
+    """Sorted plain files in the session log dir."""
+    if not log_dir or not os.path.isdir(log_dir):
+        return []
+    return sorted(
+        f for f in os.listdir(log_dir)
+        if os.path.isfile(os.path.join(log_dir, f)))
+
+
+def tail_log_file(log_dir: str, fname: str,
+                  tail_bytes: int = 65536) -> dict:
+    """Last ``tail_bytes`` of one log file. ``fname`` is clamped to
+    its basename — no traversal out of the session dir. Returns
+    {file, content, truncated} or {file, content:"", error}."""
+    fname = os.path.basename(fname)
+    path = os.path.join(log_dir or "", fname)
+    if not os.path.isfile(path):
+        return {"file": fname, "content": "",
+                "error": "no such log file"}
+    tail = min(max(int(tail_bytes), 1), 1 << 20)
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - tail))
+        content = f.read().decode("utf-8", "replace")
+    return {"file": fname, "content": content,
+            "truncated": size > tail}

@@ -195,3 +195,39 @@ def test_dashboard_metrics_autoconfig(rt):
         assert targets[0]["targets"] == [f"{dash.host}:{dash.port}"]
     finally:
         dash.stop()
+
+
+def test_dashboard_logs_api(rt):
+    """Log viewer endpoints: list files, tail one, reject traversal
+    (reference: the dashboard log module behind the SPA logs tab)."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard.head import start_dashboard
+
+    @ray_tpu.remote
+    def noisy():
+        print("log-viewer-probe-line")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    dash = start_dashboard(port=0)
+    try:
+        files = _json.loads(urllib.request.urlopen(
+            dash.url + "/api/logs", timeout=10).read())["files"]
+        assert files, "no worker logs listed"
+        target = next((f for f in files if f.startswith("worker-")),
+                      files[0])
+        out = _json.loads(urllib.request.urlopen(
+            dash.url + f"/api/logs?file={target}",
+            timeout=10).read())
+        assert out["file"] == target and "content" in out
+        # traversal is clamped to basename
+        out = _json.loads(urllib.request.urlopen(
+            dash.url + "/api/logs?file=..%2F..%2Fetc%2Fpasswd",
+            timeout=10).read())
+        assert out.get("error") or "root:" not in out.get(
+            "content", "")
+    finally:
+        dash.stop()
