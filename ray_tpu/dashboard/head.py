@@ -140,8 +140,11 @@ class _Handler(BaseHTTPRequestHandler):
         fname = q.get("file", [None])[0]
         if not fname:
             return {"files": list_log_files(log_dir)}
-        return tail_log_file(log_dir, fname,
-                             int(q.get("tail", ["65536"])[0]))
+        try:
+            tail = int(q.get("tail", ["65536"])[0])
+        except ValueError:
+            tail = 65536          # garbage query param -> default
+        return tail_log_file(log_dir, fname, tail)
 
     def _agent_stats(self) -> dict:
         """Daemon-reported samples + an on-demand head self-sample

@@ -9,6 +9,7 @@ from dataclasses import dataclass, field, replace
 from typing import Any, Callable
 
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.checkpoints import Checkpointable, tree_to_host
 from ray_tpu.rllib.learner import JaxLearner, PPOHyperparams
 
 
@@ -61,7 +62,7 @@ class AlgorithmConfig:
 PPOConfig = AlgorithmConfig
 
 
-class PPO:
+class PPO(Checkpointable):
     """Proximal Policy Optimization on the new-API-stack layout."""
 
     def __init__(self, config: AlgorithmConfig):
@@ -106,6 +107,26 @@ class PPO:
             "time_learn_s": round(learn_time, 3),
             **metrics,
         }
+
+    def get_state(self) -> dict:
+        """Checkpointable state: learner params + optimizer state +
+        iteration (reference: Algorithm.save_to_path components)."""
+        return {
+            "iteration": self.iteration,
+            "learner": {
+                "params": tree_to_host(self.learner.params),
+                "opt_state": tree_to_host(self.learner.opt_state),
+            },
+        }
+
+    def set_state(self, state: dict) -> None:
+        import jax
+        self.iteration = int(state["iteration"])
+        self.learner.params = jax.device_put(
+            state["learner"]["params"])
+        self.learner.opt_state = jax.device_put(
+            state["learner"]["opt_state"])
+        self.runners.set_weights(self.learner.get_weights())
 
     def stop(self) -> None:
         self.runners.shutdown()

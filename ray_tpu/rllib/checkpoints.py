@@ -1,0 +1,68 @@
+"""Algorithm checkpointing (reference: the ``Checkpointable`` mixin,
+``rllib/utils/checkpoints.py`` — Algorithm/Learner components save and
+restore their state trees so long trainings resume).
+
+The mixin works over a ``get_state()``/``set_state()`` contract and
+writes through ``ray_tpu.util.storage``, so a checkpoint lands on
+local disk or any registered scheme (``mock-s3://…``, real clouds) the
+same way train checkpoints do.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+from ray_tpu.util.storage import is_uri, storage_for_uri, uri_join
+
+_STATE_FILE = "algorithm_state.pkl"
+
+
+class Checkpointable:
+    """save_to_path / restore_from_path / from_checkpoint over a
+    get_state/set_state contract."""
+
+    def get_state(self) -> dict:
+        raise NotImplementedError
+
+    def set_state(self, state: dict) -> None:
+        raise NotImplementedError
+
+    def save_to_path(self, path: str) -> str:
+        blob = pickle.dumps(self.get_state())
+        if is_uri(path):
+            uri = uri_join(path, _STATE_FILE)
+            storage_for_uri(uri).write_bytes(uri, blob)
+            return path
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, _STATE_FILE), "wb") as f:
+            f.write(blob)
+        return path
+
+    def restore_from_path(self, path: str) -> None:
+        if is_uri(path):
+            uri = uri_join(path, _STATE_FILE)
+            blob = storage_for_uri(uri).read_bytes(uri)
+        else:
+            with open(os.path.join(path, _STATE_FILE), "rb") as f:
+                blob = f.read()
+        self.set_state(pickle.loads(blob))
+
+    @classmethod
+    def from_checkpoint(cls, path: str, config: Any):
+        """Build a fresh algorithm from ``config`` and restore the
+        checkpointed state into it (reference:
+        Algorithm.from_checkpoint)."""
+        algo = (config.build() if hasattr(config, "build")
+                else cls(config))
+        algo.restore_from_path(path)
+        return algo
+
+
+def tree_to_host(tree):
+    """Device pytree -> plain numpy (picklable, device-independent)."""
+    import jax
+    import numpy as np
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

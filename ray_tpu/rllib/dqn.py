@@ -19,11 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ray_tpu.rllib.catalog import build_q_network
+from ray_tpu.rllib.checkpoints import Checkpointable, tree_to_host
 from ray_tpu.rllib.env_runner import EnvRunnerGroup
-from ray_tpu.rllib.catalog import (
-    build_actor_critic,
-    build_q_network,
-)
 
 
 @dataclass
@@ -161,7 +159,7 @@ class DQNConfig:
         return DQN(self)
 
 
-class DQN:
+class DQN(Checkpointable):
     def __init__(self, config: DQNConfig):
         assert config.env is not None
         self.config = config
@@ -176,6 +174,30 @@ class DQN:
                                    config.policy_config["obs_dim"])
         self.rng = np.random.default_rng(config.seed)
         self.iteration = 0
+        self.runners.set_weights(self.learner.get_weights())
+
+    def get_state(self) -> dict:
+        """Learner params + target net + optimizer + iteration.
+        The replay buffer is deliberately NOT checkpointed (same
+        default as the reference: fresh buffer on resume)."""
+        return {
+            "iteration": self.iteration,
+            "learner": {
+                "params": tree_to_host(self.learner.params),
+                "target_params": tree_to_host(
+                    self.learner.target_params),
+                "opt_state": tree_to_host(self.learner.opt_state),
+            },
+        }
+
+    def set_state(self, state: dict) -> None:
+        import jax
+        self.iteration = int(state["iteration"])
+        lst = state["learner"]
+        self.learner.params = jax.device_put(lst["params"])
+        self.learner.target_params = jax.device_put(
+            lst["target_params"])
+        self.learner.opt_state = jax.device_put(lst["opt_state"])
         self.runners.set_weights(self.learner.get_weights())
 
     def _epsilon(self) -> float:

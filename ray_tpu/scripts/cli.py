@@ -120,13 +120,20 @@ def _cmd_logs(args) -> int:
         print("no logs directory for this session")
         return 1
     if args.file:
-        out = tail_log_file(log_dir, args.file,
-                            args.tail_bytes or (1 << 20))
+        # CLI semantics: --tail-bytes 0 = the WHOLE file; no implicit
+        # size cap (the 1 MiB default bound is for the HTTP viewer).
+        want = args.tail_bytes if args.tail_bytes else (1 << 62)
+        out = tail_log_file(log_dir, args.file, want,
+                            max_bytes=1 << 62)
         if out.get("error"):
             print(f"no such log file: {args.file} "
                   f"(run `logs` with no argument to list)")
             return 1
         sys.stdout.write(out["content"])
+        if out.get("truncated"):
+            print(f"\n[truncated to last {want} bytes; use "
+                  f"--tail-bytes 0 for the whole file]",
+                  file=sys.stderr)
         return 0
     for n in list_log_files(log_dir):
         size = os.path.getsize(os.path.join(log_dir, n))

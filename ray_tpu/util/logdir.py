@@ -19,16 +19,24 @@ def list_log_files(log_dir: str) -> list[str]:
 
 
 def tail_log_file(log_dir: str, fname: str,
-                  tail_bytes: int = 65536) -> dict:
-    """Last ``tail_bytes`` of one log file. ``fname`` is clamped to
-    its basename — no traversal out of the session dir. Returns
-    {file, content, truncated} or {file, content:"", error}."""
+                  tail_bytes: int = 65536,
+                  max_bytes: int = 1 << 20) -> dict:
+    """Last ``tail_bytes`` of one log file (clamped to ``max_bytes``
+    — the dashboard keeps the 1 MiB default as an HTTP response
+    bound; the CLI raises it). ``fname`` is clamped to its basename —
+    no traversal out of the session dir. Returns {file, content,
+    truncated} or {file, content:"", error}."""
     fname = os.path.basename(fname)
-    path = os.path.join(log_dir or "", fname)
+    if not log_dir or not os.path.isdir(log_dir):
+        # A falsy dir must NOT degrade to reading the server
+        # process's cwd (log capture disabled => no logs, period).
+        return {"file": fname, "content": "",
+                "error": "log capture is disabled for this session"}
+    path = os.path.join(log_dir, fname)
     if not os.path.isfile(path):
         return {"file": fname, "content": "",
                 "error": "no such log file"}
-    tail = min(max(int(tail_bytes), 1), 1 << 20)
+    tail = min(max(int(tail_bytes), 1), max_bytes)
     with open(path, "rb") as f:
         f.seek(0, os.SEEK_END)
         size = f.tell()
