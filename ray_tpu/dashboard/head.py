@@ -95,12 +95,69 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(serve.status())
             elif path == "/api/logs":
                 self._send_json(self._logs())
+            elif path == "/api/jobs":
+                from ray_tpu.job_submission import JobSubmissionClient
+                self._send_json([j.__dict__ for j in
+                                 JobSubmissionClient().list_jobs()])
+            elif path.startswith("/api/jobs/"):
+                from ray_tpu.job_submission import JobSubmissionClient
+                parts = path.split("/")
+                sid = parts[3]
+                client = JobSubmissionClient()
+                try:
+                    if len(parts) > 4 and parts[4] == "logs":
+                        self._send_json(
+                            {"logs": client.get_job_logs(sid)})
+                    else:
+                        self._send_json(
+                            client.get_job_info(sid).__dict__)
+                except ValueError as e:
+                    # Unknown job id is a CLIENT error, not a server
+                    # fault (matches the POST path's contract).
+                    self._send(404, json.dumps(
+                        {"error": str(e)}).encode())
             elif path == "/metrics":
                 from ray_tpu.util.metrics import prometheus_text
                 self._send(200, prometheus_text().encode(),
                            "text/plain; version=0.0.4")
             else:
                 self._send(404, b'{"error": "not found"}')
+        except Exception as e:  # noqa: BLE001
+            self._send(500, json.dumps({"error": str(e)}).encode())
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        """Job REST API (reference: the dashboard job module's REST
+        endpoints backing JobSubmissionClient): POST /api/jobs
+        {entrypoint, runtime_env?, metadata?, submission_id?}
+        submits; POST /api/jobs/<id>/stop stops."""
+        path = self.path.split("?")[0].rstrip("/")
+        try:
+            from ray_tpu.job_submission import JobSubmissionClient
+            client = JobSubmissionClient()
+            if path == "/api/jobs":
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict) or \
+                        not body.get("entrypoint"):
+                    self._send(400, json.dumps(
+                        {"error": "body must be a JSON object with "
+                                  "an 'entrypoint'"}).encode())
+                    return
+                sid = client.submit_job(
+                    entrypoint=body["entrypoint"],
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"),
+                    submission_id=body.get("submission_id"))
+                self._send_json({"submission_id": sid})
+                return
+            parts = path.split("/")
+            if (len(parts) == 5 and parts[1] == "api"
+                    and parts[2] == "jobs" and parts[4] == "stop"):
+                self._send_json({"stopped": client.stop_job(parts[3])})
+                return
+            self._send(404, b'{"error": "not found"}')
+        except ValueError as e:
+            self._send(400, json.dumps({"error": str(e)}).encode())
         except Exception as e:  # noqa: BLE001
             self._send(500, json.dumps({"error": str(e)}).encode())
 

@@ -108,15 +108,48 @@ class _JobSupervisor:
                 self._proc.kill()
 
 
+_JOBS_NS = "jobs"
+
+
 class JobSubmissionClient:
-    """Submit/inspect/stop jobs against the local runtime."""
+    """Submit/inspect/stop jobs against the local runtime.
+
+    The job table lives in internal KV and supervisors are NAMED
+    actors, so EVERY client instance — other processes, the
+    dashboard's REST endpoints — sees every job (reference: the job
+    table lives in the GCS, dashboard/modules/job)."""
 
     def __init__(self, address: str | None = None):
         import ray_tpu
         if not ray_tpu.is_initialized():
             ray_tpu.init(ignore_reinit_error=True)
         self._ray = ray_tpu
-        self._jobs: dict[str, tuple] = {}  # id -> (handle, JobInfo)
+        self._handles: dict[str, object] = {}   # sid -> actor handle
+
+    def _kv(self):
+        from ray_tpu.experimental import internal_kv
+        return internal_kv
+
+    def _put_info(self, info: "JobInfo") -> None:
+        import pickle
+        self._kv()._kv_put(b"job:" + info.submission_id.encode(),
+                           pickle.dumps(info), namespace=_JOBS_NS)
+
+    def _put_info_if_present(self, info: "JobInfo") -> None:
+        """Persist ONLY when the table entry still exists — a
+        concurrent delete_job must win (no resurrecting deleted
+        jobs from a racing reader)."""
+        key = b"job:" + info.submission_id.encode()
+        if self._kv()._kv_get(key, namespace=_JOBS_NS) is not None:
+            self._put_info(info)
+
+    def _get_info(self, sid: str) -> "JobInfo":
+        import pickle
+        raw = self._kv()._kv_get(b"job:" + sid.encode(),
+                                 namespace=_JOBS_NS)
+        if raw is None:
+            raise ValueError(f"unknown job {sid!r}")
+        return pickle.loads(raw)
 
     def submit_job(self, *, entrypoint: str,
                    submission_id: str | None = None,
@@ -124,7 +157,8 @@ class JobSubmissionClient:
                    metadata: dict | None = None) -> str:
         import ray_tpu
         sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
-        if sid in self._jobs:
+        if self._kv()._kv_get(b"job:" + sid.encode(),
+                              namespace=_JOBS_NS) is not None:
             raise ValueError(f"submission_id {sid!r} already exists")
         # Full runtime_env build (staging, plugins, pip gating) —
         # failures surface here at submission time.
@@ -140,24 +174,56 @@ class JobSubmissionClient:
                        status=JobStatus.PENDING,
                        start_time=time.time(),
                        metadata=dict(metadata or {}))
-        self._jobs[sid] = (handle, info)
+        self._handles[sid] = handle
+        self._put_info(info)
         return sid
 
     def _handle(self, sid: str):
-        if sid not in self._jobs:
-            raise ValueError(f"unknown job {sid!r}")
-        return self._jobs[sid][0]
+        h = self._handles.get(sid)
+        if h is None:
+            if self._kv()._kv_get(b"job:" + sid.encode(),
+                                  namespace=_JOBS_NS) is None:
+                raise ValueError(f"unknown job {sid!r}")
+            # Another client submitted it: reconnect through the
+            # supervisor's well-known actor name.
+            h = self._ray.get_actor(f"_job_supervisor_{sid}")
+            self._handles[sid] = h
+        return h
 
     def get_job_status(self, submission_id: str) -> str:
-        return self._ray.get(
-            self._handle(submission_id).status.remote(), timeout=60)
+        # Through get_job_info: shares its KV fallback, so a job
+        # whose supervisor is gone still reports its persisted
+        # terminal state instead of raising.
+        return self.get_job_info(submission_id).status
 
     def get_job_info(self, submission_id: str) -> JobInfo:
-        handle, info = self._jobs[submission_id]
-        d = self._ray.get(handle.info.remote(), timeout=60)
-        info.status = d["status"]
-        info.end_time = d["end_time"]
-        info.return_code = d["return_code"]
+        info = self._get_info(submission_id)
+        if info.status in JobStatus.TERMINAL:
+            # KV is authoritative for finished jobs: no supervisor
+            # RPC, no redundant rewrite.
+            return info
+        try:
+            handle = self._handle(submission_id)
+            d = self._ray.get(handle.info.remote(), timeout=60)
+            info.status = d["status"]
+            info.end_time = d["end_time"]
+            info.return_code = d["return_code"]
+            if info.status in JobStatus.TERMINAL:
+                self._put_info_if_present(info)
+        except Exception as e:  # noqa: BLE001
+            from ray_tpu.core.exceptions import ActorDiedError
+            if isinstance(e, (ValueError, ActorDiedError)):
+                # Supervisor actor permanently gone while the table
+                # says non-terminal: the job can never report again —
+                # mark it failed (reference: jobs whose supervisor
+                # dies are FAILED).
+                info.status = JobStatus.FAILED
+                info.end_time = info.end_time or time.time()
+                self._put_info_if_present(info)
+                self._handles.pop(submission_id, None)
+            # Transient errors (RPC timeout on a loaded box): return
+            # the last known state unchanged — never poison the
+            # table over a hiccup.
         return info
 
     def get_job_logs(self, submission_id: str) -> str:
@@ -170,7 +236,9 @@ class JobSubmissionClient:
         return True
 
     def list_jobs(self) -> list[JobInfo]:
-        return [self.get_job_info(sid) for sid in list(self._jobs)]
+        keys = self._kv()._kv_list(b"job:", namespace=_JOBS_NS)
+        sids = sorted(k.decode()[len("job:"):] for k in keys)
+        return [self.get_job_info(sid) for sid in sids]
 
     def wait_until_finished(self, submission_id: str,
                             timeout: float = 600,
@@ -185,12 +253,14 @@ class JobSubmissionClient:
             f"job {submission_id} not finished after {timeout}s")
 
     def delete_job(self, submission_id: str) -> bool:
-        handle, _ = self._jobs.pop(submission_id, (None, None))
-        if handle is not None:
-            try:
-                self._ray.kill(handle)
-            except Exception:  # noqa: BLE001
-                pass
+        try:
+            handle = self._handle(submission_id)
+            self._ray.kill(handle)
+        except Exception:  # noqa: BLE001
+            pass
+        self._handles.pop(submission_id, None)
+        self._kv()._kv_del(b"job:" + submission_id.encode(),
+                           namespace=_JOBS_NS)
         return True
 
 

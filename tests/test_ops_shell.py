@@ -231,3 +231,76 @@ def test_dashboard_logs_api(rt):
             "content", "")
     finally:
         dash.stop()
+
+
+def test_jobs_rest_api(rt):
+    """Job REST API (reference: dashboard/modules/job REST behind
+    JobSubmissionClient): POST submits, GET lists/inspects/tails,
+    POST /stop stops — and the KV-backed job table makes jobs
+    visible across client instances."""
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    from ray_tpu.dashboard.head import start_dashboard
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    dash = start_dashboard(port=0)
+    try:
+        req = urllib.request.Request(
+            dash.url + "/api/jobs",
+            data=_json.dumps({
+                "entrypoint":
+                    "python -c \"print('rest-job-output')\"",
+            }).encode(), method="POST")
+        sid = _json.loads(urllib.request.urlopen(
+            req, timeout=60).read())["submission_id"]
+        # A FRESH client sees the job (KV-backed table).
+        JobSubmissionClient().wait_until_finished(sid, timeout=120)
+        jobs = _json.loads(urllib.request.urlopen(
+            dash.url + "/api/jobs", timeout=30).read())
+        assert any(j["submission_id"] == sid for j in jobs)
+        info = _json.loads(urllib.request.urlopen(
+            dash.url + f"/api/jobs/{sid}", timeout=30).read())
+        assert info["status"] == "SUCCEEDED", info
+        deadline = _time.time() + 30
+        logs = ""
+        while _time.time() < deadline:
+            logs = _json.loads(urllib.request.urlopen(
+                dash.url + f"/api/jobs/{sid}/logs",
+                timeout=30).read())["logs"]
+            if "rest-job-output" in logs:
+                break
+            _time.sleep(0.3)
+        assert "rest-job-output" in logs
+        # missing entrypoint -> 400
+        bad = urllib.request.Request(
+            dash.url + "/api/jobs", data=b"{}", method="POST")
+        import urllib.error
+        try:
+            urllib.request.urlopen(bad, timeout=30)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        dash.stop()
+
+
+def test_jobs_rest_unknown_id_is_404(rt):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    import pytest as _pytest
+
+    from ray_tpu.dashboard.head import start_dashboard
+
+    dash = start_dashboard(port=0)
+    try:
+        with _pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                dash.url + "/api/jobs/raysubmit_nope", timeout=30)
+        assert ei.value.code == 404
+        assert "unknown job" in _json.loads(ei.value.read())["error"]
+    finally:
+        dash.stop()
