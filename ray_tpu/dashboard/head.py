@@ -153,7 +153,13 @@ class _Handler(BaseHTTPRequestHandler):
             parts = path.split("/")
             if (len(parts) == 5 and parts[1] == "api"
                     and parts[2] == "jobs" and parts[4] == "stop"):
-                self._send_json({"stopped": client.stop_job(parts[3])})
+                try:
+                    self._send_json(
+                        {"stopped": client.stop_job(parts[3])})
+                except ValueError as e:
+                    # Unknown id -> 404, same contract as GET.
+                    self._send(404, json.dumps(
+                        {"error": str(e)}).encode())
                 return
             self._send(404, b'{"error": "not found"}')
         except ValueError as e:

@@ -136,18 +136,27 @@ class JobSubmissionClient:
                            pickle.dumps(info), namespace=_JOBS_NS)
 
     def _put_info_if_present(self, info: "JobInfo") -> None:
-        """Persist ONLY when the table entry still exists — a
-        concurrent delete_job must win (no resurrecting deleted
-        jobs from a racing reader)."""
-        key = b"job:" + info.submission_id.encode()
+        """Persist ONLY when the table entry still exists and was not
+        tombstoned — a concurrent delete_job must win. The get/put
+        pair is not atomic, so delete_job ALSO writes a tombstone:
+        even a racing re-put leaves the job invisible (readers filter
+        tombstoned ids)."""
+        sid = info.submission_id
+        key = b"job:" + sid.encode()
+        if self._tombstoned(sid):
+            return
         if self._kv()._kv_get(key, namespace=_JOBS_NS) is not None:
             self._put_info(info)
+
+    def _tombstoned(self, sid: str) -> bool:
+        return self._kv()._kv_get(b"job_deleted:" + sid.encode(),
+                                  namespace=_JOBS_NS) is not None
 
     def _get_info(self, sid: str) -> "JobInfo":
         import pickle
         raw = self._kv()._kv_get(b"job:" + sid.encode(),
                                  namespace=_JOBS_NS)
-        if raw is None:
+        if raw is None or self._tombstoned(sid):
             raise ValueError(f"unknown job {sid!r}")
         return pickle.loads(raw)
 
@@ -227,8 +236,25 @@ class JobSubmissionClient:
         return info
 
     def get_job_logs(self, submission_id: str) -> str:
-        return self._ray.get(
-            self._handle(submission_id).logs.remote(), timeout=60)
+        try:
+            logs = self._ray.get(
+                self._handle(submission_id).logs.remote(), timeout=60)
+            # Best-effort persistence for after the supervisor dies.
+            if self._get_info(submission_id).status in \
+                    JobStatus.TERMINAL:
+                self._kv()._kv_put(
+                    b"job_logs:" + submission_id.encode(),
+                    logs[-(1 << 20):].encode("utf-8", "replace"),
+                    namespace=_JOBS_NS)
+            return logs
+        except ValueError:
+            # Supervisor gone (or never known here): fall back to the
+            # persisted tail — but only for jobs the table knows.
+            self._get_info(submission_id)     # raises if unknown
+            raw = self._kv()._kv_get(
+                b"job_logs:" + submission_id.encode(),
+                namespace=_JOBS_NS)
+            return (raw or b"").decode("utf-8", "replace")
 
     def stop_job(self, submission_id: str) -> bool:
         self._ray.get(self._handle(submission_id).stop.remote(),
@@ -238,7 +264,13 @@ class JobSubmissionClient:
     def list_jobs(self) -> list[JobInfo]:
         keys = self._kv()._kv_list(b"job:", namespace=_JOBS_NS)
         sids = sorted(k.decode()[len("job:"):] for k in keys)
-        return [self.get_job_info(sid) for sid in sids]
+        out = []
+        for sid in sids:
+            try:
+                out.append(self.get_job_info(sid))
+            except ValueError:
+                pass            # tombstoned/deleted mid-listing
+        return out
 
     def wait_until_finished(self, submission_id: str,
                             timeout: float = 600,
@@ -259,7 +291,13 @@ class JobSubmissionClient:
         except Exception:  # noqa: BLE001
             pass
         self._handles.pop(submission_id, None)
+        # Tombstone FIRST: a reader racing the delete may re-put the
+        # info entry, but tombstoned ids stay invisible forever.
+        self._kv()._kv_put(b"job_deleted:" + submission_id.encode(),
+                           b"1", namespace=_JOBS_NS)
         self._kv()._kv_del(b"job:" + submission_id.encode(),
+                           namespace=_JOBS_NS)
+        self._kv()._kv_del(b"job_logs:" + submission_id.encode(),
                            namespace=_JOBS_NS)
         return True
 

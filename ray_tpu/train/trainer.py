@@ -33,6 +33,9 @@ class Result:
     path: str
     metrics_history: list[dict[str, Any]] = field(default_factory=list)
     error: str | None = None
+    # When RunConfig.storage_path is a URI: the mirrored location of
+    # the final checkpoint in remote storage.
+    remote_checkpoint_uri: str | None = None
 
     @property
     def checkpoint(self):
@@ -70,7 +73,28 @@ class JaxTrainer:
 
     def fit(self) -> Result:
         name = self.run_config.name or f"train_{int(time.time())}"
-        trial_dir = os.path.join(self.run_config.storage_path, name)
+        from ray_tpu.util.storage import is_uri
+        remote_uri = None
+        if is_uri(self.run_config.storage_path):
+            # Remote storage_path (reference: StorageContext's
+            # fs/S3/GS URIs, storage.py:352): run against a local
+            # staging dir, mirror the trial tree to the URI at every
+            # exit — a TPU pod's results and checkpoints land
+            # off-host. Workers still write to the staging dir
+            # (single host or shared FS), exactly the reference's
+            # local-then-upload flow.
+            import tempfile
+            from ray_tpu.util.storage import uri_join
+            remote_uri = uri_join(self.run_config.storage_path, name)
+            # UNIQUE staging per fit(): a shared fixed dir would
+            # mirror a previous run's files into this run's URI.
+            base = "/tmp/ray_tpu_sessions/experiments_staging"
+            os.makedirs(base, exist_ok=True)
+            trial_dir = tempfile.mkdtemp(prefix=f"{name}_",
+                                         dir=base)
+        else:
+            trial_dir = os.path.join(self.run_config.storage_path,
+                                     name)
         os.makedirs(trial_dir, exist_ok=True)
 
         max_failures = self.run_config.failure_config.max_failures
@@ -84,7 +108,9 @@ class JaxTrainer:
             preexisting = frozenset()
         while True:
             try:
-                return self._fit_once(trial_dir, restored)
+                return self._mirror(trial_dir, remote_uri,
+                                    self._fit_once(trial_dir,
+                                                   restored))
             except _WorkerGroupError as e:
                 attempt += 1
                 # Workers persist checkpoints to storage before the
@@ -95,10 +121,35 @@ class JaxTrainer:
                     trial_dir, e.latest_ckpt, exclude=preexisting,
                     world_size=self.scaling.num_workers)
                 if max_failures >= 0 and attempt > max_failures:
-                    return Result(metrics={}, checkpoint_dir=latest,
-                                  path=trial_dir, error=e.error)
+                    return self._mirror(trial_dir, remote_uri, Result(
+                        metrics={}, checkpoint_dir=latest,
+                        path=trial_dir, error=e.error))
                 # Elastic slice restart from the latest checkpoint.
                 restored = latest
+
+    def _mirror(self, trial_dir: str, remote_uri: str | None,
+                result: Result) -> Result:
+        if remote_uri is None:
+            return result
+        from ray_tpu.util.storage import storage_for_uri, uri_join
+        try:
+            storage_for_uri(remote_uri).upload_dir(trial_dir,
+                                                   remote_uri)
+        except Exception as e:  # noqa: BLE001
+            # A failed mirror must NOT discard a finished Result —
+            # everything still exists locally; surface the problem
+            # on the result instead of raising away hours of work.
+            result.error = (result.error or "") + (
+                f" remote mirror to {remote_uri} failed: {e} "
+                f"(local copy intact at {trial_dir})").strip()
+            return result
+        result.path = remote_uri
+        if result.checkpoint_dir:
+            rel = os.path.relpath(result.checkpoint_dir, trial_dir)
+            if not rel.startswith(".."):
+                result.remote_checkpoint_uri = uri_join(remote_uri,
+                                                        rel)
+        return result
 
     # -- internals --
 

@@ -142,6 +142,16 @@ class Tuner:
         scheduler = tc.scheduler or FIFOScheduler()
 
         exp_name = self.run_config.name or f"tune_{int(time.time())}"
+        from ray_tpu.util.storage import is_uri
+        if is_uri(self.run_config.storage_path):
+            # JaxTrainer mirrors URI storage_paths; the Tuner's
+            # experiment-journal machinery is local-path only so far.
+            # Fail loudly instead of silently creating a literal
+            # "scheme:/..." directory on local disk.
+            raise ValueError(
+                "Tuner does not support URI storage_path yet "
+                f"({self.run_config.storage_path!r}); use a "
+                "local/NFS path — JaxTrainer.fit supports URIs")
         exp_dir = os.path.join(self.run_config.storage_path, exp_name)
         os.makedirs(exp_dir, exist_ok=True)
 

@@ -147,3 +147,41 @@ def test_local_storage_paths(tmp_path):
     st.write_bytes(p, b"x")
     assert st.read_bytes("file://" + p) == b"x"
     assert isinstance(storage_for_uri(p), LocalStorage)
+
+
+def test_trainer_storage_path_uri(s3root, rt):
+    """RunConfig.storage_path as a URI: the trial tree (metrics +
+    checkpoints) mirrors to remote storage at fit() exit and the
+    Result points at the remote checkpoint (reference:
+    StorageContext's local-then-upload flow)."""
+    from ray_tpu.train import (
+        Checkpoint,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+        report,
+    )
+
+    def loop(config):
+        import os as _os
+        import tempfile
+        d = tempfile.mkdtemp()
+        with open(_os.path.join(d, "w.txt"), "w") as f:
+            f.write("weights!")
+        report({"loss": 0.25},
+               checkpoint=Checkpoint.from_directory(d))
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="uri_trial",
+            storage_path="mock-s3://experiments"),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.path == "mock-s3://experiments/uri_trial"
+    assert result.remote_checkpoint_uri, result
+    st = storage_for_uri(result.remote_checkpoint_uri)
+    content = st.read_bytes(
+        uri_join(result.remote_checkpoint_uri, "w.txt"))
+    assert content == b"weights!"
