@@ -83,15 +83,10 @@ class JaxTrainer:
             # off-host. Workers still write to the staging dir
             # (single host or shared FS), exactly the reference's
             # local-then-upload flow.
-            import tempfile
-            from ray_tpu.util.storage import uri_join
+            from ray_tpu.util.storage import stage_dir, uri_join
             remote_uri = uri_join(self.run_config.storage_path, name)
-            # UNIQUE staging per fit(): a shared fixed dir would
-            # mirror a previous run's files into this run's URI.
-            base = "/tmp/ray_tpu_sessions/experiments_staging"
-            os.makedirs(base, exist_ok=True)
-            trial_dir = tempfile.mkdtemp(prefix=f"{name}_",
-                                         dir=base)
+            trial_dir = stage_dir(
+                "/tmp/ray_tpu_sessions/experiments_staging", name)
         else:
             trial_dir = os.path.join(self.run_config.storage_path,
                                      name)
@@ -131,17 +126,13 @@ class JaxTrainer:
                 result: Result) -> Result:
         if remote_uri is None:
             return result
-        from ray_tpu.util.storage import storage_for_uri, uri_join
-        try:
-            storage_for_uri(remote_uri).upload_dir(trial_dir,
-                                                   remote_uri)
-        except Exception as e:  # noqa: BLE001
+        from ray_tpu.util.storage import mirror_dir, uri_join
+        err = mirror_dir(trial_dir, remote_uri)
+        if err:
             # A failed mirror must NOT discard a finished Result —
             # everything still exists locally; surface the problem
             # on the result instead of raising away hours of work.
-            result.error = (result.error or "") + (
-                f" remote mirror to {remote_uri} failed: {e} "
-                f"(local copy intact at {trial_dir})").strip()
+            result.error = ((result.error or "") + " " + err).strip()
             return result
         result.path = remote_uri
         if result.checkpoint_dir:

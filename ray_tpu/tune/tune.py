@@ -102,12 +102,17 @@ class Tuner:
                  param_space: dict | None = None,
                  tune_config: TuneConfig | None = None,
                  run_config: RunConfig | None = None,
-                 _restore_trials: list[Trial] | None = None):
+                 _restore_trials: list[Trial] | None = None,
+                 _restore_exp_dir: str | None = None):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self._restore_trials = _restore_trials
+        # Restored experiments keep their original (possibly remote)
+        # exp_dir semantics: a URI restore must re-mirror back to the
+        # SAME remote location under the SAME name.
+        self._restore_exp_dir = _restore_exp_dir
 
     @classmethod
     def restore(cls, exp_dir: str, trainable: Callable | Any,
@@ -116,15 +121,38 @@ class Tuner:
         completed trials keep their results; pending/running/errored
         trials are re-run (from their latest checkpoint when the
         trainable consumes ``restored_checkpoint_dir``)."""
-        state_file = os.path.join(exp_dir, "experiment_state.json")
+        from ray_tpu.util.storage import is_uri, storage_for_uri
+        orig_exp_dir = exp_dir
+        if is_uri(exp_dir):
+            # Restore from a mirrored experiment: download the tree
+            # into a staging dir and resume from there. The resumed
+            # fit() re-mirrors to the SAME remote exp dir.
+            import tempfile
+            staging = tempfile.mkdtemp(prefix="tune_restore_")
+            storage_for_uri(exp_dir).download_dir(exp_dir, staging)
+            local_dir = staging
+        else:
+            local_dir = exp_dir
+        state_file = os.path.join(local_dir, "experiment_state.json")
         with open(state_file) as f:
             state = json.load(f)
+        exp_name = state.get("name") or os.path.basename(
+            orig_exp_dir.rstrip("/"))
         trials = []
         for row in state["trials"]:
+            ckpt = row["checkpoint_dir"]
+            if ckpt and not os.path.isabs(ckpt):
+                # Journals store checkpoint dirs RELATIVE to exp_dir
+                # so a mirrored experiment restores on any host:
+                # rebase onto the downloaded tree.
+                ckpt = os.path.join(local_dir, ckpt)
+            if ckpt and not os.path.isdir(ckpt):
+                ckpt = None      # checkpoint not in the mirror:
+                #                  the trial restarts from scratch
             t = Trial(trial_id=row["trial_id"], config=row["config"],
                       state=row["state"], metrics=row["metrics"],
                       history=row["history"],
-                      checkpoint_dir=row["checkpoint_dir"],
+                      checkpoint_dir=ckpt,
                       error=row["error"])
             if t.state != "COMPLETED":
                 t.state = "PENDING"
@@ -132,10 +160,13 @@ class Tuner:
                 t.metrics, t.history, t.error = {}, [], None
             trials.append(t)
         run_config = RunConfig(
-            name=os.path.basename(exp_dir.rstrip("/")),
-            storage_path=os.path.dirname(exp_dir.rstrip("/")))
+            name=exp_name,
+            storage_path=(os.path.dirname(orig_exp_dir.rstrip("/"))
+                          if not is_uri(orig_exp_dir) else
+                          orig_exp_dir.rsplit("/", 1)[0]))
         return cls(trainable, tune_config=tune_config,
-                   run_config=run_config, _restore_trials=trials)
+                   run_config=run_config, _restore_trials=trials,
+                   _restore_exp_dir=local_dir)
 
     def fit(self) -> ResultGrid:
         tc = self.tune_config
@@ -143,17 +174,27 @@ class Tuner:
 
         exp_name = self.run_config.name or f"tune_{int(time.time())}"
         from ray_tpu.util.storage import is_uri
+        remote_uri = None
         if is_uri(self.run_config.storage_path):
-            # JaxTrainer mirrors URI storage_paths; the Tuner's
-            # experiment-journal machinery is local-path only so far.
-            # Fail loudly instead of silently creating a literal
-            # "scheme:/..." directory on local disk.
-            raise ValueError(
-                "Tuner does not support URI storage_path yet "
-                f"({self.run_config.storage_path!r}); use a "
-                "local/NFS path — JaxTrainer.fit supports URIs")
-        exp_dir = os.path.join(self.run_config.storage_path, exp_name)
+            # URI storage_path: run against a unique local staging
+            # dir, mirror the whole experiment tree (journal, trial
+            # dirs, checkpoints) to the URI at fit() exit, and the
+            # (small) journal on EVERY save so an interrupted run is
+            # restorable from the remote — same stage-then-upload
+            # flow as JaxTrainer (reference: StorageContext).
+            from ray_tpu.util.storage import stage_dir, uri_join
+            remote_uri = uri_join(self.run_config.storage_path,
+                                  exp_name)
+            exp_dir = (self._restore_exp_dir
+                       or stage_dir(
+                           "/tmp/ray_tpu_sessions/tune_staging",
+                           exp_name))
+        else:
+            exp_dir = os.path.join(self.run_config.storage_path,
+                                   exp_name)
         os.makedirs(exp_dir, exist_ok=True)
+        self._exp_name = exp_name
+        self._remote_uri = remote_uri
 
         fn = _as_function_trainable(self.trainable)
         max_conc = tc.max_concurrent_trials or self._resource_bound(tc)
@@ -221,6 +262,12 @@ class Tuner:
             trial_id=t.trial_id, config=t.config, metrics=t.metrics,
             metrics_history=t.history, checkpoint_dir=t.checkpoint_dir,
             state=t.state, error=t.error) for t in trials]
+        if remote_uri is not None:
+            from ray_tpu.util.storage import mirror_dir
+            err = mirror_dir(exp_dir, remote_uri)
+            if err:
+                import warnings
+                warnings.warn(f"tune experiment {exp_name!r}: {err}")
         return ResultGrid(results)
 
     # -- internals --
@@ -231,10 +278,21 @@ class Tuner:
         return max(1, int(total.get("CPU", 1.0) // per))
 
     def _save_state(self, exp_dir: str, trials: list[Trial]) -> None:
-        state = {"trials": [
+        def rel_ckpt(p):
+            # Relative-to-exp_dir checkpoint paths make the journal
+            # portable: a mirrored experiment restores on any host
+            # by rebasing onto the downloaded tree.
+            if p and os.path.isabs(p):
+                r = os.path.relpath(p, exp_dir)
+                return r if not r.startswith("..") else p
+            return p
+
+        state = {"name": getattr(self, "_exp_name", None),
+                 "trials": [
             {"trial_id": t.trial_id, "config": t.config,
              "state": t.state, "metrics": t.metrics,
-             "history": t.history, "checkpoint_dir": t.checkpoint_dir,
+             "history": t.history,
+             "checkpoint_dir": rel_ckpt(t.checkpoint_dir),
              "error": t.error} for t in trials]}
         tmp = os.path.join(exp_dir, ".experiment_state.tmp")
         try:
@@ -243,7 +301,20 @@ class Tuner:
             os.replace(tmp,
                        os.path.join(exp_dir, "experiment_state.json"))
         except (OSError, TypeError):
-            pass   # non-serializable config — resume unsupported
+            return   # non-serializable config — resume unsupported
+        remote = getattr(self, "_remote_uri", None)
+        if remote is not None:
+            # Journal mirrors on EVERY save (it is small): an
+            # interrupted URI run stays restorable from the remote.
+            from ray_tpu.util.storage import storage_for_uri, uri_join
+            try:
+                with open(os.path.join(
+                        exp_dir, "experiment_state.json"), "rb") as f:
+                    storage_for_uri(remote).write_bytes(
+                        uri_join(remote, "experiment_state.json"),
+                        f.read())
+            except Exception:  # noqa: BLE001 — best-effort mid-run
+                pass
 
     def _start_trial(self, t: Trial, fn, exp_dir: str,
                      tc: TuneConfig, scheduler) -> None:
@@ -257,7 +328,8 @@ class Tuner:
                        if k != "CPU"},
         ).remote(0, 1, {})
         ctx_kwargs = {
-            "experiment_name": os.path.basename(exp_dir),
+            "experiment_name": getattr(self, "_exp_name",
+                                       os.path.basename(exp_dir)),
             "storage_path": self.run_config.storage_path,
             "trial_dir": trial_dir,
             "restored_checkpoint_dir": t.restore_from,

@@ -210,6 +210,26 @@ register_storage("file", LocalStorage)
 register_storage("mock-s3", MockS3Storage)
 
 
+def stage_dir(base: str, name: str) -> str:
+    """Unique local staging dir for a named run mirrored to a URI
+    (shared by JaxTrainer and Tuner — a fixed shared dir would leak
+    a previous run's files into the next run's remote tree)."""
+    import tempfile
+    os.makedirs(base, exist_ok=True)
+    return tempfile.mkdtemp(prefix=f"{name}_", dir=base)
+
+
+def mirror_dir(local_dir: str, uri: str) -> str | None:
+    """Upload a tree; returns an error description instead of raising
+    (a failed mirror must never discard finished local results)."""
+    try:
+        storage_for_uri(uri).upload_dir(local_dir, uri)
+        return None
+    except Exception as e:  # noqa: BLE001
+        return (f"remote mirror to {uri} failed: {e} "
+                f"(local copy intact at {local_dir})")
+
+
 def storage_for_uri(uri: str) -> Storage:
     scheme = uri.split("://", 1)[0] if is_uri(uri) else "file"
     with _lock:

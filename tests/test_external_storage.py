@@ -185,3 +185,82 @@ def test_trainer_storage_path_uri(s3root, rt):
     content = st.read_bytes(
         uri_join(result.remote_checkpoint_uri, "w.txt"))
     assert content == b"weights!"
+
+
+def test_tuner_storage_path_uri(s3root, rt):
+    """Tuner with a URI storage_path: the experiment tree (journal +
+    results) mirrors to remote storage, and Tuner.restore accepts
+    the remote URI directly."""
+    from ray_tpu.tune import TuneConfig, Tuner, grid_search
+
+    def trainable(config):
+        from ray_tpu.train import report
+        report({"score": config["x"] * 2})
+
+    from ray_tpu.train.config import RunConfig
+    grid = Tuner(
+        trainable,
+        param_space={"x": grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="uri_exp",
+                             storage_path="mock-s3://tune"),
+    ).fit()
+    assert len(grid) == 3
+    assert grid.get_best_result("score", "max").metrics["score"] == 6
+    uri = "mock-s3://tune/uri_exp"
+    keys = storage_for_uri(uri).list_keys(uri)
+    assert "experiment_state.json" in keys, keys
+    # Restore straight from the remote mirror.
+    restored = Tuner.restore(uri, trainable)
+    grid2 = restored.fit()
+    assert len(grid2) == 3
+    assert grid2.get_best_result("score", "max").metrics[
+        "score"] == 6
+
+
+def test_tuner_uri_restore_remirrors_and_rebases(s3root, rt):
+    """Restore-from-URI must (a) rebase journal checkpoint paths onto
+    the downloaded tree and (b) re-mirror the resumed experiment back
+    to the SAME remote location under the SAME name."""
+    import json as _json
+
+    from ray_tpu.train.config import RunConfig
+    from ray_tpu.tune import TuneConfig, Tuner, grid_search
+
+    def trainable(config):
+        from ray_tpu.train import Checkpoint, get_context, report
+        import os as _os
+        import tempfile
+        ctx = get_context()
+        # experiment_name must be the configured run name, not a
+        # mangled staging-dir basename.
+        assert ctx.experiment_name == "remirror_exp", \
+            ctx.experiment_name
+        d = tempfile.mkdtemp()
+        open(_os.path.join(d, "ck.txt"), "w").write(
+            str(config["x"]))
+        report({"score": config["x"]},
+               checkpoint=Checkpoint.from_directory(d))
+
+    uri = "mock-s3://tune2/remirror_exp"
+    Tuner(trainable, param_space={"x": grid_search([1, 2])},
+          tune_config=TuneConfig(metric="score", mode="max"),
+          run_config=RunConfig(name="remirror_exp",
+                               storage_path="mock-s3://tune2")).fit()
+    st = storage_for_uri(uri)
+    journal = _json.loads(st.read_bytes(
+        uri_join(uri, "experiment_state.json")))
+    assert journal["name"] == "remirror_exp"
+    # journaled checkpoint paths are portable (relative)
+    for row in journal["trials"]:
+        if row["checkpoint_dir"]:
+            import os as _os
+            assert not _os.path.isabs(row["checkpoint_dir"]), row
+
+    restored = Tuner.restore(uri, trainable)
+    grid2 = restored.fit()
+    assert len(grid2) == 2
+    # the resumed run re-mirrored to the SAME uri (journal updated)
+    journal2 = _json.loads(st.read_bytes(
+        uri_join(uri, "experiment_state.json")))
+    assert journal2["name"] == "remirror_exp"
