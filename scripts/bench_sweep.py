@@ -1,0 +1,101 @@
+"""One-shot config sweep for the GPT-2 headline bench.
+
+Runs ``bench.py --gpt2`` children across a grid of env-tunable knobs
+(batch, CE chunk/unroll, flash block sizes) and prints one JSON line
+per config plus a final ranking. Run ON AN IDLE HOST with the chip
+free — each config costs a full gpt2 child (~60-120 s warm-cache).
+
+    python scripts/bench_sweep.py                 # default grid
+    python scripts/bench_sweep.py --configs '[{"RAY_TPU_CE_UNROLL":"2"}]'
+
+The sweep is an engineering probe: results guide the default config
+baked into bench.py, nothing is banked.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+DEFAULT_GRID: list[dict[str, str]] = [
+    {},                                           # current defaults
+    {"RAY_TPU_CE_UNROLL": "2"},
+    {"RAY_TPU_CE_CHUNK": "4096"},
+    {"RAY_TPU_CE_CHUNK": "4096", "RAY_TPU_CE_UNROLL": "2"},
+    {"RAY_TPU_CE_CHUNK": "8192"},
+    {"RAY_TPU_BENCH_BATCH": "16"},
+    {"RAY_TPU_BENCH_BATCH": "48"},
+]
+
+
+def run_one(env_over: dict[str, str], timeout: float) -> dict:
+    env = dict(os.environ)
+    env.update(env_over)
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--gpt2"], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, start_new_session=True, env=env,
+        cwd=REPO, text=True)
+    t0 = time.perf_counter()
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        return {"env": env_over, "error": f"timeout {timeout:.0f}s"}
+    for line in reversed((out or "").strip().splitlines()):
+        if line.strip().startswith("{"):
+            try:
+                res = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            return {"env": env_over, "value": res.get("value", 0.0),
+                    "wall_s": round(time.perf_counter() - t0, 1),
+                    "error": res.get("error"),
+                    "extra": res.get("extra", {})}
+    tail = (err or out or "").strip().splitlines()[-3:]
+    return {"env": env_over,
+            "error": (" | ".join(tail) or "no output")[:300]}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default=None,
+                    help="JSON list of env-override dicts")
+    ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--repeat", type=int, default=1,
+                    help="repeats per config (keep best)")
+    args = ap.parse_args()
+    grid = (json.loads(args.configs) if args.configs
+            else DEFAULT_GRID)
+
+    results = []
+    for cfg in grid:
+        best = None
+        for _ in range(max(1, args.repeat)):
+            r = run_one(cfg, args.timeout)
+            print(json.dumps(r), flush=True)
+            if r.get("value") and (best is None
+                                   or r["value"] > best["value"]):
+                best = r
+        results.append(best or {"env": cfg, "value": 0.0})
+
+    ranked = sorted((r for r in results if r.get("value")),
+                    key=lambda r: -r["value"])
+    print(json.dumps({"ranking": [
+        {"env": r["env"], "value": r["value"]} for r in ranked]},
+        indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Produce PERF_rN.jsonl: median of N full microbenchmark runs.
+
+The 1-core host's effective speed swings run-to-run (r5: host memcpy
+7.0-8.4 GiB/s, multi-client tasks 2.4-5.8k/s across back-to-back
+identical runs), so the snapshot records the per-metric MEDIAN with
+every run's raw value in ``extra.runs``, raw per-run files alongside.
+Host context (cores, load at start) is recorded so floors set on
+bigger machines are interpretable.
+
+Run ON AN IDLE HOST:
+    python scripts/perf_snapshot.py [--round 5] [--runs 3] [--serve]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def one_run(path: str, serve: bool, timeout: float,
+            quick: bool = False) -> list[dict]:
+    cmd = [sys.executable, "-m", "ray_tpu.perf"]
+    if serve:
+        cmd.append("--serve")
+    if quick:
+        cmd.append("--quick")
+    # Own session + group kill on timeout: a wedged run must neither
+    # crash the multi-run median nor leak its worker processes (same
+    # contract as bench_watch._run).
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO, start_new_session=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        out, err = "", f"timeout after {timeout:.0f}s"
+    rows = []
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    if proc.returncode != 0:
+        sys.stderr.write((err or "")[-2000:] + "\n")
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=5)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--serve", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="0.5s windows (drive/smoke only)")
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    load0 = os.getloadavg()[0]
+    all_runs: list[list[dict]] = []
+    for i in range(args.runs):
+        raw = os.path.join(REPO, f"perf_r{args.round:02d}_run{i+1}.jsonl")
+        t0 = time.time()
+        rows = one_run(raw, args.serve, args.timeout,
+                       quick=args.quick)
+        print(f"run {i+1}: {len(rows)} metrics in {time.time()-t0:.0f}s",
+              file=sys.stderr)
+        all_runs.append(rows)
+
+    by_metric: dict[str, list[dict]] = {}
+    order: list[str] = []
+    for rows in all_runs:
+        for r in rows:
+            m = r.get("metric")
+            if not m:
+                continue
+            if m not in by_metric:
+                by_metric[m] = []
+                order.append(m)
+            by_metric[m].append(r)
+
+    out_path = os.path.join(REPO, f"PERF_r{args.round:02d}.jsonl")
+    with open(out_path, "w") as f:
+        for m in order:
+            rows = by_metric[m]
+            vals = [r["value"] for r in rows]
+            med = statistics.median(vals)
+            extra = dict(rows[0].get("extra") or {})
+            extra["runs"] = [round(v, 2) for v in vals]
+            extra["note"] = f"median of {len(vals)} full runs"
+            extra["host"] = {"cores": os.cpu_count(),
+                             "load1_at_start": round(load0, 2)}
+            f.write(json.dumps({
+                "metric": m, "value": round(med, 1)
+                if med >= 100 else round(med, 2),
+                "unit": rows[0].get("unit"), "extra": extra}) + "\n")
+    print(f"wrote {out_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
