@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 from typing import TYPE_CHECKING
 
 from ray_tpu.core.ids import ObjectID
@@ -165,7 +166,24 @@ class ObjectRefGenerator:
         return f"ObjectRefGenerator({self._task_id_bytes.hex()})"
 
 
+class _RehydrateStats(threading.local):
+    """Per-thread count of refs rehydrated by pickle loads. Lets a
+    caller prove a just-loaded blob contained NO refs (count unchanged
+    across the loads) — the precondition for reusing a client's args
+    blob verbatim instead of re-serializing it (each pickled ref
+    carries a one-shot nonce, so a blob WITH refs must be re-encoded
+    for the next hop). Thread-local: a shared counter could lose an
+    increment in a race and falsely certify a ref-ful blob clean."""
+
+    def __init__(self):
+        self.count = 0
+
+
+rehydrate_stats = _RehydrateStats()
+
+
 def _rehydrate_ref(id_bytes: bytes, owner_hint, nonce=None):
+    rehydrate_stats.count += 1
     ref = ObjectRef(ObjectID(id_bytes), owner_hint)
     # Register the deserializing process as a borrower so the owner keeps
     # the object alive while this ref exists (reference: borrower tracking

@@ -1312,8 +1312,14 @@ class DriverRuntime:
     def submit_task(self, fn_id: str, fn_blob: bytes | None,
                     fn_name: str, args: tuple, kwargs: dict,
                     options: TaskOptions,
-                    preminted: tuple | None = None
+                    preminted: tuple | None = None,
+                    packed: tuple | None = None
                     ) -> list[ObjectRef]:
+        """``packed=(args_blob, arg_refs)`` reuses an already-encoded
+        args payload (owned submits: the client's blob, proven
+        ref-free) instead of re-serializing — safe ONLY when the blob
+        contains no pickled ObjectRefs (each carries a one-shot
+        nonce that must be re-minted per hop)."""
         if fn_blob is not None:
             self._fn_cache.setdefault(fn_id, fn_blob)
         # Resolve the runtime env now: a broken env (task- OR
@@ -1335,7 +1341,10 @@ class DriverRuntime:
             return_ids = [] if streaming else [
                 ObjectID.for_return(task_id, i)
                 for i in range(options.num_returns)]
-        args_blob, arg_refs = self._pack_args(args, kwargs)
+        if packed is not None:
+            args_blob, arg_refs = packed
+        else:
+            args_blob, arg_refs = self._pack_args(args, kwargs)
         rec = TaskRecord(
             task_id=task_id, fn_id=fn_id, name=fn_name or "task",
             args_blob=args_blob, arg_refs=arg_refs, options=options,
@@ -2630,6 +2639,12 @@ class DriverRuntime:
         depth = self.config.worker_pipeline_depth
         if depth <= 1 or w.is_actor or not self._pipelineable(rec):
             return
+        # Cheap unlocked pre-check: nothing pending means nothing to
+        # pipeline — skip the _res_cv acquisition and node scan (this
+        # runs on EVERY dispatch; a stale read just means one missed
+        # pipelining opportunity that the normal path picks up).
+        if not self._pending:
+            return
         extras: list[TaskRecord] = []
         with self._res_cv:
             with w.lease_lock:
@@ -3059,7 +3074,9 @@ class DriverRuntime:
     def submit_actor_task(self, actor_id: ActorID, method: str,
                           args: tuple, kwargs: dict,
                           num_returns: int = 1, trace_ctx=None,
-                          preminted: tuple | None = None):
+                          preminted: tuple | None = None,
+                          packed: tuple | None = None):
+        """``packed``: see submit_task — ref-free pre-encoded args."""
         rec = self._actors.get(actor_id)
         if rec is None:
             raise ActorDiedError(actor_id.hex(), "unknown actor")
@@ -3071,7 +3088,10 @@ class DriverRuntime:
             return_ids = [] if streaming else [
                 ObjectID.for_return(task_id, i)
                 for i in range(num_returns)]
-        args_blob, arg_refs = self._pack_args(args, kwargs)
+        if packed is not None:
+            args_blob, arg_refs = packed
+        else:
+            args_blob, arg_refs = self._pack_args(args, kwargs)
         refs = [self.register_ref(ObjectRef(oid)) for oid in return_ids]
         if streaming:
             with self._stream_lock:
@@ -4445,11 +4465,20 @@ class DriverRuntime:
                 # duplicate source.
                 return
         try:
+            from ray_tpu.core.object_ref import rehydrate_stats
+            c0 = rehydrate_stats.count
             args, kwargs = ser.loads(args_kwargs_blob)
+            # Ref-free blob (no rehydrations during loads): reuse the
+            # client's encoding verbatim — skips a full re-pickle per
+            # submit. Ref-carrying blobs must be re-encoded (one-shot
+            # nonces per hop).
+            packed = ((args_kwargs_blob, [])
+                      if rehydrate_stats.count == c0 else None)
             options = self._loads_options_cached(opts_blob)
             refs = self.submit_task(
                 fn_id, fn_blob, fn_name, args, kwargs, options,
-                preminted=(TaskID(tid_bytes), return_ids))
+                preminted=(TaskID(tid_bytes), return_ids),
+                packed=packed)
             # The remote client holds the only refs. The escape pin
             # and its consuming borrow-add are registered HERE in one
             # step (the client registers only the release finalizer):
@@ -4485,11 +4514,16 @@ class DriverRuntime:
                 # order), which are far outside any replay window.
                 self._actor_owned_seen.popitem(last=False)
         try:
+            from ray_tpu.core.object_ref import rehydrate_stats
+            c0 = rehydrate_stats.count
             args, kwargs = ser.loads(args_kwargs_blob)
+            packed = ((args_kwargs_blob, [])
+                      if rehydrate_stats.count == c0 else None)
             refs = self.submit_actor_task(
                 ActorID(actor_id_bytes), method, args, kwargs,
                 num_returns, trace_ctx,
-                preminted=(task_id, return_ids))
+                preminted=(task_id, return_ids),
+                packed=packed)
             for r, nonce in zip(refs, nonces):
                 self.on_ref_escaped(r.id, nonce)
                 self.on_borrow_add(r.id, nonce)

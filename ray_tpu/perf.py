@@ -161,23 +161,32 @@ def _run_benchmarks(rec, quick: bool) -> None:
     # finisher read near-solo bandwidth while the others still queue
     # (CFS quantum ~ a 25 MiB copy on this host), inflating the
     # "ceiling" above what the hardware delivers concurrently.
-    start_bar = _th.Barrier(n_streams + 1)
+    start_bar = _th.Barrier(n_streams)
+    spans = [None] * n_streams
 
     def _stream(i):
         s, d = bufs[i]
         start_bar.wait()
+        t0 = time.perf_counter()
         for _ in range(reps):
             d[:] = s
+        spans[i] = (t0, time.perf_counter())
 
     ths = [_th.Thread(target=_stream, args=(i,))
            for i in range(n_streams)]
     for t in ths:
         t.start()
-    start_bar.wait()
-    t0 = time.perf_counter()
     for t in ths:
         t.join()
-    window = time.perf_counter() - t0
+    # Window = earliest post-barrier start to latest finish, measured
+    # INSIDE the worker threads: timing from the main thread is
+    # skewed by its own rescheduling delay on a contended 1-core host
+    # (in either direction, depending on whether it stamps before or
+    # after its barrier arrival).
+    done = [sp for sp in spans if sp is not None]
+    if not done:
+        raise RuntimeError("all memcpy streams died before timing")
+    window = max(e for _, e in done) - min(s0 for s0, _ in done)
     total_gib = n_streams * reps * sizes / (1 << 30)
     row = {"metric": "host_memcpy_aggregate_gigabytes",
            "value": round(total_gib / window, 2), "unit": "GiB/s",

@@ -17,10 +17,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
@@ -37,35 +37,20 @@ DEFAULT_GRID: list[dict[str, str]] = [
 
 
 def run_one(env_over: dict[str, str], timeout: float) -> dict:
-    env = dict(os.environ)
-    env.update(env_over)
-    proc = subprocess.Popen(
-        [sys.executable, BENCH, "--gpt2"], stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE, start_new_session=True, env=env,
-        cwd=REPO, text=True)
+    from _proc import last_json_line, run_child, tail_error
     t0 = time.perf_counter()
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        proc.wait()
+    out, err, rc, timed_out = run_child(
+        [sys.executable, BENCH, "--gpt2"], timeout,
+        extra_env=env_over, cwd=REPO)
+    if timed_out:
         return {"env": env_over, "error": f"timeout {timeout:.0f}s"}
-    for line in reversed((out or "").strip().splitlines()):
-        if line.strip().startswith("{"):
-            try:
-                res = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            return {"env": env_over, "value": res.get("value", 0.0),
-                    "wall_s": round(time.perf_counter() - t0, 1),
-                    "error": res.get("error"),
-                    "extra": res.get("extra", {})}
-    tail = (err or out or "").strip().splitlines()[-3:]
-    return {"env": env_over,
-            "error": (" | ".join(tail) or "no output")[:300]}
+    res = last_json_line(out)
+    if res is not None:
+        return {"env": env_over, "value": res.get("value", 0.0),
+                "wall_s": round(time.perf_counter() - t0, 1),
+                "error": res.get("error"),
+                "extra": res.get("extra", {})}
+    return {"env": env_over, "error": tail_error(err, out, rc)}
 
 
 def main() -> None:

@@ -32,10 +32,10 @@ from __future__ import annotations
 
 import json
 import os
-import signal
-import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
@@ -62,34 +62,17 @@ def _log(event: dict) -> None:
 
 def _run(args: list[str], timeout: float,
          extra_env: dict | None = None) -> tuple[dict | None, str]:
-    """Run a child in its own session; parse last JSON stdout line.
-    Kills the whole process group on timeout (wedged jax threads can
-    survive a plain terminate)."""
-    env = None
-    if extra_env:
-        env = dict(os.environ)
-        env.update(extra_env)
-    proc = subprocess.Popen(
-        args, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        start_new_session=True, cwd=REPO, text=True, env=env)
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        proc.wait()
-        return None, f"timeout after {timeout:.0f}s"
-    for line in reversed((out or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), ""
-            except json.JSONDecodeError:
-                continue
-    tail = (err or out or "").strip().splitlines()[-3:]
-    return None, f"rc={proc.returncode}: " + " | ".join(tail)[:300]
+    """Run a child under the shared session-kill contract; parse the
+    last JSON stdout line (scripts/_proc.py)."""
+    from _proc import last_json_line, run_child, tail_error
+    out, err, rc, timed_out = run_child(args, timeout,
+                                        extra_env=extra_env, cwd=REPO)
+    if timed_out:
+        return None, err
+    res = last_json_line(out)
+    if res is not None:
+        return res, ""
+    return None, tail_error(err, out, rc)
 
 
 def probe_alive() -> tuple[bool, str]:

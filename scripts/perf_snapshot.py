@@ -15,12 +15,12 @@ from __future__ import annotations
 
 import argparse
 import json
-import signal
 import os
 import statistics
-import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -32,24 +32,14 @@ def one_run(path: str, serve: bool, timeout: float,
         cmd.append("--serve")
     if quick:
         cmd.append("--quick")
-    # Own session + group kill on timeout: a wedged run must neither
-    # crash the multi-run median nor leak its worker processes (same
-    # contract as bench_watch._run).
-    proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        text=True, cwd=REPO, start_new_session=True,
-        env={**os.environ, "JAX_PLATFORMS": "cpu",
-             "PYTHONPATH": REPO + os.pathsep
-             + os.environ.get("PYTHONPATH", "")})
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        proc.wait()
-        out, err = "", f"timeout after {timeout:.0f}s"
+    # Shared session-kill contract (scripts/_proc.py): a wedged run
+    # must neither crash the multi-run median nor leak its workers.
+    from _proc import run_child
+    out, err, rc, _timed_out = run_child(
+        cmd, timeout, cwd=REPO,
+        extra_env={"JAX_PLATFORMS": "cpu",
+                   "PYTHONPATH": REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")})
     rows = []
     for line in (out or "").splitlines():
         line = line.strip()
@@ -61,7 +51,7 @@ def one_run(path: str, serve: bool, timeout: float,
     with open(path, "w") as f:
         for r in rows:
             f.write(json.dumps(r) + "\n")
-    if proc.returncode != 0:
+    if rc != 0:
         sys.stderr.write((err or "")[-2000:] + "\n")
     return rows
 
