@@ -14,6 +14,7 @@ from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.bc import BC, BCConfig
 from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.dreamer import Dreamer, DreamerConfig
 from ray_tpu.rllib.env_runner import EnvRunner, EnvRunnerGroup, Episode
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.learner import JaxLearner, RecurrentJaxLearner
@@ -31,6 +32,7 @@ __all__ = [
     "AlgorithmConfig", "PPO", "PPOConfig",
     "APPO", "APPOConfig", "BC", "BCConfig", "CQL", "CQLConfig",
     "DQN", "DQNConfig", "ReplayBuffer",
+    "Dreamer", "DreamerConfig",
     "Impala", "ImpalaConfig", "MARWIL", "MARWILConfig",
     "connectors", "offline", "ConnectorV2", "ConnectorPipelineV2",
     "LearnerGroup",

@@ -1,0 +1,681 @@
+"""Dreamer: model-based RL — world model + imagination-trained AC.
+
+Reference analog: ``rllib/algorithms/dreamerv3/`` (world-model
+Learner with RSSM + reward/continue/decoder heads, actor-critic
+trained entirely on imagined latent rollouts,
+``dreamerv3/dreamerv3.py``, ``utils/summaries.py`` et al.). The
+reference implementation is ~10k LoC of TF2; this is the TPU-first
+re-design of the same algorithm family, compact but structurally
+faithful:
+
+- **RSSM with straight-through categorical latents** (n_cat
+  independent categoricals of n_classes, DreamerV3's discrete
+  stochastic state), 1% uniform mixing on every categorical
+  ("unimix") so KL terms stay finite.
+- **Symlog regression** for the reward head; two-hot is scoped out
+  (lite), plain MSE in symlog space keeps the scale-robustness
+  property that motivates it.
+- **KL balancing with free bits**: dyn loss KL(sg(post)||prior) and
+  rep loss KL(post||sg(prior)), each clipped below 1 nat.
+- **Imagination training**: actor-critic never sees a real
+  transition — posterior states from the world-model batch seed
+  H-step latent rollouts through the prior; λ-returns over imagined
+  reward/continue train the critic (MSE) and the actor (REINFORCE
+  with normalized advantages + entropy, the reference's discrete-
+  action path).
+- Every update is ONE jitted program (scan over time inside);
+  the replay buffer is host-side numpy, same split as dqn.py.
+
+Rollouts run on EnvRunner actors with ``policy="dreamer"``: the
+module exposes the recurrent-policy protocol (obs, carry) -> (logits,
+value, carry') plus a ``feed_action`` hook so the chosen action
+enters the next step's dynamics (the carry holds (h, z, a_prev)).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.checkpoints import Checkpointable, tree_to_host
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+@dataclass(frozen=True)
+class DreamerModelConfig:
+    obs_dim: int = 4
+    num_actions: int = 2
+    embed: int = 64
+    deter: int = 64                  # GRU deterministic state
+    n_cat: int = 8                   # categorical latents
+    n_classes: int = 8               # classes per latent
+    hidden: int = 64                 # head MLP width
+    unimix: float = 0.01
+
+    @property
+    def z_dim(self) -> int:
+        return self.n_cat * self.n_classes
+
+
+class _MLP(nn.Module):
+    width: int
+    out: int
+    n_hidden: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(self.n_hidden):
+            x = nn.silu(nn.Dense(self.width)(x))
+        return nn.Dense(self.out)(x)
+
+
+def _unimix_logits(logits, cfg: DreamerModelConfig):
+    """Mix 1% uniform into each categorical (DreamerV3 'unimix'):
+    keeps every class probability nonzero so the balanced KL cannot
+    blow up on a confident prior meeting a different posterior."""
+    shaped = logits.reshape(logits.shape[:-1]
+                            + (cfg.n_cat, cfg.n_classes))
+    probs = jax.nn.softmax(shaped, axis=-1)
+    probs = ((1 - cfg.unimix) * probs + cfg.unimix / cfg.n_classes)
+    return jnp.log(probs)
+
+
+def _st_sample(logp, key):
+    """Straight-through one-hot sample from per-categorical
+    log-probs [..., n_cat, n_classes] -> flat [..., n_cat*n_classes]:
+    forward pass is the hard sample, gradient flows via the probs."""
+    idx = jax.random.categorical(key, logp, axis=-1)
+    onehot = jax.nn.one_hot(idx, logp.shape[-1], dtype=logp.dtype)
+    probs = jnp.exp(logp)
+    z = onehot + probs - jax.lax.stop_gradient(probs)
+    return z.reshape(z.shape[:-2] + (-1,))
+
+
+def _mode(logp):
+    idx = jnp.argmax(logp, axis=-1)
+    onehot = jax.nn.one_hot(idx, logp.shape[-1], dtype=logp.dtype)
+    return onehot.reshape(onehot.shape[:-2] + (-1,))
+
+
+def _kl_cat(logp_a, logp_b):
+    """Sum over classes and categoricals of KL(a || b); mean over
+    leading dims is the caller's job."""
+    return jnp.sum(jnp.exp(logp_a) * (logp_a - logp_b), axis=(-2, -1))
+
+
+class DreamerModule(nn.Module):
+    """World model + actor + critic under one param tree
+    ({"wm": ..., "actor": ..., "critic": ...})."""
+
+    cfg: DreamerModelConfig
+
+    def setup(self):
+        c = self.cfg
+        self.encoder = _MLP(c.hidden, c.embed, name="wm_encoder")
+        self.gru = nn.GRUCell(c.deter, name="wm_gru")
+        self.prior_net = _MLP(c.hidden, c.z_dim, name="wm_prior")
+        self.post_net = _MLP(c.hidden, c.z_dim, name="wm_post")
+        self.decoder = _MLP(c.hidden, c.obs_dim, name="wm_decoder")
+        self.reward_head = _MLP(c.hidden, 1, name="wm_reward")
+        self.cont_head = _MLP(c.hidden, 1, name="wm_cont")
+        self.actor = _MLP(c.hidden, c.num_actions, name="actor")
+        self.critic = _MLP(c.hidden, 1, name="critic")
+
+    # -- state helpers --
+
+    def _feat(self, h, z):
+        return jnp.concatenate([h, z], axis=-1)
+
+    def _core(self, h, z, a_onehot):
+        """Deterministic update h' = GRU([z, a], h)."""
+        x = jnp.concatenate([z, a_onehot], axis=-1)
+        h2, _ = self.gru(h, x)
+        return h2
+
+    def _prior_logp(self, h):
+        return _unimix_logits(self.prior_net(h), self.cfg)
+
+    def _post_logp(self, h, embed):
+        return _unimix_logits(
+            self.post_net(jnp.concatenate([h, embed], axis=-1)),
+            self.cfg)
+
+    # -- world-model training pass --
+
+    def observe(self, obs, actions, is_first, key):
+        """[B, T, ...] teacher-forced pass. Returns dict of
+        per-step h, z, prior/posterior log-probs, head outputs."""
+        c = self.cfg
+        B, T = actions.shape
+        embeds = self.encoder(symlog(obs))               # [B, T, E]
+        a_onehot = jax.nn.one_hot(actions, c.num_actions,
+                                  dtype=obs.dtype)
+        h0 = jnp.zeros((B, c.deter), obs.dtype)
+        z0 = jnp.zeros((B, c.z_dim), obs.dtype)
+        keys = jax.random.split(key, T)
+
+        def step(carry, xt):
+            h, z, a_prev = carry
+            embed_t, a_t, first_t, k_t = xt
+            # Episode starts reset the latent state AND the incoming
+            # action (no dynamics across an env reset).
+            mask = (1.0 - first_t)[:, None]
+            h, z, a_prev = h * mask, z * mask, a_prev * mask
+            h2 = self._core(h, z, a_prev)
+            prior = self._prior_logp(h2)
+            post = self._post_logp(h2, embed_t)
+            z2 = _st_sample(post, k_t)
+            return (h2, z2, a_t), (h2, z2, prior, post)
+
+        xs = (embeds.transpose(1, 0, 2), a_onehot.transpose(1, 0, 2),
+              is_first.transpose(1, 0), keys)
+        _, (hs, zs, priors, posts) = jax.lax.scan(
+            step, (h0, z0, jnp.zeros_like(a_onehot[:, 0])), xs)
+        hs = hs.transpose(1, 0, 2)                        # [B, T, H]
+        zs = zs.transpose(1, 0, 2)
+        feat = self._feat(hs, zs)
+        return {
+            "h": hs, "z": zs,
+            "prior": priors.transpose(1, 0, 2, 3),
+            "post": posts.transpose(1, 0, 2, 3),
+            "obs_hat": self.decoder(feat),
+            "reward_hat": self.reward_head(feat)[..., 0],
+            "cont_logit": self.cont_head(feat)[..., 0],
+        }
+
+    # -- imagination --
+
+    def img_step(self, h, z, a_onehot, key):
+        """One prior step (no observation): the imagination
+        transition."""
+        h2 = self._core(h, z, a_onehot)
+        z2 = _st_sample(self._prior_logp(h2), key)
+        return h2, z2
+
+    def heads(self, h, z):
+        feat = self._feat(h, z)
+        return {
+            "reward": symexp(self.reward_head(feat)[..., 0]),
+            "cont": jax.nn.sigmoid(self.cont_head(feat)[..., 0]),
+            "value": self.critic(feat)[..., 0],
+            "logits": self.actor(feat),
+        }
+
+    def init_all(self, obs, actions, is_first, key):
+        """Init-only trace touching EVERY submodule WITHOUT the scan:
+        flax cannot create params inside ``lax.scan`` (tracer leak),
+        and it creates params only for modules the traced method
+        reaches — so this walks one unrolled step through encoder/
+        core/prior/post plus every head."""
+        c = self.cfg
+        B = obs.shape[0]
+        embed = self.encoder(symlog(obs[:, 0]))
+        h = jnp.zeros((B, c.deter), obs.dtype)
+        z = jnp.zeros((B, c.z_dim), obs.dtype)
+        a = jax.nn.one_hot(actions[:, 0], c.num_actions,
+                           dtype=obs.dtype)
+        h2 = self._core(h, z, a)
+        prior = self._prior_logp(h2)
+        post = self._post_logp(h2, embed)
+        z2 = _st_sample(post, key)
+        feat = self._feat(h2, z2)
+        return (self.decoder(feat), self.reward_head(feat),
+                self.cont_head(feat), self.actor(feat),
+                self.critic(feat), prior)
+
+    # -- rollout-policy protocol (EnvRunner policy="dreamer") --
+
+    def rollout_step(self, obs, carry):
+        """(obs [1, D], carry (h, z, a_prev)) -> (logits, value,
+        carry'). Latent uses the posterior MODE (deterministic —
+        rollout exploration comes from the actor's categorical
+        sampling host-side); the action slot is filled afterwards by
+        ``feed_action``."""
+        h, z, a_prev = carry
+        embed = self.encoder(symlog(obs))
+        h2 = self._core(h, z, a_prev)
+        z2 = _mode(self._post_logp(h2, embed))
+        feat = self._feat(h2, z2)
+        logits = self.actor(feat)
+        value = self.critic(feat)[..., 0]
+        return logits, value, (h2, z2, jnp.zeros_like(a_prev))
+
+
+class _RolloutPolicy:
+    """Adapter giving DreamerModule the recurrent-policy surface the
+    EnvRunner expects (init_params / initial_state / apply /
+    feed_action)."""
+
+    def __init__(self, cfg: DreamerModelConfig):
+        self.cfg = cfg
+        self.module = DreamerModule(cfg)
+        self.hidden_state = cfg.deter   # recurrent-protocol metadata
+
+    def init_params(self, key):
+        c = self.cfg
+        obs = jnp.zeros((1, c.obs_dim))
+        carry = self.initial_state(1)
+        return self.module.init(key, obs, carry,
+                                method="rollout_step")["params"]
+
+    def initial_state(self, batch: int):
+        c = self.cfg
+        return (jnp.zeros((batch, c.deter)),
+                jnp.zeros((batch, c.z_dim)),
+                jnp.zeros((batch, c.num_actions)))
+
+    def apply(self, variables, obs, carry, method=None):
+        return self.module.apply(variables, obs, carry,
+                                 method="rollout_step")
+
+    def feed_action(self, carry, action: int):
+        h, z, a = carry
+        a2 = jax.nn.one_hot(jnp.asarray([action]), self.cfg.num_actions,
+                            dtype=a.dtype)
+        return (h, z, a2)
+
+
+def build_dreamer_policy(policy_config: dict) -> _RolloutPolicy:
+    cfg = DreamerModelConfig(**{
+        k: v for k, v in policy_config.items()
+        if k in DreamerModelConfig.__dataclass_fields__})
+    return _RolloutPolicy(cfg)
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+
+class SequenceReplay:
+    """Episode store sampling [B, T] training segments with is_first
+    flags (reference: dreamerv3's EpisodeReplayBuffer)."""
+
+    def __init__(self, capacity_steps: int, seq_len: int):
+        self.capacity = capacity_steps
+        self.seq_len = seq_len
+        self.episodes: list[dict[str, np.ndarray]] = []
+        self.steps = 0
+
+    def add_episodes(self, episodes) -> int:
+        n = 0
+        for ep in episodes:
+            if ep.length < 2:
+                continue
+            self.episodes.append({
+                "obs": np.stack(ep.obs).astype(np.float32),
+                "actions": np.asarray(ep.actions, np.int32),
+                "rewards": np.asarray(ep.rewards, np.float32),
+                "cont": np.asarray(
+                    [1.0] * (ep.length - 1)
+                    + [0.0 if ep.terminated else 1.0], np.float32),
+            })
+            self.steps += ep.length
+            n += ep.length
+        while self.steps > self.capacity and len(self.episodes) > 1:
+            self.steps -= len(self.episodes.pop(0)["actions"])
+        return n
+
+    def sample(self, batch: int, rng) -> dict[str, np.ndarray] | None:
+        if not self.episodes:
+            return None
+        T = self.seq_len
+        out = {k: [] for k in ("obs", "actions", "rewards", "cont",
+                               "is_first")}
+        for _ in range(batch):
+            ep = self.episodes[rng.integers(len(self.episodes))]
+            L = len(ep["actions"])
+            s = int(rng.integers(0, max(1, L - T + 1)))
+            sl = slice(s, s + T)
+            n = len(ep["actions"][sl])
+            pad = T - n
+
+            def p0(x, pad=pad):
+                if pad == 0:
+                    return x
+                return np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+
+            first = np.zeros(n, np.float32)
+            if s == 0:
+                first[0] = 1.0
+            out["obs"].append(p0(ep["obs"][sl]))
+            out["actions"].append(p0(ep["actions"][sl]))
+            out["rewards"].append(p0(ep["rewards"][sl]))
+            # Padding is masked via cont=0 on padded steps.
+            out["cont"].append(p0(ep["cont"][sl]))
+            out["is_first"].append(p0(first))
+        return {k: np.stack(v) for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# learner
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DreamerHyperparams:
+    wm_lr: float = 3e-4
+    ac_lr: float = 1e-4
+    gamma: float = 0.97
+    gae_lambda: float = 0.95
+    horizon: int = 10                # imagination length
+    free_bits: float = 1.0
+    dyn_scale: float = 0.5
+    rep_scale: float = 0.1
+    entropy_coeff: float = 3e-3
+    batch_size: int = 8
+    seq_len: int = 16
+    buffer_steps: int = 20_000
+    wm_updates_per_iter: int = 8
+    ac_updates_per_iter: int = 8
+    learning_starts: int = 300
+    max_grad_norm: float = 100.0
+
+
+class DreamerLearner:
+    def __init__(self, cfg: DreamerModelConfig,
+                 hp: DreamerHyperparams, seed: int = 0):
+        self.cfg, self.hp = cfg, hp
+        self.module = DreamerModule(cfg)
+        obs = jnp.zeros((1, 2, cfg.obs_dim))
+        acts = jnp.zeros((1, 2), jnp.int32)
+        first = jnp.zeros((1, 2))
+        self.params = self.module.init(
+            jax.random.key(seed), obs, acts, first,
+            jax.random.key(0), method="init_all")["params"]
+        self.wm_opt = optax.chain(
+            optax.clip_by_global_norm(hp.max_grad_norm),
+            optax.adam(hp.wm_lr))
+        self.ac_opt = optax.chain(
+            optax.clip_by_global_norm(hp.max_grad_norm),
+            optax.adam(hp.ac_lr))
+        wm_mask = {k: k.startswith("wm_") for k in self.params}
+        ac_mask = {k: not k.startswith("wm_") for k in self.params}
+        self._wm_mask, self._ac_mask = wm_mask, ac_mask
+        self.wm_opt_state = self.wm_opt.init(
+            _masked(self.params, wm_mask))
+        self.ac_opt_state = self.ac_opt.init(
+            _masked(self.params, ac_mask))
+        self._key = jax.random.key(seed + 1)
+        self._wm_update = jax.jit(self._wm_update_fn,
+                                  donate_argnums=(0, 1))
+        self._ac_update = jax.jit(self._ac_update_fn,
+                                  donate_argnums=(0, 1))
+
+    # -- world model --
+
+    def _wm_loss(self, params, batch, key):
+        hp = self.hp
+        out = self.module.apply({"params": params}, batch["obs"],
+                                batch["actions"], batch["is_first"],
+                                key, method="observe")
+        # cont doubles as the pad mask (padded steps carry cont=0 and
+        # zero reward/obs — recon on them is harmless but excluded
+        # anyway for cleanliness).
+        mask = jnp.concatenate([
+            jnp.ones_like(batch["cont"][:, :1]),
+            batch["cont"][:, :-1]], axis=1)
+        msum = mask.sum() + 1e-8
+        recon = (((out["obs_hat"] - symlog(batch["obs"])) ** 2
+                  ).sum(-1) * mask).sum() / msum
+        rew = (((out["reward_hat"] - symlog(batch["rewards"])) ** 2)
+               * mask).sum() / msum
+        cont = (optax.sigmoid_binary_cross_entropy(
+            out["cont_logit"], batch["cont"]) * mask).sum() / msum
+        dyn = jnp.maximum(_kl_cat(
+            jax.lax.stop_gradient(out["post"]), out["prior"]),
+            hp.free_bits)
+        rep = jnp.maximum(_kl_cat(
+            out["post"], jax.lax.stop_gradient(out["prior"])),
+            hp.free_bits)
+        dyn = (dyn * mask).sum() / msum
+        rep = (rep * mask).sum() / msum
+        total = recon + rew + cont + hp.dyn_scale * dyn \
+            + hp.rep_scale * rep
+        aux = {"wm_loss": total, "recon_loss": recon,
+               "reward_loss": rew, "cont_loss": cont, "kl_dyn": dyn}
+        return total, (aux, out)
+
+    def _wm_update_fn(self, params, opt_state, batch, key):
+        (_t, (aux, out)), grads = jax.value_and_grad(
+            self._wm_loss, has_aux=True)(params, batch, key)
+        grads = _masked(grads, self._wm_mask)
+        updates, opt_state = self.wm_opt.update(
+            grads, opt_state, _masked(params, self._wm_mask))
+        params = optax.apply_updates(
+            params, _padded(updates, params))
+        return params, opt_state, aux, out["h"], out["z"]
+
+    # -- actor-critic in imagination --
+
+    def _ac_loss(self, params, h0, z0, key):
+        hp, c = self.hp, self.cfg
+        N = h0.shape[0]
+        keys = jax.random.split(key, hp.horizon)
+
+        def step(carry, k):
+            h, z = carry
+            heads = self.module.apply({"params": params}, h, z,
+                                      method="heads")
+            k_a, k_z = jax.random.split(k)
+            a = jax.random.categorical(k_a, heads["logits"])
+            logp_all = jax.nn.log_softmax(heads["logits"])
+            logp = jnp.take_along_axis(
+                logp_all, a[:, None], axis=-1)[:, 0]
+            ent = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            a_onehot = jax.nn.one_hot(a, c.num_actions,
+                                      dtype=h.dtype)
+            h2, z2 = self.module.apply(
+                {"params": params}, h, z, a_onehot, k_z,
+                method="img_step")
+            return (h2, z2), (heads["reward"], heads["cont"],
+                              heads["value"], logp, ent)
+
+        (hH, zH), (rews, conts, values, logps, ents) = jax.lax.scan(
+            step, (h0, z0), keys)
+        vH = self.module.apply({"params": params}, hH, zH,
+                               method="heads")["value"]
+        # λ-returns over imagined trajectory, discount from the
+        # continue head (terminal states stop the return).
+        disc = hp.gamma * conts
+
+        def lam_step(acc, xt):
+            r, d, v_next = xt
+            ret = r + d * ((1 - hp.gae_lambda) * v_next
+                           + hp.gae_lambda * acc)
+            return ret, ret
+
+        v_next = jnp.concatenate([values[1:], vH[None]], axis=0)
+        _, returns = jax.lax.scan(
+            lam_step, vH, (rews, disc, v_next), reverse=True)
+        # Actor sees sg(everything) except its own logp; critic sees
+        # sg(returns). Discount-weight imagined steps so later
+        # (less reliable) steps count less.
+        weight = jnp.cumprod(
+            jnp.concatenate([jnp.ones((1, N)), disc[:-1]], axis=0),
+            axis=0)
+        weight = jax.lax.stop_gradient(weight)
+        adv = jax.lax.stop_gradient(returns - values)
+        adv = adv / jnp.maximum(1.0, jnp.std(adv))
+        actor_loss = -(weight * (logps * adv
+                                 + hp.entropy_coeff * ents)).mean()
+        critic_loss = ((weight * (
+            values - jax.lax.stop_gradient(returns)) ** 2)).mean()
+        total = actor_loss + critic_loss
+        return total, {"actor_loss": actor_loss,
+                       "critic_loss": critic_loss,
+                       "imag_return": returns.mean(),
+                       "imag_entropy": ents.mean()}
+
+    def _ac_update_fn(self, params, opt_state, h, z, key):
+        # Seed imagination from every posterior state of the world-
+        # model batch, gradients stopped (the world model is trained
+        # only by its own loss — reference: sg() boundary between WM
+        # and AC training).
+        h0 = jax.lax.stop_gradient(h.reshape(-1, h.shape[-1]))
+        z0 = jax.lax.stop_gradient(z.reshape(-1, z.shape[-1]))
+        (_t, aux), grads = jax.value_and_grad(
+            self._ac_loss, has_aux=True)(params, h0, z0, key)
+        grads = _masked(grads, self._ac_mask)
+        updates, opt_state = self.ac_opt.update(
+            grads, opt_state, _masked(params, self._ac_mask))
+        params = optax.apply_updates(
+            params, _padded(updates, params))
+        return params, opt_state, aux
+
+    # -- public --
+
+    def update(self, batch: dict[str, np.ndarray]) -> dict:
+        mb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._key, k1, k2 = jax.random.split(self._key, 3)
+        self.params, self.wm_opt_state, wm_aux, h, z = \
+            self._wm_update(self.params, self.wm_opt_state, mb, k1)
+        self.params, self.ac_opt_state, ac_aux = self._ac_update(
+            self.params, self.ac_opt_state, h, z, k2)
+        out = {**wm_aux, **ac_aux}
+        return {k: float(v) for k, v in out.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+def _masked(tree: dict, mask: dict) -> dict:
+    return {k: v for k, v in tree.items() if mask[k]}
+
+
+def _padded(updates: dict, params: dict) -> dict:
+    """Zero-update for params outside the mask so apply_updates can
+    run over the full tree."""
+    out = {}
+    for k, v in params.items():
+        out[k] = updates.get(k) if k in updates else \
+            jax.tree_util.tree_map(jnp.zeros_like, v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# algorithm
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DreamerConfig:
+    env: Any = None
+    policy_config: dict = field(default_factory=dict)
+    num_env_runners: int = 1
+    rollout_fragment_length: int = 128
+    hparams: DreamerHyperparams = field(
+        default_factory=DreamerHyperparams)
+    seed: int = 0
+
+    def environment(self, env, *, obs_dim: int, num_actions: int,
+                    **model_kw) -> "DreamerConfig":
+        return replace(self, env=env, policy_config={
+            "obs_dim": obs_dim, "num_actions": num_actions,
+            **model_kw})
+
+    def env_runners(self, num_env_runners: int) -> "DreamerConfig":
+        return replace(self, num_env_runners=num_env_runners)
+
+    def training(self, **hp_overrides) -> "DreamerConfig":
+        return replace(self, hparams=replace(self.hparams,
+                                             **hp_overrides))
+
+    def build(self) -> "Dreamer":
+        return Dreamer(self)
+
+
+class Dreamer(Checkpointable):
+    """Dreamer algorithm under the shared Algorithm surface
+    (train() -> metrics dict; Checkpointable save/restore)."""
+
+    def __init__(self, config: DreamerConfig):
+        assert config.env is not None
+        self.config = config
+        hp = config.hparams
+        cfg = DreamerModelConfig(**{
+            k: v for k, v in config.policy_config.items()
+            if k in DreamerModelConfig.__dataclass_fields__})
+        self.learner = DreamerLearner(cfg, hp, seed=config.seed)
+        self.runners = EnvRunnerGroup(
+            config.env, config.policy_config,
+            num_runners=config.num_env_runners, seed=config.seed,
+            policy="dreamer")
+        self.buffer = SequenceReplay(hp.buffer_steps, hp.seq_len)
+        self.rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+        self.runners.set_weights(self.learner.get_weights())
+
+    def get_state(self) -> dict:
+        return {
+            "iteration": self.iteration,
+            "learner": {
+                "params": tree_to_host(self.learner.params),
+                "wm_opt_state": tree_to_host(
+                    self.learner.wm_opt_state),
+                "ac_opt_state": tree_to_host(
+                    self.learner.ac_opt_state),
+            },
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.iteration = int(state["iteration"])
+        lst = state["learner"]
+        self.learner.params = jax.device_put(lst["params"])
+        self.learner.wm_opt_state = jax.device_put(
+            lst["wm_opt_state"])
+        self.learner.ac_opt_state = jax.device_put(
+            lst["ac_opt_state"])
+        self.runners.set_weights(self.learner.get_weights())
+
+    def train(self) -> dict:
+        hp = self.config.hparams
+        t0 = time.time()
+        episodes = self.runners.sample(
+            self.config.rollout_fragment_length)
+        added = self.buffer.add_episodes(episodes)
+        sample_time = time.time() - t0
+
+        metrics: dict = {}
+        t1 = time.time()
+        if self.buffer.steps >= hp.learning_starts:
+            for _ in range(hp.wm_updates_per_iter):
+                batch = self.buffer.sample(hp.batch_size, self.rng)
+                if batch is None:
+                    break
+                metrics = self.learner.update(batch)
+            self.runners.set_weights(self.learner.get_weights())
+        learn_time = time.time() - t1
+
+        self.iteration += 1
+        finished = [e for e in episodes if e.terminated or e.truncated]
+        mean_reward = (sum(e.total_reward for e in finished)
+                       / len(finished)) if finished else float("nan")
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": mean_reward,
+            "episodes_this_iter": len(finished),
+            "num_env_steps_sampled": added,
+            "buffer_steps": self.buffer.steps,
+            "time_sample_s": round(sample_time, 3),
+            "time_learn_s": round(learn_time, 3),
+            **metrics,
+        }
+
+    def stop(self) -> None:
+        self.runners.shutdown()

@@ -76,6 +76,12 @@ class EnvRunner:
                 build_recurrent_actor_critic,
             )
             self.model = build_recurrent_actor_critic(policy_config)
+        elif policy == "dreamer":
+            # World-model rollout policy (dreamer.py): recurrent
+            # protocol + a feed_action hook so the chosen action
+            # enters the next step's latent dynamics.
+            from ray_tpu.rllib.dreamer import build_dreamer_policy
+            self.model = build_dreamer_policy(policy_config)
         elif policy == "epsilon_greedy":
             from ray_tpu.rllib.catalog import build_q_network
             self.model = build_q_network(policy_config)
@@ -88,8 +94,9 @@ class EnvRunner:
         else:
             raise ValueError(f"unknown policy {policy!r}")
         self.params = self.model.init_params(jax.random.key(seed))
-        if policy == "recurrent":
-            # Stateful rollout: the GRU carry advances per step and
+        self._stateful = policy in ("recurrent", "dreamer")
+        if self._stateful:
+            # Stateful rollout: the carry advances per step and
             # resets at episode boundaries.
             self._carry = self.model.initial_state(1)
             self._fwd = jax.jit(
@@ -126,12 +133,17 @@ class EnvRunner:
             action = int(self.rng.choice(len(probs), p=probs))
             logp = float(np.log(probs[action] + 1e-9))
             return action, action, logp, float(value[0])
-        if self.policy == "recurrent":
+        if self._stateful:
             logits, value, self._carry = self._fwd(
                 self.params, obs[None], self._carry)
             probs = np.asarray(jnn.softmax(logits[0]))
             action = int(self.rng.choice(len(probs), p=probs))
             logp = float(np.log(probs[action] + 1e-9))
+            if hasattr(self.model, "feed_action"):
+                # Dreamer-class policies: the action taken feeds the
+                # NEXT step's latent dynamics.
+                self._carry = self.model.feed_action(self._carry,
+                                                     action)
             return action, action, logp, float(value[0])
         if self.policy == "epsilon_greedy":
             q = np.asarray(self._fwd(self.params, obs[None])[0])
@@ -150,7 +162,7 @@ class EnvRunner:
 
     def _new_episode(self) -> Episode:
         ep = Episode()
-        if self.policy == "recurrent":
+        if self._stateful:
             ep.state_in = np.asarray(self._carry[0])
         return ep
 
@@ -179,7 +191,7 @@ class EnvRunner:
                 # ep.obs — off-policy consumers concatenate them.
                 ep.final_obs = self._tobs
                 episodes.append(ep)
-                if self.policy == "recurrent":
+                if self._stateful:
                     self._carry = self.model.initial_state(1)
                 ep = self._new_episode()
                 self._obs, _ = self.env.reset()
@@ -190,7 +202,7 @@ class EnvRunner:
             if self.policy == "categorical":
                 _, last_v = self._fwd(self.params, self._tobs[None])
                 ep.last_value = float(last_v[0])
-            elif self.policy == "recurrent":
+            elif self._stateful:
                 _, last_v, _c = self._fwd(self.params,
                                           self._tobs[None],
                                           self._carry)

@@ -49,17 +49,27 @@ def achievable_matmul_tflops(m: int = 8192, k_short: int = 5,
         r, _ = jax.lax.scan(body, r, None, length=kk)
         return r.astype(jnp.float32).ravel()[0]
 
-    def timed(kk: int) -> float:
+    def timed(kk: int, reps: int = 2) -> float:
+        """Best of ``reps``: a relay stall inflating the SHORT chain's
+        time shrinks the two-point difference and overstates the rate
+        (one window read an impossible 255 TF/s that way) — min() is
+        the stall-robust estimator for a fixed-work measurement."""
         float(np.asarray(prog(r0, a, kk)).ravel()[0])     # compile
-        t0 = time.perf_counter()
-        float(np.asarray(prog(r0, a, kk)).ravel()[0])
-        return time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(np.asarray(prog(r0, a, kk)).ravel()[0])
+            best = min(best, time.perf_counter() - t0)
+        return best
 
     diff = timed(k_long) - timed(k_short)
     n_mm = k_long - k_short
-    # Sanity floor: n_mm matmuls cannot take under ~1/4 of the paper-
-    # peak time — below it the measurement is a stall artifact.
-    floor_s = 2 * m**3 * n_mm / (4 * 197e12)
-    if diff < floor_s:
+    # Sanity bounds, both directions: n_mm matmuls cannot run FASTER
+    # than the 197 TF/s bf16 paper peak (a reading above it means the
+    # short chain absorbed a relay stall the long one didn't — one
+    # loaded capture published an impossible 251.5 TF/s that way),
+    # nor take more than ~20x the peak time (probe swamped by load).
+    rate = 2 * m**3 * n_mm / max(diff, 1e-9) / 1e12
+    if rate > 197.0 or rate < 197.0 / 20:
         return 0.0
-    return 2 * m**3 * n_mm / diff / 1e12
+    return rate
