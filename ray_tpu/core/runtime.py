@@ -449,9 +449,29 @@ class WorkerHandle:
             self.log_path = os.path.join(
                 runtime.log_dir, f"worker-{self.index}.log")
             stdout_target = open(self.log_path, "ab", buffering=0)
+        cmd = [sys.executable, "-m", "ray_tpu.core.worker_entry",
+               runtime.client_address, self.token]
+        prefix_json = env.pop("RAY_TPU_CONTAINER_PREFIX", None)
+        if prefix_json:
+            # Container runtime env (runtime_env/plugins.py
+            # ContainerPlugin): the worker boots THROUGH the
+            # container runner's argv prefix. Popped from env so the
+            # containerized worker's own spawns don't re-wrap. A real
+            # OCI runner starts the container with the IMAGE's env,
+            # not this Popen's — every variable the worker needs
+            # (import path, session/rendezvous addresses, platform
+            # pins, plugin env_vars) must be forwarded explicitly as
+            # --env flags, spliced before the image (prefix's last
+            # element by the plugin's contract).
+            import json as _json
+            prefix = _json.loads(prefix_json)
+            fwd_prefixes = ("RAY_TPU_", "JAX_", "XLA_", "TPU_",
+                            "PYTHON")
+            fwd = [f"--env={k}={v}" for k, v in env.items()
+                   if k.startswith(fwd_prefixes)]
+            cmd = prefix[:-1] + fwd + [prefix[-1]] + cmd
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_entry",
-             runtime.client_address, self.token],
+            cmd,
             env=env,
             cwd=os.getcwd(),
             stdout=stdout_target,

@@ -25,6 +25,9 @@ class RuntimeEnv(dict):
       pip / conda: gated in this deployment (no network egress) — the
         pip plugin only *verifies* the named distributions are already
         present and fails fast otherwise;
+      container: dict — {"image": IMG, "run_options": [...]}: the
+        worker boots through an OCI runner (podman by default,
+        RAY_TPU_CONTAINER_RUNNER to override);
       config: dict — setup options (e.g. setup_timeout_seconds).
     """
 
