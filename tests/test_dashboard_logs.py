@@ -54,8 +54,16 @@ def test_dashboard_endpoints(rt):
         assert status == 200 and b"ray_tpu" in body \
             and b"<table>" in body
 
-        status, _ = _get(dash.url + "/api/timeline")
+        # timeline: the JSON feed carries the finished task's span
+        # AND the SPA ships an in-page renderer for it (a "timeline"
+        # tab with the SVG span view, not just the raw-JSON link).
+        status, body = _get(dash.url + "/api/timeline")
         assert status == 200
+        evs = json.loads(body)
+        assert any(e.get("name") == "f" and e.get("ph") == "X"
+                   for e in evs)
+        status, body = _get(dash.url + "/")
+        assert b'"timeline"' in body and b"laneOf" in body
     finally:
         dash.stop()
 
