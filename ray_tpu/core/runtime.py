@@ -1401,10 +1401,21 @@ class DriverRuntime:
             return ObjectRefGenerator(task_id.binary(), _owner=True)
         return [self.register_ref(ObjectRef(oid)) for oid in return_ids]
 
+    _EMPTY_ARGS_BLOB = None
+
     def _pack_args(self, args: tuple, kwargs: dict):
         # Top-level ObjectRefs are resolved to values before execution
         # (reference: LocalDependencyResolver / plasma arg fetch). Nested
         # refs pass through as refs.
+        if not args and not kwargs:
+            # No-arg calls (the common case for control-heavy loads)
+            # share one cached pickle instead of re-encoding ((), {})
+            # per submit.
+            blob = DriverRuntime._EMPTY_ARGS_BLOB
+            if blob is None:
+                blob = DriverRuntime._EMPTY_ARGS_BLOB = \
+                    ser.dumps(((), {}))
+            return blob, []
         arg_refs = [a for a in list(args) + list(kwargs.values())
                     if isinstance(a, ObjectRef)]
         return ser.dumps((args, kwargs)), arg_refs

@@ -29,6 +29,21 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.serialization import SerializedObject
 
 
+_EMPTY_ARGS_BLOB = None
+
+
+def _args_blob(args, kwargs) -> bytes:
+    """Pickle (args, kwargs) for the wire; no-arg calls share one
+    cached blob (the common case for control-heavy loads — skips a
+    cloudpickle round per submit)."""
+    global _EMPTY_ARGS_BLOB
+    if not args and not kwargs:
+        if _EMPTY_ARGS_BLOB is None:
+            _EMPTY_ARGS_BLOB = ser.dumps(((), {}))
+        return _EMPTY_ARGS_BLOB
+    return ser.dumps((args, kwargs))
+
+
 class ClientRuntime:
     """Worker-side proxy of the driver runtime over the unix socket.
 
@@ -557,7 +572,7 @@ class ClientRuntime:
             # Streaming returns need the head-owned generator state:
             # keep the synchronous path.
             ref_bytes = self._call(P.OP_SUBMIT, (
-                fn_id, fn_blob, fn_name, ser.dumps((args, kwargs)),
+                fn_id, fn_blob, fn_name, _args_blob(args, kwargs),
                 ser.dumps(options)))
             from ray_tpu.core.object_ref import ObjectRefGenerator
             return ObjectRefGenerator(ref_bytes[1], _owner=True)
@@ -586,7 +601,7 @@ class ClientRuntime:
             except Exception:  # noqa: BLE001
                 pass
         self._call_async(P.OP_SUBMIT_OWNED, (
-            fn_id, fn_blob, fn_name, ser.dumps((args, kwargs)),
+            fn_id, fn_blob, fn_name, _args_blob(args, kwargs),
             opts_blob, task_id.binary(),
             [o.binary() for o in return_ids], nonces))
         refs = []
@@ -750,7 +765,7 @@ class ClientRuntime:
                      max_restarts: int = 0,
                      max_concurrency: int = 1) -> ActorID:
         actor_id_bytes = self._call(P.OP_CREATE_ACTOR, (
-            cls_blob, cls_name, ser.dumps((args, kwargs)),
+            cls_blob, cls_name, _args_blob(args, kwargs),
             ser.dumps(options), name, max_restarts, max_concurrency))
         return ActorID(actor_id_bytes)
 
@@ -760,7 +775,7 @@ class ClientRuntime:
         if num_returns == "streaming":
             # Streaming needs the head-owned generator: sync path.
             ref_bytes = self._call(P.OP_SUBMIT_ACTOR, (
-                actor_id.binary(), method, ser.dumps((args, kwargs)),
+                actor_id.binary(), method, _args_blob(args, kwargs),
                 num_returns, trace_ctx))
             from ray_tpu.core.object_ref import ObjectRefGenerator
             return ObjectRefGenerator(ref_bytes[1], _owner=True)
@@ -776,7 +791,7 @@ class ClientRuntime:
                       for i in range(num_returns)]
         nonces = [_new_nonce() for _ in return_ids]
         self._call_async(P.OP_SUBMIT_ACTOR_OWNED, (
-            actor_id.binary(), method, ser.dumps((args, kwargs)),
+            actor_id.binary(), method, _args_blob(args, kwargs),
             num_returns, trace_ctx, task_id.binary(),
             [o.binary() for o in return_ids], nonces))
         refs = []

