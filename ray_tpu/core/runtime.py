@@ -1339,7 +1339,13 @@ class DriverRuntime:
         args payload (owned submits: the client's blob, proven
         ref-free) instead of re-serializing — safe ONLY when the blob
         contains no pickled ObjectRefs (each carries a one-shot
-        nonce that must be re-minted per hop)."""
+        nonce that must be re-minted per hop).
+
+        NB the preminted non-streaming registration sequence below
+        (dup check, lineage gate, PENDING event, pending add, ref
+        pins) is MIRRORED by _handle_owned_submit_many's batch
+        transaction — change one, change both
+        (tests/test_core_regressions.py pins their equivalence)."""
         if fn_blob is not None:
             self._fn_cache.setdefault(fn_id, fn_blob)
         # Resolve the runtime env now: a broken env (task- OR
@@ -3921,15 +3927,62 @@ class DriverRuntime:
                 return
             self._client_op_pool.submit(handle, req_id, op, payload)
 
+        def handle_submit_run(subs) -> None:
+            """A CONSECUTIVE run of OP_SUBMIT_OWNED triples from one
+            REQ_BATCH: dd bookkeeping stays per-item; the survivors
+            register through the batch transaction (one lock pass,
+            one dispatcher wakeup). Replies (rare — submits are
+            fire-and-forget) are sent after the transaction, which a
+            later get on this connection cannot overtake because the
+            reader thread is still here."""
+            to_run: list = []
+            dds: list = []
+            for req_id, _op, payload in subs:
+                dd, sp = P.unwrap_dd(payload)
+                if dd is not None and self._dd_begin(dd) is not None:
+                    dd = None          # replayed: cached, skip run
+                    sp = None
+                if sp is not None:
+                    to_run.append(sp)
+                    dds.append(dd)
+            if to_run:
+                if len(to_run) == 1 or self.local_mode:
+                    # local_mode has no dispatcher thread — only
+                    # submit_task's _execute_local branch (reached
+                    # via the scalar handler) runs the task.
+                    for sp in to_run:
+                        self._handle_owned_submit(
+                            sp, on_borrowed=record_conn_borrow)
+                else:
+                    self._handle_owned_submit_many(
+                        to_run, on_borrowed=record_conn_borrow)
+                for dd in dds:
+                    if dd is not None:
+                        self._dd_finish(dd, (P.ST_OK, None))
+            for req_id, _op, _payload in subs:
+                if req_id != -1:
+                    reply(req_id, P.ST_OK, None)
+
         try:
             while True:
                 req_id, op, payload = conn.recv()
                 if op == P.OP_REQ_BATCH:
                     # Coalesced requests from the client's outbox:
                     # processed strictly in order, exactly as if each
-                    # triple had arrived as its own message.
+                    # triple had arrived as its own message —
+                    # consecutive owned submits additionally share
+                    # one registration transaction.
+                    run: list = []
                     for sub in payload:
+                        if sub[1] == P.OP_SUBMIT_OWNED:
+                            run.append(sub)
+                            continue
+                        if run:
+                            handle_submit_run(run)
+                            run = []
                         handle_one(*sub)
+                    if run:
+                        handle_submit_run(run)
                     continue
                 handle_one(req_id, op, payload)
         except (EOFError, OSError):
@@ -4506,6 +4559,15 @@ class DriverRuntime:
             packed = ((args_kwargs_blob, [])
                       if rehydrate_stats.count == c0 else None)
             options = self._loads_options_cached(opts_blob)
+            if options.num_returns == "streaming":
+                # No preminted ids can carry generator state, and the
+                # pin loop below would otherwise ITERATE the returned
+                # ObjectRefGenerator (blocking this reader thread on
+                # stream_next). The in-repo client routes streaming
+                # via the synchronous submit op.
+                raise RuntimeError(
+                    "streaming returns cannot use the owned submit "
+                    "op; use the synchronous submit")
             refs = self.submit_task(
                 fn_id, fn_blob, fn_name, args, kwargs, options,
                 preminted=(TaskID(tid_bytes), return_ids),
@@ -4525,6 +4587,141 @@ class DriverRuntime:
             blob = ser.dumps(err)
             for oid in return_ids:
                 self._store_error(oid, blob)
+
+    def _handle_owned_submit_many(self, payloads: list,
+                                  on_borrowed=None) -> None:
+        """Batch transaction for a RUN of owned submits arriving in
+        one client REQ_BATCH frame: per-item decode/record-build with
+        per-item error isolation (failures land on that item's
+        preminted return ids), then ONE task-lock acquisition
+        registering every record and ONE _res_cv acquisition adding
+        them all to the pending queue with a single dispatcher
+        wakeup. A 50-task storm burst previously paid 50 lock
+        round-trips and 50 notify_all context-switch kicks on this
+        path. Semantics match per-item _handle_owned_submit exactly
+        (connection order preserved — the caller batches only
+        CONSECUTIVE submits)."""
+        from ray_tpu.core.object_ref import rehydrate_stats
+        staged = []                       # (rec, return_ids, nonces)
+        for payload in payloads:
+            (fn_id, fn_blob, fn_name, args_kwargs_blob, opts_blob,
+             tid_bytes, rid_bytes, nonces) = payload
+            return_ids = [ObjectID(b) for b in rid_bytes]
+            try:
+                if fn_blob is not None:
+                    self._fn_cache.setdefault(fn_id, fn_blob)
+                c0 = rehydrate_stats.count
+                args, kwargs = ser.loads(args_kwargs_blob)
+                options = self._loads_options_cached(opts_blob)
+                if options.num_returns == "streaming":
+                    # Streaming returns need head-minted generator
+                    # state and have no preminted return ids to carry
+                    # them — the in-repo client routes them via the
+                    # synchronous OP_SUBMIT; an owned streaming
+                    # submit is a protocol error, stored as such.
+                    raise RuntimeError(
+                        "streaming returns cannot use the owned "
+                        "submit op; use the synchronous submit")
+                if rehydrate_stats.count == c0:
+                    args_blob, arg_refs = args_kwargs_blob, []
+                else:
+                    args_blob, arg_refs = self._pack_args(args,
+                                                          kwargs)
+                env_key, env_vars = self._env_for_options_cached(
+                    options)
+                rec = TaskRecord(
+                    task_id=TaskID(tid_bytes), fn_id=fn_id,
+                    name=fn_name or "task", args_blob=args_blob,
+                    arg_refs=arg_refs, options=options,
+                    return_ids=return_ids,
+                    submitted_at=time.time(),
+                    env_key=env_key, env_vars=env_vars)
+                # Anything _pending_add_locked derives (scheduling
+                # class, effective resources) is derived HERE, inside
+                # this item's isolation, so a malformed options dict
+                # (e.g. unsortable mixed-type resource keys) fails as
+                # THIS item's error instead of blowing up later while
+                # holding _res_cv. Same options-level cache as
+                # _pending_add_locked.
+                cache = getattr(options, "_sched_cache", None)
+                if cache is None:
+                    need = self._effective_resources(options)
+                    cache = (need, self._sched_class(need, options))
+                    options._sched_cache = cache
+                rec.need, rec.sched_class = cache
+                staged.append((rec, return_ids, nonces))
+            except BaseException as e:  # noqa: BLE001
+                err = e if isinstance(e, Exception) else \
+                    RuntimeError(repr(e))
+                blob = ser.dumps(err)
+                for oid in return_ids:
+                    self._store_error(oid, blob)
+        if not staged:
+            return
+        fresh = []
+        with self._task_lock:
+            for rec, return_ids, nonces in staged:
+                if rec.task_id in self._tasks:
+                    continue              # dd-evicted replay
+                self._tasks[rec.task_id] = rec
+                fresh.append((rec, return_ids, nonces))
+
+        def fail_item(rec, return_ids, e) -> None:
+            # Per-item isolation through the bulk phases: mirror the
+            # scalar path (error stored on the item's return ids) and
+            # un-register so a dd replay can re-run it cleanly.
+            with self._task_lock:
+                self._tasks.pop(rec.task_id, None)
+            blob = ser.dumps(e if isinstance(e, Exception)
+                             else RuntimeError(repr(e)))
+            for oid in return_ids:
+                self._store_error(oid, blob)
+
+        enqueued = []
+        for item in fresh:
+            rec, return_ids, nonces = item
+            try:
+                effective_retries = (rec.options.max_retries
+                                     if rec.options.max_retries >= 0
+                                     else self.config.task_max_retries)
+                if (effective_retries > 0
+                        and self.config.lineage_cache_max_bytes > 0):
+                    self._lineage_put(rec.task_id, LineageRecord(
+                        fn_id=rec.fn_id, name=rec.name,
+                        args_blob=rec.args_blob,
+                        arg_refs=list(rec.arg_refs),
+                        options=rec.options,
+                        return_ids=list(rec.return_ids),
+                        nbytes=len(rec.args_blob) + 256))
+                self._event(rec, "PENDING")
+                enqueued.append(item)
+            except BaseException as e:  # noqa: BLE001
+                fail_item(rec, return_ids, e)
+        with self._res_cv:
+            kept = []
+            for item in enqueued:
+                try:
+                    self._pending_add_locked(item[0])
+                    kept.append(item)
+                except BaseException as e:  # noqa: BLE001
+                    fail_item(item[0], item[1], e)
+            self._res_cv.notify_all()
+        for rec, return_ids, nonces in kept:
+            try:
+                # Transient driver-side refs are registered FIRST and
+                # kept alive through the escape+borrow registration
+                # (their GC release is balanced by register_ref) —
+                # same ordering as the scalar path via submit_task's
+                # returned refs.
+                refs = [self.register_ref(ObjectRef(oid))
+                        for oid in return_ids]
+                for r, nonce in zip(refs, nonces):
+                    self.on_ref_escaped(r.id, nonce)
+                    self.on_borrow_add(r.id, nonce)
+                    if on_borrowed is not None:
+                        on_borrowed(r.id)
+            except BaseException as e:  # noqa: BLE001
+                fail_item(rec, return_ids, e)
 
     def _handle_owned_actor_submit(self, payload,
                                    on_borrowed=None) -> None:

@@ -182,3 +182,57 @@ def test_streaming_consumed_from_worker_context(rt):
     g = Gen.remote()
     out = ray_tpu.get(Consumer.remote().consume.remote(g), timeout=60)
     assert out == [0, 10, 20]
+
+
+def test_batched_submit_run_matches_scalar(rt):
+    """The REQ_BATCH consecutive-submit transaction
+    (_handle_owned_submit_many) must behave exactly like per-item
+    _handle_owned_submit: results in order, per-item error isolation
+    (one failing item cannot strand its batch-mates or kill the
+    connection), interleaved with order-sensitive actor traffic."""
+    @ray_tpu.remote(num_cpus=0)
+    def storm_client():
+        @ray_tpu.remote(num_cpus=1)
+        def ident(i):
+            return i
+
+        @ray_tpu.remote(num_cpus=1)
+        def boom():
+            raise ValueError("kaput")
+
+        # Tight submission loop from a worker client: the outbox
+        # coalesces bursts into REQ_BATCH frames, exercising the
+        # batched run path (plus error isolation inside a burst).
+        refs = [ident.remote(i) for i in range(60)]
+        bad = boom.remote()
+        refs2 = [ident.remote(100 + i) for i in range(60)]
+        out = ray_tpu.get(refs) + ray_tpu.get(refs2)
+        try:
+            ray_tpu.get(bad, timeout=30)
+            return "missed-error"
+        except Exception as e:
+            if "kaput" not in str(e):
+                return f"wrong-error: {e}"
+        return out
+
+    out = ray_tpu.get(storm_client.remote(), timeout=120)
+    assert out == list(range(60)) + list(range(100, 160)), out[:10]
+
+
+def test_owned_streaming_submit_rejected(rt):
+    """Streaming returns must NOT ride the owned-submit op (no
+    preminted ids can carry generator state; the pin loop would
+    iterate — i.e. block on — the generator). The client routes them
+    through the synchronous submit instead, which must keep working
+    from worker clients whose other traffic batches."""
+    @ray_tpu.remote(num_cpus=0)
+    def consumer():
+        @ray_tpu.remote(num_cpus=1)
+        def gen(n):
+            for i in range(n):
+                yield i * 3
+
+        g = gen.options(num_returns="streaming").remote(4)
+        return [ray_tpu.get(r, timeout=30) for r in g]
+
+    assert ray_tpu.get(consumer.remote(), timeout=120) == [0, 3, 6, 9]
