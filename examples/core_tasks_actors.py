@@ -1,0 +1,42 @@
+"""Tasks, actors, objects — the core API in one script."""
+
+import ray_tpu
+
+ray_tpu.init(num_cpus=4)
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+# tasks fan out; get() gathers
+print("squares:", ray_tpu.get([square.remote(i) for i in range(8)]))
+
+# objects: put once, share by reference
+big = ray_tpu.put(list(range(10_000)))
+
+@ray_tpu.remote
+def head3(xs):
+    return xs[:3]
+
+print("head3:", ray_tpu.get(head3.remote(big)))
+
+# actors hold state across calls
+c = Counter.remote()
+ray_tpu.get([c.incr.remote() for _ in range(10)])
+print("count:", ray_tpu.get(c.incr.remote(0)))
+
+# wait: first-completed semantics
+refs = [square.remote(i) for i in range(4)]
+done, rest = ray_tpu.wait(refs, num_returns=2, timeout=30)
+print("done/rest:", len(done), len(rest))
+
+ray_tpu.shutdown()

@@ -1,0 +1,23 @@
+"""Dataset: read -> transform -> shuffle -> iterate."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data
+
+ray_tpu.init(num_cpus=4)
+
+ds = (data.range(1000)
+      .map_batches(lambda b: {"x": [v * 2 for v in b["id"]]})
+      .filter(lambda row: row["x"] % 40 == 0)
+      .random_shuffle(seed=7))
+print("count:", ds.count())
+print("take:", ds.take(5))
+
+# feed a training loop in device-ready batches
+for batch in ds.iter_batches(batch_size=8):
+    arr = np.asarray(batch["x"])
+    break
+print("first batch:", arr)
+
+ray_tpu.shutdown()

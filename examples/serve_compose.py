@@ -1,0 +1,42 @@
+"""Two composed deployments behind HTTP."""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=4)
+
+@serve.deployment
+class Embedder:
+    def __call__(self, text):
+        return {"embedding": [len(w) for w in text.split()]}
+
+@serve.deployment(num_replicas=2)
+class App:
+    def __init__(self, embedder):
+        self.embedder = embedder
+
+    async def __call__(self, request):
+        if hasattr(request, "json"):
+            body = await request.json()
+        else:
+            body = request
+        emb = await self.embedder.remote(body["text"])
+        return {"dims": len(emb["embedding"]), **emb}
+
+handle = serve.run(App.bind(Embedder.bind()), http_port=8099)
+
+# direct handle call
+print(ray_tpu.get(handle.remote({"text": "hello tpu native serve"})))
+
+# HTTP call
+req = urllib.request.Request(
+    "http://127.0.0.1:8099/", method="POST",
+    data=json.dumps({"text": "over http"}).encode(),
+    headers={"Content-Type": "application/json"})
+print(json.load(urllib.request.urlopen(req)))
+
+serve.shutdown()
+ray_tpu.shutdown()
