@@ -16,6 +16,7 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler, ASHAScheduler, HyperBandScheduler,
     MedianStoppingRule, PopulationBasedTraining,
 )
+from ray_tpu.tune.pb2 import PB2  # noqa: E402
 from ray_tpu.tune.tune import (
     Tuner, TuneConfig, Trial, ResultGrid, TrialResult,
 )
@@ -26,6 +27,6 @@ __all__ = [
     "BayesOptSearcher", "BOHBSearcher",
     "ConcurrencyLimiter", "Searcher", "OptunaSearch",
     "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
+    "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "Tuner", "TuneConfig", "Trial", "ResultGrid", "TrialResult",
 ]

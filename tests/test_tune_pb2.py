@@ -1,0 +1,99 @@
+"""PB2 (Population Based Bandits) scheduler tests.
+
+Reference analog: python/ray/tune/schedulers/pb2.py — PBT exploit +
+GP-bandit explore. The GP is exercised directly on a known function,
+the explore step is bound-checked, and an e2e Tuner run must
+measurably steer the population toward the good region (vs where it
+started), which random PBT perturbation cannot do directionally.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.tune import PB2, TuneConfig, Tuner, uniform
+from ray_tpu.tune.pb2 import _TinyGP
+
+
+def test_tiny_gp_recovers_argmax():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(0, 1, (40, 1))
+    y = -((X[:, 0] - 0.7) ** 2)          # max at 0.7
+    gp = _TinyGP()
+    gp.fit(X, (y - y.mean()) / (y.std() + 1e-9))
+    grid = np.linspace(0, 1, 101)[:, None]
+    mu, sigma = gp.predict(grid)
+    assert abs(grid[int(np.argmax(mu)), 0] - 0.7) < 0.07
+    assert (sigma >= 0).all()
+
+
+def test_explore_respects_bounds_and_categoricals():
+    sch = PB2(metric="score", mode="max",
+              hyperparam_bounds={"lr": [1e-4, 1e-1]},
+              hyperparam_mutations={"opt": ["sgd", "adam"]},
+              seed=0)
+    # Feed enough observations for a GP fit.
+    for i, trial in enumerate(("a", "b", "c")):
+        sch.on_trial_add(trial, {"lr": 0.01 * (i + 1), "opt": "sgd"})
+        for t in range(1, 6):
+            sch.on_result(trial, {"score": t * (i + 1) * 0.01,
+                                  "training_iteration": t})
+    for _ in range(10):
+        cfg = sch._explore({"lr": 0.05, "opt": "sgd"})
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        assert cfg["opt"] in ("sgd", "adam")
+
+
+def test_pb2_requires_some_search_space():
+    with pytest.raises(ValueError):
+        PB2(metric="m")
+
+
+def _pb2_trainable(config):
+    """Reward rate maximized at lr ~ 0.8; resumes from the donor
+    checkpoint on exploit (same session convention as the PBT e2e)."""
+    import json
+    import os
+    import tempfile
+
+    from ray_tpu.train import Checkpoint, get_context, report
+    ctx = get_context()
+    score, start = 0.0, 0
+    if ctx.restored_checkpoint_dir:
+        with open(os.path.join(ctx.restored_checkpoint_dir,
+                               "state.json")) as f:
+            st = json.load(f)
+        score, start = st["score"], st["step"]
+    lr = config["lr"]
+    for step in range(start, 12):
+        score += 1.0 - (lr - 0.8) ** 2          # best at lr=0.8
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "state.json"), "w") as f:
+            json.dump({"score": score, "step": step + 1}, f)
+        report({"score": score, "training_iteration": step + 1},
+               checkpoint=Checkpoint.from_directory(d))
+
+
+def test_pb2_e2e_steers_population(rt):
+    """Trials start in the bad region [0.0, 0.3]; after
+    exploit/explore cycles the population must have moved toward
+    higher lr — directional movement random PBT perturbation cannot
+    produce."""
+    sch = PB2(metric="score", mode="max",
+              perturbation_interval=3,
+              hyperparam_bounds={"lr": [0.0, 1.0]}, seed=0)
+    tuner = Tuner(
+        _pb2_trainable,
+        param_space={"lr": uniform(0.0, 0.3)},   # start in bad region
+        tune_config=TuneConfig(num_samples=4, metric="score",
+                               mode="max", scheduler=sch,
+                               max_concurrent_trials=4),
+    )
+    results = tuner.fit()
+    assert sch.exploit_count > 0
+    final_lrs = [sch._config[t]["lr"] for t in sch._config]
+    # The population's best configs moved toward the optimum: at
+    # least one explored config above the initial 0.3 ceiling.
+    assert max(final_lrs) > 0.3, final_lrs
+    best = results.get_best_result(metric="score", mode="max")
+    assert best.metrics["score"] > 0
