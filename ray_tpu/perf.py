@@ -342,6 +342,37 @@ def _run_benchmarks(rec, quick: bool) -> None:
     print(json.dumps(mc), flush=True)
     rec(mc)
 
+    # Small-object put storm from N client processes (reference:
+    # multi_client_put_calls_Plasma_Store — many writers, 1 KiB
+    # objects; measures the control/ingest path, not bandwidth).
+    @ray_tpu.remote(num_cpus=0)
+    def _put_calls_worker(barrier, n_calls: int):
+        payload = b"x" * 1024
+        for _ in range(50):                 # warm channel + arena
+            r = ray_tpu.put(payload)
+            del r
+        if not ray_tpu.get(barrier.arrive.remote(), timeout=90):
+            raise RuntimeError("put-calls barrier timed out")
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            r = ray_tpu.put(payload)
+            del r
+        return n_calls / (time.perf_counter() - t0)
+
+    n_calls = 200 if quick else 2000
+    barrier2 = _Barrier.options(
+        max_concurrency=n_clients + 1).remote(n_clients)
+    rates = ray_tpu.get(
+        [_put_calls_worker.remote(barrier2, n_calls)
+         for _ in range(n_clients)],
+        timeout=300)
+    row = {"metric": "multi_client_put_calls_1KiB",
+           "value": round(sum(rates), 1), "unit": "calls/s",
+           "extra": {"clients": n_clients,
+                     "per_client": [round(r) for r in rates]}}
+    print(json.dumps(row), flush=True)
+    rec(row)
+
 
 def run_serve_bench(quick: bool = False) -> dict:
     """Serve requests/s through a 2-replica deployment (steady-state
