@@ -32,6 +32,65 @@ from ray_tpu.data.block import (
     slice_block, to_block,
 )
 
+class _ExecStats:
+    """Per-stage pull timing for one streaming execution (the
+    reference's DatasetStats analog, scoped to what the pull-based
+    executor can observe: blocks yielded + time the consumer spent
+    blocked in each stage's generator).
+
+    The stage wrappers are strictly NESTED (the consumer pulls only
+    the outermost; each stage's next() blocks inside its upstream's
+    next()), so a stage's raw accrual includes everything upstream —
+    ``summary()`` reports SELF time (own accrual minus the stage
+    directly beneath), which is what identifies the bottleneck."""
+
+    def __init__(self):
+        self.stages: list[dict] = []
+        self._t0 = None                 # first actual consumer pull
+        self._t_last = None             # last yield observed
+
+    def timed(self, name: str, refs):
+        import time as _time
+        entry = {"stage": name, "blocks": 0, "wait_s": 0.0}
+        self.stages.append(entry)
+        if refs is None:
+            return refs
+
+        def gen():
+            it = iter(refs)
+            while True:
+                t0 = _time.perf_counter()
+                if self._t0 is None:
+                    self._t0 = t0       # lazy: on the first pull
+                try:
+                    r = next(it)
+                except StopIteration:
+                    entry["wait_s"] += _time.perf_counter() - t0
+                    return
+                self._t_last = _time.perf_counter()
+                entry["wait_s"] += self._t_last - t0
+                entry["blocks"] += 1
+                yield r
+
+        return gen()
+
+    def summary(self) -> str:
+        total = ((self._t_last - self._t0)
+                 if self._t0 is not None and self._t_last is not None
+                 else 0.0)
+        lines = ["Dataset execution stats:"]
+        prev_wait = 0.0
+        for e in self.stages:
+            self_wait = max(0.0, e["wait_s"] - prev_wait)
+            prev_wait = e["wait_s"]
+            lines.append(
+                f"  {e['stage']:<12} {e['blocks']:>5} blocks   "
+                f"self pull-wait {self_wait * 1e3:8.1f} ms")
+        lines.append(f"  total wall (first pull -> last block): "
+                     f"{total * 1e3:.1f} ms")
+        return "\n".join(lines)
+
+
 # -- logical ops -----------------------------------------------------------
 
 @dataclass
@@ -307,12 +366,14 @@ class Dataset:
                        ) -> Iterator[ray_tpu.ObjectRef]:
         """The streaming executor: yields block refs in order with at
         most max_in_flight tasks outstanding (default: the
-        DataContext knob)."""
+        DataContext knob). Each stage's pull is timed into
+        ``_last_stats`` (consumed by ``stats()``)."""
         if max_in_flight is None:
             from ray_tpu.data.context import DataContext
             max_in_flight = DataContext.get_current().max_in_flight
         from ray_tpu.data.optimizer import optimize
         stages = _split_stages(optimize(self._plan))
+        self._last_stats = _ExecStats()
         refs = None
 
         # Bind stage payloads BY VALUE: these generators evaluate
@@ -356,7 +417,22 @@ class Dataset:
                 refs = itertools.chain(
                     refs, *(o._stream_blocks(max_in_flight)
                             for o in payload.others))
+            refs = self._last_stats.timed(kind, refs)
         return refs
+
+    def stats(self) -> str:
+        """Execution stats of the LAST run of this dataset's plan
+        (reference: Dataset.stats() — per-operator summaries).
+        Per-stage block counts and pull-blocked wall time: stages
+        stream concurrently, so each stage's time is the time the
+        consumer spent WAITING on that stage (already-prefetched
+        blocks count ~0), which is exactly what identifies the
+        bottleneck stage."""
+        st = getattr(self, "_last_stats", None)
+        if st is None or not st.stages:
+            return ("Dataset has not been executed yet — iterate or "
+                    "materialize it first, then call stats().")
+        return st.summary()
 
     def iter_blocks(self, max_in_flight: int | None = None):
         for ref in self._stream_blocks(max_in_flight):

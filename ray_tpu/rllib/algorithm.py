@@ -8,7 +8,9 @@ import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable
 
-from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.env_runner import (
+    EnvRunnerGroup, SupportsEvaluation,
+)
 from ray_tpu.rllib.checkpoints import Checkpointable, tree_to_host
 from ray_tpu.rllib.learner import JaxLearner, PPOHyperparams
 
@@ -62,7 +64,7 @@ class AlgorithmConfig:
 PPOConfig = AlgorithmConfig
 
 
-class PPO(Checkpointable):
+class PPO(Checkpointable, SupportsEvaluation):
     """Proximal Policy Optimization on the new-API-stack layout."""
 
     def __init__(self, config: AlgorithmConfig):

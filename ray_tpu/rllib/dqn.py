@@ -21,7 +21,9 @@ import optax
 
 from ray_tpu.rllib.catalog import build_q_network
 from ray_tpu.rllib.checkpoints import Checkpointable, tree_to_host
-from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.env_runner import (
+    EnvRunnerGroup, SupportsEvaluation,
+)
 
 
 @dataclass
@@ -159,7 +161,7 @@ class DQNConfig:
         return DQN(self)
 
 
-class DQN(Checkpointable):
+class DQN(Checkpointable, SupportsEvaluation):
     def __init__(self, config: DQNConfig):
         assert config.env is not None
         self.config = config
@@ -242,6 +244,17 @@ class DQN(Checkpointable):
             "time_learn_s": round(learn_time, 3),
             **metrics,
         }
+
+    def evaluate(self, num_episodes: int = 10) -> dict:
+        """Greedy-policy evaluation: epsilon forced to 0 for the
+        eval rounds and restored after (reference: Algorithm.evaluate
+        runs with explore=False — the training epsilon would make
+        this measure the exploration policy, not the learned one)."""
+        self.runners.set_epsilon(0.0)
+        try:
+            return super().evaluate(num_episodes)
+        finally:
+            self.runners.set_epsilon(self._epsilon())
 
     def stop(self) -> None:
         self.runners.shutdown()

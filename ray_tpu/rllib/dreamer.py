@@ -45,7 +45,9 @@ import numpy as np
 import optax
 
 from ray_tpu.rllib.checkpoints import Checkpointable, tree_to_host
-from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.env_runner import (
+    EnvRunnerGroup, SupportsEvaluation,
+)
 
 
 def symlog(x):
@@ -600,7 +602,7 @@ class DreamerConfig:
         return Dreamer(self)
 
 
-class Dreamer(Checkpointable):
+class Dreamer(Checkpointable, SupportsEvaluation):
     """Dreamer algorithm under the shared Algorithm surface
     (train() -> metrics dict; Checkpointable save/restore)."""
 

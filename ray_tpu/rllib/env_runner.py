@@ -33,6 +33,10 @@ class Episode:
     # chunk continues across sample() calls). The learner replays
     # from it so BPTT segments see their true rollout state.
     state_in: Any = None
+    # False when this chunk CONTINUES an episode whose head was
+    # collected in an earlier sample() call — evaluation must not
+    # count such tails as full episodes.
+    started_at_reset: bool = True
 
     @property
     def length(self) -> int:
@@ -105,6 +109,7 @@ class EnvRunner:
             self._fwd = jax.jit(
                 lambda p, o: self.model.apply({"params": p}, o))
         self._obs, _ = self.env.reset(seed=seed)
+        self._at_reset = True       # no steps taken since env reset
         # Transformed current obs: each observation passes through the
         # (possibly stateful) env_to_module pipeline EXACTLY once —
         # bootstrap values and episode records reuse this cache, so
@@ -161,7 +166,7 @@ class EnvRunner:
         return a, a, float(logp[0]), 0.0
 
     def _new_episode(self) -> Episode:
-        ep = Episode()
+        ep = Episode(started_at_reset=self._at_reset)
         if self._stateful:
             ep.state_in = np.asarray(self._carry[0])
         return ep
@@ -175,6 +180,7 @@ class EnvRunner:
             env_action, action, logp, value = self._act(obs)
             env_action = self.module_to_env(env_action, {})
             next_obs, reward, term, trunc, _ = self.env.step(env_action)
+            self._at_reset = False
             ep.obs.append(obs)
             ep.actions.append(action)
             ep.rewards.append(float(reward))
@@ -193,6 +199,7 @@ class EnvRunner:
                 episodes.append(ep)
                 if self._stateful:
                     self._carry = self.model.initial_state(1)
+                self._at_reset = True
                 ep = self._new_episode()
                 self._obs, _ = self.env.reset()
                 self._tobs = np.asarray(self.env_to_module(
@@ -237,17 +244,27 @@ class EnvRunnerGroup:
         ]
 
     def sample(self, steps_per_runner: int) -> list[Episode]:
+        return [ep for chunks in
+                self.sample_per_runner(steps_per_runner)
+                for ep in chunks]
+
+    def sample_per_runner(self, steps_per_runner: int
+                          ) -> list[list[Episode]]:
+        """Per-runner episode-chunk lists (order within each runner
+        preserved — evaluation stitches multi-round episodes on it).
+        A lost runner is respawned and contributes [] this round."""
         refs = [r.sample.remote(steps_per_runner) for r in self.runners]
-        episodes: list[Episode] = []
+        out: list[list[Episode]] = []
         for i, ref in enumerate(refs):
             try:
-                episodes.extend(ray_tpu.get(ref, timeout=300))
+                out.append(ray_tpu.get(ref, timeout=300))
             except Exception:  # noqa: BLE001 — respawn lost runner
                 self.runners[i] = EnvRunner.remote(
                     self._maker, self._policy_config,
                     self._seed + i + 1000, self._policy,
                     self._e2m, self._m2e)
-        return episodes
+                out.append([])
+        return out
 
     def set_weights(self, params) -> None:
         ref = ray_tpu.put(params)   # broadcast via object store
@@ -264,3 +281,62 @@ class EnvRunnerGroup:
                 ray_tpu.kill(r)
             except Exception:  # noqa: BLE001
                 pass
+
+
+def evaluate_policy(runners: "EnvRunnerGroup",
+                    num_episodes: int = 10,
+                    max_rounds: int = 50) -> dict:
+    """Evaluate the runners' CURRENT weights over ``num_episodes``
+    COMPLETE episodes (reference: Algorithm.evaluate / evaluation
+    EnvRunners; the training runners double as evaluators because
+    weights are pushed eagerly after every update).
+
+    Chunks are stitched PER RUNNER: sample() yields episode chunks,
+    and an episode longer than one round spans several chunks — the
+    per-runner pending accumulator carries reward/length across
+    rounds, so long episodes are counted exactly. A pending head
+    whose first chunk did NOT start at an env reset is the tail of a
+    TRAINING episode and is discarded at completion (its reward
+    total would be a lie)."""
+    pending = [None] * len(runners.runners)   # (reward, length, at_reset)
+    rewards: list[float] = []
+    lengths: list[int] = []
+    rounds = 0
+    while len(rewards) < num_episodes and rounds < max_rounds:
+        per_runner = runners.sample_per_runner(256)
+        for i, chunks in enumerate(per_runner):
+            for ep in chunks:
+                if pending[i] is None:
+                    pending[i] = [0.0, 0, ep.started_at_reset]
+                pending[i][0] += ep.total_reward
+                pending[i][1] += ep.length
+                if ep.terminated or ep.truncated:
+                    r, ln, clean = pending[i]
+                    pending[i] = None
+                    if clean:
+                        rewards.append(r)
+                        lengths.append(ln)
+        rounds += 1
+    rewards, lengths = rewards[:num_episodes], lengths[:num_episodes]
+    n = len(rewards)
+    return {
+        "evaluation": {
+            "episodes": n,
+            "episode_reward_mean": (sum(rewards) / n) if n else
+            float("nan"),
+            "episode_reward_min": min(rewards) if n else float("nan"),
+            "episode_reward_max": max(rewards) if n else float("nan"),
+            "episode_len_mean": (sum(lengths) / n) if n else
+            float("nan"),
+        }
+    }
+
+
+class SupportsEvaluation:
+    """Default Algorithm.evaluate over the training runner group —
+    ONE implementation shared by every runner-backed algorithm
+    (subclasses override to adjust exploration, e.g. DQN zeroes
+    epsilon for greedy evaluation)."""
+
+    def evaluate(self, num_episodes: int = 10) -> dict:
+        return evaluate_policy(self.runners, num_episodes)

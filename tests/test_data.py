@@ -108,3 +108,20 @@ def test_dataset_feeds_training(rt):
         assert "dp" in str(batch["x"].sharding.spec)
         seen += 16
     assert seen == 64
+
+
+def test_dataset_stats_reports_stages(rt):
+    """stats() (reference: Dataset.stats) — per-stage block counts
+    and pull-wait times from the LAST execution; unexecuted datasets
+    say so instead of lying."""
+    ds = (ray_tpu.data.range(64)
+          .map_batches(lambda b: {"id": [v + 1 for v in b["id"]]})
+          .random_shuffle(seed=3))
+    assert "not been executed" in ds.stats()
+    assert ds.count() == 64
+    out = ds.stats()
+    assert "source" in out and "shuffle" in out
+    # every stage yielded the full block set
+    import re
+    counts = [int(m) for m in re.findall(r"(\d+) blocks", out)]
+    assert counts and all(c == counts[0] for c in counts), out

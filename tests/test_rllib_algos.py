@@ -375,3 +375,61 @@ def test_cql_learns_point1d_offline(rt):
     mean_ep = total / 5
     # Random policy scores ~-6; decent control > -2.5.
     assert mean_ep > -2.5, f"CQL policy too weak: {mean_ep:.2f}"
+
+
+def test_algorithm_evaluate_full_episodes_only(rt):
+    """Algorithm.evaluate (reference: evaluation EnvRunners): reward
+    stats over COMPLETE episodes — tails of episodes begun during
+    training sampling must not count (they'd undercount reward)."""
+    class FixedRewardEnv:
+        def __init__(self):
+            self.t = 0
+
+        def reset(self, seed=None):
+            self.t = 0
+            return np.zeros(2, np.float32), {}
+
+        def step(self, action):
+            self.t += 1
+            return (np.zeros(2, np.float32), 1.0, self.t >= 5,
+                    False, {})
+
+    algo = (DQNConfig()
+            .environment(FixedRewardEnv, obs_dim=2, num_actions=2)
+            .build())
+    algo.train()          # leaves runners mid-episode
+    ev = algo.evaluate(num_episodes=6)["evaluation"]
+    # every complete episode is exactly 5 steps of +1
+    assert ev["episodes"] == 6
+    assert ev["episode_reward_mean"] == 5.0, ev
+    assert ev["episode_len_mean"] == 5.0
+    algo.stop()
+
+
+def test_evaluate_stitches_episodes_longer_than_a_round(rt):
+    """Episodes longer than one 256-step sample round span several
+    chunks; the per-runner stitcher must count them exactly once with
+    the FULL reward (a naive chunk filter would never count them and
+    return NaN)."""
+    class LongEnv:
+        def __init__(self):
+            self.t = 0
+
+        def reset(self, seed=None):
+            self.t = 0
+            return np.zeros(2, np.float32), {}
+
+        def step(self, action):
+            self.t += 1
+            return (np.zeros(2, np.float32), 1.0, self.t >= 400,
+                    False, {})
+
+    algo = (DQNConfig()
+            .environment(LongEnv, obs_dim=2, num_actions=2)
+            .env_runners(1)
+            .build())
+    ev = algo.evaluate(num_episodes=2)["evaluation"]
+    assert ev["episodes"] == 2, ev
+    assert ev["episode_reward_mean"] == 400.0, ev
+    assert ev["episode_len_mean"] == 400.0
+    algo.stop()
