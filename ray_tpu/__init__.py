@@ -26,6 +26,8 @@ from ray_tpu.core.api import (
     put,
     wait,
     cancel,
+    get_gpu_ids,
+    get_tpu_ids,
     kill,
     get_actor,
     available_resources,
@@ -61,7 +63,7 @@ __all__ = [
     "get",
     "put",
     "wait",
-    "cancel",
+    "cancel", "get_gpu_ids", "get_tpu_ids",
     "kill",
     "get_actor",
     "method",

@@ -52,3 +52,36 @@ def tpu_gang_resources() -> dict[str, float]:
     if pod and tpu_worker_id() == 0:
         out[f"TPU-{pod}-head"] = 1.0
     return out
+
+
+def get_tpu_ids() -> list[int]:
+    """Chip indices assigned to THIS process (reference analog:
+    ray.get_gpu_ids for the accelerator the scheduler manages).
+    Inside a CPU-only worker (JAX_PLATFORMS=cpu injected because the
+    task holds no TPU resource) this is []; a TPU-holding worker or
+    the driver sees the visible chips (TPU_VISIBLE_CHIPS when a
+    gang/slice assignment pinned them, else every detected chip)."""
+    import os
+    vis = os.environ.get("TPU_VISIBLE_CHIPS")
+    if vis:
+        return [int(x) for x in vis.split(",") if x.strip() != ""]
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return []
+    return list(range(detect_tpu_chips()))
+
+
+def get_gpu_ids() -> list[int]:
+    """Compatibility shim for code written against the reference's
+    ray.get_gpu_ids(): this framework schedules TPUs, not GPUs, so
+    the assigned-GPU list comes straight from CUDA_VISIBLE_DEVICES
+    (set by an external launcher if at all) and is [] on TPU hosts."""
+    import os
+    vis = os.environ.get("CUDA_VISIBLE_DEVICES", "").strip()
+    if not vis or vis == "NoDevFiles":
+        return []
+    out = []
+    for x in vis.split(","):
+        x = x.strip()
+        if x.isdigit():
+            out.append(int(x))
+    return out

@@ -233,6 +233,21 @@ def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
     return get_runtime().wait(list(refs), num_returns, timeout)
 
 
+def get_tpu_ids() -> list:
+    """Chip indices assigned to this process (see
+    core/accelerator.py — the reference's ray.get_gpu_ids analog for
+    the accelerator this framework schedules)."""
+    from ray_tpu.core.accelerator import get_tpu_ids as _g
+    return _g()
+
+
+def get_gpu_ids() -> list:
+    """Compatibility shim for reference code: assigned GPUs from
+    CUDA_VISIBLE_DEVICES; [] on TPU hosts."""
+    from ray_tpu.core.accelerator import get_gpu_ids as _g
+    return _g()
+
+
 def cancel(ref: ObjectRef, force: bool = False) -> None:
     get_runtime().cancel(ref, force)
 
