@@ -66,6 +66,12 @@ def _pb2_trainable(config):
         score, start = st["score"], st["step"]
     lr = config["lr"]
     for step in range(start, 12):
+        import time
+        # Pace the steps so the population genuinely overlaps in
+        # time — on the sharded 1-core CI host, unpaced trials can
+        # serialize and the exploit quantile never sees 2+ live
+        # trials (same pacing as the PBT e2e).
+        time.sleep(0.03)
         score += 1.0 - (lr - 0.8) ** 2          # best at lr=0.8
         d = tempfile.mkdtemp()
         with open(os.path.join(d, "state.json"), "w") as f:
