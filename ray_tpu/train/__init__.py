@@ -23,7 +23,7 @@ from ray_tpu.train.config import (
 )
 from ray_tpu.train.session import (
     Checkpoint,
-    get_context,
+    get_checkpoint, get_context, get_dataset_shard,
     report,
 )
 from ray_tpu.train.trainer import JaxTrainer, Result
@@ -32,6 +32,6 @@ __all__ = [
     "TrainState", "init_train_state", "make_train_step",
     "make_multi_train_step", "shard_batch",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
-    "Checkpoint", "get_context", "report",
+    "Checkpoint", "get_checkpoint", "get_context", "get_dataset_shard", "report",
     "JaxTrainer", "Result",
 ]

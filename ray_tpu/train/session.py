@@ -28,6 +28,9 @@ class TrainContext:
     trial_dir: str = ""
     restored_checkpoint_dir: str | None = None
     loop_config: dict = field(default_factory=dict)
+    # Per-worker Data shards (trainer ``datasets=`` -> streaming_split
+    # -> this worker's DataIterator), keyed by dataset name.
+    dataset_shards: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -90,6 +93,28 @@ def report(metrics: dict[str, Any], checkpoint=None) -> None:
     """Report metrics (and optionally a checkpoint) from the training
     loop — the worker-side API (reference: train.report)."""
     get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint():
+    """The checkpoint this run was restored from, or None on a fresh
+    start (reference: ray.train.get_checkpoint — the canonical
+    resume pattern)."""
+    ctx = get_context()
+    if ctx.restored_checkpoint_dir:
+        return Checkpoint(ctx.restored_checkpoint_dir)
+    return None
+
+
+def get_dataset_shard(name: str = "train"):
+    """THIS worker's shard of the trainer's ``datasets[name]``
+    (reference: ray.train.get_dataset_shard over
+    Dataset.streaming_split)."""
+    shards = get_context().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}: pass datasets={{{name!r}: "
+            f"ds}} to the trainer (available: {sorted(shards)})")
+    return shards[name]
 
 
 def get_context() -> TrainContext:

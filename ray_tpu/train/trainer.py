@@ -63,11 +63,16 @@ class JaxTrainer:
                  *,
                  train_loop_config: dict | None = None,
                  scaling_config: ScalingConfig | None = None,
-                 run_config: RunConfig | None = None):
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None):
         self.train_loop = train_loop_per_worker
         self.loop_config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        # {name: Dataset} — streaming_split per worker at fit();
+        # workers read via train.get_dataset_shard(name)
+        # (reference: DataParallelTrainer datasets= + DataConfig).
+        self.datasets = datasets or {}
 
     # -- public API --
 
@@ -167,6 +172,10 @@ class JaxTrainer:
                 "trial_dir": trial_dir,
                 "restored_checkpoint_dir": restored,
             }
+            if self.datasets:
+                ctx_kwargs["dataset_shards_all"] = {
+                    name: ds.streaming_split(group.num_workers)
+                    for name, ds in self.datasets.items()}
             group.run("start_loop", (self.train_loop, self.loop_config),
                       ctx_kwargs, timeout=120)
 

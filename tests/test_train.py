@@ -177,3 +177,55 @@ def test_session_index_monotonic_after_restore():
     assert checkpoint_index(None) == -1
     assert checkpoint_index("/a/b/checkpoint_000004") == 4
     assert checkpoint_index("/a/b/weird") == -1
+
+
+def test_trainer_datasets_shard_to_workers(rt, tmp_path):
+    """datasets={...} (reference: DataParallelTrainer datasets= +
+    get_dataset_shard): streaming_split per worker, disjoint shards
+    covering every row exactly once; get_checkpoint() is None on a
+    fresh run."""
+    import json
+    import os
+
+    import ray_tpu
+    from ray_tpu import data
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    out_dir = str(tmp_path / "shards")
+    os.makedirs(out_dir, exist_ok=True)
+
+    def loop():
+        from ray_tpu.train import (
+            get_checkpoint, get_context, get_dataset_shard, report,
+        )
+        assert get_checkpoint() is None
+        ctx = get_context()
+        ids = []
+        for b in get_dataset_shard("train").iter_batches(
+                batch_size=16):
+            ids.extend(int(x) for x in b["id"])
+        with open(os.path.join(
+                os.environ["SHARD_OUT"],
+                f"rank{ctx.world_rank}.json"), "w") as f:
+            json.dump(ids, f)
+        report({"n": len(ids)})
+
+    os.environ["SHARD_OUT"] = out_dir
+    try:
+        tr = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=str(tmp_path)),
+            datasets={"train": data.range(100)})
+        res = tr.fit()
+        assert res.error is None, res.error
+    finally:
+        os.environ.pop("SHARD_OUT", None)
+    shards = []
+    for r in (0, 1):
+        with open(os.path.join(out_dir, f"rank{r}.json")) as f:
+            shards.append(json.load(f))
+    all_ids = sorted(shards[0] + shards[1])
+    assert all_ids == list(range(100))           # full coverage
+    assert not set(shards[0]) & set(shards[1])   # disjoint
+    assert shards[0] and shards[1]               # both worked
