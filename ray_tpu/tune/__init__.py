@@ -17,12 +17,24 @@ from ray_tpu.tune.schedulers import (
     MedianStoppingRule, PopulationBasedTraining,
 )
 from ray_tpu.tune.pb2 import PB2  # noqa: E402
+from ray_tpu.tune.compat import (  # noqa: E402
+    MaximumIterationStopper, Stopper, TrialPlateauStopper,
+    register_trainable, run, with_parameters, with_resources,
+)
+from ray_tpu.tune.search import (  # noqa: E402
+    lograndint, qlograndint, qloguniform, qrandint, qrandn,
+    quniform, randn, sample_from,
+)
 from ray_tpu.tune.tune import (
     Tuner, TuneConfig, Trial, ResultGrid, TrialResult,
 )
 
 __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
+    "quniform", "qloguniform", "qrandint", "qlograndint", "qrandn",
+    "lograndint", "randn", "sample_from",
+    "run", "register_trainable", "with_parameters", "with_resources",
+    "Stopper", "MaximumIterationStopper", "TrialPlateauStopper",
     "BasicVariantGenerator", "RandomSearcher", "TPESearcher",
     "BayesOptSearcher", "BOHBSearcher",
     "ConcurrencyLimiter", "Searcher", "OptunaSearch",

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import itertools
 import random
+import types
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -61,7 +62,64 @@ def randint(low: int, high: int) -> _RandInt:
     return _RandInt(low, high)
 
 
-def _sample(spec, rng: random.Random):
+@dataclass(frozen=True)
+class _Quantized:
+    """Quantized/derived continuous spec (reference: tune.quniform
+    family) — sample the base spec, post-process."""
+    base: object
+    q: float | None = None
+    as_int: bool = False
+
+
+@dataclass(frozen=True)
+class _Randn:
+    mean: float = 0.0
+    sd: float = 1.0
+
+
+@dataclass(frozen=True)
+class _SampleFrom:
+    """tune.sample_from(fn): fn(spec_context) -> value. The callable
+    receives the partially-sampled config (reference semantics allow
+    dependent parameters)."""
+    fn: object
+
+
+def quniform(low: float, high: float, q: float) -> _Quantized:
+    return _Quantized(_Uniform(low, high), q=q)
+
+
+def qloguniform(low: float, high: float, q: float) -> _Quantized:
+    return _Quantized(_LogUniform(low, high), q=q)
+
+
+def qrandint(low: int, high: int, q: int) -> _Quantized:
+    return _Quantized(_RandInt(low, high), q=float(q), as_int=True)
+
+
+def lograndint(low: int, high: int) -> _Quantized:
+    return _Quantized(_LogUniform(low, max(high - 1, low) + 1),
+                      as_int=True)
+
+
+def qlograndint(low: int, high: int, q: int) -> _Quantized:
+    return _Quantized(_LogUniform(low, max(high - 1, low) + 1),
+                      q=float(q), as_int=True)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> _Randn:
+    return _Randn(mean, sd)
+
+
+def qrandn(mean: float, sd: float, q: float) -> _Quantized:
+    return _Quantized(_Randn(mean, sd), q=q)
+
+
+def sample_from(fn) -> _SampleFrom:
+    return _SampleFrom(fn)
+
+
+def _sample(spec, rng: random.Random, partial_config: dict | None = None):
     import math
     if isinstance(spec, (_Choice, _GridSearch)):
         # Samplers treat grid_search dims as categorical (the grid
@@ -74,6 +132,16 @@ def _sample(spec, rng: random.Random):
                                     math.log(spec.high)))
     if isinstance(spec, _RandInt):
         return rng.randrange(spec.low, spec.high)
+    if isinstance(spec, _Randn):
+        return rng.gauss(spec.mean, spec.sd)
+    if isinstance(spec, _Quantized):
+        v = _sample(spec.base, rng)
+        if spec.q:
+            v = round(v / spec.q) * spec.q
+        return int(round(v)) if spec.as_int else float(v)
+    if isinstance(spec, _SampleFrom):
+        return spec.fn(types.SimpleNamespace(
+            config=dict(partial_config or {})))
     if callable(spec):
         return spec()
     return spec
@@ -130,7 +198,8 @@ class BasicVariantGenerator(Searcher):
                     if k in grid_keys:
                         cfg[k] = combo[grid_keys.index(k)]
                     else:
-                        cfg[k] = _sample(v, self.rng)
+                        cfg[k] = _sample(v, self.rng,
+                                         partial_config=cfg)
                 out.append(cfg)
         return out
 
@@ -402,7 +471,8 @@ class BayesOptSearcher(Searcher):
                 cfg[k] = vals[min(len(vals) - 1,
                                   round(u * (len(vals) - 1)))]
             else:
-                cfg[k] = _sample(spec, self.rng)
+                cfg[k] = _sample(spec, self.rng,
+                                 partial_config=cfg)
         return cfg
 
     def suggest(self, trial_id: str) -> dict | None:

@@ -31,6 +31,17 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 
 
+def _stop_requested(stop, trial_id: str, result: dict) -> bool:
+    """TuneConfig.stop in its three classic forms (Stopper /
+    callable / {metric: threshold})."""
+    if stop is None:
+        return False
+    if isinstance(stop, dict):
+        return any(k in result and result[k] >= v
+                   for k, v in stop.items())
+    return bool(stop(trial_id, result))
+
+
 @dataclass
 class TuneConfig:
     num_samples: int = 1
@@ -42,6 +53,11 @@ class TuneConfig:
     resources_per_trial: dict[str, float] = field(
         default_factory=lambda: {"CPU": 1.0})
     seed: int | None = None
+    # Per-result stop condition: a tune.Stopper, a callable
+    # (trial_id, result) -> bool, or a dict {metric: threshold}
+    # (stop when result[metric] >= threshold — classic tune.run
+    # semantics).
+    stop: Any = None
 
 
 @dataclass
@@ -322,9 +338,11 @@ class Tuner:
         os.makedirs(trial_dir, exist_ok=True)
         if hasattr(scheduler, "on_trial_add"):
             scheduler.on_trial_add(t.trial_id, t.config)
+        res = (getattr(fn, "_tune_resources", None)
+               or tc.resources_per_trial)
         t.actor = TrainWorker.options(
-            num_cpus=tc.resources_per_trial.get("CPU", 1.0),
-            resources={k: v for k, v in tc.resources_per_trial.items()
+            num_cpus=res.get("CPU", 1.0),
+            resources={k: v for k, v in res.items()
                        if k != "CPU"},
         ).remote(0, 1, {})
         ctx_kwargs = {
@@ -365,6 +383,9 @@ class Tuner:
                 # rung results, not only completions.
                 searcher.on_trial_result(t.trial_id, m)
             decision = scheduler.on_result(t.trial_id, m)
+            if decision not in (STOP, EXPLOIT) and \
+                    _stop_requested(tc.stop, t.trial_id, m):
+                decision = STOP
             if decision in (STOP, EXPLOIT):
                 break
         changed = bool(p["results"])

@@ -1,0 +1,63 @@
+"""Classic-tune compatibility surface (reference: tune.run family)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import report
+
+
+def test_samplers_shapes():
+    import random
+    from ray_tpu.tune.search import _sample
+    r = random.Random(0)
+    assert _sample(tune.quniform(0, 1, 0.25), r) in (
+        0.0, 0.25, 0.5, 0.75, 1.0)
+    assert isinstance(_sample(tune.qrandint(0, 100, 10), r), int)
+    v = _sample(tune.lograndint(1, 1000), r)
+    assert isinstance(v, int) and 1 <= v <= 1000
+    assert isinstance(_sample(tune.randn(0, 1), r), float)
+    got = _sample(tune.sample_from(lambda spec: spec.config["a"] * 2),
+                  r, {"a": 21})
+    assert got == 42
+
+
+def test_run_with_parameters_and_dict_stop(rt):
+    big = list(range(20_000))
+
+    def obj(config, table):
+        assert len(table) == 20_000
+        for i in range(10):
+            report({"loss": config["x"], "score": i})
+
+    grid = tune.run(tune.with_parameters(obj, table=big),
+                    config={"x": tune.grid_search([0.1, 0.2])},
+                    metric="loss", mode="min", stop={"score": 4})
+    # dict stop: each trial dies at its 5th report (score >= 4)
+    assert all(len(t.metrics_history) <= 5 for t in grid)
+    assert len(list(grid)) == 2
+
+
+def test_register_trainable_and_stoppers(rt):
+    tune.register_trainable(
+        "compat_obj", lambda cfg: [report({"loss": 1.0})
+                                   for _ in range(10)])
+    grid = tune.run("compat_obj", config={},
+                    stop=tune.MaximumIterationStopper(3))
+    assert all(len(t.metrics_history) <= 3 for t in grid)
+    with pytest.raises(ValueError, match="register_trainable"):
+        tune.run("never_registered", config={})
+    with pytest.raises(TypeError, match="unsupported arguments"):
+        tune.run("compat_obj", config={}, fancy_new_arg=1)
+
+
+def test_plateau_stopper():
+    st = tune.TrialPlateauStopper(metric="m", std=0.01,
+                                  num_results=3, grace_period=3)
+    # improving metric: never stops
+    assert not any(st("t", {"m": float(i)}) for i in range(6))
+    # flat metric: stops once the window fills
+    st2 = tune.TrialPlateauStopper(metric="m", std=0.01,
+                                   num_results=3, grace_period=3)
+    hits = [st2("t", {"m": 1.0}) for _ in range(4)]
+    assert hits[-1] is True
