@@ -17,12 +17,16 @@ from ray_tpu.serve.api import (
     status,
     shutdown,
     get_deployment_handle,
+    get_app_handle,
+    start,
+    delete,
     grpc_ingress_token,
     batch,
     Application,
     Deployment,
     DeploymentHandle,
 )
+from ray_tpu.serve.replica import get_replica_context, ReplicaContext
 from ray_tpu.serve.autoscaling import AutoscalingConfig
 from ray_tpu.serve.multiplex import (
     get_multiplexed_model_id,

@@ -260,6 +260,58 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
 
+def get_app_handle(name: str) -> DeploymentHandle:
+    """Handle to a running application's ingress deployment
+    (reference: serve.get_app_handle; applications and their ingress
+    deployments share a name here)."""
+    return DeploymentHandle(name)
+
+
+def start(*, http_port: int | None = None,
+          grpc_port: int | None = None) -> None:
+    """Boot the serve control plane (controller + optional proxies)
+    without deploying anything (reference: serve.start) — idempotent;
+    later serve.run/deploy_config calls attach to it."""
+    global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
+    _ensure_controller()
+    if http_port is not None and (_proxy is None
+                                  or _proxy_port != http_port):
+        from ray_tpu.serve.proxy import ProxyActor
+        _proxy = ProxyActor.options(
+            num_cpus=0, max_concurrency=32).remote(http_port)
+        _proxy_port = http_port
+        ray_tpu.get(_proxy.ready.remote(), timeout=30)
+    if grpc_port is not None and (_grpc_proxy is None
+                                  or _grpc_proxy_port != grpc_port):
+        from ray_tpu.serve.grpc_proxy import GRPCProxyActor
+        from ray_tpu.experimental import internal_kv
+        token = grpc_ingress_token()
+        internal_kv._kv_put(_GRPC_TOKEN_KV[0], token.encode(),
+                            namespace=_GRPC_TOKEN_KV[1])
+        _grpc_proxy = GRPCProxyActor.options(
+            num_cpus=0, max_concurrency=32).remote(
+                grpc_port, auth_token=token)
+        _grpc_proxy_port = grpc_port
+        ray_tpu.get(_grpc_proxy.ready.remote(), timeout=30)
+
+
+def delete(name: str, *, timeout: float = 30.0) -> bool:
+    """Remove a deployment/application: replicas drain then die
+    (reference: serve.delete). Returns False for an unknown name."""
+    controller = _ensure_controller()
+    ok = ray_tpu.get(controller.delete_deployment.remote(name),
+                     timeout=timeout)
+    if ok:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if name not in ray_tpu.get(
+                    controller.list_deployments.remote(),
+                    timeout=10):
+                break
+            time.sleep(0.1)
+    return bool(ok)
+
+
 _CONFIG_APPS_KV = (b"serve:config_apps", "serve")
 
 

@@ -71,9 +71,13 @@ class ServeController:
         return True
 
     def delete_deployment(self, name: str) -> bool:
-        self.desired.pop(name, None)
-        self._reconcile_once()
-        return True
+        """Remove a deployment from the desired state; replicas drain
+        then die via reconcile. Returns False for an unknown name so
+        serve.delete can report honestly."""
+        known = self.desired.pop(name, None) is not None
+        if known:
+            self._reconcile_once()
+        return known
 
     # -- live state queries (router/long-poll surface) --
 

@@ -11,6 +11,9 @@ from __future__ import annotations
 import threading
 
 import ray_tpu
+from ray_tpu.serve.replica_ctx import (     # noqa: F401 — re-export
+    ReplicaContext, get_replica_context,
+)
 
 
 @ray_tpu.remote
@@ -18,6 +21,13 @@ class Replica:
     def __init__(self, cls_or_fn, init_args, init_kwargs,
                  replica_tag: str):
         self.tag = replica_tag
+        # Import at CALL time: this class ships by value (see
+        # replica_ctx docstring), so only a runtime import reaches
+        # the worker's real module — where user code reads from.
+        from ray_tpu.serve import replica_ctx
+        replica_ctx.set_current(replica_ctx.ReplicaContext(
+            deployment=replica_tag.split("#", 1)[0],
+            replica_tag=replica_tag))
         self._inflight = 0
         self._lock = threading.Lock()
         self._total = 0

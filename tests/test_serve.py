@@ -167,3 +167,29 @@ def test_steady_state_zero_controller_rpcs(serve_rt):
     for i in range(20):
         assert ray_tpu.get(handle.remote(i), timeout=60) == i
     assert router.controller_rpcs == before
+
+
+def test_serve_compat_surface(rt):
+    """start/get_app_handle/delete/get_replica_context (reference:
+    the serve module's classic operational surface)."""
+    from ray_tpu import serve
+
+    serve.start()                        # idempotent boot
+
+    @serve.deployment(num_replicas=1)
+    class CompatApp:
+        def __call__(self, x):
+            from ray_tpu.serve import get_replica_context
+            ctx = get_replica_context()
+            return {"who": ctx.deployment, "tag": ctx.replica_tag,
+                    "x": x}
+
+    serve.run(CompatApp.bind())
+    out = ray_tpu.get(serve.get_app_handle("CompatApp").remote(7),
+                      timeout=60)
+    assert out["who"] == "CompatApp" and out["x"] == 7
+    assert out["tag"].startswith("CompatApp#")
+    assert serve.delete("CompatApp") is True
+    assert "CompatApp" not in serve.status()["deployments"]
+    assert serve.delete("never_deployed") is False
+    serve.shutdown()
