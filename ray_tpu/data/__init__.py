@@ -23,7 +23,15 @@ from ray_tpu.data.io import (
     read_webdataset,
     from_items,
     from_numpy,
+    from_numpy_refs,
     from_pandas,
+    from_pandas_refs,
+    from_arrow_refs,
+    range_tensor,
+    read_parquet_bulk,
+    read_datasource,
+    Datasource,
+    ReadTask,
     range as range_,  # noqa: A001 — re-exported as .range below
     read_binary_files,
     read_csv,
@@ -43,6 +51,9 @@ __all__ = [
     "read_text",
     "read_numpy",
     "from_numpy", "from_pandas", "read_parquet", "read_csv",
+    "from_numpy_refs", "from_pandas_refs", "from_arrow_refs",
+    "range_tensor", "read_parquet_bulk", "read_datasource",
+    "Datasource", "ReadTask",
     "read_json", "read_images", "read_binary_files",
     "read_tfrecords", "read_sql", "from_huggingface",
     "read_webdataset",

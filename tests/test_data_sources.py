@@ -244,3 +244,53 @@ def test_read_webdataset_subdir_keys_no_collision(rt, tmp_path):
     assert [r["__key__"] for r in rows] == ["a/0", "b/0"]
     assert rows[0]["img"] == b"aaa" and rows[1]["img"] == b"bbb"
     assert [r["cls"] for r in rows] == [1, 2]
+
+
+def test_refs_constructors_and_range_tensor(rt):
+    import numpy as np
+
+    refs = [ray_tpu.put(np.arange(4) + i * 4) for i in range(3)]
+    ds = ray_tpu.data.from_numpy_refs(refs)
+    assert ds.count() == 12
+
+    rt_ds = ray_tpu.data.range_tensor(5, shape=(3,))
+    rows = rt_ds.take(5)
+    assert np.asarray(rows[2]["data"]).tolist() == [2, 2, 2]
+
+
+def test_read_datasource_seam(rt):
+    """Custom Datasource -> ReadTask list -> Dataset (the reference's
+    pluggable read seam, ray.data.read_datasource)."""
+    import pytest
+
+    class Rows(ray_tpu.data.Datasource):
+        def get_read_tasks(self, parallelism):
+            return [ray_tpu.data.ReadTask(
+                lambda i=i: [{"v": i}]) for i in range(6)]
+
+    ds = ray_tpu.data.read_datasource(Rows())
+    assert sorted(r["v"] for r in ds.take(100)) == list(range(6))
+
+    class Empty(ray_tpu.data.Datasource):
+        def get_read_tasks(self, parallelism):
+            return []
+
+    with pytest.raises(ValueError, match="no tasks"):
+        ray_tpu.data.read_datasource(Empty())
+
+
+def test_from_pandas_refs_and_parquet_bulk(rt, tmp_path):
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    refs = [ray_tpu.put(pd.DataFrame({"a": [i, i + 1],
+                                      "s": ["x", "y"]}))
+            for i in (0, 10)]
+    ds = ray_tpu.data.from_pandas_refs(refs)
+    assert ds.count() == 4
+    assert sorted(r["a"] for r in ds.take(10)) == [0, 1, 10, 11]
+
+    pq.write_table(pa.table({"x": [1, 2, 3]}),
+                   str(tmp_path / "f.parquet"))
+    assert ray_tpu.data.read_parquet_bulk(str(tmp_path)).count() == 3
