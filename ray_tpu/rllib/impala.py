@@ -21,7 +21,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.env_runner import (
+    EnvRunnerGroup, SupportsEvaluation,
+)
 from ray_tpu.rllib.catalog import build_actor_critic
 
 
@@ -223,7 +225,7 @@ class ImpalaConfig:
         return Impala(self)
 
 
-class Impala:
+class Impala(SupportsEvaluation):
     learner_cls = ImpalaLearner   # subclasses (APPO) swap the learner
 
     def __init__(self, config: ImpalaConfig):

@@ -19,7 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.env_runner import (
+    EnvRunnerGroup, SupportsEvaluation,
+)
 from ray_tpu.rllib.models import (
     ContinuousConfig, SquashedGaussianActor, TwinQ,
 )
@@ -203,7 +205,7 @@ class SACConfig:
         return SAC(self)
 
 
-class SAC:
+class SAC(SupportsEvaluation):
     def __init__(self, config: SACConfig):
         assert config.env is not None
         self.config = config
