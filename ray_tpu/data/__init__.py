@@ -6,7 +6,7 @@ backpressure; ``streaming_split`` feeds trainer gangs and
 mesh (SURVEY.md §2.3/§2.4).
 """
 
-from ray_tpu.data.context import DataContext
+from ray_tpu.data.context import DataContext, DatasetContext
 from ray_tpu.data.dataset import (
     ActorPoolStrategy,
     DataIterator,
@@ -45,7 +45,7 @@ range = range_  # noqa: A001
 
 __all__ = [
     "ActorPoolStrategy",
-    "DataContext", "Dataset", "DataIterator", "GroupedData", "range",
+    "DataContext", "DatasetContext", "Dataset", "DataIterator", "GroupedData", "range",
     "from_items",
     "from_arrow",
     "read_text",

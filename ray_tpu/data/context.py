@@ -41,3 +41,7 @@ class DataContext:
             if cls._current is None:
                 cls._current = cls()
             return cls._current
+
+
+# Classic-name alias (reference kept both spellings alive).
+DatasetContext = DataContext
