@@ -485,6 +485,11 @@ class Dataset:
             return block.schema
         return None
 
+    def columns(self) -> list[str] | None:
+        """Column names (reference: Dataset.columns)."""
+        sch = self.schema()
+        return list(sch.names) if sch is not None else None
+
     def materialize(self) -> "Dataset":
         blocks = list(self.iter_blocks())
         return Dataset([_Source([(lambda b=b: b) for b in blocks])])
