@@ -86,3 +86,21 @@ __all__ = [
     "placement_group",
     "remove_placement_group",
 ]
+
+
+_SUBPACKAGES = ("data", "train", "tune", "serve", "rllib", "workflow",
+                "autoscaler", "dag", "experimental", "util",
+                "runtime_env", "collective")
+
+
+def __getattr__(name: str):
+    """Lazy subpackage attributes: ``import ray_tpu`` is enough for
+    ``ray_tpu.data.range(...)`` to work (reference ergonomics —
+    ``ray.data`` resolves after ``import ray``) without paying every
+    library's import cost up front."""
+    if name in _SUBPACKAGES:
+        import importlib
+        mod = importlib.import_module(f"ray_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
