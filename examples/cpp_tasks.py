@@ -1,0 +1,58 @@
+"""C++ tasks and actors: native task bodies through the normal API.
+
+The reference's C++ worker API (cpp/include/ray/api.h) lets users
+write remote functions in C++. Here: write C++, compile once, call
+`.remote()` like any Python task; actor state is a live C++ object
+inside the actor's worker process.
+"""
+
+import ray_tpu
+from ray_tpu import cpp
+
+SRC = r"""
+#include "ray_tpu.h"
+using raytpu::Args; using raytpu::Bytes;
+
+static Bytes dot(const Args& a) {           // two f64 buffers -> f64
+  const double* x = reinterpret_cast<const double*>(a[0].data());
+  const double* y = reinterpret_cast<const double*>(a[1].data());
+  size_t n = a[0].size() / sizeof(double);
+  double s = 0;
+  for (size_t i = 0; i < n; ++i) s += x[i] * y[i];
+  return raytpu::bytes_of(s);
+}
+RAY_TPU_TASK(dot);
+
+class RunningMean {
+  double sum_ = 0; int64_t n_ = 0;
+ public:
+  explicit RunningMean(const Args&) {}
+  Bytes observe(const Args& a) {
+    sum_ += raytpu::as<double>(a[0]); ++n_;
+    return raytpu::bytes_of(sum_ / n_);
+  }
+};
+RAY_TPU_ACTOR(RunningMean);
+RAY_TPU_METHOD(RunningMean, observe);
+
+RAY_TPU_MODULE();
+"""
+
+ray_tpu.init(num_cpus=2)
+
+lib = cpp.load_library(cpp.compile_library(SRC))
+
+import numpy as np
+x = np.arange(1000, dtype=np.float64)
+ref = lib.dot.remote(x, x)
+print("dot(x, x) =", cpp.to_f64(ray_tpu.get(ref)))
+assert cpp.to_f64(ray_tpu.get(ref)) == float(x @ x)
+
+Mean = lib.actor_class("RunningMean")
+m = Mean.remote()
+for v in (1.0, 2.0, 3.0):
+    last = m.observe.remote(v)
+print("running mean =", cpp.to_f64(ray_tpu.get(last)))
+
+ray_tpu.shutdown()
+print("ok")

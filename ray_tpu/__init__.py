@@ -90,7 +90,7 @@ __all__ = [
 
 _SUBPACKAGES = ("data", "train", "tune", "serve", "rllib", "workflow",
                 "autoscaler", "dag", "experimental", "util",
-                "runtime_env", "collective")
+                "runtime_env", "collective", "cpp")
 
 
 def __getattr__(name: str):

@@ -1,0 +1,174 @@
+"""C++ worker API (SURVEY §2.1 N29, scoped).
+
+Reference analog: ``cpp/include/ray/api.h`` + the C++ task executor
+(``cpp/src/ray/runtime/task/task_executor.cc``). Tasks and an actor are
+written in C++, compiled at test time into a shared object with the
+``ray_tpu/cpp/ray_tpu.h`` header, and driven through the NORMAL task
+machinery: submission, worker execution (native code in the worker
+process via the C ABI), error propagation, and actor state held as a
+live C++ object inside the actor's worker.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import cpp
+
+CPP_SOURCE = r"""
+#include "ray_tpu.h"
+#include <numeric>
+
+using raytpu::Args;
+using raytpu::Bytes;
+
+static Bytes add(const Args& a) {
+  return raytpu::bytes_of(raytpu::as<double>(a[0]) +
+                          raytpu::as<double>(a[1]));
+}
+RAY_TPU_TASK(add);
+
+// Operates on a raw byte buffer (the numpy-array path).
+static Bytes sum_u8(const Args& a) {
+  int64_t s = 0;
+  for (unsigned char c : a[0]) s += c;
+  return raytpu::bytes_of(s);
+}
+RAY_TPU_TASK(sum_u8);
+
+static Bytes shout(const Args& a) {
+  std::string s(a[0]);
+  for (auto& c : s) c = toupper(c);
+  return s;
+}
+RAY_TPU_TASK(shout);
+
+static Bytes fail(const Args&) {
+  throw std::runtime_error("deliberate C++ failure");
+}
+RAY_TPU_TASK(fail);
+
+class Counter {
+  int64_t n_ = 0;
+ public:
+  explicit Counter(const Args& a) {
+    if (!a.empty()) n_ = raytpu::as<int64_t>(a[0]);
+  }
+  Bytes add(const Args& a) {
+    n_ += raytpu::as<int64_t>(a[0]);
+    return raytpu::bytes_of(n_);
+  }
+  Bytes get(const Args&) { return raytpu::bytes_of(n_); }
+};
+RAY_TPU_ACTOR(Counter);
+RAY_TPU_METHOD(Counter, add);
+RAY_TPU_METHOD(Counter, get);
+
+RAY_TPU_MODULE();
+"""
+
+
+@pytest.fixture(scope="module")
+def lib():
+    path = cpp.compile_library(CPP_SOURCE)
+    return cpp.load_library(path)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_enumeration(lib):
+    assert lib.task_names == ["add", "sum_u8", "shout", "fail"]
+    assert lib.actor_names == ["Counter"]
+    assert lib.methods("Counter") == ["add", "get"]
+
+
+def test_local_invocation(lib):
+    # __call__ runs the native code in-process (no cluster needed).
+    assert cpp.to_f64(lib.add(1.5, 2.0)) == pytest.approx(3.5)
+
+
+def test_remote_task(rt, lib):
+    ref = lib.add.remote(cpp.f64(1.5), cpp.f64(2.25))
+    assert cpp.to_f64(ray_tpu.get(ref)) == pytest.approx(3.75)
+    # auto-coercion: plain floats pack as f64
+    assert cpp.to_f64(ray_tpu.get(lib.add.remote(1.0, 2.0))) == 3.0
+
+
+def test_remote_task_numpy_buffer(rt, lib):
+    np = pytest.importorskip("numpy")
+    arr = np.arange(100, dtype=np.uint8)
+    got = cpp.to_i64(ray_tpu.get(lib.sum_u8.remote(arr)))
+    assert got == int(arr.sum())
+
+
+def test_remote_task_str(rt, lib):
+    assert ray_tpu.get(lib.shout.remote("tpu")) == b"TPU"
+
+
+def test_cpp_exception_propagates(rt, lib):
+    ref = lib.fail.remote()
+    with pytest.raises(Exception, match="deliberate C\\+\\+ failure"):
+        ray_tpu.get(ref)
+
+
+def test_unknown_task(lib):
+    with pytest.raises(AttributeError, match="no C\\+\\+ task"):
+        lib.task("nope")
+
+
+def test_cpp_actor(rt, lib):
+    Counter = lib.actor_class("Counter")
+    c = Counter.remote(cpp.i64(10))
+    assert cpp.to_i64(ray_tpu.get(c.add.remote(cpp.i64(5)))) == 15
+    assert cpp.to_i64(ray_tpu.get(c.add.remote(7))) == 22
+    # state lives in the C++ object inside the actor's worker
+    assert cpp.to_i64(ray_tpu.get(c.get.remote())) == 22
+
+
+def test_two_libraries_isolated_registries(lib):
+    """Regression: the inline registry symbol must not interpose across
+    dlopen'd libraries (hidden visibility + RTLD_LOCAL) — a second
+    library must NOT see the first one's tasks/actors."""
+    src2 = r"""
+    #include "ray_tpu.h"
+    static raytpu::Bytes only2(const raytpu::Args&) { return "2"; }
+    RAY_TPU_TASK(only2);
+    RAY_TPU_MODULE();
+    """
+    lib2 = cpp.load_library(cpp.compile_library(src2))
+    assert lib2.task_names == ["only2"]
+    assert lib2.actor_names == []
+    assert lib.task_names == ["add", "sum_u8", "shout", "fail"]
+    assert lib2.only2() == b"2"
+
+
+def test_method_without_actor_is_catchable():
+    """RAY_TPU_METHOD without RAY_TPU_ACTOR must fail as CppError at
+    construction, not std::terminate the process at dlopen."""
+    src = r"""
+    #include "ray_tpu.h"
+    using raytpu::Args; using raytpu::Bytes;
+    class Ghost {
+     public:
+      explicit Ghost(const Args&) {}
+      Bytes go(const Args&) { return "x"; }
+    };
+    RAY_TPU_METHOD(Ghost, go);
+    RAY_TPU_MODULE();
+    """
+    lib = cpp.load_library(cpp.compile_library(src))
+    assert lib.actor_names == []
+    with pytest.raises(cpp.CppError, match="RAY_TPU_ACTOR"):
+        cpp._actor_new(lib.path, "Ghost", ())
+
+
+def test_cpp_actor_independent_instances(rt, lib):
+    Counter = lib.actor_class("Counter")
+    a, b = Counter.remote(), Counter.remote(cpp.i64(100))
+    ray_tpu.get(a.add.remote(1))
+    assert cpp.to_i64(ray_tpu.get(a.get.remote())) == 1
+    assert cpp.to_i64(ray_tpu.get(b.get.remote())) == 100
