@@ -335,7 +335,9 @@ def load_library(path: str, num_cpus: float = 1) -> CppLibrary:
 def compile_library(source: str, out: str | None = None,
                     extra_flags: list[str] | None = None) -> str:
     """Compile C++ source text (or a source-file path) into a shared
-    object including the ``ray_tpu.h`` API header; returns the .so path.
+    object including the ``ray_tpu.h`` API header; returns the .so
+    path. The caller owns the returned file (with ``out=None`` it is a
+    tempfile the caller should delete when done).
     """
     if os.path.exists(source) and source.endswith((".cc", ".cpp", ".cxx")):
         src_path, cleanup = source, False
@@ -344,6 +346,7 @@ def compile_library(source: str, out: str | None = None,
         with os.fdopen(fd, "w") as f:
             f.write(source)
         cleanup = True
+    made_out = out is None
     if out is None:
         fd, out = tempfile.mkstemp(suffix=".so")
         os.close(fd)
@@ -358,6 +361,13 @@ def compile_library(source: str, out: str | None = None,
         if r.returncode != 0:
             raise CppError(
                 "compile failed:\n" + r.stderr.decode(errors="replace")[:4000])
+    except BaseException:
+        if made_out:  # don't leave a zero-byte .so behind on failure
+            try:
+                os.unlink(out)
+            except OSError:
+                pass
+        raise
     finally:
         if cleanup:
             try:

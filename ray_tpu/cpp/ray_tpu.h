@@ -40,8 +40,16 @@
 //
 //   RAY_TPU_MODULE();   // emits the C ABI, exactly once per library
 //
-// Build:  g++ -O2 -shared -fPIC -std=c++17 -o libmytasks.so mytasks.cc
-// (or ray_tpu.cpp.compile_library from Python).
+// Build (the -fvisibility flags are REQUIRED when more than one task
+// library may load into a process — without them the inline registry
+// symbol is emitted STB_GNU_UNIQUE and binds process-globally even
+// under RTLD_LOCAL, merging the libraries' registries):
+//
+//   g++ -O2 -shared -fPIC -std=c++17 \
+//       -fvisibility=hidden -fvisibility-inlines-hidden \
+//       -o libmytasks.so mytasks.cc
+//
+// (or just use ray_tpu.cpp.compile_library, which passes them.)
 
 #ifndef RAY_TPU_CPP_API_H_
 #define RAY_TPU_CPP_API_H_

@@ -16,6 +16,7 @@ from ray_tpu.data.dataset import (
 from ray_tpu.data.io import (
     from_arrow,
     from_huggingface,
+    read_bigquery,
     read_numpy,
     read_sql,
     read_text,
@@ -55,6 +56,6 @@ __all__ = [
     "range_tensor", "read_parquet_bulk", "read_datasource",
     "Datasource", "ReadTask",
     "read_json", "read_images", "read_binary_files",
-    "read_tfrecords", "read_sql", "from_huggingface",
+    "read_tfrecords", "read_sql", "read_bigquery", "from_huggingface",
     "read_webdataset",
 ]
