@@ -13,7 +13,9 @@ from ray_tpu.data.dataset import (
     Dataset,
     GroupedData,
 )
+from ray_tpu.data import aggregate  # noqa: F401  (ray.data.aggregate)
 from ray_tpu.data.io import (
+    Datasink,
     from_arrow,
     from_huggingface,
     read_bigquery,
@@ -54,7 +56,7 @@ __all__ = [
     "from_numpy", "from_pandas", "read_parquet", "read_csv",
     "from_numpy_refs", "from_pandas_refs", "from_arrow_refs",
     "range_tensor", "read_parquet_bulk", "read_datasource",
-    "Datasource", "ReadTask",
+    "Datasource", "ReadTask", "Datasink", "aggregate",
     "read_json", "read_images", "read_binary_files",
     "read_tfrecords", "read_sql", "read_bigquery", "from_huggingface",
     "read_webdataset",

@@ -195,6 +195,17 @@ class _Union:
 _FUSABLE = (_MapBatches, _MapRows, _FlatMap, _Filter)
 
 
+def _concat_row_slices(picks: list, schema_block):
+    """One block from (block, start, end) row slices; an empty pick
+    list yields a zero-row block with the dataset's schema."""
+    if not picks:
+        if schema_block is None:
+            return to_block({})
+        return slice_block(schema_block, 0, 0)
+    parts = [slice_block(b, s, e) for b, s, e in picks]
+    return parts[0] if len(parts) == 1 else concat_blocks(parts)
+
+
 def _apply_fused(block, ops: list):
     """Run a fused chain of transforms on one block (executes inside a
     worker task)."""
@@ -352,6 +363,26 @@ class Dataset:
                     block_to_batch(block)[col]).tolist())
         return sorted(out)
 
+    def aggregate(self, *aggs) -> dict:
+        """Whole-dataset aggregation over AggregateFn descriptors
+        (reference: Dataset.aggregate + python/ray/data/aggregate.py).
+        Returns one dict keyed by each agg's name."""
+        from ray_tpu.data.aggregate import AggregateFn
+        for a in aggs:
+            if not isinstance(a, AggregateFn):
+                raise TypeError(f"expected AggregateFn, got {type(a)!r}")
+        accs = [a.init() for a in aggs]
+        for block in self.iter_blocks():
+            n = block_num_rows(block)
+            if n == 0:  # an all-filtered block may even lack columns
+                continue
+            batch = block_to_batch(block)
+            for i, a in enumerate(aggs):
+                col = (np.asarray(batch[a.on]) if a.on is not None
+                       else np.empty(n))
+                accs[i] = a.accumulate_block(accs[i], col)
+        return {a.name: a.finalize(acc) for a, acc in zip(aggs, accs)}
+
     def _scalar_agg(self, col: str, op, empty):
         parts = [op(block_to_batch(b)[col])
                  for b in self.iter_blocks() if b.num_rows]
@@ -494,6 +525,25 @@ class Dataset:
         blocks = list(self.iter_blocks())
         return Dataset([_Source([(lambda b=b: b) for b in blocks])])
 
+    def size_bytes(self) -> int:
+        """In-memory (arrow) size (reference: Dataset.size_bytes)."""
+        return sum(b.nbytes for b in self.iter_blocks())
+
+    def show(self, limit: int = 20) -> None:
+        """Print up to ``limit`` rows (reference: Dataset.show)."""
+        for row in self.take(limit):
+            print(row)
+
+    def copy(self) -> "Dataset":
+        """A new Dataset over the same (immutable) plan so further
+        appends diverge (reference: Dataset.copy)."""
+        return Dataset(list(self._plan))
+
+    def iterator(self) -> "DataIterator":
+        """Whole-dataset DataIterator (reference: Dataset.iterator —
+        a streaming_split(1) shard)."""
+        return DataIterator(self, shard=0, num_shards=1)
+
     def num_blocks(self) -> int:
         n = 0
         for _ in self._stream_blocks():
@@ -512,6 +562,115 @@ class Dataset:
         mat = self.materialize()
         src: _Source = mat._plan[0]
         return [Dataset([_Source(src.read_fns[i::n])]) for i in range(n)]
+
+    @staticmethod
+    def _split_blocks_at(blocks: list, sizes: list[int],
+                         indices: list[int]) -> list["Dataset"]:
+        """Shared row-index splitter over already-pulled blocks (the
+        pipeline executes ONCE even when the caller also needed the
+        total row count)."""
+        total = sum(sizes)
+        bounds = [0, *indices, total]
+        schema_block = blocks[0] if blocks else None
+        out = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            hi = min(hi, total)
+            picks = []
+            off = 0
+            for b, sz in zip(blocks, sizes):
+                s, e = max(lo - off, 0), min(hi - off, sz)
+                if s < e:
+                    picks.append((b, s, e))
+                off += sz
+            out.append(Dataset([_Source([
+                lambda p=picks, sb=schema_block:
+                    _concat_row_slices(p, sb)])]))
+        return out
+
+    def split_at_indices(self, indices: list[int]) -> list["Dataset"]:
+        """Split at global ROW indices -> len(indices)+1 datasets
+        (reference: Dataset.split_at_indices)."""
+        if any(i < 0 for i in indices):
+            raise ValueError("indices must be non-negative")
+        if list(indices) != sorted(indices):
+            raise ValueError("indices must be sorted")
+        blocks = list(self.iter_blocks())
+        sizes = [block_num_rows(b) for b in blocks]
+        return self._split_blocks_at(blocks, sizes, list(indices))
+
+    def split_proportionately(self, proportions: list[float]
+                              ) -> list["Dataset"]:
+        """(reference: Dataset.split_proportionately — the remainder
+        becomes a final extra split, so len(out) == len(props)+1)."""
+        if not proportions or any(p <= 0 for p in proportions) \
+                or sum(proportions) >= 1:
+            raise ValueError(
+                "proportions must be positive and sum to < 1")
+        blocks = list(self.iter_blocks())
+        sizes = [block_num_rows(b) for b in blocks]
+        n = sum(sizes)
+        cuts, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            cuts.append(int(n * acc))
+        return self._split_blocks_at(blocks, sizes, cuts)
+
+    def train_test_split(self, test_size: float | int, *,
+                         shuffle: bool = False,
+                         seed: int | None = None
+                         ) -> tuple["Dataset", "Dataset"]:
+        """(reference: Dataset.train_test_split — the test split is
+        the TAIL, after an optional shuffle)."""
+        ds = self.random_shuffle(seed) if shuffle else self
+        blocks = list(ds.iter_blocks())
+        sizes = [block_num_rows(b) for b in blocks]
+        n = sum(sizes)
+        if isinstance(test_size, float):
+            if not 0 < test_size < 1:
+                raise ValueError("float test_size must be in (0, 1)")
+            test_n = int(n * test_size)
+        else:
+            if not 0 <= test_size <= n:
+                raise ValueError(f"int test_size must be in [0, {n}]")
+            test_n = test_size
+        train, test = self._split_blocks_at(blocks, sizes,
+                                            [n - test_n])
+        return train, test
+
+    def randomize_block_order(self, *, seed: int | None = None
+                              ) -> "Dataset":
+        """Shuffle BLOCK order only (cheap; reference:
+        Dataset.randomize_block_order). Lazy when the plan is a pure
+        source; otherwise materializes first (a downstream all-to-all
+        stage makes block order meaningful)."""
+        import random as _random
+        rng = _random.Random(seed)
+        if len(self._plan) == 1 and isinstance(self._plan[0], _Source):
+            fns = list(self._plan[0].read_fns)
+            rng.shuffle(fns)
+            return Dataset([_Source(fns)])
+        mat = self.materialize()
+        fns = list(mat._plan[0].read_fns)
+        rng.shuffle(fns)
+        return Dataset([_Source(fns)])
+
+    def random_sample(self, fraction: float, *,
+                      seed: int | None = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample).
+        With a fixed seed the draw is deterministic per (seed, block
+        row count) — block-level, matching the reference's
+        per-block-rng contract, not a global permutation."""
+        if not 0 <= fraction <= 1:
+            raise ValueError("fraction must be in [0, 1]")
+
+        def sample(batch):
+            import numpy as _np
+            n = len(next(iter(batch.values()))) if batch else 0
+            rng = _np.random.default_rng(seed)
+            mask = rng.random(n) < fraction
+            return {k: _np.asarray(v)[mask] for k, v in batch.items()}
+
+        return self.map_batches(sample)
 
     # -- io --
 
@@ -556,6 +715,147 @@ class Dataset:
                      for k, v in row.items()})
                  for row in block_rows(block)))
 
+    def write_numpy(self, path: str, *, column: str) -> None:
+        """One ``part-NNNNN.npy`` of ``column`` per block (reference:
+        Dataset.write_numpy)."""
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            batch = block_to_batch(block)
+            if column not in batch:
+                raise ValueError(
+                    f"column {column!r} not in {list(batch)}")
+            np.save(f"{path}/part-{i:05d}.npy", batch[column])
+
+    def write_sql(self, sql: str, connection_factory) -> None:
+        """``executemany`` one parameterized INSERT per block
+        (reference: Dataset.write_sql — same DB-API contract as
+        read_sql; row values bind positionally in column order)."""
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            for block in self.iter_blocks():
+                rows = [tuple(
+                    v.item() if hasattr(v, "item") else v
+                    for v in row.values())
+                    for row in block_rows(block)]
+                if rows:
+                    cur.executemany(sql, rows)
+            conn.commit()
+        finally:
+            conn.close()
+
+    def write_webdataset(self, path: str) -> None:
+        """One ``part-NNNNN.tar`` shard per block, one member per
+        column per row keyed webdataset-style (reference:
+        Dataset.write_webdataset). bytes columns write raw; str utf-8;
+        ints/floats as decimal text (so ``cls``-style columns
+        round-trip through read_webdataset's int parsing)."""
+        import io as iolib
+        import os
+        import tarfile
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            with tarfile.open(f"{path}/part-{i:05d}.tar", "w") as tf:
+                for j, row in enumerate(block_rows(block)):
+                    key = row.get("__key__", f"{i:05d}{j:06d}")
+                    for col, v in row.items():
+                        if col == "__key__":
+                            continue
+                        if isinstance(v, bytes):
+                            payload = v
+                        elif isinstance(v, str):
+                            payload = v.encode()
+                        elif hasattr(v, "item"):
+                            payload = str(v.item()).encode()
+                        else:
+                            payload = str(v).encode()
+                        info = tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(payload)
+                        tf.addfile(info, iolib.BytesIO(payload))
+
+    def write_images(self, path: str, column: str = "image", *,
+                     file_format: str = "png") -> None:
+        """Rows of ``column`` (HWC uint8 arrays) -> image files
+        (reference: Dataset.write_images; PIL encode)."""
+        import os
+        from PIL import Image
+        os.makedirs(path, exist_ok=True)
+        k = 0
+        for block in self.iter_blocks():
+            for row in block_rows(block):
+                arr = np.asarray(row[column])
+                Image.fromarray(arr).save(
+                    f"{path}/img-{k:06d}.{file_format}")
+                k += 1
+
+    def write_bigquery(self, project_id: str, dataset: str, *,
+                       transport=None) -> None:
+        """Stream rows via tabledata.insertAll (reference:
+        Dataset.write_bigquery). Same injectable transport as
+        read_bigquery."""
+        from ray_tpu.data.io import _BigQueryRest
+        t = transport if transport is not None else _BigQueryRest()
+        try:
+            ds_id, table_id = dataset.split(".", 1)
+        except ValueError:
+            raise ValueError(
+                f"dataset must be 'dataset_id.table_id', got {dataset!r}"
+            ) from None
+        url = (f"{_BigQueryRest.BASE}/projects/{project_id}/datasets/"
+               f"{ds_id}/tables/{table_id}/insertAll")
+        for block in self.iter_blocks():
+            payload = [{"json": {
+                k: (v.item() if hasattr(v, "item") else
+                    v.tolist() if hasattr(v, "tolist") else v)
+                for k, v in row.items()}} for row in block_rows(block)]
+            if payload:
+                out = t("POST", url, None, {"rows": payload})
+                errs = out.get("insertErrors")
+                if errs:
+                    raise RuntimeError(f"bigquery insertAll: {errs}")
+
+    def write_datasink(self, datasink) -> None:
+        """Custom sink seam (reference: Dataset.write_datasink /
+        ray.data.Datasink): calls ``on_write_start()``, ``write(block)``
+        per block, then ``on_write_complete()`` —
+        ``on_write_failed(err)`` on any raise."""
+        start = getattr(datasink, "on_write_start", None)
+        if start:
+            start()
+        try:
+            for block in self.iter_blocks():
+                datasink.write(block)
+        except BaseException as e:
+            failed = getattr(datasink, "on_write_failed", None)
+            if failed:
+                failed(e)
+            raise
+        done = getattr(datasink, "on_write_complete", None)
+        if done:
+            done()
+
+    # -- refs exports (counterparts of the from_*_refs constructors) --
+
+    def to_arrow_refs(self) -> list:
+        """Blocks as stored ObjectRefs (reference:
+        Dataset.to_arrow_refs)."""
+        return [ray_tpu.put(b) for b in self.iter_blocks()]
+
+    def to_pandas_refs(self) -> list:
+        """(reference: Dataset.to_pandas_refs)"""
+        return [ray_tpu.put(b.to_pandas()) for b in self.iter_blocks()]
+
+    def to_numpy_refs(self, *, column: str | None = None) -> list:
+        """(reference: Dataset.to_numpy_refs — one ref per block;
+        dict of all columns, or just ``column``)."""
+        out = []
+        for block in self.iter_blocks():
+            batch = block_to_batch(block)
+            out.append(ray_tpu.put(
+                batch[column] if column is not None else batch))
+        return out
+
     def iter_torch_batches(self, batch_size: int | None = None,
                            drop_last: bool = False,
                            device: str | None = None):
@@ -587,6 +887,83 @@ class Dataset:
             import pandas as pd
             return pd.DataFrame()
         return pa.concat_tables(blocks).to_pandas()
+
+    def to_torch(self, *, label_column: str | None = None,
+                 batch_size: int | None = None,
+                 drop_last: bool = False):
+        """A torch ``IterableDataset`` over this Dataset (reference:
+        Dataset.to_torch). Without ``label_column`` it yields batch
+        dicts of tensors; with it, ``(features_dict, label_tensor)``
+        pairs — re-iterating re-streams the pipeline."""
+        import torch
+        from torch.utils.data import IterableDataset
+
+        outer = self
+
+        class _TorchDataset(IterableDataset):
+            def __iter__(self):
+                for batch in outer.iter_torch_batches(
+                        batch_size=batch_size, drop_last=drop_last):
+                    if label_column is None:
+                        yield batch
+                    else:
+                        label = batch.pop(label_column)
+                        yield batch, label
+
+        _ = torch  # import check only
+        return _TorchDataset()
+
+    def iter_tf_batches(self, batch_size: int | None = None,
+                        drop_last: bool = False):
+        """Batches as tf tensors (reference: Dataset.iter_tf_batches).
+        Soft-gated on tensorflow: a clear ImportError where it is
+        absent."""
+        try:
+            import tensorflow as tf
+        except ImportError as e:
+            raise ImportError(
+                "iter_tf_batches requires tensorflow, which is not "
+                "installed in this environment") from e
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield {k: tf.convert_to_tensor(v) for k, v in batch.items()}
+
+    def to_tf(self, feature_columns, label_columns, *,
+              batch_size: int = 1):
+        """A ``tf.data.Dataset`` of (features, labels) (reference:
+        Dataset.to_tf). Gated on tensorflow availability like
+        iter_tf_batches."""
+        try:
+            import tensorflow as tf
+        except ImportError as e:
+            raise ImportError(
+                "to_tf requires tensorflow, which is not installed "
+                "in this environment") from e
+
+        feats = ([feature_columns] if isinstance(feature_columns, str)
+                 else list(feature_columns))
+        labels = ([label_columns] if isinstance(label_columns, str)
+                  else list(label_columns))
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size):
+                f = {k: batch[k] for k in feats}
+                l = {k: batch[k] for k in labels}
+                yield (f[feats[0]] if len(feats) == 1 else f,
+                       l[labels[0]] if len(labels) == 1 else l)
+
+        probe = self.take_batch(batch_size)
+
+        def sig(cols):
+            specs = {
+                k: tf.TensorSpec(
+                    shape=(None, *np.asarray(probe[k]).shape[1:]),
+                    dtype=tf.as_dtype(np.asarray(probe[k]).dtype))
+                for k in cols}
+            return specs[cols[0]] if len(cols) == 1 else specs
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(sig(feats), sig(labels)))
 
     def take_batch(self, batch_size: int = 20
                    ) -> dict[str, np.ndarray]:
@@ -1195,3 +1572,24 @@ class GroupedData:
         """fn(group_batch: dict[str, np.ndarray]) -> dict-row or
         list of dict-rows."""
         return self._agg("map_groups", fn)
+
+    def aggregate(self, *aggs) -> Dataset:
+        """AggregateFn descriptors per group -> one row per group
+        keyed by each agg's name (reference: GroupedData.aggregate)."""
+        from ray_tpu.data.aggregate import AggregateFn
+        for a in aggs:
+            if not isinstance(a, AggregateFn):
+                raise TypeError(f"expected AggregateFn, got {type(a)!r}")
+        key = self._key
+
+        def agg_group(batch):
+            n = len(next(iter(batch.values()))) if batch else 0
+            row = {key: np.asarray(batch[key])[0]}
+            for a in aggs:
+                col = (np.asarray(batch[a.on]) if a.on is not None
+                       else np.empty(n))
+                row[a.name] = a.finalize(
+                    a.accumulate_block(a.init(), col))
+            return row
+
+        return self.map_groups(agg_group)

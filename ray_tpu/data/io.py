@@ -692,6 +692,24 @@ class Datasource:
         return None
 
 
+class Datasink:
+    """Pluggable write sink ABC (reference: ray.data.Datasink):
+    override ``write(block)``; lifecycle hooks are optional. Drive
+    with ``Dataset.write_datasink``."""
+
+    def on_write_start(self) -> None:
+        pass
+
+    def write(self, block) -> None:
+        raise NotImplementedError
+
+    def on_write_complete(self) -> None:
+        pass
+
+    def on_write_failed(self, error: BaseException) -> None:
+        pass
+
+
 def read_datasource(datasource: Datasource, *,
                     parallelism: int | None = None) -> Dataset:
     """(reference: ray.data.read_datasource)"""

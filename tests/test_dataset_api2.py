@@ -1,0 +1,302 @@
+"""Round-5 Dataset API surface batch (reference: ray.data.Dataset —
+aggregate/splits/sampling/refs-exports/writers/torch+tf exports).
+"""
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.data.aggregate import (
+    AggregateFn, Count, Max, Mean, Min, Std, Sum,
+)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _ds(rt, n=20, parallelism=4):
+    return data.range(n, parallelism=parallelism).map(
+        lambda r: {"id": r["id"], "x": float(r["id"]) * 0.5})
+
+
+# -- aggregate ----------------------------------------------------------
+
+
+def test_dataset_aggregate(rt):
+    ds = _ds(rt)
+    out = ds.aggregate(Count(), Sum("id"), Mean("x"), Min("id"),
+                       Max("id"), Std("x"))
+    assert out["count()"] == 20
+    assert out["sum(id)"] == sum(range(20))
+    assert out["mean(x)"] == pytest.approx(np.mean(np.arange(20) * 0.5))
+    assert out["min(id)"] == 0 and out["max(id)"] == 19
+    assert out["std(x)"] == pytest.approx(
+        np.std(np.arange(20) * 0.5, ddof=1))
+
+
+def test_aggregate_empty_blocks_and_std_stability(rt):
+    # filter empties 3 of 4 blocks: Min/Max must skip them
+    ds = data.range(20, parallelism=4).filter(lambda r: r["id"] < 5)
+    out = ds.aggregate(Count(), Min("id"), Max("id"))
+    assert out == {"count()": 5, "min(id)": 0, "max(id)": 4}
+    # Welford merge: stddev around a huge mean must not cancel
+    big = data.from_items([{"x": 1e8 + i} for i in range(5)])
+    got = big.aggregate(Std("x"))["std(x)"]
+    assert got == pytest.approx(np.std(1e8 + np.arange(5), ddof=1),
+                                rel=1e-9)
+
+
+def test_dataset_aggregate_custom_fn(rt):
+    prod = AggregateFn(
+        init=lambda: 1.0,
+        accumulate_block=lambda a, col: a * float(np.prod(col)),
+        merge=lambda a, b: a * b,
+        on="x", name="prod(x)")
+    out = data.from_items([{"x": 2.0}, {"x": 3.0}, {"x": 4.0}]).aggregate(
+        prod)
+    assert out["prod(x)"] == pytest.approx(24.0)
+
+
+def test_dataset_aggregate_type_error(rt):
+    with pytest.raises(TypeError, match="AggregateFn"):
+        _ds(rt).aggregate("sum")
+
+
+def test_groupby_aggregate(rt):
+    ds = data.from_items([
+        {"g": i % 3, "v": float(i)} for i in range(12)])
+    rows = sorted(ds.groupby("g").aggregate(Count(), Sum("v")).take_all(),
+                  key=lambda r: r["g"])
+    assert [r["g"] for r in rows] == [0, 1, 2]
+    assert all(r["count()"] == 4 for r in rows)
+    for r in rows:
+        assert r["sum(v)"] == sum(float(i) for i in range(12)
+                                  if i % 3 == r["g"])
+
+
+# -- splits / sampling --------------------------------------------------
+
+
+def test_split_at_indices(rt):
+    parts = _ds(rt).split_at_indices([5, 5, 17])
+    assert [p.count() for p in parts] == [5, 0, 12, 3]
+    assert [r["id"] for r in parts[2].take_all()] == list(range(5, 17))
+    # empty split keeps the schema
+    assert parts[1].columns() == ["id", "x"]
+
+
+def test_split_at_indices_validation(rt):
+    with pytest.raises(ValueError, match="sorted"):
+        _ds(rt).split_at_indices([7, 3])
+    with pytest.raises(ValueError, match="non-negative"):
+        _ds(rt).split_at_indices([-1])
+
+
+def test_split_proportionately(rt):
+    parts = data.range(100, parallelism=5).split_proportionately(
+        [0.1, 0.3])
+    assert [p.count() for p in parts] == [10, 30, 60]
+    with pytest.raises(ValueError):
+        data.range(10).split_proportionately([0.5, 0.6])
+
+
+def test_train_test_split(rt):
+    train, test = data.range(50, parallelism=5).train_test_split(0.2)
+    assert train.count() == 40 and test.count() == 10
+    assert [r["id"] for r in test.take_all()] == list(range(40, 50))
+    train2, test2 = data.range(50, parallelism=5).train_test_split(
+        7, shuffle=True, seed=3)
+    assert train2.count() == 43 and test2.count() == 7
+    all_ids = sorted(r["id"] for r in train2.take_all()) + \
+        sorted(r["id"] for r in test2.take_all())
+    assert sorted(all_ids) == list(range(50))
+
+
+def test_randomize_block_order(rt):
+    ds = data.range(40, parallelism=8)
+    shuf = ds.randomize_block_order(seed=5)
+    ids = [r["id"] for r in shuf.take_all()]
+    assert sorted(ids) == list(range(40))
+    assert ids != list(range(40))  # 8! orders; seed 5 is not identity
+    # within a block, row order is preserved
+    first_block = ids[:5]
+    assert first_block == list(range(first_block[0], first_block[0] + 5))
+
+
+def test_random_sample(rt):
+    ds = data.range(400, parallelism=4)
+    n = ds.random_sample(0.5, seed=11).count()
+    assert 100 < n < 300
+    assert ds.random_sample(0.0).count() == 0
+    assert ds.random_sample(1.0).count() == 400
+    with pytest.raises(ValueError):
+        ds.random_sample(1.5)
+
+
+# -- inspection ---------------------------------------------------------
+
+
+def test_size_bytes_show_copy_iterator(rt, capsys):
+    ds = _ds(rt)
+    assert ds.size_bytes() > 0
+    ds.show(3)
+    out = capsys.readouterr().out
+    assert out.count("\n") == 3 and "'id'" in out
+    c = ds.copy().filter(lambda r: r["id"] < 5)
+    assert c.count() == 5 and ds.count() == 20
+    it = ds.iterator()
+    got = sum(len(b["id"]) for b in it.iter_batches(batch_size=6))
+    assert got == 20
+
+
+# -- refs exports -------------------------------------------------------
+
+
+def test_to_refs_exports(rt):
+    ds = _ds(rt, n=8, parallelism=2)
+    arrow_refs = ds.to_arrow_refs()
+    assert sum(t.num_rows for t in ray_tpu.get(arrow_refs)) == 8
+    pd_refs = ds.to_pandas_refs()
+    assert sum(len(df) for df in ray_tpu.get(pd_refs)) == 8
+    npy = ray_tpu.get(ds.to_numpy_refs(column="id"))
+    assert np.concatenate(npy).tolist() == list(range(8))
+    dicts = ray_tpu.get(ds.to_numpy_refs())
+    assert set(dicts[0]) == {"id", "x"}
+    # round-trip through the from_*_refs constructors
+    assert data.from_arrow_refs(arrow_refs).count() == 8
+
+
+# -- writers ------------------------------------------------------------
+
+
+def test_write_numpy(rt, tmp_path):
+    p = str(tmp_path / "npy")
+    _ds(rt, n=10, parallelism=2).write_numpy(p, column="x")
+    parts = sorted(os.listdir(p))
+    assert parts == ["part-00000.npy", "part-00001.npy"]
+    got = np.concatenate([np.load(f"{p}/{f}") for f in parts])
+    assert got.tolist() == [i * 0.5 for i in range(10)]
+    with pytest.raises(ValueError, match="nope"):
+        _ds(rt).write_numpy(p, column="nope")
+
+
+def test_write_sql_roundtrip(rt, tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("create table t (id int, x real)")
+    conn.commit()
+    conn.close()
+    _ds(rt, n=6).write_sql("insert into t values (?, ?)",
+                           lambda: sqlite3.connect(db))
+    back = data.read_sql("select id, x from t order by id",
+                         lambda: sqlite3.connect(db))
+    assert [r["id"] for r in back.take_all()] == list(range(6))
+
+
+def test_write_webdataset_roundtrip(rt, tmp_path):
+    p = str(tmp_path / "wds")
+    ds = data.from_items([
+        {"txt": f"hello{i}", "cls": i} for i in range(5)])
+    ds.write_webdataset(p)
+    back = data.read_webdataset(f"{p}/*.tar")
+    rows = sorted(back.take_all(), key=lambda r: r["cls"])
+    assert [r["cls"] for r in rows] == list(range(5))  # int parsed
+    assert rows[2]["txt"] == b"hello2"  # bytes by contract
+
+
+def test_write_images_roundtrip(rt, tmp_path):
+    p = str(tmp_path / "imgs")
+    arr = (np.arange(4 * 6 * 3, dtype=np.uint8)
+           .reshape(4, 6, 3))
+    data.from_items([{"image": arr}, {"image": arr[::-1].copy()}]
+                    ).write_images(p)
+    assert sorted(os.listdir(p)) == ["img-000000.png", "img-000001.png"]
+    back = data.read_images(f"{p}/*.png")
+    got = sorted(back.take_all(), key=lambda r: r["path"])
+    assert np.array_equal(got[0]["image"], arr)
+
+
+def test_write_bigquery(rt):
+    calls = []
+
+    def transport(method, url, params, body):
+        calls.append((method, url, body))
+        return {}
+
+    _ds(rt, n=4, parallelism=2).write_bigquery(
+        "proj", "d.t", transport=transport)
+    assert len(calls) == 2
+    method, url, body = calls[0]
+    assert method == "POST" and url.endswith("/tables/t/insertAll")
+    assert body["rows"][0]["json"]["id"] == 0
+    bad = lambda m, u, p, b: {"insertErrors": [{"index": 0}]}  # noqa: E731
+    with pytest.raises(RuntimeError, match="insertAll"):
+        _ds(rt, n=2).write_bigquery("proj", "d.t", transport=bad)
+
+
+def test_write_datasink(rt):
+    class Sink(data.Datasink):
+        def __init__(self):
+            self.events = []
+
+        def on_write_start(self):
+            self.events.append("start")
+
+        def write(self, block):
+            self.events.append(block.num_rows)
+
+        def on_write_complete(self):
+            self.events.append("done")
+
+    s = Sink()
+    _ds(rt, n=10, parallelism=2).write_datasink(s)
+    assert s.events[0] == "start" and s.events[-1] == "done"
+    assert sum(e for e in s.events if isinstance(e, int)) == 10
+
+    class FailSink(Sink):
+        def write(self, block):
+            raise RuntimeError("sink boom")
+
+        def on_write_failed(self, error):
+            self.events.append(f"failed:{error}")
+
+    f = FailSink()
+    with pytest.raises(RuntimeError, match="sink boom"):
+        _ds(rt).write_datasink(f)
+    assert any(str(e).startswith("failed:") for e in f.events)
+
+
+# -- framework exports --------------------------------------------------
+
+
+def test_to_torch(rt):
+    import torch
+    tds = _ds(rt, n=12, parallelism=2).to_torch(
+        label_column="x", batch_size=4)
+    batches = list(tds)
+    assert len(batches) == 3
+    feats, label = batches[0]
+    assert isinstance(label, torch.Tensor) and label.shape[0] == 4
+    assert set(feats) == {"id"}
+    plain = list(_ds(rt, n=4).to_torch(batch_size=2))
+    assert set(plain[0]) == {"id", "x"}
+
+
+def test_tf_exports(rt):
+    tf = pytest.importorskip("tensorflow")
+    batches = list(_ds(rt, n=8, parallelism=2).iter_tf_batches(
+        batch_size=4))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["x"], tf.Tensor)
+    assert batches[0]["x"].shape[0] == 4
+    tfds = _ds(rt, n=8, parallelism=2).to_tf("id", "x", batch_size=4)
+    feats, labels = next(iter(tfds))
+    assert feats.shape[0] == 4 and labels.dtype == tf.float64
