@@ -53,6 +53,49 @@ from ray_tpu.core.placement_group import (
     placement_group,
     remove_placement_group,
 )
+from ray_tpu.core.ids import (
+    ActorClassID,
+    ActorID,
+    FunctionID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    UniqueID,
+    WorkerID,
+)
+from ray_tpu.core.logging_config import LoggingConfig
+from ray_tpu.client_builder import ClientBuilder, client
+from ray_tpu.cross_language import (
+    Language,
+    cpp_function,
+    java_actor_class,
+    java_function,
+)
+
+# Worker-mode constants (reference: python/ray/_private/worker.py —
+# SCRIPT_MODE drivers, WORKER_MODE executors, LOCAL_MODE inline).
+SCRIPT_MODE = 0
+WORKER_MODE = 1
+LOCAL_MODE = 2
+
+# (reference: ray.DynamicObjectRefGenerator — the num_returns=
+# "streaming"/"dynamic" return type; one class serves both here)
+DynamicObjectRefGenerator = ObjectRefGenerator
+
+
+def show_in_dashboard(message: str, key: str = "") -> None:
+    """Publish a short free-form message for this process to the
+    dashboard's KV (reference: ray.show_in_dashboard — per-worker
+    display strings). Readable via
+    ``experimental.internal_kv._kv_get(f"worker_msg:{pid}|{key}",
+    namespace="dashboard")``."""
+    import os
+
+    from ray_tpu.experimental.internal_kv import _kv_put
+    _kv_put(f"worker_msg:{os.getpid()}|{key}", message.encode(),
+            namespace="dashboard")
 
 __all__ = [
     "__version__",
@@ -85,6 +128,12 @@ __all__ = [
     "PlacementGroup",
     "placement_group",
     "remove_placement_group",
+    "ActorClassID", "ActorID", "FunctionID", "JobID", "NodeID",
+    "ObjectID", "PlacementGroupID", "TaskID", "UniqueID", "WorkerID",
+    "LoggingConfig", "ClientBuilder", "client",
+    "Language", "cpp_function", "java_actor_class", "java_function",
+    "SCRIPT_MODE", "WORKER_MODE", "LOCAL_MODE",
+    "DynamicObjectRefGenerator", "show_in_dashboard",
 ]
 
 

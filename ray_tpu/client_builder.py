@@ -1,0 +1,62 @@
+"""ray.client() builder surface (reference:
+python/ray/client_builder.py — ClientBuilder/ClientContext).
+
+A thin, faithful wrapper over client-mode ``init(address=...)``: the
+builder accumulates env/namespace, ``connect()`` initializes, and the
+returned context is a context manager whose ``disconnect()`` shuts the
+client down.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ClientContext:
+    """(reference: ray.client_builder.ClientContext)"""
+
+    address: str
+    namespace: str | None = None
+
+    def disconnect(self) -> None:
+        import ray_tpu
+        ray_tpu.shutdown()
+
+    def __enter__(self) -> "ClientContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disconnect()
+
+
+class ClientBuilder:
+    """(reference: ray.ClientBuilder) ``ray_tpu.client(addr)
+    .env({...}).namespace("n").connect()``."""
+
+    def __init__(self, address: str | None = None):
+        self._address = address or "auto"
+        self._runtime_env: dict | None = None
+        self._namespace: str | None = None
+
+    def env(self, runtime_env: dict) -> "ClientBuilder":
+        self._runtime_env = runtime_env
+        return self
+
+    def namespace(self, namespace: str) -> "ClientBuilder":
+        self._namespace = namespace
+        return self
+
+    def connect(self) -> ClientContext:
+        import ray_tpu
+        kwargs = {}
+        if self._runtime_env is not None:
+            kwargs["runtime_env"] = self._runtime_env
+        ray_tpu.init(address=self._address, **kwargs)
+        return ClientContext(address=self._address,
+                             namespace=self._namespace)
+
+
+def client(address: str | None = None) -> ClientBuilder:
+    """(reference: ray.client)"""
+    return ClientBuilder(address)

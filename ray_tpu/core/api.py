@@ -51,6 +51,7 @@ def init(num_cpus: int | None = None,
          address: str | None = None,
          log_to_driver: bool = True,
          cluster_token: str | bytes | None = None,
+         logging_config=None,
          _system_config: dict[str, Any] | None = None):
     """Start the single-node runtime in this process (driver), or —
     with ``address`` — connect this process as a CLIENT of a running
@@ -76,10 +77,14 @@ def init(num_cpus: int | None = None,
             raise RuntimeError(
                 "ray_tpu.init() called twice; pass "
                 "ignore_reinit_error=True to allow")
+        if logging_config is not None:
+            # Apply on the driver AND export to os.environ so spawned
+            # workers/daemons inherit it (worker_entry applies it).
+            logging_config._apply()
+            logging_config._export_env()
         if address is not None:
             bad = {"num_cpus": num_cpus, "num_tpus": num_tpus,
                    "resources": resources,
-                   "runtime_env": runtime_env,
                    "_system_config": _system_config}
             passed = [k for k, v in bad.items() if v]
             if local_mode:
@@ -90,6 +95,13 @@ def init(num_cpus: int | None = None,
                     f"{', '.join(passed)} configure a NEW cluster and "
                     f"would be silently ignored — remove them or drop "
                     f"address")
+            if runtime_env:
+                # Client-default env for every task/actor this client
+                # submits without its own (reference: ray client's
+                # init(runtime_env=...) job default). Validate BEFORE
+                # dialing so a bad env doesn't leak a connection.
+                from ray_tpu.runtime_env import validate_runtime_env
+                validate_runtime_env(runtime_env)
             from ray_tpu.core.worker import ClientRuntime
             token = cluster_token
             if token is None:
@@ -99,6 +111,8 @@ def init(num_cpus: int | None = None,
                 token = bytes.fromhex(token)
             _runtime = ClientRuntime(_resolve_address(address),
                                      token=token)
+            if runtime_env:
+                _runtime.default_runtime_env = dict(runtime_env)
             atexit.register(_shutdown_at_exit)
             return _runtime
         cfg = Config.from_env(_system_config)

@@ -232,3 +232,24 @@ class NodeID(BaseID):
 
 class PlacementGroupID(BaseID):
     SIZE = PLACEMENT_GROUP_ID_SIZE
+
+
+# Compat id families the reference exports at top level
+# (python/ray/__init__.py __all__) that this runtime does not mint
+# itself: real bytes-subclass ids with the reference sizes, usable
+# anywhere a hashable opaque id is expected.
+
+class UniqueID(BaseID):
+    SIZE = 28  # reference: kUniqueIDSize
+
+
+class WorkerID(UniqueID):
+    pass
+
+
+class FunctionID(UniqueID):
+    pass
+
+
+class ActorClassID(UniqueID):
+    pass

@@ -1,0 +1,99 @@
+"""Structured logging configuration (reference: ray.LoggingConfig,
+python/ray/_private/structured_logging/ — the ray.init(logging_config=
+LoggingConfig(...)) surface).
+
+The driver applies it immediately; worker/daemon processes inherit it
+through ``RAY_TPU_LOG_ENCODING`` / ``RAY_TPU_LOG_LEVEL`` env vars and
+apply it at entry (``worker_entry.main`` calls
+:func:`apply_from_env`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+
+_VALID_ENCODINGS = ("TEXT", "JSON")
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line: asctime/levelname/name/message plus
+    any requested standard attrs (the reference's JSON encoding)."""
+
+    def __init__(self, extra_attrs: list[str] | None = None):
+        super().__init__()
+        self._extra = list(extra_attrs or [])
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "asctime": self.formatTime(record),
+            "levelname": record.levelname,
+            "name": record.name,
+            "message": record.getMessage(),
+        }
+        for a in self._extra:
+            out[a] = getattr(record, a, None)
+        if record.exc_info:
+            out["exc_text"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+@dataclass
+class LoggingConfig:
+    """(reference: ray.LoggingConfig) ``encoding`` is TEXT or JSON;
+    ``additional_log_standard_attrs`` names extra LogRecord attributes
+    to include (JSON mode)."""
+
+    encoding: str = "TEXT"
+    log_level: str = "INFO"
+    additional_log_standard_attrs: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.encoding not in _VALID_ENCODINGS:
+            raise ValueError(
+                f"encoding must be one of {_VALID_ENCODINGS}, "
+                f"got {self.encoding!r}")
+
+    def _apply(self) -> None:
+        """Configure the ``ray_tpu`` logger tree in THIS process."""
+        logger = logging.getLogger("ray_tpu")
+        logger.setLevel(self.log_level)
+        handler = logging.StreamHandler()
+        if self.encoding == "JSON":
+            handler.setFormatter(
+                _JsonFormatter(self.additional_log_standard_attrs))
+        else:
+            handler.setFormatter(logging.Formatter(
+                "%(asctime)s\t%(levelname)s %(name)s -- %(message)s"))
+        # replace, don't stack: re-init must not duplicate lines
+        logger.handlers = [h for h in logger.handlers
+                           if not getattr(h, "_ray_tpu_cfg", False)]
+        handler._ray_tpu_cfg = True
+        logger.addHandler(handler)
+        logger.propagate = False
+
+    def _export_env(self) -> None:
+        """Publish to os.environ so spawned workers inherit it."""
+        os.environ["RAY_TPU_LOG_ENCODING"] = self.encoding
+        os.environ["RAY_TPU_LOG_LEVEL"] = self.log_level
+        if self.additional_log_standard_attrs:
+            os.environ["RAY_TPU_LOG_EXTRA_ATTRS"] = ",".join(
+                self.additional_log_standard_attrs)
+
+
+def apply_from_env() -> None:
+    """Worker-side: honor an inherited logging config, if any."""
+    enc = os.environ.get("RAY_TPU_LOG_ENCODING")
+    if not enc:
+        return
+    extras = [a for a in os.environ.get(
+        "RAY_TPU_LOG_EXTRA_ATTRS", "").split(",") if a]
+    try:
+        LoggingConfig(
+            encoding=enc,
+            log_level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+            additional_log_standard_attrs=extras)._apply()
+    except ValueError:
+        pass  # malformed env must not kill a worker boot

@@ -69,6 +69,10 @@ class ClientRuntime:
         # large ones through the chunked transfer plane.
         self._allow_desc = os.environ.get(
             "RAY_TPU_NO_SHM", "0") not in ("1", "true")
+        # Client-default runtime env (reference: ray client
+        # init(runtime_env=...) / ClientBuilder.env): injected into
+        # task/actor options that don't set their own.
+        self.default_runtime_env: dict | None = None
         self._send_lock = threading.Lock()
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._pending_lock = threading.Lock()
@@ -566,8 +570,19 @@ class ClientRuntime:
 
     # -- task / actor API --
 
+    def _with_default_env(self, options):
+        if not self.default_runtime_env or \
+                getattr(options, "runtime_env", None) is not None:
+            return options
+        import dataclasses
+        # a fresh instance: never mutate the (shared, blob-cached)
+        # options object hanging off the RemoteFunction
+        return dataclasses.replace(
+            options, runtime_env=dict(self.default_runtime_env))
+
     def submit_task(self, fn_id: str, fn_blob: bytes | None, fn_name: str,
                     args: tuple, kwargs: dict, options):
+        options = self._with_default_env(options)
         if options.num_returns == "streaming":
             # Streaming returns need the head-owned generator state:
             # keep the synchronous path.
@@ -764,6 +779,7 @@ class ClientRuntime:
                      kwargs: dict, options, name: str = "",
                      max_restarts: int = 0,
                      max_concurrency: int = 1) -> ActorID:
+        options = self._with_default_env(options)
         actor_id_bytes = self._call(P.OP_CREATE_ACTOR, (
             cls_blob, cls_name, _args_blob(args, kwargs),
             ser.dumps(options), name, max_restarts, max_concurrency))

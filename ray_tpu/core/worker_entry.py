@@ -37,6 +37,10 @@ def main() -> None:
     if wd and os.path.isdir(wd):
         os.chdir(wd)
 
+    # honor a driver-exported structured-logging config, if any
+    from ray_tpu.core.logging_config import apply_from_env
+    apply_from_env()
+
     address, token = sys.argv[1], sys.argv[2]
     conn = mpc.Client(address, family="AF_UNIX")
     conn.send(("hello", "exec", token))

@@ -379,7 +379,7 @@ class Dataset:
             batch = block_to_batch(block)
             for i, a in enumerate(aggs):
                 col = (np.asarray(batch[a.on]) if a.on is not None
-                       else np.empty(n))
+                       else np.zeros(n))
                 accs[i] = a.accumulate_block(accs[i], col)
         return {a.name: a.finalize(acc) for a, acc in zip(aggs, accs)}
 
@@ -657,16 +657,30 @@ class Dataset:
     def random_sample(self, fraction: float, *,
                       seed: int | None = None) -> "Dataset":
         """Bernoulli row sample (reference: Dataset.random_sample).
-        With a fixed seed the draw is deterministic per (seed, block
-        row count) — block-level, matching the reference's
-        per-block-rng contract, not a global permutation."""
+        With a fixed seed the draw is deterministic; each block's rng
+        is salted with a content digest so distinct blocks draw
+        INDEPENDENT masks (a bare per-block ``default_rng(seed)``
+        would give equal-sized blocks identical masks — correlated
+        sampling, caught in review)."""
         if not 0 <= fraction <= 1:
             raise ValueError("fraction must be in [0, 1]")
 
         def sample(batch):
+            import zlib
+
             import numpy as _np
             n = len(next(iter(batch.values()))) if batch else 0
-            rng = _np.random.default_rng(seed)
+            if seed is None:
+                rng = _np.random.default_rng()
+            else:
+                digest = 0
+                for k in sorted(batch):
+                    arr = _np.asarray(batch[k])
+                    data = (repr(arr[:32].tolist()).encode()
+                            if arr.dtype == object else
+                            _np.ascontiguousarray(arr).tobytes()[:4096])
+                    digest = zlib.crc32(data, digest)
+                rng = _np.random.default_rng([seed, n, digest])
             mask = rng.random(n) < fraction
             return {k: _np.asarray(v)[mask] for k, v in batch.items()}
 
@@ -952,7 +966,13 @@ class Dataset:
                 yield (f[feats[0]] if len(feats) == 1 else f,
                        l[labels[0]] if len(labels) == 1 else l)
 
+        # One eager probe batch builds the TensorSpecs (the generator
+        # re-streams the pipeline when tf.data first iterates).
         probe = self.take_batch(batch_size)
+        if not probe:
+            raise ValueError(
+                "to_tf needs at least one row to derive the output "
+                "signature; the dataset is empty")
 
         def sig(cols):
             specs = {
@@ -1587,7 +1607,7 @@ class GroupedData:
             row = {key: np.asarray(batch[key])[0]}
             for a in aggs:
                 col = (np.asarray(batch[a.on]) if a.on is not None
-                       else np.empty(n))
+                       else np.zeros(n))
                 row[a.name] = a.finalize(
                     a.accumulate_block(a.init(), col))
             return row

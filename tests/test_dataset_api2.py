@@ -131,6 +131,18 @@ def test_randomize_block_order(rt):
     assert first_block == list(range(first_block[0], first_block[0] + 5))
 
 
+def test_random_sample_blocks_draw_independently(rt):
+    # 4 equal-sized blocks of DIFFERENT content: with a fixed seed the
+    # per-block masks must differ (regression: a bare default_rng(seed)
+    # gave equal-sized blocks identical masks)
+    ds = data.range(400, parallelism=4).random_sample(0.5, seed=7)
+    picked = [r["id"] for r in ds.take_all()]
+    per_block = [
+        {i - 100 * b for i in picked if 100 * b <= i < 100 * (b + 1)}
+        for b in range(4)]
+    assert not all(s == per_block[0] for s in per_block[1:])
+
+
 def test_random_sample(rt):
     ds = data.range(400, parallelism=4)
     n = ds.random_sample(0.5, seed=11).count()

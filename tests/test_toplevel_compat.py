@@ -1,0 +1,131 @@
+"""Top-level API compat batch (reference: python/ray/__init__.py
+__all__): id families, worker-mode constants, LoggingConfig,
+client()/ClientBuilder, cross-language surface, show_in_dashboard.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_id_families():
+    assert issubclass(ray_tpu.WorkerID, ray_tpu.UniqueID)
+    uid = ray_tpu.UniqueID(os.urandom(ray_tpu.UniqueID.SIZE))
+    assert isinstance(uid, bytes) and len({uid, uid}) == 1
+    for name in ("ActorClassID", "ActorID", "FunctionID", "JobID",
+                 "NodeID", "ObjectID", "PlacementGroupID", "TaskID"):
+        assert hasattr(ray_tpu, name)
+
+
+def test_mode_constants_and_generator_alias():
+    assert (ray_tpu.SCRIPT_MODE, ray_tpu.WORKER_MODE,
+            ray_tpu.LOCAL_MODE) == (0, 1, 2)
+    assert ray_tpu.DynamicObjectRefGenerator is ray_tpu.ObjectRefGenerator
+
+
+def test_language_and_java_stubs():
+    assert ray_tpu.Language.CPP.value == 2
+    with pytest.raises(NotImplementedError, match="N30"):
+        ray_tpu.java_function("a.B", "f")
+    with pytest.raises(NotImplementedError, match="N30"):
+        ray_tpu.java_actor_class("a.B")
+
+
+def test_cpp_function(rt):
+    from ray_tpu import cpp
+    path = cpp.compile_library(r"""
+    #include "ray_tpu.h"
+    static raytpu::Bytes twice(const raytpu::Args& a) {
+      return raytpu::bytes_of(2 * raytpu::as<int64_t>(a[0]));
+    }
+    RAY_TPU_TASK(twice);
+    RAY_TPU_MODULE();
+    """)
+    fn = ray_tpu.cpp_function(path, "twice")
+    assert cpp.to_i64(ray_tpu.get(fn.remote(21))) == 42
+
+
+def test_logging_config_json(capsys):
+    ray_tpu.LoggingConfig(encoding="JSON", log_level="DEBUG")._apply()
+    try:
+        logging.getLogger("ray_tpu.test").debug("structured hello")
+        line = capsys.readouterr().err.strip().splitlines()[-1]
+        rec = json.loads(line)
+        assert rec["message"] == "structured hello"
+        assert rec["levelname"] == "DEBUG"
+    finally:
+        logging.getLogger("ray_tpu").handlers = []
+        logging.getLogger("ray_tpu").propagate = True
+
+
+def test_logging_config_validation_and_env_roundtrip(monkeypatch):
+    with pytest.raises(ValueError, match="encoding"):
+        ray_tpu.LoggingConfig(encoding="YAML")
+    cfg = ray_tpu.LoggingConfig(encoding="JSON", log_level="WARNING",
+                                additional_log_standard_attrs=["lineno"])
+    cfg._export_env()
+    try:
+        from ray_tpu.core import logging_config as lc
+        lc.apply_from_env()
+        lg = logging.getLogger("ray_tpu")
+        assert lg.level == logging.WARNING
+        assert any(getattr(h, "_ray_tpu_cfg", False) for h in lg.handlers)
+    finally:
+        for k in ("RAY_TPU_LOG_ENCODING", "RAY_TPU_LOG_LEVEL",
+                  "RAY_TPU_LOG_EXTRA_ATTRS"):
+            os.environ.pop(k, None)
+        logging.getLogger("ray_tpu").handlers = []
+        logging.getLogger("ray_tpu").propagate = True
+
+
+def test_client_builder(rt):
+    script = textwrap.dedent("""
+        import os
+        import sys
+        import ray_tpu
+        ctx = ray_tpu.client(sys.argv[1]).namespace("n1").env(
+            {"env_vars": {"BUILDER_ENV_PROBE": "e42"}}).connect()
+        @ray_tpu.remote
+        def f():
+            return 7
+        assert ray_tpu.get(f.remote()) == 7
+        # the builder's env() is the client-default runtime_env:
+        # tasks submitted without their own env inherit it
+        @ray_tpu.remote
+        def probe_env():
+            return os.environ.get("BUILDER_ENV_PROBE")
+        assert ray_tpu.get(probe_env.remote()) == "e42"
+        assert ctx.namespace == "n1"
+        ctx.disconnect()
+        assert not ray_tpu.is_initialized()
+        print("BUILDER_OK")
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", script, ray_tpu.client_address()],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "BUILDER_OK" in out.stdout
+
+
+def test_show_in_dashboard(rt):
+    ray_tpu.show_in_dashboard("training step 7", key="phase")
+    from ray_tpu.experimental.internal_kv import _kv_get
+    got = _kv_get(f"worker_msg:{os.getpid()}|phase",
+                  namespace="dashboard")
+    assert got == b"training step 7"
