@@ -44,8 +44,13 @@ class ClientBuilder:
         return self
 
     def namespace(self, namespace: str) -> "ClientBuilder":
-        self._namespace = namespace
-        return self
+        """Namespaces are NOT implemented: named actors are global in
+        this runtime, so silently accepting a namespace would fake an
+        isolation that does not exist (same honesty contract as the
+        java_* stubs)."""
+        raise NotImplementedError(
+            "ray_tpu has no actor namespaces; named actors are "
+            "cluster-global. Drop .namespace(...) or prefix names.")
 
     def connect(self) -> ClientContext:
         import ray_tpu

@@ -28,6 +28,46 @@ def _set_actor_context(actor_id: ActorID) -> None:
     _actor_context = actor_id
 
 
+# Per-execution task context (reference: runtime_context.get_task_id /
+# get_current_placement_group). A ContextVar, not threading.local:
+# actor max_concurrency>1 runs calls on executor threads (each thread
+# has its own context) AND async actor methods interleave as asyncio
+# tasks on one shared loop (each task gets a context copy — a
+# thread-local on the loop thread would bleed between coroutines).
+import contextvars as _contextvars
+
+_task_ctx: "_contextvars.ContextVar[tuple | None]" = \
+    _contextvars.ContextVar("ray_tpu_task_ctx", default=None)
+_actor_pg = None  # the PG the hosting actor was placed under
+
+
+def _set_task_context(task_id_bytes: bytes | None, pg=None) -> None:
+    _task_ctx.set((task_id_bytes, pg))
+
+
+def _clear_task_context() -> None:
+    _task_ctx.set(None)
+
+
+def _current_task_id() -> bytes | None:
+    v = _task_ctx.get()
+    return v[0] if v else None
+
+
+def _current_task_pg():
+    v = _task_ctx.get()
+    return v[1] if v else None
+
+
+def _set_actor_pg(pg) -> None:
+    global _actor_pg
+    _actor_pg = pg
+
+
+def _current_actor_pg():
+    return _actor_pg
+
+
 def get_runtime():
     if _runtime is None:
         raise RuntimeNotInitializedError()
@@ -305,6 +345,12 @@ class RuntimeContext:
 
     def get_actor_id(self) -> str | None:
         return _actor_context.hex() if _actor_context else None
+
+    def get_task_id(self) -> str | None:
+        """(reference: RuntimeContext.get_task_id) The id of the task
+        or actor call executing on THIS thread, else None (driver)."""
+        tid = _current_task_id()
+        return tid.hex() if tid else None
 
     def get_job_id(self) -> str:
         rt = get_runtime_or_none()

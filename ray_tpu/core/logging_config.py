@@ -81,6 +81,10 @@ class LoggingConfig:
         if self.additional_log_standard_attrs:
             os.environ["RAY_TPU_LOG_EXTRA_ATTRS"] = ",".join(
                 self.additional_log_standard_attrs)
+        else:
+            # a prior init's leftover must not leak into this
+            # session's workers
+            os.environ.pop("RAY_TPU_LOG_EXTRA_ATTRS", None)
 
 
 def apply_from_env() -> None:

@@ -51,13 +51,62 @@ def placement_group(bundles: list[dict[str, float]],
     if not bundles:
         raise ValueError("placement group needs at least one bundle")
     from ray_tpu.core.api import get_runtime
-    pg_id = get_runtime().create_placement_group(bundles, strategy)
+    pg_id = get_runtime().create_placement_group(bundles, strategy,
+                                                 name)
     return PlacementGroup(pg_id, bundles, strategy)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     from ray_tpu.core.api import get_runtime
     get_runtime().remove_placement_group(pg.id)
+
+
+def _pg_rows() -> list[dict]:
+    """State rows for all live PGs, from the driver or via the client
+    state op."""
+    from ray_tpu.core.api import get_runtime
+    rt = get_runtime()
+    if hasattr(rt, "_pgs"):
+        from ray_tpu.util import state as state_api
+        return state_api.list_placement_groups()
+    from ray_tpu.core import protocol as P
+    return rt._call(P.OP_STATE, ("placement_groups", None))
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    """Look a NAMED placement group up (reference:
+    ray.util.get_placement_group)."""
+    if not name:
+        raise ValueError("name must be non-empty")
+    for row in _pg_rows():
+        if row.get("name") == name:
+            return PlacementGroup(
+                PlacementGroupID(bytes.fromhex(
+                    row["placement_group_id"])),
+                row["bundles"], row["strategy"])
+    raise ValueError(f"no placement group named {name!r}")
+
+
+def placement_group_table(pg: PlacementGroup | None = None) -> dict:
+    """With ``pg``: that group's info row directly; without: PG id ->
+    row (both matching ray.util.placement_group_table's shapes)."""
+    rows = _pg_rows()
+    if pg is not None:
+        want = pg.id.hex()
+        return next((r for r in rows
+                     if r["placement_group_id"] == want), {})
+    return {r["placement_group_id"]: r for r in rows}
+
+
+def get_current_placement_group() -> PlacementGroup | None:
+    """The PG this task/actor is running inside, else None (reference:
+    ray.util.get_current_placement_group). Workers learn it from the
+    exec payload (tasks) or the actor-init payload (actor methods)."""
+    from ray_tpu.core import api
+    pg = api._current_task_pg()
+    if pg is not None:
+        return pg
+    return api._current_actor_pg()
 
 
 class PlacementGroupSchedulingStrategy:

@@ -19,8 +19,10 @@ from __future__ import annotations
 
 # exec channel, driver -> worker
 EXEC_TASK = "task"            # (EXEC_TASK, task_id_bytes, fn_id, fn_blob|None,
-                              #  args_blob, arg_objects, num_returns, options)
-EXEC_ACTOR_INIT = "actor_init"  # (.., actor_id_bytes, cls_blob, args_blob, arg_objects)
+                              #  args_blob, arg_objects, num_returns,
+                              #  trace_ctx[, placement_group])
+EXEC_ACTOR_INIT = "actor_init"  # (.., actor_id_bytes, cls_blob, args_blob,
+                                #  arg_objects, max_concurrency[, placement_group])
 EXEC_ACTOR_CALL = "actor_call"  # (.., task_id_bytes, method, args_blob, arg_objects, num_returns)
 EXEC_SHUTDOWN = "shutdown"    # (EXEC_SHUTDOWN,)
 EXEC_BATCH = "exec_batch"     # (EXEC_BATCH, [msg, ...]) — coalesced

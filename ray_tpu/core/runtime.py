@@ -253,6 +253,7 @@ class PGRecord:
     pg_id: PlacementGroupID
     bundles: list[dict[str, float]]
     strategy: str
+    name: str = ""
     # Per-bundle unclaimed reservations + the node each bundle landed
     # on (reference: bundles own their reserved resources,
     # placement_group_resource_manager.cc; 2-phase placement
@@ -2238,7 +2239,8 @@ class DriverRuntime:
         for row in state.get("pgs", []):
             bundles = [dict(b) for b in row["bundles"]]
             new_id = self.create_placement_group(bundles,
-                                                 row["strategy"])
+                                                 row["strategy"],
+                                                 row.get("name", ""))
             pg_map[row.get("id", "")] = PlacementGroup(
                 new_id, bundles, row["strategy"])
 
@@ -2643,7 +2645,8 @@ class DriverRuntime:
             w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id,
                     fn_blob, rec.args_blob, resolved,
                     rec.options.num_returns,
-                    getattr(rec.options, "trace_ctx", None)))
+                    getattr(rec.options, "trace_ctx", None),
+                    getattr(rec.options, "placement_group", None)))
         except BaseException:
             # The rec never reached the worker: it must not occupy
             # the lease queue (a live worker would otherwise never
@@ -2756,7 +2759,8 @@ class DriverRuntime:
             w.send((P.EXEC_TASK, rec.task_id.binary(), rec.fn_id,
                     fn_blob, rec.args_blob, resolved,
                     rec.options.num_returns,
-                    getattr(rec.options, "trace_ctx", None)))
+                    getattr(rec.options, "trace_ctx", None),
+                    getattr(rec.options, "placement_group", None)))
         except BaseException:
             with w.lease_lock:
                 try:
@@ -3048,7 +3052,8 @@ class DriverRuntime:
             try:
                 w.send((P.EXEC_ACTOR_INIT, rec.actor_id.binary(),
                         rec.cls_blob, rec.init_args_blob, resolved,
-                        rec.max_concurrency))
+                        rec.max_concurrency,
+                        getattr(rec.options, "placement_group", None)))
             except Exception:
                 send_failed = True
                 raise
@@ -3362,14 +3367,23 @@ class DriverRuntime:
     # ---------------- placement groups ----------------
 
     def create_placement_group(self, bundles: list[dict[str, float]],
-                               strategy: str) -> PlacementGroupID:
+                               strategy: str,
+                               name: str = "") -> PlacementGroupID:
         pg_id = PlacementGroupID.from_random()
-        rec = PGRecord(pg_id=pg_id, bundles=bundles, strategy=strategy)
+        rec = PGRecord(pg_id=pg_id, bundles=bundles, strategy=strategy,
+                       name=name)
         with self._pg_lock:
+            if name:
+                # named PGs are unique among live groups (reference:
+                # placement_group(name=...) raises on a taken name)
+                for other in self._pgs.values():
+                    if other.name == name:
+                        raise ValueError(
+                            f"placement group name {name!r} is taken")
             self._pgs[pg_id] = rec
         self._journal({"op": "pg", "row": {
             "id": pg_id.hex(), "bundles": bundles,
-            "strategy": strategy}})
+            "strategy": strategy, "name": name}})
 
         def reserve():
             # All-or-nothing bundle placement across nodes per strategy
@@ -4990,8 +5004,10 @@ class DriverRuntime:
                 return self.timeline()
             return fns[kind](filters)
         if op == P.OP_PG_CREATE:
-            bundles, strategy = payload
-            return self.create_placement_group(bundles, strategy).binary()
+            bundles, strategy, name = (payload if len(payload) == 3
+                                       else (*payload, ""))
+            return self.create_placement_group(
+                bundles, strategy, name).binary()
         if op == P.OP_PG_REMOVE:
             self.remove_placement_group(PlacementGroupID(payload))
             return None

@@ -45,6 +45,27 @@ class SerializedObject:
         return len(self.data) + sum(len(b) for b in self.buffers)
 
 
+# type -> (serializer, deserializer); see register_serializer
+# (reference: ray.util.register_serializer /
+# python/ray/_private/serialization.py custom-serializer hooks).
+_custom_serializers: dict[type, tuple] = {}
+
+
+def register_serializer(cls: type, *, serializer, deserializer) -> None:
+    """Route instances of ``cls`` through ``serializer(obj) -> state``
+    on pickle and ``deserializer(state) -> obj`` on unpickle, in every
+    serialization path (task args, returns, put objects)."""
+    if not isinstance(cls, type):
+        raise TypeError(f"cls must be a type, got {cls!r}")
+    if not callable(serializer) or not callable(deserializer):
+        raise TypeError("serializer and deserializer must be callable")
+    _custom_serializers[cls] = (serializer, deserializer)
+
+
+def deregister_serializer(cls: type) -> None:
+    _custom_serializers.pop(cls, None)
+
+
 class _Pickler(cloudpickle.CloudPickler):
     """cloudpickle with a host-copy reducer for jax device arrays.
 
@@ -60,6 +81,16 @@ class _Pickler(cloudpickle.CloudPickler):
         self.contained_refs: list = []
 
     def reducer_override(self, obj):
+        if _custom_serializers:
+            entry = _custom_serializers.get(type(obj))
+            if entry is not None:
+                ser_fn, deser_fn = entry
+                # The deserializer travels WITH the payload (pickled
+                # by value), so the receiving process needs no
+                # registration of its own — reference semantics
+                # (ray.util.register_serializer) without the GCS
+                # broadcast machinery.
+                return (deser_fn, (ser_fn(obj),))
         jax = sys.modules.get("jax")
         if jax is not None and isinstance(obj, jax.Array):
             import numpy as np
@@ -76,7 +107,10 @@ class _Pickler(cloudpickle.CloudPickler):
             self.contained_refs.append((obj.id, nonce))
             return (_rehydrate_ref,
                     (obj.id.binary(), obj._owner_hint, nonce))
-        return NotImplemented
+        # cloudpickle's own reducer_override carries function/class
+        # by-value pickling — must delegate, not return NotImplemented
+        # (shadowing it breaks lambda/closure payloads).
+        return super().reducer_override(obj)
 
 
 def serialize(value, copy_buffers: bool = True) -> SerializedObject:
@@ -114,8 +148,26 @@ def deserialize(obj: SerializedObject):
 
 
 def dumps(value) -> bytes:
-    """One-shot in-band serialization (small control-plane payloads)."""
+    """One-shot in-band serialization (small control-plane payloads).
+    The bare-cloudpickle fast path is kept unless custom serializers
+    are registered (the registry check is one dict truthiness test)."""
+    if _custom_serializers:
+        # custom-serializer-only pickler: dumps() must NOT take the
+        # _Pickler ObjectRef escape-pin path (control payloads are not
+        # stored objects; pinning refs here would leak pins)
+        buf = io.BytesIO()
+        _CustomOnlyPickler(buf, protocol=5).dump(value)
+        return buf.getvalue()
     return cloudpickle.dumps(value, protocol=5)
+
+
+class _CustomOnlyPickler(cloudpickle.CloudPickler):
+    def reducer_override(self, obj):
+        entry = _custom_serializers.get(type(obj))
+        if entry is not None:
+            ser_fn, deser_fn = entry
+            return (deser_fn, (ser_fn(obj),))
+        return super().reducer_override(obj)
 
 
 def loads(data: bytes):

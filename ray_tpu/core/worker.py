@@ -863,9 +863,9 @@ class ClientRuntime:
     def timeline(self):
         return []
 
-    def create_placement_group(self, bundles, strategy):
+    def create_placement_group(self, bundles, strategy, name=""):
         return PlacementGroupIDFromBytes(
-            self._call(P.OP_PG_CREATE, (bundles, strategy)))
+            self._call(P.OP_PG_CREATE, (bundles, strategy, name)))
 
     def pg_ready(self, pg_id, timeout=None):
         return True
@@ -1010,15 +1010,28 @@ def _run_maybe_async_actor(fn, args, kwargs):
     blocking call inside an async method stalls the loop — the same
     documented anti-pattern as the reference's async actors."""
     import inspect
+
+    def _with_ctx(coro):
+        # carry the submitting thread's task context into the loop
+        # task (run_coroutine_threadsafe does not propagate it)
+        from ray_tpu.core import api
+
+        async def runner(tid=api._current_task_id(),
+                         pg=api._current_task_pg()):
+            api._set_task_context(tid, pg)
+            return await coro
+        return runner()
+
     if inspect.iscoroutinefunction(fn):
         import asyncio
         return asyncio.run_coroutine_threadsafe(
-            fn(*args, **kwargs), _ensure_actor_loop()).result()
+            _with_ctx(fn(*args, **kwargs)),
+            _ensure_actor_loop()).result()
     result = fn(*args, **kwargs)
     if inspect.iscoroutine(result):
         import asyncio
         return asyncio.run_coroutine_threadsafe(
-            result, _ensure_actor_loop()).result()
+            _with_ctx(result), _ensure_actor_loop()).result()
     return result
 
 
@@ -1145,9 +1158,10 @@ def worker_main(conn, client_address: str) -> None:
                     pass
 
     def exec_task(task_id_bytes, fn_id, fn_blob, args_blob, resolved,
-                  num_returns, trace_ctx=None):
+                  num_returns, trace_ctx=None, pg=None):
         from ray_tpu.util.tracing import get_tracer
         tr = get_tracer()
+        api._set_task_context(task_id_bytes, pg)
         # Tracing follows the incoming task: an untraced task on a
         # pooled worker must not keep recording (and later flush)
         # spans left enabled by an earlier traced task.
@@ -1174,6 +1188,7 @@ def worker_main(conn, client_address: str) -> None:
                 if not isinstance(e, TaskError) else e
             send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
         finally:
+            api._clear_task_context()
             if trace_ctx is not None:
                 _flush_spans()
 
@@ -1201,6 +1216,9 @@ def worker_main(conn, client_address: str) -> None:
                                resolved, num_returns, trace_ctx=None):
         from ray_tpu.util.tracing import get_tracer
         tr = get_tracer()
+        # Actor calls inherit the hosting actor's PG for
+        # get_current_placement_group; cleared in the finally below.
+        api._set_task_context(task_id_bytes, api._current_actor_pg())
         if trace_ctx is not None:
             tr.enable()
         elif serialize_calls:
@@ -1242,6 +1260,7 @@ def worker_main(conn, client_address: str) -> None:
             err = ActorError(method, traceback.format_exc(), None)
             send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
         finally:
+            api._clear_task_context()
             if trace_ctx is not None:
                 _flush_spans()
 
@@ -1296,6 +1315,10 @@ def worker_main(conn, client_address: str) -> None:
             return False
 
         async def _acall():
+            # runs as its own asyncio task (own context copy): set the
+            # task context HERE — the submitting thread's context does
+            # not reach run_coroutine_threadsafe coroutines
+            api._set_task_context(task_id_bytes, api._current_actor_pg())
             async with loop_sem:
                 try:
                     result = await bound(*args, **kwargs)
@@ -1323,12 +1346,14 @@ def worker_main(conn, client_address: str) -> None:
                     return False
         elif kind == P.EXEC_TASK:
             (_, task_id_bytes, fn_id, fn_blob, args_blob, resolved,
-             num_returns, trace_ctx) = msg
+             num_returns, trace_ctx) = msg[:8]
             exec_task(task_id_bytes, fn_id, fn_blob, args_blob,
-                      resolved, num_returns, trace_ctx)
+                      resolved, num_returns, trace_ctx,
+                      pg=msg[8] if len(msg) > 8 else None)
         elif kind == P.EXEC_ACTOR_INIT:
             (_, actor_id_bytes, cls_blob, args_blob, resolved,
-             max_concurrency) = msg
+             max_concurrency) = msg[:6]
+            api._set_actor_pg(msg[6] if len(msg) > 6 else None)
             try:
                 cls = ser.loads(cls_blob)
                 args, kwargs = _materialize_args(args_blob, resolved)

@@ -25,6 +25,7 @@ from ray_tpu.serve.api import (
     Application,
     Deployment,
     DeploymentHandle,
+    HTTPOptions,
 )
 from ray_tpu.serve.replica import get_replica_context, ReplicaContext
 from ray_tpu.serve.autoscaling import AutoscalingConfig

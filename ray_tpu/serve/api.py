@@ -267,18 +267,52 @@ def get_app_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
 
+@dataclass
+class HTTPOptions:
+    """HTTP proxy options (reference: serve.config.HTTPOptions).
+    Honored fields: ``host`` and ``port`` (the proxy binds them);
+    ``location="NoServer"`` skips the proxy. The remaining reference
+    fields are accepted for signature compatibility and recorded but
+    have no effect in this proxy."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+    root_path: str = ""
+    request_timeout_s: float | None = None
+    keep_alive_timeout_s: float = 5.0
+    location: str = "HeadOnly"
+
+
 def start(*, http_port: int | None = None,
-          grpc_port: int | None = None) -> None:
+          grpc_port: int | None = None,
+          http_options: HTTPOptions | dict | None = None) -> None:
     """Boot the serve control plane (controller + optional proxies)
     without deploying anything (reference: serve.start) — idempotent;
     later serve.run/deploy_config calls attach to it."""
     global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
     _ensure_controller()
+    host = "127.0.0.1"
+    if http_options is not None:
+        if isinstance(http_options, dict):
+            http_options = HTTPOptions(**http_options)
+        if http_options.location == "NoServer":
+            # NoServer wins over an http_port argument: no proxy.
+            http_port = None
+        else:
+            host = http_options.host
+            if http_port is None:
+                http_port = http_options.port
+    if http_port is not None and _proxy is not None \
+            and _proxy_port == http_port and host != "127.0.0.1":
+        raise ValueError(
+            f"an HTTP proxy is already bound on port {http_port} "
+            f"(host 127.0.0.1); serve.shutdown() first to rebind on "
+            f"{host!r}")
     if http_port is not None and (_proxy is None
                                   or _proxy_port != http_port):
         from ray_tpu.serve.proxy import ProxyActor
         _proxy = ProxyActor.options(
-            num_cpus=0, max_concurrency=32).remote(http_port)
+            num_cpus=0, max_concurrency=32).remote(http_port, host)
         _proxy_port = http_port
         ray_tpu.get(_proxy.ready.remote(), timeout=30)
     if grpc_port is not None and (_grpc_proxy is None

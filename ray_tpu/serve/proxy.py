@@ -15,8 +15,9 @@ import ray_tpu
 
 @ray_tpu.remote
 class ProxyActor:
-    def __init__(self, port: int):
+    def __init__(self, port: int, host: str = "127.0.0.1"):
         self.port = port
+        self.host = host
         self.routes: dict[str, str] = {}     # route_prefix -> deployment
         self._routers: dict[str, object] = {}
         self._controller = None
@@ -137,7 +138,7 @@ class ProxyActor:
             app.router.add_route("*", "/{tail:.*}", handler)
             runner = web.AppRunner(app)
             await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            site = web.TCPSite(runner, self.host, self.port)
             await site.start()
             self._started.set()
             while True:

@@ -1,0 +1,55 @@
+"""Rate-limited logging helpers (reference:
+python/ray/util/debug.py — log_once / disable_log_once_globally /
+enable_periodic_logging).
+
+``log_once(key)`` returns True exactly once per key (or once per
+period when periodic logging is enabled), so callers can guard noisy
+warnings.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_lock = threading.Lock()
+_seen: dict[str, float] = {}
+_disabled = False
+_period_s: float | None = None
+
+
+def log_once(key: str) -> bool:
+    global _seen
+    if _disabled:
+        return False
+    now = time.monotonic()
+    with _lock:
+        last = _seen.get(key)
+        if last is None or (_period_s is not None
+                            and now - last >= _period_s):
+            _seen[key] = now
+            return True
+    return False
+
+
+def disable_log_once_globally() -> None:
+    """Every subsequent log_once returns False (reference behavior:
+    silence guarded logs process-wide)."""
+    global _disabled
+    _disabled = True
+
+
+def enable_periodic_logging(period_s: float = 60.0) -> None:
+    """log_once keys re-arm every ``period_s`` (the reference re-arms
+    periodically so long-running jobs still surface guarded logs)."""
+    global _disabled, _period_s
+    _disabled = False
+    _period_s = period_s
+
+
+def _reset_for_tests() -> None:
+    global _disabled, _period_s
+    with _lock:
+        _seen.clear()
+    _disabled = False
+    _period_s = None

@@ -126,6 +126,7 @@ def list_placement_groups(filters=None, limit: int = 10_000
     for rec in recs:
         row = {
             "placement_group_id": rec.pg_id.hex(),
+            "name": rec.name,
             "state": "CREATED" if rec.created else "PENDING",
             "strategy": rec.strategy,
             "bundles": [dict(b) for b in rec.bundles],

@@ -99,7 +99,13 @@ def test_client_builder(rt):
         import os
         import sys
         import ray_tpu
-        ctx = ray_tpu.client(sys.argv[1]).namespace("n1").env(
+        # namespaces are honestly unimplemented: loud, not silent
+        try:
+            ray_tpu.client(sys.argv[1]).namespace("n1")
+            raise SystemExit("namespace should raise")
+        except NotImplementedError:
+            pass
+        ctx = ray_tpu.client(sys.argv[1]).env(
             {"env_vars": {"BUILDER_ENV_PROBE": "e42"}}).connect()
         @ray_tpu.remote
         def f():
@@ -111,7 +117,6 @@ def test_client_builder(rt):
         def probe_env():
             return os.environ.get("BUILDER_ENV_PROBE")
         assert ray_tpu.get(probe_env.remote()) == "e42"
-        assert ctx.namespace == "n1"
         ctx.disconnect()
         assert not ray_tpu.is_initialized()
         print("BUILDER_OK")

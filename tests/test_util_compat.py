@@ -1,0 +1,199 @@
+"""ray.util compat batch (reference: python/ray/util/__init__.py):
+custom serializers, log_once, named placement groups +
+get_current_placement_group, list_named_actors, task runtime context.
+"""
+
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu import util as rutil
+from ray_tpu.util.log_once import _reset_for_tests
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class Unpicklable:
+    """Carries a lock: plain pickling raises."""
+
+    def __init__(self, value):
+        self.value = value
+        self.lock = threading.Lock()
+
+
+def test_register_serializer_roundtrip(rt):
+    # a LOCAL class: cloudpickle ships it (and the deserializer that
+    # closes over it) by value, so workers need no import path
+    class Local(Unpicklable):
+        pass
+
+    rutil.register_serializer(
+        Local,
+        serializer=lambda o: o.value,
+        deserializer=lambda v: Local(v))
+    try:
+        # through the object store
+        ref = ray_tpu.put(Local(41))
+        back = ray_tpu.get(ref)
+        assert isinstance(back, Unpicklable) and back.value == 41
+
+        # through task args: deserialization needs NO registration on
+        # the receiver (the deserializer travels with the payload)
+        @ray_tpu.remote
+        def read_value(o):
+            return o.value
+
+        assert ray_tpu.get(read_value.remote(Local(1))) == 1
+
+        # returning one requires the SERIALIZING process (the worker)
+        # to register too — registration is process-local, the
+        # reference's documented contract
+        @ray_tpu.remote
+        def bump(o):
+            from ray_tpu import util as u
+            U = type(o)
+            u.register_serializer(U, serializer=lambda x: x.value,
+                                  deserializer=lambda v: U(v))
+            return U(o.value + 1)
+
+        out = ray_tpu.get(bump.remote(Local(1)))
+        assert out.value == 2
+    finally:
+        rutil.deregister_serializer(Local)
+    with pytest.raises(Exception):
+        ray_tpu.put(Local(1))
+
+
+def test_register_serializer_validation():
+    with pytest.raises(TypeError):
+        rutil.register_serializer("notatype", serializer=str,
+                                  deserializer=str)
+    with pytest.raises(TypeError):
+        rutil.register_serializer(Unpicklable, serializer=None,
+                                  deserializer=str)
+
+
+def test_log_once():
+    _reset_for_tests()
+    assert rutil.log_once("k1") is True
+    assert rutil.log_once("k1") is False
+    assert rutil.log_once("k2") is True
+    rutil.disable_log_once_globally()
+    assert rutil.log_once("k3") is False
+    rutil.enable_periodic_logging(period_s=0.0)
+    assert rutil.log_once("k1") is True  # re-armed
+    _reset_for_tests()
+
+
+def test_get_node_ip_address():
+    ip = rutil.get_node_ip_address()
+    assert isinstance(ip, str) and ip.count(".") == 3
+
+
+def test_list_named_actors(rt):
+    @ray_tpu.remote(num_cpus=0)
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    a = Svc.options(name="util_compat_svc").remote()
+    ray_tpu.get(a.ping.remote())
+    assert "util_compat_svc" in rutil.list_named_actors()
+
+
+def test_named_placement_group(rt):
+    pg = rutil.placement_group([{"CPU": 1}], name="util_pg_1")
+    assert pg.ready(timeout=10)
+    got = rutil.get_placement_group("util_pg_1")
+    assert got.id == pg.id
+    with pytest.raises(ValueError, match="taken"):
+        rutil.placement_group([{"CPU": 1}], name="util_pg_1")
+    table = rutil.placement_group_table()
+    assert table[pg.id.hex()]["name"] == "util_pg_1"
+    with pytest.raises(ValueError, match="no placement group"):
+        rutil.get_placement_group("nope_pg")
+    rutil.remove_placement_group(pg)
+
+
+def test_get_current_placement_group(rt):
+    pg = rutil.placement_group([{"CPU": 1}], name="util_pg_ctx")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        cur = rutil.get_current_placement_group()
+        tid = ray_tpu.get_runtime_context().get_task_id()
+        return (cur.id.hex() if cur else None, tid)
+
+    in_pg, tid = ray_tpu.get(
+        where.options(placement_group=pg).remote())
+    assert in_pg == pg.id.hex()
+    assert isinstance(tid, str) and len(tid) > 0
+
+    out_pg, _ = ray_tpu.get(where.remote())
+    assert out_pg is None
+
+    # driver context: no PG, no task id
+    assert rutil.get_current_placement_group() is None
+    assert ray_tpu.get_runtime_context().get_task_id() is None
+    rutil.remove_placement_group(pg)
+
+
+def test_async_actor_sees_task_context(rt):
+    """Regression: coroutine methods run as asyncio tasks on the
+    shared actor loop — the context must reach them (a thread-local
+    set on the pool thread would not)."""
+    pg = rutil.placement_group([{"CPU": 1}], name="util_pg_async")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=1, max_concurrency=4)
+    class A:
+        async def ctx(self):
+            cur = rutil.get_current_placement_group()
+            tid = ray_tpu.get_runtime_context().get_task_id()
+            return (cur.id.hex() if cur else None, tid)
+
+    a = A.options(placement_group=pg).remote()
+    got_pg, tid = ray_tpu.get(a.ctx.remote())
+    assert got_pg == pg.id.hex()
+    assert tid
+    ray_tpu.kill(a)
+    rutil.remove_placement_group(pg)
+
+
+def test_placement_group_table_single(rt):
+    pg = rutil.placement_group([{"CPU": 1}], name="util_pg_tbl")
+    assert pg.ready(timeout=10)
+    row = rutil.placement_group_table(pg)  # the row itself, not a map
+    assert row["name"] == "util_pg_tbl"
+    assert row["state"] in ("CREATED", "PENDING")
+    rutil.remove_placement_group(pg)
+
+
+def test_actor_inherits_pg_context(rt):
+    pg = rutil.placement_group([{"CPU": 1}], name="util_pg_actor")
+    assert pg.ready(timeout=10)
+
+    @ray_tpu.remote(num_cpus=1)
+    class InPg:
+        def current(self):
+            cur = rutil.get_current_placement_group()
+            return cur.id.hex() if cur else None
+
+    a = InPg.options(placement_group=pg).remote()
+    assert ray_tpu.get(a.current.remote()) == pg.id.hex()
+    ray_tpu.kill(a)
+    rutil.remove_placement_group(pg)
+
+
+def test_serve_http_options(rt):
+    from ray_tpu import serve
+    assert serve.HTTPOptions().port == 8000
+    opts = serve.HTTPOptions(host="127.0.0.1", port=0)
+    assert opts.location == "HeadOnly"
