@@ -1,19 +1,37 @@
 """Durable workflows (reference: python/ray/workflow/)."""
 
 from ray_tpu.workflow.api import (
+    EventListener,
     cancel,
+    continuation,
+    delete,
     get_metadata,
     get_output,
+    get_output_async,
     get_status,
     init,
     list_all,
+    options,
     resume,
+    resume_all,
+    resume_async,
     run,
     run_async,
+    sleep,
+    wait_for_event,
 )
-from ray_tpu.workflow.common import WorkflowStatus
+from ray_tpu.workflow.common import (
+    WorkflowCancellationError,
+    WorkflowError,
+    WorkflowExecutionError,
+    WorkflowStatus,
+)
 
 __all__ = [
-    "init", "run", "run_async", "resume", "get_output", "get_status",
-    "get_metadata", "list_all", "cancel", "WorkflowStatus",
+    "init", "run", "run_async", "resume", "resume_async", "resume_all",
+    "get_output", "get_output_async", "get_status", "get_metadata",
+    "list_all", "cancel", "delete", "sleep", "wait_for_event",
+    "EventListener", "continuation", "options", "WorkflowStatus",
+    "WorkflowError", "WorkflowExecutionError",
+    "WorkflowCancellationError",
 ]

@@ -29,12 +29,103 @@ from ray_tpu.dag.dag_node import (
     _DAGInputData,
 )
 from ray_tpu.workflow import storage as wf_storage
-from ray_tpu.workflow.common import WorkflowStatus
+from ray_tpu.workflow.common import (
+    WorkflowCancellationError,
+    WorkflowExecutionError,
+    WorkflowStatus,
+)
 
 _running: dict[str, threading.Thread] = {}
 _results: dict[str, Any] = {}
 _cancel_flags: dict[str, threading.Event] = {}
 _lock = threading.Lock()
+
+
+# -- events / sleep / continuation (reference: workflow/api.py
+#    wait_for_event + event_listener.py; workflow.continuation) -------
+
+
+class EventListener:
+    """Event-source ABC (reference: workflow.EventListener): subclass
+    and implement ``poll_for_event`` (sync or async); its return value
+    becomes the event step's (durably checkpointed) result, so a
+    resumed workflow does NOT re-poll a received event."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class Continuation:
+    """A step's "keep going with this DAG" return value — build with
+    :func:`continuation` (reference: workflow.continuation)."""
+
+    def __init__(self, dag_node: DAGNode):
+        if not isinstance(dag_node, DAGNode):
+            raise TypeError(
+                f"continuation() takes a bound DAG node, got "
+                f"{type(dag_node).__name__}")
+        self.dag = dag_node
+
+
+def continuation(dag_node: DAGNode) -> Continuation:
+    """Return this from a workflow step to dynamically extend the
+    workflow: the sub-DAG executes with its own durable step log (keys
+    namespaced under the returning step), and its result becomes the
+    step's result (reference: workflow.continuation — dynamic
+    workflows)."""
+    return Continuation(dag_node)
+
+
+def _poll_listener(listener_cls, args, kwargs):
+    import asyncio
+    import inspect
+    listener = listener_cls()
+    out = listener.poll_for_event(*args, **kwargs)
+    if inspect.iscoroutine(out):
+        out = asyncio.run(out)
+    return out
+
+
+def _durable_sleep(duration: float) -> None:
+    time.sleep(duration)
+
+
+def wait_for_event(event_listener_cls, *args, **kwargs) -> DAGNode:
+    """A workflow step that completes when the listener's
+    ``poll_for_event(*args, **kwargs)`` returns (reference:
+    workflow.wait_for_event). The event payload is checkpointed like
+    any step result."""
+    if not (isinstance(event_listener_cls, type)
+            and issubclass(event_listener_cls, EventListener)):
+        raise TypeError("wait_for_event takes an EventListener "
+                        "subclass")
+    import ray_tpu
+    rf = ray_tpu.remote(num_cpus=0)(_poll_listener)
+    return rf.options(name=f"event_{event_listener_cls.__name__}").bind(
+        event_listener_cls, args, kwargs)
+
+
+def sleep(duration: float) -> DAGNode:
+    """A durable timer step (reference: workflow.sleep): a resumed
+    workflow whose sleep already completed does not sleep again."""
+    import ray_tpu
+    rf = ray_tpu.remote(num_cpus=0)(_durable_sleep)
+    return rf.options(name="workflow_sleep").bind(duration)
+
+
+def options(*, name: str | None = None, metadata: dict | None = None,
+            **kwargs) -> dict:
+    """Step options for ``fn.options(**workflow.options(...))``
+    (reference: workflow.options). ``name`` keys the step's durable
+    log entry — give steps stable names so refactors don't orphan
+    their checkpoints; ``metadata`` is recorded in the workflow
+    metadata."""
+    out = dict(kwargs)
+    if name is not None:
+        out["name"] = name
+    if metadata is not None:
+        out["_workflow_metadata"] = metadata
+    return out
 
 
 def init(storage: str | None = None) -> None:
@@ -45,12 +136,27 @@ def init(storage: str | None = None) -> None:
 
 def _step_keys(order: list[DAGNode]) -> dict[int, str]:
     keys: dict[int, str] = {}
+    named_seen: dict[str, int] = {}
     for i, n in enumerate(order):
+        explicit = None
         if isinstance(n, FunctionNode):
-            name = n._remote_fn.underlying_function.__name__
+            opts = getattr(n._remote_fn, "_default_opts", {}) or {}
+            explicit = opts.get("name")
+            name = (explicit
+                    or n._remote_fn.underlying_function.__name__)
         else:
             name = type(n).__name__
-        keys[id(n)] = f"{i:04d}_{name}"
+        if explicit:
+            # Explicitly-named steps get POSITION-INDEPENDENT keys —
+            # the whole point of workflow.options(name=...): inserting
+            # a step must not orphan existing checkpoints. Repeats of
+            # one name key by occurrence order.
+            count = named_seen.get(explicit, 0)
+            named_seen[explicit] = count + 1
+            keys[id(n)] = (f"named_{explicit}" if count == 0
+                           else f"named_{explicit}_{count + 1}")
+        else:
+            keys[id(n)] = f"{i:04d}_{name}"
     return keys
 
 
@@ -70,37 +176,66 @@ def _execute(dag: DAGNode, store: wf_storage.WorkflowStorage,
     order = dag.topological_order()
     _validate(order)
     keys = _step_keys(order)
-    # node id -> concrete value OR pending ObjectRef. Independent
-    # branches run in parallel: fresh steps are submitted as tasks
-    # with upstream ObjectRefs as args (the runtime resolves them),
-    # then a second pass persists each result as it completes.
+    # node id -> concrete value OR pending ObjectRef. Sibling branches
+    # run in parallel (submitted before either is consumed); a
+    # dependency is MATERIALIZED (awaited + continuation-expanded +
+    # persisted) the first time a consumer needs it — dynamic
+    # workflows mean an upstream ref may hold a Continuation, which
+    # must expand through the durable executor before dependents see
+    # its value.
     vals: dict[int, Any] = {}
+    is_step: set[int] = set()          # ids whose results persist
+
+    def await_ref(ref):
+        """Poll, don't block: cancel() must interrupt a workflow stuck
+        on a long step (e.g. an event poll)."""
+        while True:
+            done, _ = ray_tpu.wait([ref], timeout=0.2)
+            if done:
+                return ray_tpu.get(ref)
+            if cancel.is_set():
+                _cancel_inflight(vals)
+                raise _Canceled()
+
+    def materialize(node) -> Any:
+        value = vals[id(node)]
+        changed = False
+        if isinstance(value, ObjectRef):
+            value = await_ref(value)
+            changed = True
+        # A step (fresh, or cache-loaded after a crash mid-
+        # continuation) returning a Continuation extends the
+        # workflow; sub-steps get their own durable log namespaced
+        # under this step, then the final value overwrites the step
+        # entry so a completed continuation resumes as a cached value.
+        while isinstance(value, Continuation):
+            if changed:  # checkpoint the outer step first
+                store.save_step(keys[id(node)], value)
+            sub = _SubStore(store, keys[id(node)])
+            value = _execute(value.dag, sub, None, cancel)
+            changed = True
+        if changed and id(node) in is_step:
+            store.save_step(keys[id(node)], value)
+        vals[id(node)] = value
+        return value
 
     def resolve_nested(obj):
-        """Resolve a nested container arg to concrete values (nested
-        refs would reach the task unresolved, so block on them)."""
         if isinstance(obj, DAGNode):
-            v = vals[id(obj)]
-            return ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+            return materialize(obj)
         if isinstance(obj, (list, tuple)):
             return type(obj)(resolve_nested(v) for v in obj)
         if isinstance(obj, dict):
             return {k: resolve_nested(v) for k, v in obj.items()}
         return obj
 
-    def resolve_top(obj):
-        if isinstance(obj, DAGNode):
-            return vals[id(obj)]       # value or ref; both fine as args
-        return resolve_nested(obj)
-
-    # Pass 1: submit every non-cached step (refs flow as task args).
     for n in order:
         if cancel.is_set():
+            _cancel_inflight(vals)
             raise _Canceled()
         if isinstance(n, InputNode):
             vals[id(n)] = input_val
         elif isinstance(n, InputAttributeNode):
-            base = vals[id(n._bound_args[0])]
+            base = materialize(n._bound_args[0])
             if isinstance(base, _DAGInputData):
                 vals[id(n)] = base.pick(n._key)
             elif isinstance(n._key, int):
@@ -109,34 +244,66 @@ def _execute(dag: DAGNode, store: wf_storage.WorkflowStorage,
                 vals[id(n)] = (base[n._key] if isinstance(base, dict)
                                else getattr(base, n._key))
         elif isinstance(n, MultiOutputNode):
-            pass  # resolved in pass 2
+            vals[id(n)] = [materialize(c) for c in n._bound_args]
         elif store.has_step(keys[id(n)]):
+            is_step.add(id(n))
             vals[id(n)] = store.load_step(keys[id(n)])
         else:
-            args = tuple(resolve_top(a) for a in n._bound_args)
-            kwargs = {k: resolve_top(v)
+            is_step.add(id(n))
+            args = tuple(resolve_nested(a) for a in n._bound_args)
+            kwargs = {k: resolve_nested(v)
                       for k, v in n._bound_kwargs.items()}
             vals[id(n)] = n._remote_fn.remote(*args, **kwargs)
 
-    # Pass 2: persist results in topo order — every step completed
-    # before a failure is durably logged, so resume() skips it.
+    # Final pass: everything submitted completes and persists (topo
+    # order — every step completed before a failure is durably
+    # logged, so resume() skips it).
     for n in order:
-        if cancel.is_set():
-            raise _Canceled()
         if isinstance(n, MultiOutputNode):
-            vals[id(n)] = [
-                ray_tpu.get(vals[id(c)])
-                if isinstance(vals[id(c)], ObjectRef) else vals[id(c)]
-                for c in n._bound_args]
-        elif isinstance(vals.get(id(n)), ObjectRef):
-            value = ray_tpu.get(vals[id(n)])
-            store.save_step(keys[id(n)], value)
-            vals[id(n)] = value
+            continue
+        materialize(n)
     return vals[id(order[-1])]
+
+
+class _SubStore:
+    """Step-log namespace for a continuation's sub-DAG (keys prefixed
+    by the parent step key, same backing storage)."""
+
+    def __init__(self, store, prefix: str):
+        self._store = store
+        self._prefix = prefix
+
+    def _k(self, key: str) -> str:
+        return f"{self._prefix}__{key}"
+
+    def has_step(self, key: str) -> bool:
+        return self._store.has_step(self._k(key))
+
+    def save_step(self, key: str, value) -> None:
+        self._store.save_step(self._k(key), value)
+
+    def load_step(self, key: str):
+        return self._store.load_step(self._k(key))
 
 
 class _Canceled(Exception):
     pass
+
+
+def _cancel_inflight(vals: dict) -> None:
+    """Best-effort kill of still-running steps so a canceled workflow
+    does not leave workers pinned in event polls."""
+    import ray_tpu
+    from ray_tpu.core.object_ref import ObjectRef
+    for v in vals.values():
+        if isinstance(v, ObjectRef):
+            try:
+                ray_tpu.cancel(v, force=True)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+_RESULT_KEY = "__result__"  # step-blob slot for the final output
 
 
 def _run_thread(workflow_id: str, dag: DAGNode, input_val: Any) -> None:
@@ -147,6 +314,10 @@ def _run_thread(workflow_id: str, dag: DAGNode, input_val: Any) -> None:
         result = _execute(dag, store, input_val, cancel)
         with _lock:
             _results[workflow_id] = ("ok", result)
+        # The final result is durable too (its own blob — meta.json
+        # stays small): get_output()/get_output_async() work from ANY
+        # process after completion.
+        store.save_step(_RESULT_KEY, result)
         meta["status"] = WorkflowStatus.SUCCESSFUL
         meta["end_time"] = time.time()
         store.save_meta(meta)
@@ -168,12 +339,28 @@ def run_async(dag: DAGNode, *, workflow_id: str | None = None,
     """Start a workflow; returns its id immediately."""
     workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
     store = wf_storage.WorkflowStorage(workflow_id)
-    store.save_meta({
+    meta = {
         "workflow_id": workflow_id,
         "status": WorkflowStatus.RUNNING,
         "start_time": time.time(),
         "dag_blob": ser.dumps((dag, args)).hex(),
-    })
+    }
+    # step metadata from workflow.options(metadata=...) is part of the
+    # durable record (reference: workflow metadata storage)
+    step_md = {}
+    order = dag.topological_order()
+    keys = _step_keys(order)
+    for n in order:
+        if isinstance(n, FunctionNode):
+            m = (getattr(n._remote_fn, "_default_opts", {}) or {}
+                 ).get("_workflow_metadata")
+            if m:
+                step_md[keys[id(n)]] = m
+    if step_md:
+        meta["step_metadata"] = step_md
+    import os
+    meta["executor_pid"] = os.getpid()
+    store.save_meta(meta)
     with _lock:
         _cancel_flags[workflow_id] = threading.Event()
         t = threading.Thread(target=_run_thread,
@@ -194,8 +381,25 @@ def run(dag: DAGNode, *, workflow_id: str | None = None,
 def get_output(workflow_id: str, timeout: float | None = None) -> Any:
     t = _running.get(workflow_id)
     if t is None:
-        raise ValueError(f"workflow {workflow_id!r} is not running "
-                         f"in this process; use resume()")
+        # Not running here: a completed workflow's output is durable.
+        store = wf_storage.WorkflowStorage(workflow_id)
+        meta = store.load_meta()
+        if meta is None:
+            raise ValueError(f"no stored workflow {workflow_id!r}")
+        status = meta.get("status")
+        if status == WorkflowStatus.SUCCESSFUL \
+                and store.has_step(_RESULT_KEY):
+            return store.load_step(_RESULT_KEY)
+        if status == WorkflowStatus.CANCELED:
+            raise WorkflowCancellationError(
+                f"workflow {workflow_id} was canceled")
+        if status == WorkflowStatus.FAILED:
+            raise WorkflowExecutionError(
+                f"workflow {workflow_id} failed: "
+                f"{meta.get('error', '?')}")
+        raise ValueError(
+            f"workflow {workflow_id!r} is {status} and not running "
+            f"in this process; use resume()")
     t.join(timeout)
     if t.is_alive():
         raise TimeoutError(f"workflow {workflow_id} still running")
@@ -203,28 +407,134 @@ def get_output(workflow_id: str, timeout: float | None = None) -> Any:
     if kind == "ok":
         return payload
     if kind == "canceled":
-        raise RuntimeError(f"workflow {workflow_id} was canceled")
+        raise WorkflowCancellationError(
+            f"workflow {workflow_id} was canceled")
     raise payload
 
 
-def resume(workflow_id: str, timeout: float | None = None) -> Any:
-    """Re-run from durable state: completed steps load from storage,
-    the rest re-execute (reference: workflow.resume)."""
+def _await_workflow(root: str, workflow_id: str,
+                    poll_s: float = 0.2) -> Any:
+    """Worker-side wait: poll the durable meta until terminal (the
+    get_output_async / resume_async ObjectRef body)."""
+    from ray_tpu.workflow import storage as st
+    st.set_root(root)
+    from ray_tpu.workflow.common import (
+        WorkflowCancellationError as WCE,
+        WorkflowExecutionError as WEE,
+    )
+    while True:
+        store = st.WorkflowStorage(workflow_id)
+        meta = store.load_meta()
+        if meta is None:
+            raise ValueError(f"no stored workflow {workflow_id!r}")
+        status = meta.get("status")
+        if status == WorkflowStatus.SUCCESSFUL:
+            return store.load_step(_RESULT_KEY)
+        if status == WorkflowStatus.CANCELED:
+            raise WCE(f"workflow {workflow_id} was canceled")
+        if status == WorkflowStatus.FAILED:
+            raise WEE(f"workflow {workflow_id} failed: "
+                      f"{meta.get('error', '?')}")
+        time.sleep(poll_s)
+
+
+def get_output_async(workflow_id: str):
+    """The workflow's output as an ObjectRef (reference:
+    workflow.get_output_async): ray_tpu.get(ref) blocks until the
+    workflow finishes."""
+    import ray_tpu
+    rf = ray_tpu.remote(num_cpus=0)(_await_workflow)
+    return rf.remote(wf_storage.get_root(), workflow_id)
+
+
+def _start_resume(workflow_id: str) -> None:
+    """Shared resume launcher: load the durable DAG, mark RUNNING
+    (with this executor's pid), spawn the run thread."""
+    import os
     store = wf_storage.WorkflowStorage(workflow_id)
     meta = store.load_meta()
     if meta is None:
         raise ValueError(f"no stored workflow {workflow_id!r}")
     dag, args = ser.loads(bytes.fromhex(meta["dag_blob"]))
     meta["status"] = WorkflowStatus.RUNNING
+    meta["executor_pid"] = os.getpid()
     store.save_meta(meta)
     with _lock:
         _cancel_flags[workflow_id] = threading.Event()
         t = threading.Thread(target=_run_thread,
                              args=(workflow_id, dag, args),
-                             daemon=True)
+                             daemon=True,
+                             name=f"workflow_{workflow_id[:16]}")
         _running[workflow_id] = t
     t.start()
+
+
+def resume(workflow_id: str, timeout: float | None = None) -> Any:
+    """Re-run from durable state: completed steps load from storage,
+    the rest re-execute (reference: workflow.resume)."""
+    _start_resume(workflow_id)
     return get_output(workflow_id, timeout=timeout)
+
+
+def resume_async(workflow_id: str):
+    """Start resuming and return the output ObjectRef immediately
+    (reference: workflow.resume_async)."""
+    _start_resume(workflow_id)
+    return get_output_async(workflow_id)
+
+
+def _pid_alive(pid) -> bool:
+    import os
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except (OSError, TypeError, ValueError):
+        return False
+
+
+def resume_all() -> list:
+    """Resume every resumable workflow — FAILED, RESUMABLE, or
+    RUNNING whose recorded executor process is dead (a crash left it
+    behind). A RUNNING workflow whose executor pid is still alive on
+    this host is skipped — resuming it would start a second
+    concurrent execution. (Executors on OTHER hosts sharing a storage
+    root are indistinguishable from crashed ones — same caveat as the
+    reference's storage-level liveness.) Returns
+    [(workflow_id, output_ref)] (reference: workflow.resume_all)."""
+    out = []
+    for wid in wf_storage.list_workflows():
+        meta = wf_storage.WorkflowStorage(wid).load_meta()
+        if not meta or "dag_blob" not in meta:
+            continue
+        status = meta.get("status")
+        t = _running.get(wid)
+        live_here = t is not None and t.is_alive()
+        if live_here or status in (WorkflowStatus.SUCCESSFUL,
+                                   WorkflowStatus.CANCELED):
+            continue
+        if status == WorkflowStatus.RUNNING \
+                and _pid_alive(meta.get("executor_pid")):
+            continue
+        out.append((wid, resume_async(wid)))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    """Remove a workflow's durable state (reference: workflow.delete).
+    Refuses while it is executing in this process."""
+    import shutil
+    t = _running.get(workflow_id)
+    if t is not None and t.is_alive():
+        raise RuntimeError(
+            f"workflow {workflow_id} is running; cancel() it first")
+    store = wf_storage.WorkflowStorage(workflow_id)
+    if store.load_meta() is None:
+        raise ValueError(f"no stored workflow {workflow_id!r}")
+    shutil.rmtree(store.dir, ignore_errors=True)
+    with _lock:
+        _running.pop(workflow_id, None)
+        _results.pop(workflow_id, None)
+        _cancel_flags.pop(workflow_id, None)
 
 
 def get_status(workflow_id: str) -> str:

@@ -49,7 +49,12 @@ class WorkflowStorage:
     # -- metadata -------------------------------------------------------
 
     def save_meta(self, meta: dict) -> None:
-        tmp = self._meta_path + ".tmp"
+        # unique tmp per writer: cancel() (caller thread) and the run
+        # thread can save concurrently — a SHARED tmp name makes one
+        # writer's os.replace race the other's (caught by the cancel
+        # drive: FileNotFoundError on the second replace)
+        tmp = (f"{self._meta_path}.{os.getpid()}."
+               f"{threading.get_ident()}.tmp")
         with open(tmp, "w") as f:
             json.dump(meta, f)
         os.replace(tmp, self._meta_path)  # atomic
@@ -70,7 +75,8 @@ class WorkflowStorage:
         return os.path.exists(self._step_path(step_key))
 
     def save_step(self, step_key: str, value: Any) -> None:
-        tmp = self._step_path(step_key) + ".tmp"
+        tmp = (f"{self._step_path(step_key)}.{os.getpid()}."
+               f"{threading.get_ident()}.tmp")  # unique per writer
         with open(tmp, "wb") as f:
             f.write(ser.dumps(value))
         os.replace(tmp, self._step_path(step_key))

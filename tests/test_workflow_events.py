@@ -1,0 +1,246 @@
+"""Workflow events, continuations, async outputs (reference:
+python/ray/workflow — wait_for_event/event_listener.py, continuation
+dynamic workflows, resume_all/get_output_async/delete).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.workflow.common import WorkflowCancellationError
+
+
+@pytest.fixture(scope="module")
+def rt(tmp_path_factory):
+    ray_tpu.init(num_cpus=2)
+    workflow.init(str(tmp_path_factory.mktemp("wf_events")))
+    yield
+    ray_tpu.shutdown()
+
+
+class FileEvent(workflow.EventListener):
+    """Fires when a marker file exists (content is the payload)."""
+
+    def poll_for_event(self, path):
+        while not os.path.exists(path):
+            time.sleep(0.05)
+        with open(path) as f:
+            return f.read()
+
+
+class AsyncFileEvent(workflow.EventListener):
+    async def poll_for_event(self, path):
+        import asyncio
+        while not os.path.exists(path):
+            await asyncio.sleep(0.05)
+        with open(path) as f:
+            return f.read()
+
+
+@ray_tpu.remote
+def shout(x):
+    return str(x).upper()
+
+
+def test_wait_for_event(rt, tmp_path):
+    marker = str(tmp_path / "evt1")
+    ev = workflow.wait_for_event(FileEvent, marker)
+    wid = workflow.run_async(shout.bind(ev))
+    time.sleep(0.3)
+    assert workflow.get_status(wid) == "RUNNING"
+    with open(marker, "w") as f:
+        f.write("fired")
+    assert workflow.get_output(wid, timeout=60) == "FIRED"
+
+
+def test_wait_for_event_async_listener_checkpointed(rt, tmp_path):
+    marker = str(tmp_path / "evt2")
+    with open(marker, "w") as f:
+        f.write("async-ev")
+    ev = workflow.wait_for_event(AsyncFileEvent, marker)
+    wid = "wf_evt_ckpt"
+    assert workflow.run(shout.bind(ev), workflow_id=wid,
+                        timeout=60) == "ASYNC-EV"
+    # the event result is durable: resume does NOT re-poll (marker
+    # removed, yet resume succeeds from the checkpoint)
+    os.unlink(marker)
+    assert workflow.resume(wid, timeout=60) == "ASYNC-EV"
+
+
+def test_wait_for_event_validation(rt):
+    with pytest.raises(TypeError, match="EventListener"):
+        workflow.wait_for_event(object)
+
+
+def test_sleep_step(rt):
+    @ray_tpu.remote
+    def after(_):
+        return "woke"
+
+    t0 = time.monotonic()
+    assert workflow.run(after.bind(workflow.sleep(0.4)),
+                        timeout=60) == "woke"
+    assert time.monotonic() - t0 >= 0.4
+
+
+def test_continuation_dynamic_workflow(rt):
+    @ray_tpu.remote
+    def fib(n):
+        if n <= 1:
+            return n
+        return workflow.continuation(fib_sum.bind(n))
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def fib_sum(n):
+        return workflow.continuation(
+            add.bind(fib.bind(n - 1), fib.bind(n - 2)))
+
+    assert workflow.run(fib.bind(7), workflow_id="wf_fib",
+                        timeout=120) == 13
+    # completed continuations are durable: resume is a cache read
+    assert workflow.resume("wf_fib", timeout=60) == 13
+
+
+def test_continuation_type_error():
+    with pytest.raises(TypeError, match="bound DAG node"):
+        workflow.continuation(42)
+
+
+def test_get_output_async_and_durable_output(rt):
+    @ray_tpu.remote
+    def slowly(x):
+        time.sleep(0.3)
+        return x * 2
+
+    wid = workflow.run_async(slowly.bind(21))
+    ref = workflow.get_output_async(wid)
+    assert ray_tpu.get(ref, timeout=60) == 42
+    # durable output: readable without the executor thread
+    assert workflow.get_output(wid) == 42
+    from ray_tpu.workflow import api as wf_api
+    wf_api._running.pop(wid, None)  # simulate a fresh process
+    assert workflow.get_output(wid) == 42
+
+
+def test_resume_all(rt):
+    @ray_tpu.remote
+    def flaky(path):
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("x")
+            raise RuntimeError("first attempt fails")
+        return "recovered"
+
+    import tempfile
+    markers = [tempfile.mktemp() for _ in range(2)]
+    wids = []
+    for i, m in enumerate(markers):
+        wid = f"wf_resume_all_{i}"
+        with pytest.raises(ray_tpu.TaskError):
+            workflow.run(flaky.bind(m), workflow_id=wid, timeout=60)
+        wids.append(wid)
+    resumed = dict(workflow.resume_all())
+    for wid in wids:
+        assert ray_tpu.get(resumed[wid], timeout=60) == "recovered"
+    for m in markers:
+        os.unlink(m)
+
+
+def test_cancel_raises_cancellation_error(rt, tmp_path):
+    marker = str(tmp_path / "never")
+    ev = workflow.wait_for_event(FileEvent, marker)
+    wid = workflow.run_async(shout.bind(ev))
+    time.sleep(0.3)
+    workflow.cancel(wid)
+    with pytest.raises(WorkflowCancellationError):
+        workflow.get_output(wid, timeout=60)
+    assert workflow.get_status(wid) == "CANCELED"
+
+
+def test_delete(rt):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    wid = "wf_delete_me"
+    assert workflow.run(one.bind(), workflow_id=wid, timeout=60) == 1
+    workflow.delete(wid)
+    with pytest.raises(ValueError, match="no stored workflow"):
+        workflow.get_status(wid)
+    with pytest.raises(ValueError):
+        workflow.delete(wid)
+
+
+def test_named_step_checkpoint_survives_dag_refactor(rt, tmp_path):
+    """workflow.options(name=...) keys are position-independent: a
+    step inserted AHEAD must not orphan the named checkpoint."""
+    hits = str(tmp_path / "hits")
+
+    @ray_tpu.remote
+    def expensive():
+        with open(hits, "a") as f:
+            f.write("x")
+        return 10
+
+    @ray_tpu.remote
+    def plus(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def boom(_):
+        raise RuntimeError("v1 fails downstream")
+
+    named = expensive.options(**workflow.options(name="exp"))
+    wid = "wf_refactor"
+    with pytest.raises(ray_tpu.TaskError):
+        workflow.run(boom.bind(named.bind()), workflow_id=wid,
+                     timeout=60)
+    assert open(hits).read() == "x"
+    # "refactor": new DAG for the same workflow inserts a step ahead
+    # and replaces the failing tail; the named checkpoint must load.
+    from ray_tpu.workflow import api as wf_api
+    from ray_tpu.workflow import storage as wf_st
+    store = wf_st.WorkflowStorage(wid)
+    meta = store.load_meta()
+    from ray_tpu.core import serialization as ser2
+    new_dag = plus.bind(named.bind(), plus.bind(1, 2))
+    meta["dag_blob"] = ser2.dumps((new_dag, None)).hex()
+    store.save_meta(meta)
+    assert workflow.resume(wid, timeout=60) == 13
+    assert open(hits).read() == "x"  # NOT re-executed
+
+
+def test_failed_workflow_durable_error(rt, tmp_path):
+    @ray_tpu.remote
+    def die():
+        raise RuntimeError("permanent")
+
+    wid = "wf_dead"
+    with pytest.raises(ray_tpu.TaskError):
+        workflow.run(die.bind(), workflow_id=wid, timeout=60)
+    from ray_tpu.workflow import api as wf_api
+    wf_api._running.pop(wid, None)  # simulate another process
+    with pytest.raises(workflow.WorkflowExecutionError, match="failed"):
+        workflow.get_output(wid)
+
+
+def test_step_options_name_and_metadata(rt):
+    @ray_tpu.remote
+    def val():
+        return 5
+
+    node = val.options(**workflow.options(
+        name="stable_step", metadata={"owner": "team-x"})).bind()
+    wid = "wf_opts"
+    assert workflow.run(node, workflow_id=wid, timeout=60) == 5
+    md = workflow.get_metadata(wid)
+    # explicitly-named steps get position-independent keys
+    assert md["step_metadata"] == {
+        "named_stable_step": {"owner": "team-x"}}
