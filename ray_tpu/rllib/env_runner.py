@@ -64,8 +64,16 @@ class EnvRunner:
         self.module_to_env = ConnectorPipelineV2(module_to_env or [])
 
         if isinstance(env_maker_or_name, str):
-            import gymnasium
-            self.env = gymnasium.make(env_maker_or_name)
+            # tune.register_env names first (cluster KV — names
+            # registered on the driver resolve inside runner actors),
+            # then gymnasium ids (reference: tune/registry.py).
+            from ray_tpu.tune.registry import get_registered_env
+            maker = get_registered_env(env_maker_or_name)
+            if maker is not None:
+                self.env = maker()
+            else:
+                import gymnasium
+                self.env = gymnasium.make(env_maker_or_name)
         else:
             self.env = env_maker_or_name()
         self.rng = np.random.default_rng(seed)
@@ -236,6 +244,11 @@ class EnvRunnerGroup:
         self._policy = policy
         self._e2m = env_to_module
         self._m2e = module_to_env
+        if isinstance(env_maker_or_name, str):
+            # pre-init tune.register_env registrations reach the KV
+            # before the runner actors (in worker processes) resolve
+            from ray_tpu.tune.registry import flush_pending
+            flush_pending()
         self.runners = [
             EnvRunner.remote(env_maker_or_name, policy_config,
                              seed + i, policy,

@@ -49,3 +49,6 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = field(
         default_factory=CheckpointConfig)
     verbose: bool = False
+    # tune.Callback instances (reference: RunConfig.callbacks) —
+    # invoked by the Tuner controller on trial lifecycle events.
+    callbacks: list = field(default_factory=list)

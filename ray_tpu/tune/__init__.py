@@ -28,6 +28,13 @@ from ray_tpu.tune.search import (  # noqa: E402
 from ray_tpu.tune.tune import (
     Tuner, TuneConfig, Trial, ResultGrid, TrialResult,
 )
+from ray_tpu.tune.classic import (  # noqa: E402
+    Callback, CLIReporter, Experiment, ExperimentAnalysis,
+    PlacementGroupFactory, ProgressReporter, ResumeConfig,
+    Trainable, TuneError, create_scheduler, create_searcher,
+    run_experiments,
+)
+from ray_tpu.tune.registry import register_env  # noqa: E402
 
 __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
@@ -41,4 +48,8 @@ __all__ = [
     "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining", "PB2",
     "Tuner", "TuneConfig", "Trial", "ResultGrid", "TrialResult",
+    "Trainable", "Callback", "ProgressReporter", "CLIReporter",
+    "ExperimentAnalysis", "Experiment", "run_experiments",
+    "create_searcher", "create_scheduler", "PlacementGroupFactory",
+    "TuneError", "ResumeConfig", "register_env",
 ]

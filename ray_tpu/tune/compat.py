@@ -63,9 +63,13 @@ def with_parameters(trainable: Callable, **large_objects):
     return wrapped
 
 
-def with_resources(trainable: Callable, resources: dict):
-    """Attach a per-trial resource request (consumed by the
-    controller when it creates trial actors)."""
+def with_resources(trainable: Callable, resources):
+    """Attach a per-trial resource request — a plain dict or a
+    tune.PlacementGroupFactory (consumed by the controller when it
+    creates trial actors)."""
+    from ray_tpu.tune.classic import PlacementGroupFactory
+    if isinstance(resources, PlacementGroupFactory):
+        resources = resources.required_resources
     fn = get_trainable(trainable)
 
     def wrapped(config):
@@ -128,6 +132,9 @@ def run(trainable, *, config: dict | None = None,
         mode: str | None = None, scheduler=None, search_alg=None,
         stop=None, storage_path: str | None = None,
         name: str | None = None, max_concurrent_trials: int = 0,
+        callbacks: list | None = None,
+        progress_reporter=None,
+        resources_per_trial=None,
         **ignored: Any):
     """Classic entry point: builds a Tuner and fits it. Unknown
     keyword arguments are rejected loudly rather than silently
@@ -139,20 +146,37 @@ def run(trainable, *, config: dict | None = None,
             f"use the Tuner API for anything beyond the classic "
             f"surface")
     from ray_tpu.train import RunConfig
+    from ray_tpu.tune.classic import Trainable
     from ray_tpu.tune.tune import TuneConfig, Tuner
 
-    fn = get_trainable(trainable)
+    if isinstance(trainable, type) and issubclass(trainable,
+                                                  Trainable):
+        from ray_tpu.tune.classic import _class_trainable_fn
+        fn = _class_trainable_fn(trainable)
+    else:
+        fn = get_trainable(trainable)
+    if resources_per_trial is not None:
+        fn = with_resources(fn, resources_per_trial)
+    cbs = list(callbacks or [])
+    if progress_reporter is not None:
+        cbs.append(progress_reporter)   # reporters ARE callbacks here
+    tc = TuneConfig(
+        num_samples=num_samples, metric=metric,
+        mode=mode or "min",
+        scheduler=scheduler, search_alg=search_alg,
+        max_concurrent_trials=max_concurrent_trials,
+        stop=stop)
+    rc_kwargs = {}
+    if storage_path:
+        rc_kwargs["storage_path"] = storage_path
+    if name:
+        rc_kwargs["name"] = name
+    if cbs:
+        rc_kwargs["callbacks"] = cbs
     tuner = Tuner(
         fn,
         param_space=config or {},
-        tune_config=TuneConfig(
-            num_samples=num_samples, metric=metric,
-            mode=mode or "min",
-            scheduler=scheduler, search_alg=search_alg,
-            max_concurrent_trials=max_concurrent_trials,
-            stop=stop),
-        run_config=RunConfig(storage_path=storage_path or "",
-                             name=name) if storage_path or name
-        else None,
+        tune_config=tc,
+        run_config=RunConfig(**rc_kwargs) if rc_kwargs else None,
     )
     return tuner.fit()

@@ -132,11 +132,16 @@ class Tuner:
 
     @classmethod
     def restore(cls, exp_dir: str, trainable: Callable | Any,
-                *, tune_config: TuneConfig | None = None) -> "Tuner":
+                *, tune_config: TuneConfig | None = None,
+                resume_config=None) -> "Tuner":
         """Resume an interrupted experiment from its journaled state:
         completed trials keep their results; pending/running/errored
         trials are re-run (from their latest checkpoint when the
-        trainable consumes ``restored_checkpoint_dir``)."""
+        trainable consumes ``restored_checkpoint_dir``).
+        ``resume_config`` (tune.ResumeConfig) refines errored-trial
+        handling: resume_errored=False keeps them as terminal ERROR
+        results; restart_errored=True re-runs them from scratch
+        (checkpoint dropped) instead of from their last checkpoint."""
         from ray_tpu.util.storage import is_uri, storage_for_uri
         orig_exp_dir = exp_dir
         if is_uri(exp_dir):
@@ -170,9 +175,19 @@ class Tuner:
                       history=row["history"],
                       checkpoint_dir=ckpt,
                       error=row["error"])
-            if t.state != "COMPLETED":
+            was_error = t.state == "ERROR"
+            resume_errored = (resume_config is None
+                              or getattr(resume_config,
+                                         "resume_errored", True))
+            restart_errored = getattr(resume_config,
+                                      "restart_errored", False)
+            if t.state != "COMPLETED" and (
+                    not was_error or resume_errored
+                    or restart_errored):
                 t.state = "PENDING"
-                t.restore_from = t.checkpoint_dir
+                t.restore_from = (None if (was_error
+                                           and restart_errored)
+                                  else t.checkpoint_dir)
                 t.metrics, t.history, t.error = {}, [], None
             trials.append(t)
         run_config = RunConfig(
@@ -217,6 +232,7 @@ class Tuner:
 
         trials: list[Trial] = []
         pending: list[Trial] = []
+        self._trials = trials
         if self._restore_trials is not None:
             trials = self._restore_trials
             pending = [t for t in trials if t.state == "PENDING"]
@@ -274,6 +290,7 @@ class Tuner:
                 self._save_state(exp_dir, trials)
 
         self._save_state(exp_dir, trials)
+        self._cb("on_experiment_end", None)
         results = [TrialResult(
             trial_id=t.trial_id, config=t.config, metrics=t.metrics,
             metrics_history=t.history, checkpoint_dir=t.checkpoint_dir,
@@ -287,6 +304,30 @@ class Tuner:
         return ResultGrid(results)
 
     # -- internals --
+
+    def _cb(self, hook: str, trial, result: dict | None = None) -> None:
+        """Invoke tune.Callback hooks (reference: tune/callback.py);
+        a raising callback must not take the controller down."""
+        cbs = getattr(self.run_config, "callbacks", None) or []
+        if not cbs:
+            return
+        it = getattr(self, "_cb_iteration", 0) + 1
+        self._cb_iteration = it
+        trials = getattr(self, "_trials", [])
+        for cb in cbs:
+            fn = getattr(cb, hook, None)
+            if fn is None:
+                continue
+            try:
+                if hook == "on_trial_result":
+                    fn(it, trials, trial, result)
+                elif hook == "on_experiment_end":
+                    fn(trials)
+                else:
+                    fn(it, trials, trial)
+            except Exception as e:  # noqa: BLE001
+                import warnings
+                warnings.warn(f"tune callback {hook} raised: {e!r}")
 
     def _resource_bound(self, tc: TuneConfig) -> int:
         total = ray_tpu.cluster_resources()
@@ -354,17 +395,30 @@ class Tuner:
         }
         t.state = "RUNNING"
         t.actor.start_loop.remote((fn, t.config), ctx_kwargs)
+        self._cb("on_trial_start", t)
 
     def _poll_trial(self, t: Trial, fn, exp_dir: str, tc: TuneConfig,
                     scheduler, searcher) -> tuple[bool, bool]:
         """Poll one trial; returns (still_running, state_changed)."""
         try:
             p = ray_tpu.get(t.actor.poll.remote(), timeout=60)
+            if p["done"]:
+                # poll() caps each drain (16): a finished trial may
+                # still have queued results — the final metrics must
+                # be the LAST report, not the 16th (caught by the
+                # 20-iteration class-trainable test).
+                while p["results"]:
+                    extra = ray_tpu.get(t.actor.poll.remote(),
+                                        timeout=60)
+                    if not extra["results"]:
+                        break
+                    p["results"].extend(extra["results"])
         except Exception as e:  # noqa: BLE001 — actor died
             t.state = "ERROR"
             t.error = str(e)
             if searcher:
                 searcher.on_trial_complete(t.trial_id, None, error=True)
+            self._cb("on_trial_error", t)
             return False, True
         decision = CONTINUE
         for r in p["results"]:
@@ -373,6 +427,7 @@ class Tuner:
             m.setdefault("training_iteration", t.iteration)
             t.metrics = m
             t.history.append(m)
+            self._cb("on_trial_result", t, result=m)
             if r["checkpoint_dir"]:
                 t.checkpoint_dir = r["checkpoint_dir"]
                 if hasattr(scheduler, "on_checkpoint"):
@@ -405,6 +460,7 @@ class Tuner:
             scheduler.on_trial_complete(t.trial_id)
             if searcher:
                 searcher.on_trial_complete(t.trial_id, t.metrics)
+            self._cb("on_trial_complete", t)
             return False, True
         if p["done"]:
             t.state = "ERROR" if p["error"] else "COMPLETED"
@@ -414,6 +470,8 @@ class Tuner:
                 searcher.on_trial_complete(t.trial_id, t.metrics,
                                            error=bool(p["error"]))
             ray_tpu.kill(t.actor)
+            self._cb("on_trial_error" if p["error"]
+                     else "on_trial_complete", t)
             return False, True
         return True, changed
 
@@ -421,6 +479,10 @@ class Tuner:
 def _as_function_trainable(trainable) -> Callable:
     from ray_tpu.train.trainer import JaxTrainer
 
+    from ray_tpu.tune.classic import Trainable, _class_trainable_fn
+    if isinstance(trainable, type) and issubclass(trainable,
+                                                  Trainable):
+        return _class_trainable_fn(trainable)
     if isinstance(trainable, JaxTrainer):
         def run_trainer(config):
             from ray_tpu.train import report

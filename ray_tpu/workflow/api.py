@@ -176,92 +176,113 @@ def _execute(dag: DAGNode, store: wf_storage.WorkflowStorage,
     order = dag.topological_order()
     _validate(order)
     keys = _step_keys(order)
-    # node id -> concrete value OR pending ObjectRef. Sibling branches
-    # run in parallel (submitted before either is consumed); a
-    # dependency is MATERIALIZED (awaited + continuation-expanded +
-    # persisted) the first time a consumer needs it — dynamic
-    # workflows mean an upstream ref may hold a Continuation, which
-    # must expand through the durable executor before dependents see
-    # its value.
-    vals: dict[int, Any] = {}
-    is_step: set[int] = set()          # ids whose results persist
+    # Dataflow-frontier execution: a step is SUBMITTED the moment all
+    # its dependencies hold concrete values, and results are harvested
+    # as they complete — independent branches run in parallel at every
+    # depth. Values never flow as raw ObjectRef args: an upstream step
+    # may return a Continuation (dynamic workflows), which must expand
+    # through the durable executor BEFORE dependents consume it — the
+    # executor, not a worker task, owns that expansion (matching the
+    # reference's executor-resolves-step-outputs model).
+    vals: dict[int, Any] = {}          # node id -> concrete value
+    inflight: dict[int, Any] = {}      # node id -> pending ObjectRef
+    node_by_id = {id(n): n for n in order}
 
-    def await_ref(ref):
-        """Poll, don't block: cancel() must interrupt a workflow stuck
-        on a long step (e.g. an event poll)."""
-        while True:
-            done, _ = ray_tpu.wait([ref], timeout=0.2)
-            if done:
-                return ray_tpu.get(ref)
-            if cancel.is_set():
-                _cancel_inflight(vals)
-                raise _Canceled()
-
-    def materialize(node) -> Any:
-        value = vals[id(node)]
-        changed = False
-        if isinstance(value, ObjectRef):
-            value = await_ref(value)
-            changed = True
-        # A step (fresh, or cache-loaded after a crash mid-
-        # continuation) returning a Continuation extends the
-        # workflow; sub-steps get their own durable log namespaced
-        # under this step, then the final value overwrites the step
-        # entry so a completed continuation resumes as a cached value.
+    def expand(node, value, fresh: bool):
+        """Continuation expansion + persistence for one step value.
+        A step (fresh, or cache-loaded after a crash mid-
+        continuation) returning a Continuation extends the workflow;
+        sub-steps get their own durable log namespaced under this
+        step, then the final value overwrites the step entry so a
+        completed continuation resumes as a plain cached value."""
+        changed = fresh
         while isinstance(value, Continuation):
             if changed:  # checkpoint the outer step first
                 store.save_step(keys[id(node)], value)
             sub = _SubStore(store, keys[id(node)])
             value = _execute(value.dag, sub, None, cancel)
             changed = True
-        if changed and id(node) in is_step:
+        if changed:
             store.save_step(keys[id(node)], value)
         vals[id(node)] = value
-        return value
+
+    def deps_of(n) -> list:
+        out = []
+
+        def walk(obj):
+            if isinstance(obj, DAGNode):
+                out.append(obj)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    walk(v)
+            elif isinstance(obj, dict):
+                for v in obj.values():
+                    walk(v)
+
+        for a in n._bound_args:
+            walk(a)
+        for v in getattr(n, "_bound_kwargs", {}).values():
+            walk(v)
+        return out
 
     def resolve_nested(obj):
         if isinstance(obj, DAGNode):
-            return materialize(obj)
+            return vals[id(obj)]
         if isinstance(obj, (list, tuple)):
             return type(obj)(resolve_nested(v) for v in obj)
         if isinstance(obj, dict):
             return {k: resolve_nested(v) for k, v in obj.items()}
         return obj
 
-    for n in order:
+    waiting = list(order)
+    while waiting or inflight:
         if cancel.is_set():
-            _cancel_inflight(vals)
+            _cancel_inflight(inflight)
             raise _Canceled()
-        if isinstance(n, InputNode):
-            vals[id(n)] = input_val
-        elif isinstance(n, InputAttributeNode):
-            base = materialize(n._bound_args[0])
-            if isinstance(base, _DAGInputData):
-                vals[id(n)] = base.pick(n._key)
-            elif isinstance(n._key, int):
-                vals[id(n)] = base[n._key]
+        # Submit/compute every node whose deps are all concrete.
+        progressed = False
+        still_waiting = []
+        for n in waiting:
+            if any(id(d) not in vals for d in deps_of(n)):
+                still_waiting.append(n)
+                continue
+            progressed = True
+            if isinstance(n, InputNode):
+                vals[id(n)] = input_val
+            elif isinstance(n, InputAttributeNode):
+                base = vals[id(n._bound_args[0])]
+                if isinstance(base, _DAGInputData):
+                    vals[id(n)] = base.pick(n._key)
+                elif isinstance(n._key, int):
+                    vals[id(n)] = base[n._key]
+                else:
+                    vals[id(n)] = (base[n._key]
+                                   if isinstance(base, dict)
+                                   else getattr(base, n._key))
+            elif isinstance(n, MultiOutputNode):
+                vals[id(n)] = [vals[id(c)] for c in n._bound_args]
+            elif store.has_step(keys[id(n)]):
+                expand(n, store.load_step(keys[id(n)]), fresh=False)
             else:
-                vals[id(n)] = (base[n._key] if isinstance(base, dict)
-                               else getattr(base, n._key))
-        elif isinstance(n, MultiOutputNode):
-            vals[id(n)] = [materialize(c) for c in n._bound_args]
-        elif store.has_step(keys[id(n)]):
-            is_step.add(id(n))
-            vals[id(n)] = store.load_step(keys[id(n)])
-        else:
-            is_step.add(id(n))
-            args = tuple(resolve_nested(a) for a in n._bound_args)
-            kwargs = {k: resolve_nested(v)
-                      for k, v in n._bound_kwargs.items()}
-            vals[id(n)] = n._remote_fn.remote(*args, **kwargs)
-
-    # Final pass: everything submitted completes and persists (topo
-    # order — every step completed before a failure is durably
-    # logged, so resume() skips it).
-    for n in order:
-        if isinstance(n, MultiOutputNode):
+                args = tuple(resolve_nested(a) for a in n._bound_args)
+                kwargs = {k: resolve_nested(v)
+                          for k, v in n._bound_kwargs.items()}
+                inflight[id(n)] = n._remote_fn.remote(*args, **kwargs)
+        waiting = still_waiting
+        if not inflight:
+            if not progressed and waiting:
+                raise RuntimeError(
+                    "workflow DAG made no progress (cycle?)")
             continue
-        materialize(n)
+        # Harvest whatever finished (poll, don't block: cancel() must
+        # interrupt a workflow stuck on a long step — an event poll).
+        ref_to_nid = {ref: nid for nid, ref in inflight.items()}
+        done, _ = ray_tpu.wait(list(ref_to_nid), num_returns=1,
+                               timeout=0.2)
+        for ref in done:
+            nid = ref_to_nid[ref]
+            del inflight[nid]
+            expand(node_by_id[nid], ray_tpu.get(ref), fresh=True)
     return vals[id(order[-1])]
 
 
@@ -449,12 +470,27 @@ def get_output_async(workflow_id: str):
 
 def _start_resume(workflow_id: str) -> None:
     """Shared resume launcher: load the durable DAG, mark RUNNING
-    (with this executor's pid), spawn the run thread."""
+    (with this executor's pid), spawn the run thread. Refuses while a
+    live executor (this process OR a live recorded pid) owns the
+    workflow — a second concurrent execution would double-run steps
+    and race the durable log."""
     import os
+    from ray_tpu.workflow.common import WorkflowError
+    t = _running.get(workflow_id)
+    if t is not None and t.is_alive():
+        raise WorkflowError(
+            f"workflow {workflow_id} is already running in this "
+            f"process; cancel() it first")
     store = wf_storage.WorkflowStorage(workflow_id)
     meta = store.load_meta()
     if meta is None:
         raise ValueError(f"no stored workflow {workflow_id!r}")
+    if meta.get("status") == WorkflowStatus.RUNNING \
+            and meta.get("executor_pid") != os.getpid() \
+            and _pid_alive(meta.get("executor_pid")):
+        raise WorkflowError(
+            f"workflow {workflow_id} is RUNNING under live pid "
+            f"{meta.get('executor_pid')}; refusing a second executor")
     dag, args = ser.loads(bytes.fromhex(meta["dag_blob"]))
     meta["status"] = WorkflowStatus.RUNNING
     meta["executor_pid"] = os.getpid()
@@ -528,8 +564,15 @@ def delete(workflow_id: str) -> None:
         raise RuntimeError(
             f"workflow {workflow_id} is running; cancel() it first")
     store = wf_storage.WorkflowStorage(workflow_id)
-    if store.load_meta() is None:
+    meta = store.load_meta()
+    if meta is None:
         raise ValueError(f"no stored workflow {workflow_id!r}")
+    if meta.get("status") == WorkflowStatus.RUNNING \
+            and _pid_alive(meta.get("executor_pid")):
+        raise RuntimeError(
+            f"workflow {workflow_id} is RUNNING under live pid "
+            f"{meta.get('executor_pid')}; refusing to delete its "
+            f"storage out from under the executor")
     shutil.rmtree(store.dir, ignore_errors=True)
     with _lock:
         _running.pop(workflow_id, None)

@@ -1,0 +1,225 @@
+"""Classic Tune surface (reference: python/ray/tune/__init__.py):
+Trainable class API, Callbacks/CLIReporter, ExperimentAnalysis,
+factories, PlacementGroupFactory, Experiment/run_experiments,
+register_env, ResumeConfig.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class Quad(tune.Trainable):
+    """Minimizes (x - 3)^2 by bisection-ish steps; checkpoints its
+    current position."""
+
+    def setup(self, config):
+        self.x = config.get("x0", 0.0)
+        self.lr = config["lr"]
+
+    def step(self):
+        grad = 2 * (self.x - 3.0)
+        self.x -= self.lr * grad
+        loss = (self.x - 3.0) ** 2
+        return {"loss": loss, "done": self.iteration >= 19}
+
+    def save_checkpoint(self, checkpoint_dir):
+        with open(os.path.join(checkpoint_dir, "x.txt"), "w") as f:
+            f.write(str(self.x))
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_dir):
+        with open(os.path.join(checkpoint_dir, "x.txt")) as f:
+            self.x = float(f.read())
+
+
+def test_class_trainable(rt, tmp_path):
+    grid = tune.run(Quad, config={"lr": tune.grid_search([0.1, 0.4])},
+                    storage_path=str(tmp_path), name="quad")
+    assert len(grid) == 2
+    best = grid.get_best_result("loss", "min")
+    assert best.metrics["loss"] < 0.1
+    assert best.metrics["training_iteration"] == 20
+    assert best.checkpoint_dir  # save_checkpoint wired through
+
+
+def test_class_trainable_resume_from_checkpoint(rt, tmp_path):
+    class FailOnce(Quad):
+        def step(self):
+            marker = self.config["marker"]
+            if self.iteration == 5 and not os.path.exists(marker):
+                with open(marker, "w") as f:
+                    f.write("x")
+                raise RuntimeError("mid-flight crash")
+            return super().step()
+
+    marker = str(tmp_path / "crashed")
+    exp_dir = None
+    grid = tune.run(FailOnce,
+                    config={"lr": 0.4, "marker": marker},
+                    storage_path=str(tmp_path), name="resume_me")
+    assert grid[0].state == "ERROR"
+    exp_dir = str(tmp_path / "resume_me")
+    tuner = tune.Tuner.restore(exp_dir, FailOnce)
+    grid2 = tuner.fit()
+    r = grid2[0]
+    assert r.state == "COMPLETED"
+    # resumed from the iteration-5 checkpoint, not from zero: total
+    # training_iteration still reaches 20
+    assert r.metrics["training_iteration"] == 20
+
+
+def test_callbacks_and_cli_reporter(rt, tmp_path, capsys):
+    events = []
+
+    class Rec(tune.Callback):
+        def on_trial_start(self, it, trials, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, it, trials, trial, result):
+            events.append(("result", result["training_iteration"]))
+
+        def on_trial_complete(self, it, trials, trial):
+            events.append(("complete", trial.trial_id))
+
+        def on_experiment_end(self, trials, **info):
+            events.append(("end", len(trials)))
+
+    reporter = tune.CLIReporter(metric_columns=["loss"],
+                                max_report_frequency=0.0)
+    grid = tune.run(Quad, config={"lr": 0.4},
+                    callbacks=[Rec()], progress_reporter=reporter,
+                    storage_path=str(tmp_path), name="cbs")
+    assert len(grid) == 1
+    kinds = [e[0] for e in events]
+    assert kinds[0] == "start"
+    assert "result" in kinds and "complete" in kinds
+    assert events[-1] == ("end", 1)
+    out = capsys.readouterr().out
+    assert "== Status ==" in out and "loss" in out
+
+
+def test_experiment_analysis(rt, tmp_path):
+    tune.run(Quad, config={"lr": tune.grid_search([0.05, 0.4])},
+             storage_path=str(tmp_path), name="ana")
+    ana = tune.ExperimentAnalysis(str(tmp_path / "ana"))
+    assert len(ana.trials) == 2
+    best = ana.get_best_trial("loss", "min")
+    assert best["config"]["lr"] == 0.4, ana.trials
+    assert ana.get_best_config("loss", "min")["lr"] == 0.4
+    ckpt = ana.get_best_checkpoint("loss", "min")
+    assert ckpt and os.path.isdir(ckpt)
+    df = ana.dataframe()
+    assert len(df) == 2 and "config/lr" in df.columns
+    with pytest.raises(ValueError, match="metric"):
+        ana.get_best_trial()
+
+
+def test_factories():
+    from ray_tpu.tune.schedulers import ASHAScheduler
+    from ray_tpu.tune.search import TPESearcher
+    s = tune.create_searcher(
+        "tpe", param_space={"x": tune.uniform(0, 1)}, metric="loss",
+        mode="min", num_samples=4)
+    assert isinstance(s, TPESearcher)
+    sch = tune.create_scheduler("asha", metric="loss", mode="min")
+    assert isinstance(sch, ASHAScheduler)
+    with pytest.raises(ValueError, match="unknown searcher"):
+        tune.create_searcher("nope")
+    with pytest.raises(ValueError, match="unknown scheduler"):
+        tune.create_scheduler("nope")
+
+
+def test_placement_group_factory(rt, tmp_path):
+    pgf = tune.PlacementGroupFactory(
+        [{"CPU": 1}, {"CPU": 1, "TPU": 0}])
+    assert pgf.required_resources == {"CPU": 2, "TPU": 0}
+    with pytest.raises(ValueError):
+        tune.PlacementGroupFactory([])
+
+    def trainable(config):
+        from ray_tpu.train import report
+        report({"loss": 0.0})
+
+    wrapped = tune.with_resources(trainable, pgf)
+    assert wrapped._tune_resources == {"CPU": 2, "TPU": 0}
+    grid = tune.run(wrapped, storage_path=str(tmp_path), name="pgf")
+    assert grid[0].state == "COMPLETED"
+
+
+def test_experiment_and_run_experiments(rt, tmp_path):
+    def t1(config):
+        from ray_tpu.train import report
+        report({"score": config["a"]})
+
+    out = tune.run_experiments({
+        "exp_a": {"run": t1, "config": {"a": 1},
+                  "storage_path": str(tmp_path)},
+        "exp_b": {"run": t1, "config": {"a": 2},
+                  "storage_path": str(tmp_path)},
+    })
+    assert set(out) == {"exp_a", "exp_b"}
+    assert out["exp_b"][0].metrics["score"] == 2
+    with pytest.raises(tune.TuneError, match="unsupported spec"):
+        tune.run_experiments({"x": {"run": t1, "bogus": 1}})
+
+
+def test_register_env_resolves_in_runner_actors(rt):
+    import numpy as np
+
+    class TinyEnv:
+        """2-state toy env (gymnasium-free)."""
+
+        def __init__(self):
+            class Space:
+                def __init__(self, n):
+                    self.n = n
+                    self.shape = (2,)
+            self.observation_space = Space(2)
+            self.action_space = Space(2)
+            self._t = 0
+
+        def reset(self, *, seed=None, options=None):
+            self._t = 0
+            return np.zeros(2, dtype=np.float32), {}
+
+        def step(self, action):
+            self._t += 1
+            done = self._t >= 8
+            return (np.zeros(2, dtype=np.float32),
+                    float(action == 1), done, False, {})
+
+    tune.register_env("tiny-reg-env", TinyEnv)
+    from ray_tpu.rllib import PPOConfig
+    algo = (PPOConfig()
+            .environment("tiny-reg-env", obs_dim=2, num_actions=2)
+            .env_runners(1)
+            .build())
+    result = algo.train()
+    assert result["episodes_this_iter"] > 0
+    algo.stop()
+
+
+def test_resume_config(rt, tmp_path):
+    def die(config):
+        raise RuntimeError("always fails")
+
+    tune.run(die, storage_path=str(tmp_path), name="dead")
+    exp_dir = str(tmp_path / "dead")
+    # resume_errored=False: errored trial stays a terminal result
+    t = tune.Tuner.restore(
+        exp_dir, die,
+        resume_config=tune.ResumeConfig(resume_errored=False))
+    grid = t.fit()
+    assert grid[0].state == "ERROR"

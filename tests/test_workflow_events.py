@@ -153,6 +153,28 @@ def test_resume_all(rt):
         os.unlink(m)
 
 
+def test_deep_branches_run_in_parallel(rt):
+    """Regression: the frontier executor must keep independent
+    multi-step chains concurrent — a materialize-on-consume DFS
+    serialized them (review repro: 4.4s for what should be ~2s)."""
+    import time as _t
+
+    @ray_tpu.remote(num_cpus=1)
+    def slow(x):
+        _t.sleep(0.6)
+        return x
+
+    @ray_tpu.remote(num_cpus=1)
+    def add3(a, b, c):
+        return a + b + c
+
+    chains = [slow.bind(slow.bind(i)) for i in range(3)]
+    t0 = _t.monotonic()
+    assert workflow.run(add3.bind(*chains), timeout=120) == 3
+    wall = _t.monotonic() - t0
+    assert wall < 2.8, f"branches serialized: {wall:.1f}s"  # serial ~3.6
+
+
 def test_cancel_raises_cancellation_error(rt, tmp_path):
     marker = str(tmp_path / "never")
     ev = workflow.wait_for_event(FileEvent, marker)
