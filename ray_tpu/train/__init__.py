@@ -16,10 +16,14 @@ from ray_tpu.train.step import (
     shard_batch,
 )
 from ray_tpu.train.config import (
-    ScalingConfig,
-    RunConfig,
-    FailureConfig,
+    TRAIN_DATASET_KEY,
+    BackendConfig,
     CheckpointConfig,
+    DataConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+    SyncConfig,
 )
 from ray_tpu.train.session import (
     Checkpoint,
@@ -32,6 +36,7 @@ __all__ = [
     "TrainState", "init_train_state", "make_train_step",
     "make_multi_train_step", "shard_batch",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "BackendConfig", "DataConfig", "SyncConfig", "TRAIN_DATASET_KEY",
     "Checkpoint", "get_checkpoint", "get_context", "get_dataset_shard", "report",
     "JaxTrainer", "Result",
 ]

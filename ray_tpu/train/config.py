@@ -42,6 +42,44 @@ class CheckpointConfig:
 
 
 @dataclass
+class BackendConfig:
+    """Parent class for training-backend configurations (reference:
+    ray.train.BackendConfig — JaxTrainer's jax.distributed backend and
+    TorchTrainer's gloo backend are the in-tree instances)."""
+
+
+@dataclass
+class DataConfig:
+    """Which ``datasets=`` entries split across workers vs replicate
+    (reference: ray.train.DataConfig). ``datasets_to_split`` is "all"
+    or a list of dataset names; unsplit datasets are iterated in full
+    by every worker."""
+
+    datasets_to_split: Any = "all"
+
+    def __post_init__(self):
+        if self.datasets_to_split != "all" and not isinstance(
+                self.datasets_to_split, (list, tuple, set)):
+            raise ValueError(
+                "datasets_to_split must be 'all' or a list of names")
+
+
+@dataclass
+class SyncConfig:
+    """Experiment-dir syncing knobs (reference: ray.train.SyncConfig).
+    This runtime mirrors experiment trees through the storage seam on
+    journal writes and at fit() exit; ``sync_period`` and
+    ``sync_artifacts`` are accepted for signature compatibility and
+    recorded on the RunConfig."""
+
+    sync_period: float = 300.0
+    sync_artifacts: bool = False
+
+
+TRAIN_DATASET_KEY = "train"  # (reference: ray.train.constants)
+
+
+@dataclass
 class RunConfig:
     name: str = ""
     storage_path: str = "/tmp/ray_tpu_sessions/experiments"

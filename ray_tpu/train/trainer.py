@@ -64,7 +64,8 @@ class JaxTrainer:
                  train_loop_config: dict | None = None,
                  scaling_config: ScalingConfig | None = None,
                  run_config: RunConfig | None = None,
-                 datasets: dict | None = None):
+                 datasets: dict | None = None,
+                 dataset_config=None):
         self.train_loop = train_loop_per_worker
         self.loop_config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
@@ -73,6 +74,8 @@ class JaxTrainer:
         # workers read via train.get_dataset_shard(name)
         # (reference: DataParallelTrainer datasets= + DataConfig).
         self.datasets = datasets or {}
+        from ray_tpu.train.config import DataConfig
+        self.dataset_config = dataset_config or DataConfig()
 
     # -- public API --
 
@@ -173,8 +176,14 @@ class JaxTrainer:
                 "restored_checkpoint_dir": restored,
             }
             if self.datasets:
+                # DataConfig.datasets_to_split: "all" or a list of
+                # names; unsplit datasets replicate — every worker
+                # iterates the full stream (reference: DataConfig).
+                to_split = self.dataset_config.datasets_to_split
                 ctx_kwargs["dataset_shards_all"] = {
-                    name: ds.streaming_split(group.num_workers)
+                    name: (ds.streaming_split(group.num_workers)
+                           if (to_split == "all" or name in to_split)
+                           else [ds.iterator()] * group.num_workers)
                     for name, ds in self.datasets.items()}
             group.run("start_loop", (self.train_loop, self.loop_config),
                       ctx_kwargs, timeout=120)

@@ -85,7 +85,10 @@ def _class_trainable_fn(trainable_cls):
         t = trainable_cls(config)
         ckpt = get_checkpoint()
         if ckpt is not None:
-            t.load_checkpoint(ckpt.path)
+            # dict checkpoints round-trip as a pickled file; dir
+            # checkpoints hand back the path (both reference forms)
+            d = _load_trainable_dict(ckpt.path)
+            t.load_checkpoint(d if d is not None else ckpt.path)
             it = _load_trainable_iteration(ckpt.path)
             if it is not None:
                 t._iteration = it
@@ -106,8 +109,18 @@ def _class_trainable_fn(trainable_cls):
                         shutil.rmtree(tmp_dir, ignore_errors=True)
                         tmp_dir = None
                     else:
-                        path = (saved if isinstance(saved, str)
-                                else tmp_dir)
+                        if isinstance(saved, dict):
+                            # the reference's other checkpoint form:
+                            # persist the dict, hand it back on load
+                            _save_trainable_dict(tmp_dir, saved)
+                            path = tmp_dir
+                        elif isinstance(saved, str):
+                            path = saved
+                        else:
+                            raise TuneError(
+                                f"save_checkpoint must return a "
+                                f"path, a dict, or None — got "
+                                f"{type(saved).__name__}")
                         _save_trainable_iteration(path, t._iteration)
                         from ray_tpu.train.session import Checkpoint
                         checkpoint = Checkpoint(path)
@@ -123,6 +136,23 @@ def _class_trainable_fn(trainable_cls):
 
     run.__name__ = trainable_cls.__name__
     return run
+
+
+def _save_trainable_dict(path: str, state: dict) -> None:
+    from ray_tpu.core import serialization as ser
+    with open(os.path.join(path, ".trainable_dict_ckpt.pkl"),
+              "wb") as f:
+        f.write(ser.dumps(state))
+
+
+def _load_trainable_dict(path: str) -> dict | None:
+    from ray_tpu.core import serialization as ser
+    try:
+        with open(os.path.join(path, ".trainable_dict_ckpt.pkl"),
+                  "rb") as f:
+            return ser.loads(f.read())
+    except OSError:
+        return None
 
 
 def _save_trainable_iteration(path: str, iteration: int) -> None:

@@ -232,7 +232,6 @@ class Tuner:
 
         trials: list[Trial] = []
         pending: list[Trial] = []
-        self._trials = trials
         if self._restore_trials is not None:
             trials = self._restore_trials
             pending = [t for t in trials if t.state == "PENDING"]
@@ -240,6 +239,9 @@ class Tuner:
         else:
             searcher = tc.search_alg or BasicVariantGenerator(
                 self.param_space, tc.num_samples, seed=tc.seed)
+        # AFTER the restore rebinding: callbacks must see the real
+        # trial list, not the pre-restore empty one.
+        self._trials = trials
 
         running: list[Trial] = []
         exhausted = False   # fallback for searchers that never
@@ -403,11 +405,14 @@ class Tuner:
         try:
             p = ray_tpu.get(t.actor.poll.remote(), timeout=60)
             if p["done"]:
-                # poll() caps each drain (16): a finished trial may
-                # still have queued results — the final metrics must
-                # be the LAST report, not the 16th (caught by the
-                # 20-iteration class-trainable test).
-                while p["results"]:
+                # poll() caps each drain (16) AND reads the done flag
+                # AFTER draining — a report landing in that window
+                # leaves a queued result behind even when this batch
+                # came back empty. On done, drain unconditionally
+                # until an empty batch: the final metrics must be the
+                # LAST report (caught by the 20-iteration
+                # class-trainable test + review).
+                while True:
                     extra = ray_tpu.get(t.actor.poll.remote(),
                                         timeout=60)
                     if not extra["results"]:

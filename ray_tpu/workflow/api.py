@@ -38,6 +38,7 @@ from ray_tpu.workflow.common import (
 _running: dict[str, threading.Thread] = {}
 _results: dict[str, Any] = {}
 _cancel_flags: dict[str, threading.Event] = {}
+_starting: set[str] = set()    # resume guard over the IO window
 _lock = threading.Lock()
 
 
@@ -206,7 +207,7 @@ def _execute(dag: DAGNode, store: wf_storage.WorkflowStorage,
             store.save_step(keys[id(node)], value)
         vals[id(node)] = value
 
-    def deps_of(n) -> list:
+    def _deps_of(n) -> list:
         out = []
 
         def walk(obj):
@@ -224,6 +225,11 @@ def _execute(dag: DAGNode, store: wf_storage.WorkflowStorage,
         for v in getattr(n, "_bound_kwargs", {}).values():
             walk(v)
         return out
+
+    # Dep sets are immutable: walk each node's arg tree ONCE, not on
+    # every 0.2 s scheduler tick (an event-poll-blocked workflow would
+    # otherwise busy-rescan all waiting nodes for hours).
+    dep_ids = {id(n): [id(d) for d in _deps_of(n)] for n in order}
 
     def resolve_nested(obj):
         if isinstance(obj, DAGNode):
@@ -243,7 +249,7 @@ def _execute(dag: DAGNode, store: wf_storage.WorkflowStorage,
         progressed = False
         still_waiting = []
         for n in waiting:
-            if any(id(d) not in vals for d in deps_of(n)):
+            if any(d not in vals for d in dep_ids[id(n)]):
                 still_waiting.append(n)
                 continue
             progressed = True
@@ -476,33 +482,44 @@ def _start_resume(workflow_id: str) -> None:
     and race the durable log."""
     import os
     from ray_tpu.workflow.common import WorkflowError
-    t = _running.get(workflow_id)
-    if t is not None and t.is_alive():
-        raise WorkflowError(
-            f"workflow {workflow_id} is already running in this "
-            f"process; cancel() it first")
-    store = wf_storage.WorkflowStorage(workflow_id)
-    meta = store.load_meta()
-    if meta is None:
-        raise ValueError(f"no stored workflow {workflow_id!r}")
-    if meta.get("status") == WorkflowStatus.RUNNING \
-            and meta.get("executor_pid") != os.getpid() \
-            and _pid_alive(meta.get("executor_pid")):
-        raise WorkflowError(
-            f"workflow {workflow_id} is RUNNING under live pid "
-            f"{meta.get('executor_pid')}; refusing a second executor")
-    dag, args = ser.loads(bytes.fromhex(meta["dag_blob"]))
-    meta["status"] = WorkflowStatus.RUNNING
-    meta["executor_pid"] = os.getpid()
-    store.save_meta(meta)
+    # check-then-act under _lock: two concurrent resume() calls must
+    # not both pass the liveness guard (the _starting sentinel covers
+    # the storage-IO window between guard and thread registration)
     with _lock:
-        _cancel_flags[workflow_id] = threading.Event()
-        t = threading.Thread(target=_run_thread,
-                             args=(workflow_id, dag, args),
-                             daemon=True,
-                             name=f"workflow_{workflow_id[:16]}")
-        _running[workflow_id] = t
-    t.start()
+        t = _running.get(workflow_id)
+        if (t is not None and t.is_alive()) \
+                or workflow_id in _starting:
+            raise WorkflowError(
+                f"workflow {workflow_id} is already running in this "
+                f"process; cancel() it first")
+        _starting.add(workflow_id)
+    try:
+        store = wf_storage.WorkflowStorage(workflow_id)
+        meta = store.load_meta()
+        if meta is None:
+            raise ValueError(f"no stored workflow {workflow_id!r}")
+        if meta.get("status") == WorkflowStatus.RUNNING \
+                and meta.get("executor_pid") != os.getpid() \
+                and _pid_alive(meta.get("executor_pid")):
+            raise WorkflowError(
+                f"workflow {workflow_id} is RUNNING under live pid "
+                f"{meta.get('executor_pid')}; refusing a second "
+                f"executor")
+        dag, args = ser.loads(bytes.fromhex(meta["dag_blob"]))
+        meta["status"] = WorkflowStatus.RUNNING
+        meta["executor_pid"] = os.getpid()
+        store.save_meta(meta)
+        with _lock:
+            _cancel_flags[workflow_id] = threading.Event()
+            t = threading.Thread(target=_run_thread,
+                                 args=(workflow_id, dag, args),
+                                 daemon=True,
+                                 name=f"workflow_{workflow_id[:16]}")
+            _running[workflow_id] = t
+        t.start()
+    finally:
+        with _lock:
+            _starting.discard(workflow_id)
 
 
 def resume(workflow_id: str, timeout: float | None = None) -> Any:

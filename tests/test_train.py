@@ -229,3 +229,58 @@ def test_trainer_datasets_shard_to_workers(rt, tmp_path):
     assert all_ids == list(range(100))           # full coverage
     assert not set(shards[0]) & set(shards[1])   # disjoint
     assert shards[0] and shards[1]               # both worked
+
+
+def test_data_config_replicates_unsplit_datasets(rt, tmp_path):
+    """DataConfig(datasets_to_split=[...]) (reference:
+    ray.train.DataConfig): listed datasets shard across workers,
+    unlisted ones replicate — every worker sees the FULL stream."""
+    import json
+    import os
+
+    from ray_tpu import data
+    from ray_tpu.train import (
+        DataConfig, JaxTrainer, RunConfig, ScalingConfig,
+    )
+
+    out_dir = str(tmp_path / "repl")
+    os.makedirs(out_dir, exist_ok=True)
+
+    def loop():
+        from ray_tpu.train import get_context, get_dataset_shard, report
+        ctx = get_context()
+        train_ids = [int(x)
+                     for b in get_dataset_shard("train").iter_batches(
+                         batch_size=16) for x in b["id"]]
+        val_ids = [int(x)
+                   for b in get_dataset_shard("val").iter_batches(
+                       batch_size=16) for x in b["id"]]
+        with open(os.path.join(os.environ["REPL_OUT"],
+                               f"rank{ctx.world_rank}.json"),
+                  "w") as f:
+            json.dump({"train": train_ids, "val": val_ids}, f)
+        report({"n": len(train_ids)})
+
+    os.environ["REPL_OUT"] = out_dir
+    try:
+        tr = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=str(tmp_path)),
+            datasets={"train": data.range(40),
+                      "val": data.range(10)},
+            dataset_config=DataConfig(datasets_to_split=["train"]))
+        res = tr.fit()
+        assert res.error is None, res.error
+    finally:
+        os.environ.pop("REPL_OUT", None)
+    shards = []
+    for r in (0, 1):
+        with open(os.path.join(out_dir, f"rank{r}.json")) as f:
+            shards.append(json.load(f))
+    # train: disjoint full coverage; val: FULL copy on every worker
+    assert sorted(shards[0]["train"] + shards[1]["train"]) == \
+        list(range(40))
+    assert not set(shards[0]["train"]) & set(shards[1]["train"])
+    assert sorted(shards[0]["val"]) == list(range(10))
+    assert sorted(shards[1]["val"]) == list(range(10))

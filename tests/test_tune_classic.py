@@ -80,6 +80,42 @@ def test_class_trainable_resume_from_checkpoint(rt, tmp_path):
     assert r.metrics["training_iteration"] == 20
 
 
+def test_class_trainable_dict_checkpoint(rt, tmp_path):
+    """save_checkpoint may return a DICT (the reference's other form):
+    it must round-trip back into load_checkpoint on resume."""
+
+    class DictCkpt(tune.Trainable):
+        def setup(self, config):
+            self.x = 0.0
+            self.marker = config["marker"]
+
+        def step(self):
+            if self.iteration == 3 and not os.path.exists(self.marker):
+                with open(self.marker, "w") as f:
+                    f.write("x")
+                raise RuntimeError("crash after 3")
+            self.x += 1.0
+            return {"x": self.x, "done": self.iteration >= 7}
+
+        def save_checkpoint(self, checkpoint_dir):
+            return {"x": self.x}
+
+        def load_checkpoint(self, checkpoint):
+            assert isinstance(checkpoint, dict), checkpoint
+            self.x = checkpoint["x"]
+
+    marker = str(tmp_path / "crashed")
+    tune.run(DictCkpt, config={"marker": marker},
+             storage_path=str(tmp_path), name="dictc")
+    tuner = tune.Tuner.restore(str(tmp_path / "dictc"), DictCkpt)
+    grid = tuner.fit()
+    r = grid[0]
+    assert r.state == "COMPLETED"
+    # resumed from x=3 (dict restored), finished at iteration 8 total
+    assert r.metrics["x"] == 8.0
+    assert r.metrics["training_iteration"] == 8
+
+
 def test_callbacks_and_cli_reporter(rt, tmp_path, capsys):
     events = []
 
