@@ -6,7 +6,13 @@ backpressure; ``streaming_split`` feeds trainer gangs and
 mesh (SURVEY.md §2.3/§2.4).
 """
 
-from ray_tpu.data.context import DataContext, DatasetContext
+from ray_tpu.data.context import (
+    DataContext,
+    DatasetContext,
+    ExecutionOptions,
+    ExecutionResources,
+    set_progress_bars,
+)
 from ray_tpu.data.dataset import (
     ActorPoolStrategy,
     DataIterator,
@@ -15,7 +21,14 @@ from ray_tpu.data.dataset import (
 )
 from ray_tpu.data import aggregate  # noqa: F401  (ray.data.aggregate)
 from ray_tpu.data.io import (
+    BlockBasedFileDatasink,
     Datasink,
+    RowBasedFileDatasink,
+    from_dask,
+    from_modin,
+    from_spark,
+    from_tf,
+    from_torch,
     from_arrow,
     from_huggingface,
     read_bigquery,
@@ -57,7 +70,17 @@ __all__ = [
     "from_numpy_refs", "from_pandas_refs", "from_arrow_refs",
     "range_tensor", "read_parquet_bulk", "read_datasource",
     "Datasource", "ReadTask", "Datasink", "aggregate",
+    "BlockBasedFileDatasink", "RowBasedFileDatasink",
+    "from_torch", "from_tf", "from_dask", "from_modin", "from_spark",
+    "ExecutionOptions", "ExecutionResources", "set_progress_bars",
+    "DatasetIterator", "Preprocessor", "NodeIdStr",
     "read_json", "read_images", "read_binary_files",
     "read_tfrecords", "read_sql", "read_bigquery", "from_huggingface",
     "read_webdataset",
 ]
+
+# Compat aliases (reference kept both spellings alive).
+from ray_tpu.data.dataset import DataIterator as DatasetIterator  # noqa: E402
+from ray_tpu.data.preprocessor import Preprocessor  # noqa: E402,F401
+
+NodeIdStr = str  # (reference: ray.data.NodeIdStr type alias)

@@ -160,9 +160,16 @@ def default_policies(max_in_flight: int) -> list[BackpressurePolicy]:
     ctx = DataContext.get_current()
     chain: list[BackpressurePolicy] = [
         ConcurrencyCapPolicy(max_in_flight)]
+    # The ExecutionOptions resource limit is read HERE (policy build
+    # time), so the reference idiom of mutating the options in place
+    # (ctx.execution_options.resource_limits.object_store_memory = N)
+    # takes effect on the next execution — not only the assignment
+    # form the property setter catches.
+    opt_mem = ctx.execution_options.resource_limits.object_store_memory
+    budget = (int(opt_mem) if opt_mem is not None
+              else ctx.object_store_budget_bytes)
     if ctx.backpressure_policies is not None:
         chain.extend(ctx.backpressure_policies)
-    elif ctx.object_store_budget_bytes > 0:
-        chain.append(
-            StoreMemoryPolicy(ctx.object_store_budget_bytes))
+    elif budget > 0:
+        chain.append(StoreMemoryPolicy(budget))
     return chain

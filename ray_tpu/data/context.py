@@ -9,7 +9,33 @@ executor actually honors.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ExecutionResources:
+    """Resource amounts for execution limits (reference:
+    ray.data.ExecutionResources). ``object_store_memory`` is the knob
+    this executor honors (bytes; it feeds the store-byte backpressure
+    budget); cpu/gpu are recorded for compatibility."""
+
+    cpu: float | None = None
+    gpu: float | None = None
+    object_store_memory: int | None = None
+
+
+@dataclass
+class ExecutionOptions:
+    """(reference: ray.data.ExecutionOptions) Apply via
+    ``DataContext.get_current().execution_options = opts``:
+    ``resource_limits.object_store_memory`` maps onto the
+    store-byte backpressure budget."""
+
+    resource_limits: ExecutionResources = field(
+        default_factory=ExecutionResources)
+    locality_with_output: bool = False
+    preserve_order: bool = True  # our executor yields in order
+    verbose_progress: bool = False
 
 
 @dataclass
@@ -32,6 +58,10 @@ class DataContext:
     # Device-prefetch depth for iter_device_batches.
     prefetch_batches: int = 2
 
+    # Progress-bar toggle (reference: set_progress_bars) — consumed
+    # by Dataset.stats()/iter wrappers that print progress.
+    enable_progress_bars: bool = True
+
     _current = None
     _lock = threading.Lock()
 
@@ -41,6 +71,30 @@ class DataContext:
             if cls._current is None:
                 cls._current = cls()
             return cls._current
+
+    @property
+    def execution_options(self) -> ExecutionOptions:
+        opts = getattr(self, "_execution_options", None)
+        if opts is None:
+            opts = ExecutionOptions()
+            self._execution_options = opts
+        return opts
+
+    @execution_options.setter
+    def execution_options(self, opts: ExecutionOptions) -> None:
+        self._execution_options = opts
+        mem = opts.resource_limits.object_store_memory
+        if mem is not None:
+            self.object_store_budget_bytes = int(mem)
+
+
+def set_progress_bars(enabled: bool) -> bool:
+    """(reference: ray.data.set_progress_bars) Returns the previous
+    setting."""
+    ctx = DataContext.get_current()
+    prev = ctx.enable_progress_bars
+    ctx.enable_progress_bars = bool(enabled)
+    return prev
 
 
 # Classic-name alias (reference kept both spellings alive).

@@ -692,6 +692,64 @@ class Datasource:
         return None
 
 
+def from_torch(torch_dataset, *,
+               column: str = "item") -> Dataset:
+    """A (map-style or iterable) torch dataset -> Dataset (reference:
+    ray.data.from_torch). Items land in one ``item`` column (tensors
+    convert to numpy); the torch dataset is materialized at
+    construction, matching the reference's behavior."""
+    rows = []
+    for item in torch_dataset:
+        if hasattr(item, "numpy"):
+            item = item.numpy()
+        rows.append({column: item})
+    return from_items(rows)
+
+
+def from_tf(tf_dataset) -> Dataset:
+    """A ``tf.data.Dataset`` -> Dataset (reference:
+    ray.data.from_tf — the tf dataset is fully materialized; element
+    dicts become columns, bare tensors an ``item`` column)."""
+    rows = []
+    for elem in tf_dataset.as_numpy_iterator():
+        if isinstance(elem, dict):
+            rows.append(elem)
+        elif isinstance(elem, tuple):
+            rows.append({f"item_{i}": v for i, v in enumerate(elem)})
+        else:
+            rows.append({"item": elem})
+    return from_items(rows)
+
+
+def from_dask(df) -> Dataset:
+    """(reference: ray.data.from_dask) Requires dask."""
+    try:
+        import dask.dataframe as dd  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "from_dask requires dask, which is not installed in this "
+            "environment") from e
+    return from_pandas(df.compute())
+
+
+def from_modin(df) -> Dataset:
+    """(reference: ray.data.from_modin) Requires modin."""
+    if not hasattr(df, "_to_pandas"):
+        raise TypeError(
+            f"from_modin expects a modin DataFrame, got "
+            f"{type(df).__name__}")
+    return from_pandas(df._to_pandas())
+
+
+def from_spark(df) -> Dataset:
+    """(reference: ray.data.from_spark) Requires pyspark."""
+    if not hasattr(df, "toPandas"):
+        raise TypeError(
+            f"from_spark expects a pyspark DataFrame, got "
+            f"{type(df).__name__}")
+    return from_pandas(df.toPandas())
+
+
 class Datasink:
     """Pluggable write sink ABC (reference: ray.data.Datasink):
     override ``write(block)``; lifecycle hooks are optional. Drive
@@ -708,6 +766,59 @@ class Datasink:
 
     def on_write_failed(self, error: BaseException) -> None:
         pass
+
+
+class BlockBasedFileDatasink(Datasink):
+    """File-per-block sink base (reference:
+    ray.data.BlockBasedFileDatasink): subclass and implement
+    ``write_block_to_file(block, file)`` (binary file object)."""
+
+    def __init__(self, path: str, *, file_format: str = "bin"):
+        import os
+        self.path = path
+        self.file_format = file_format
+        self._index = 0
+        os.makedirs(path, exist_ok=True)
+
+    def write_block_to_file(self, block, file) -> None:
+        raise NotImplementedError
+
+    def write(self, block) -> None:
+        import os
+        out = os.path.join(
+            self.path,
+            f"part-{self._index:05d}.{self.file_format}")
+        self._index += 1
+        with open(out, "wb") as f:
+            self.write_block_to_file(block, f)
+
+
+class RowBasedFileDatasink(Datasink):
+    """File-per-row sink base (reference:
+    ray.data.RowBasedFileDatasink): subclass and implement
+    ``write_row_to_file(row, file)``."""
+
+    def __init__(self, path: str, *, file_format: str = "bin"):
+        import os
+        self.path = path
+        self.file_format = file_format
+        self._index = 0
+        os.makedirs(path, exist_ok=True)
+
+    def write_row_to_file(self, row: dict, file) -> None:
+        raise NotImplementedError
+
+    def write(self, block) -> None:
+        import os
+
+        from ray_tpu.data.block import block_rows
+        for row in block_rows(block):
+            out = os.path.join(
+                self.path,
+                f"row-{self._index:06d}.{self.file_format}")
+            self._index += 1
+            with open(out, "wb") as f:
+                self.write_row_to_file(row, f)
 
 
 def read_datasource(datasource: Datasource, *,

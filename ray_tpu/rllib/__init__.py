@@ -21,7 +21,8 @@ from ray_tpu.rllib.learner import JaxLearner, RecurrentJaxLearner
 from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.multi_agent import (
-    MultiAgentEnvRunner, MultiAgentPPO, MultiAgentPPOConfig,
+    MultiAgentEnv, MultiAgentEnvRunner, MultiAgentPPO,
+    MultiAgentPPOConfig,
 )
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib import connectors

@@ -26,6 +26,26 @@ from ray_tpu.rllib.env_runner import Episode
 from ray_tpu.rllib.learner import JaxLearner, PPOHyperparams
 
 
+class MultiAgentEnv:
+    """Subclassable base for the protocol above (reference:
+    rllib/env/multi_agent_env.py). Duck-typed envs work too — the
+    runners only need reset/step with dict agents; this base exists
+    so reference-style ``class MyEnv(MultiAgentEnv)`` code ports
+    unchanged and gets the contract documented in one place."""
+
+    def __init__(self):
+        # per-INSTANCE list: a class-level [] default would be shared
+        # mutable state across every env instance and subclass
+        self.possible_agents: list = list(
+            getattr(type(self), "possible_agents", []))
+
+    def reset(self, *, seed=None, options=None):
+        raise NotImplementedError
+
+    def step(self, actions: dict):
+        raise NotImplementedError
+
+
 @ray_tpu.remote
 class MultiAgentEnvRunner:
     """Steps one MultiAgentEnv; keeps a host copy of every policy."""

@@ -66,11 +66,11 @@ class DataConfig:
 
 @dataclass
 class SyncConfig:
-    """Experiment-dir syncing knobs (reference: ray.train.SyncConfig).
-    This runtime mirrors experiment trees through the storage seam on
-    journal writes and at fit() exit; ``sync_period`` and
-    ``sync_artifacts`` are accepted for signature compatibility and
-    recorded on the RunConfig."""
+    """Experiment-dir syncing knobs (reference: ray.train.SyncConfig),
+    carried on ``RunConfig(sync_config=...)``. This runtime mirrors
+    experiment trees through the storage seam on journal writes and at
+    fit() exit, so ``sync_period``/``sync_artifacts`` are recorded but
+    do not schedule a background syncer."""
 
     sync_period: float = 300.0
     sync_artifacts: bool = False
@@ -90,3 +90,7 @@ class RunConfig:
     # tune.Callback instances (reference: RunConfig.callbacks) —
     # invoked by the Tuner controller on trial lifecycle events.
     callbacks: list = field(default_factory=list)
+    # Accepted for reference-signature compatibility; experiment-tree
+    # mirroring in this runtime happens through the storage seam
+    # (journal writes + fit() exit), not a background syncer.
+    sync_config: SyncConfig | None = None

@@ -1,0 +1,152 @@
+"""Data compat batch 2 (reference: ray.data.__init__): framework
+constructors, file datasinks, ExecutionOptions wiring, preprocessors.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.data.preprocessor import (
+    Concatenator, LabelEncoder, MinMaxScaler, StandardScaler,
+)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_from_torch(rt):
+    import torch
+    from torch.utils.data import TensorDataset
+    tds = TensorDataset(torch.arange(6, dtype=torch.float32))
+    ds = data.from_torch(tds)
+    rows = ds.take_all()
+    assert len(rows) == 6
+    # TensorDataset yields 1-tuples
+    assert float(rows[3]["item"][0]) == 3.0
+
+
+def test_from_tf(rt):
+    import tensorflow as tf
+    tds = tf.data.Dataset.from_tensor_slices(
+        {"x": np.arange(5), "y": np.arange(5) * 2.0})
+    ds = data.from_tf(tds)
+    rows = sorted(ds.take_all(), key=lambda r: r["x"])
+    assert [r["x"] for r in rows] == list(range(5))
+    assert rows[2]["y"] == 4.0
+
+
+def test_from_dask_gated():
+    try:
+        import dask  # noqa: F401
+        pytest.skip("dask present")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="dask"):
+        data.from_dask(object())
+
+
+def test_block_based_file_datasink(rt, tmp_path):
+    class NpySink(data.BlockBasedFileDatasink):
+        def write_block_to_file(self, block, file):
+            from ray_tpu.data.block import block_to_batch
+            np.save(file, block_to_batch(block)["id"])
+
+    sink = NpySink(str(tmp_path / "npys"), file_format="npy")
+    data.range(10, parallelism=2).write_datasink(sink)
+    import os
+    parts = sorted(os.listdir(tmp_path / "npys"))
+    assert parts == ["part-00000.npy", "part-00001.npy"]
+    got = np.concatenate(
+        [np.load(tmp_path / "npys" / p) for p in parts])
+    assert got.tolist() == list(range(10))
+
+
+def test_row_based_file_datasink(rt, tmp_path):
+    class TxtSink(data.RowBasedFileDatasink):
+        def write_row_to_file(self, row, file):
+            file.write(str(row["id"]).encode())
+
+    sink = TxtSink(str(tmp_path / "rows"), file_format="txt")
+    data.range(4, parallelism=2).write_datasink(sink)
+    import os
+    files = sorted(os.listdir(tmp_path / "rows"))
+    assert len(files) == 4
+    assert open(tmp_path / "rows" / files[2]).read() == "2"
+
+
+def test_execution_options_wire_into_budget():
+    ctx = data.DataContext.get_current()
+    before = ctx.object_store_budget_bytes
+    try:
+        ctx.execution_options = data.ExecutionOptions(
+            resource_limits=data.ExecutionResources(
+                object_store_memory=123456))
+        assert ctx.object_store_budget_bytes == 123456
+    finally:
+        ctx.object_store_budget_bytes = before
+
+
+def test_execution_options_in_place_mutation(rt):
+    """The reference idiom mutates the options IN PLACE — the policy
+    build must read through execution_options, not only the setter."""
+    from ray_tpu.data.backpressure import (
+        StoreMemoryPolicy, default_policies,
+    )
+    ctx = data.DataContext.get_current()
+    before = ctx.execution_options.resource_limits.object_store_memory
+    try:
+        ctx.execution_options.resource_limits.object_store_memory = \
+            777_000
+        chain = default_policies(4)
+        mems = [p for p in chain if isinstance(p, StoreMemoryPolicy)]
+        assert mems and mems[0].budget_bytes == 777_000
+    finally:
+        ctx.execution_options.resource_limits.object_store_memory = \
+            before
+
+
+def test_set_progress_bars():
+    prev = data.set_progress_bars(False)
+    assert data.DataContext.get_current().enable_progress_bars is False
+    data.set_progress_bars(prev)
+
+
+def test_standard_scaler(rt):
+    ds = data.from_items([{"a": float(i), "b": i % 2} for i in range(8)])
+    sc = StandardScaler(["a"])
+    out = sc.fit_transform(ds)
+    vals = np.array(sorted(r["a"] for r in out.take_all()))
+    assert abs(vals.mean()) < 1e-9
+    assert abs(vals.std() - 1.0) < 1e-9
+    # serve-time single batch path
+    b = sc.transform_batch({"a": np.array([3.5]), "b": np.array([0])})
+    assert abs(b["a"][0]) < 1e-9  # 3.5 is the mean of 0..7
+    with pytest.raises(RuntimeError, match="fit"):
+        StandardScaler(["a"]).transform(ds)
+
+
+def test_minmax_and_label_and_concat(rt):
+    ds = data.from_items([
+        {"x": float(i), "y": float(10 - i), "cls": "ab"[i % 2]}
+        for i in range(5)])
+    mm = MinMaxScaler(["x"]).fit(ds)
+    vals = sorted(r["x"] for r in mm.transform(ds).take_all())
+    assert vals[0] == 0.0 and vals[-1] == 1.0
+    le = LabelEncoder("cls").fit(ds)
+    assert le.classes_ == ["a", "b"]
+    rows = le.transform(ds).take_all()
+    assert set(r["cls"] for r in rows) == {0, 1}
+    cat = Concatenator(["x", "y"], "features")
+    out = cat.transform(ds).take_all()
+    assert out[0]["features"].shape == (2,)
+    assert "x" not in out[0]
+
+
+def test_dataset_iterator_alias():
+    assert data.DatasetIterator is data.DataIterator
+    assert data.NodeIdStr is str
