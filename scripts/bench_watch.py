@@ -91,6 +91,21 @@ def _load1() -> float:
         return 0.0
 
 
+def _pytest_running() -> bool:
+    """load1 is a 1-minute EMA: a test suite that JUST started reads
+    as an idle host, and a capture launched into that window both
+    reads low AND starves the suite into timing failures (r5: 9
+    test_data TaskErrors from a capture landing at suite start).
+    pgrep is instantaneous."""
+    import subprocess
+    try:
+        out = subprocess.run(["pgrep", "-fc", "pytest"],
+                             capture_output=True, timeout=10)
+        return int(out.stdout.strip() or 0) > 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
 # A capture launched while other work owns the CPU reads 10-20% low
 # (r5: the same code measured 127.1k idle vs 106-115k under builder
 # load on this 1-core host) and burns a ~780 s chip window on a
@@ -101,6 +116,10 @@ LOAD_GATE = float(os.environ.get(
     "RAY_TPU_WATCH_LOAD_GATE", (os.cpu_count() or 1) * 0.5 + 1.0))
 LOAD_DEFER_S = float(os.environ.get("RAY_TPU_WATCH_LOAD_DEFER", 120))
 MAX_DEFERRALS = int(os.environ.get("RAY_TPU_WATCH_MAX_DEFERRALS", 15))
+# Suite runs take ~15-20 min here but can stretch; 90 * 120 s = 3 h
+# before a stuck pytest-looking process stops blocking captures.
+PYTEST_MAX_DEFERRALS = int(os.environ.get(
+    "RAY_TPU_WATCH_PYTEST_MAX_DEFERRALS", 90))
 
 
 def capture() -> dict | None:
@@ -147,6 +166,7 @@ def main() -> None:
           "interval_s": PROBE_INTERVAL_S})
     interval = PROBE_INTERVAL_S
     deferrals = 0
+    pytest_deferrals = 0
     while True:
         # Load gate BEFORE the probe: each probe child imports jax
         # (real CPU — the probe churn the docstring warns about), so
@@ -155,6 +175,21 @@ def main() -> None:
         # capture proceeds anyway (a loaded capture that best-of
         # banking discards beats indefinite starvation).
         load = _load1()
+        pytest_live = _pytest_running()
+        # pytest deferrals do NOT share the load cap: banking can
+        # discard a bad bench number, but a capture launched mid-suite
+        # starves the suite into real test failures — that deferral
+        # must outlast any suite. Its own (generous) cap only breaks
+        # ties with a stale/stuck pytest-looking process.
+        if pytest_live and pytest_deferrals < PYTEST_MAX_DEFERRALS:
+            pytest_deferrals += 1
+            _log({"event": "capture_deferred_load",
+                  "load1": round(load, 2), "gate": LOAD_GATE,
+                  "pytest": True,
+                  "deferrals": pytest_deferrals})
+            time.sleep(LOAD_DEFER_S)
+            continue
+        pytest_deferrals = 0
         if load > LOAD_GATE and deferrals < MAX_DEFERRALS:
             deferrals += 1
             _log({"event": "capture_deferred_load",

@@ -59,10 +59,14 @@ def _drain_slowly(ds):
 def fresh_ctx():
     ctx = data_ctx.DataContext.get_current()
     saved = (ctx.max_in_flight, ctx.object_store_budget_bytes,
-             ctx.backpressure_policies)
+             ctx.backpressure_policies,
+             getattr(ctx, "_execution_options", None))
+    # a leaked ExecutionOptions resource limit from another module
+    # (same xdist worker) would throttle the "unbounded" phase
+    ctx._execution_options = None
     yield ctx
     (ctx.max_in_flight, ctx.object_store_budget_bytes,
-     ctx.backpressure_policies) = saved
+     ctx.backpressure_policies, ctx._execution_options) = saved
 
 
 def _wait_store_drained(timeout: float = 15.0) -> None:

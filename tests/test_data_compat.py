@@ -82,12 +82,17 @@ def test_row_based_file_datasink(rt, tmp_path):
 def test_execution_options_wire_into_budget():
     ctx = data.DataContext.get_current()
     before = ctx.object_store_budget_bytes
+    before_opts = ctx.execution_options
     try:
         ctx.execution_options = data.ExecutionOptions(
             resource_limits=data.ExecutionResources(
                 object_store_memory=123456))
         assert ctx.object_store_budget_bytes == 123456
     finally:
+        # restore the OPTIONS OBJECT too — a leaked resource limit
+        # silently throttles every later Dataset in this process
+        # (caught by test_data_backpressure in the sharded suite)
+        ctx._execution_options = before_opts
         ctx.object_store_budget_bytes = before
 
 
