@@ -17,8 +17,9 @@ from ray_tpu.autoscaler.node_provider import (
     LocalNodeProvider,
     NodeProvider,
 )
+from ray_tpu.autoscaler import sdk  # noqa: F401  (request_resources)
 
 __all__ = [
     "Autoscaler", "AutoscalerConfig", "NodeTypeConfig",
-    "NodeProvider", "LocalNodeProvider",
+    "NodeProvider", "LocalNodeProvider", "sdk",
 ]

@@ -87,10 +87,51 @@ class Autoscaler:
 
     def update(self) -> dict:
         demand = self.runtime.resource_demand()
-        launched = self._scale_up(demand)
-        terminated = self._scale_down()
-        return {"demand": len(demand), "launched": launched,
-                "terminated": terminated}
+        explicit = []
+        getter = getattr(self.runtime, "explicit_resource_requests",
+                         None)
+        if getter is not None:
+            explicit = getter()
+        # request_resources is a floor on TOTAL capacity (reference:
+        # resource_demand_scheduler packs the request against node
+        # totals) — packing it against FREE capacity would relaunch a
+        # node every pass once user work occupies the floor.
+        floor = self._pack_onto_types(explicit)
+        launched = self._scale_up(demand, floor)
+        terminated = self._scale_down(floor)
+        return {"demand": len(demand) + len(explicit),
+                "launched": launched, "terminated": terminated}
+
+    def _pack_onto_types(self, requests: list[dict]
+                         ) -> dict[str, int]:
+        """First-fit ``requests`` onto hypothetical empty nodes
+        (cheapest type that fits, open nodes absorb later requests);
+        returns nodes-per-type. Shared by the explicit-floor scale-up
+        and the idle-protection check so they can never disagree."""
+        need: dict[str, int] = {}
+        if not requests:
+            return need
+        types = sorted(self.config.node_types,
+                       key=lambda t: sum(t.resources.values()))
+        open_nodes: list[dict] = []
+        for req in requests:
+            placed = False
+            for avail in open_nodes:
+                if _fits(avail, req):
+                    _take(avail, req)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for nt in types:
+                if _fits(nt.resources, req):
+                    avail = dict(nt.resources)
+                    _take(avail, req)
+                    open_nodes.append(avail)
+                    need[nt.name] = need.get(nt.name, 0) + 1
+                    break
+            # infeasible requests are skipped (matching _scale_up)
+        return need
 
     def _counts_by_type(self) -> dict[str, int]:
         counts: dict[str, int] = {}
@@ -98,12 +139,16 @@ class Autoscaler:
             counts[n.node_type] = counts.get(n.node_type, 0) + 1
         return counts
 
-    def _scale_up(self, demand: list[dict[str, float]]) -> int:
-        # 1) satisfy min_workers
+    def _scale_up(self, demand: list[dict[str, float]],
+                  floor: dict[str, int] | None = None) -> int:
+        # 1) satisfy min_workers AND the explicit request_resources
+        #    floor (deficit vs TOTAL per-type count, busy or idle)
         counts = self._counts_by_type()
         launched = 0
         for nt in self.config.node_types:
-            while (counts.get(nt.name, 0) < nt.min_workers
+            want = max(nt.min_workers, (floor or {}).get(nt.name, 0))
+            want = min(want, nt.max_workers)
+            while (counts.get(nt.name, 0) < want
                    and launched < self.config.max_launches_per_update):
                 self.provider.create_node(nt.name, nt.resources)
                 counts[nt.name] = counts.get(nt.name, 0) + 1
@@ -160,9 +205,10 @@ class Autoscaler:
             self.launched_total += 1
         return launched
 
-    def _scale_down(self) -> int:
+    def _scale_down(self, floor: dict[str, int] | None = None) -> int:
         now = time.monotonic()
         counts = self._counts_by_type()
+        protected = floor or {}
         by_id = {n["NodeID"]: n for n in self.runtime.nodes()}
         terminated = 0
         for node in self.provider.non_terminated_nodes():
@@ -172,6 +218,12 @@ class Autoscaler:
                 continue
             busy = (info["Available"] != info["Resources"]
                     or info.get("alive_workers", 0) > 0)
+            if not busy and counts.get(node.node_type, 0) <= \
+                    protected.get(node.node_type, 0):
+                # request_resources floor holds this capacity up even
+                # while idle (reference: explicit requests persist)
+                self._idle_since.pop(node.node_id, None)
+                continue
             if busy:
                 self._idle_since.pop(node.node_id, None)
                 continue

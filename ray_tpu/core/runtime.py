@@ -3652,6 +3652,18 @@ class DriverRuntime:
             return [k for (ns, k) in self._kv
                     if ns == namespace and k.startswith(prefix)]
 
+    def request_resources(self, bundles: list[dict]) -> None:
+        """Explicit autoscaler demand floor (reference:
+        ray.autoscaler.sdk.request_resources): the request REPLACES
+        any previous one and persists until overridden — the
+        reconciler scales up to accommodate it and will not idle-kill
+        capacity it needs."""
+        self._explicit_requests = [dict(b) for b in bundles]
+
+    def explicit_resource_requests(self) -> list[dict]:
+        return [dict(b)
+                for b in getattr(self, "_explicit_requests", [])]
+
     def resource_demand(self) -> list[dict[str, float]]:
         """Unmet resource requests (autoscaler input — reference:
         resource demand in autoscaler.proto / GcsAutoscalerStateManager):

@@ -10,8 +10,12 @@ from ray_tpu.dag.dag_node import (
     MultiOutputNode,
 )
 from ray_tpu.dag.compiled_dag import CompiledDAG
+from ray_tpu.dag.dag_node import (
+    _DAGInputData as DAGInputData,  # (reference: ray.dag.DAGInputData)
+)
 
 __all__ = [
+    "DAGInputData",
     "DAGNode",
     "InputNode",
     "InputAttributeNode",

@@ -36,6 +36,41 @@ class JobInfo:
     metadata: dict = field(default_factory=dict)
 
 
+class JobType:
+    """(reference: ray.job_submission.JobType) Every job here is a
+    SUBMISSION job (driver-discovered jobs are a dashboard-crawler
+    concept in the reference)."""
+
+    SUBMISSION = "SUBMISSION"
+    DRIVER = "DRIVER"
+
+
+@dataclass
+class DriverInfo:
+    """(reference: ray.job_submission.DriverInfo)"""
+
+    id: str
+    node_ip_address: str
+    pid: str
+
+
+@dataclass
+class JobDetails:
+    """The REST-schema view of a job (reference:
+    ray.job_submission.JobDetails) — JobInfo plus type/driver info.
+    Built via :meth:`JobSubmissionClient.get_job_details`."""
+
+    job_id: str
+    submission_id: str
+    type: str
+    entrypoint: str
+    status: str
+    start_time: float
+    end_time: float | None = None
+    metadata: dict = field(default_factory=dict)
+    driver_info: DriverInfo | None = None
+
+
 class _JobSupervisor:
     """Runs IN an actor process; forks the entrypoint and tails it."""
 
@@ -234,6 +269,28 @@ class JobSubmissionClient:
             # the last known state unchanged — never poison the
             # table over a hiccup.
         return info
+
+    def get_job_details(self, submission_id: str) -> JobDetails:
+        """(reference: JobSubmissionClient.get_job_info returning the
+        JobDetails REST schema)"""
+        info = self.get_job_info(submission_id)
+        driver = None
+        handle = self._handles.get(submission_id)
+        if handle is not None:
+            try:
+                from ray_tpu.util import get_node_ip_address
+                driver = DriverInfo(
+                    id=submission_id,
+                    node_ip_address=get_node_ip_address(),
+                    pid="")
+            except Exception:  # noqa: BLE001
+                pass
+        return JobDetails(
+            job_id=submission_id, submission_id=submission_id,
+            type=JobType.SUBMISSION, entrypoint=info.entrypoint,
+            status=info.status, start_time=info.start_time,
+            end_time=info.end_time, metadata=info.metadata,
+            driver_info=driver)
 
     def get_job_logs(self, submission_id: str) -> str:
         try:

@@ -115,3 +115,81 @@ def test_tpu_slice_is_atomic(rt_small):
     assert "v5e-8" in types, types
     pg.ready(timeout=30)
     ray_tpu.remove_placement_group(pg)
+
+
+def test_request_resources_floor(rt_small):
+    """ray.autoscaler.sdk.request_resources analog: an explicit
+    request scales the cluster up WITHOUT queued work, holds the
+    capacity while idle, and releases it when cleared."""
+    from ray_tpu.autoscaler import sdk
+
+    runtime = _runtime()
+    provider = LocalNodeProvider(runtime)
+    asc = Autoscaler(AutoscalerConfig(
+        node_types=[NodeTypeConfig("cpu2", {"CPU": 2},
+                                   min_workers=0, max_workers=4)],
+        idle_timeout_s=0.3,
+    ), provider, runtime)
+
+    with pytest.raises(ValueError):
+        sdk.request_resources()
+    with pytest.raises(ValueError):
+        sdk.request_resources(bundles=[{}])
+
+    sdk.request_resources(bundles=[{"CPU": 2}, {"CPU": 2}])
+    r = asc.update()
+    assert r["launched"] == 2, r
+
+    # idle for well past idle_timeout_s: the floor holds the nodes up
+    time.sleep(0.8)
+    asc.update()
+    time.sleep(0.4)
+    asc.update()
+    assert len(provider.non_terminated_nodes()) == 2
+
+    # num_cpus shorthand REPLACES the request (1 one-CPU bundle ->
+    # existing free capacity covers it; no new launches)
+    sdk.request_resources(num_cpus=1)
+    assert asc.update()["launched"] == 0
+
+    # clearing releases the capacity to the idle reaper
+    sdk.request_resources(bundles=[])
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        asc.update()
+        if not provider.non_terminated_nodes():
+            break
+        time.sleep(0.3)
+    assert not provider.non_terminated_nodes()
+
+
+def test_request_resources_floor_is_total_capacity(rt_small):
+    """The floor measures TOTAL capacity: a floor node occupied by
+    real work must not trigger runaway relaunches (review repro)."""
+    import time as _t
+
+    from ray_tpu.autoscaler import sdk
+
+    runtime = _runtime()
+    provider = LocalNodeProvider(runtime)
+    asc = Autoscaler(AutoscalerConfig(
+        node_types=[NodeTypeConfig("cpu2", {"CPU": 2},
+                                   min_workers=0, max_workers=4)],
+        idle_timeout_s=0.3,
+    ), provider, runtime)
+    sdk.request_resources(bundles=[{"CPU": 2}])
+    assert asc.update()["launched"] == 1
+
+    @ray_tpu.remote(num_cpus=2)
+    def hold():
+        _t.sleep(2.0)
+        return 1
+
+    ref = hold.remote()
+    _t.sleep(0.5)
+    for _ in range(4):
+        assert asc.update()["launched"] == 0
+        _t.sleep(0.15)
+    assert len(provider.non_terminated_nodes()) == 1
+    assert ray_tpu.get(ref, timeout=60) == 1
+    sdk.request_resources(bundles=[])

@@ -304,3 +304,20 @@ def test_jobs_rest_unknown_id_is_404(rt):
         assert "unknown job" in _json.loads(ei.value.read())["error"]
     finally:
         dash.stop()
+
+
+def test_job_details_schema(rt):
+    """JobDetails/JobType/DriverInfo (reference:
+    ray.job_submission REST schema objects)."""
+    from ray_tpu.job_submission import (
+        JobDetails, JobStatus, JobSubmissionClient, JobType,
+    )
+    c = JobSubmissionClient()
+    sid = c.submit_job(entrypoint="python -c 'print(7*6)'")
+    assert c.wait_until_finished(sid, timeout=120) == \
+        JobStatus.SUCCEEDED
+    d = c.get_job_details(sid)
+    assert isinstance(d, JobDetails)
+    assert d.type == JobType.SUBMISSION
+    assert d.job_id == d.submission_id == sid
+    assert d.status == JobStatus.SUCCEEDED and d.end_time
