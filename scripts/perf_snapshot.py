@@ -102,8 +102,14 @@ def main() -> None:
         # retry window had memcpy 7.73 but put 5.5 vs the banked
         # 14.45 — gating on memcpy alone would have discarded the
         # best put evidence). Composite: geometric mean of both.
+        # Control-plane throughput is part of the gate: a window once
+        # scored HIGHER on an implausible memcpy reading (18.7 single
+        # vs 9.5 aggregate — contradictory) while every task/actor
+        # metric was 20-30% slower, overwriting the better snapshot.
         GATE_METRICS = ("host_memcpy_gigabytes",
-                        "single_client_put_gigabytes")
+                        "single_client_put_gigabytes",
+                        "single_client_tasks_async",
+                        "1_1_actor_calls_async")
 
         def window_score(get_value) -> float:
             score = 1.0
