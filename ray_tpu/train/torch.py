@@ -12,6 +12,10 @@ run unchanged on CPU nodes of the same cluster.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
+from ray_tpu.train.config import BackendConfig
+from ray_tpu.train.session import Checkpoint
 from ray_tpu.train.trainer import JaxTrainer
 
 
@@ -24,6 +28,22 @@ class TorchTrainer(JaxTrainer):
 
     _backend_setup = "setup_torch_distributed"
     _setup_single_worker = True
+
+    def __init__(self, *args, torch_config=None, **kwargs):
+        if torch_config is not None and not isinstance(torch_config,
+                                                       TorchConfig):
+            # normalize duck-typed configs so TorchConfig is the ONE
+            # place the gloo constraint lives
+            torch_config = TorchConfig(
+                backend=getattr(torch_config, "backend", "gloo"),
+                timeout_s=getattr(torch_config, "timeout_s", 1800))
+        super().__init__(*args, **kwargs)
+        self.torch_config = torch_config
+        if torch_config is not None:
+            # forwarded to setup_torch_distributed via the rendezvous
+            # payload (init_process_group timeout)
+            self._backend_setup_extra = {
+                "timeout_s": torch_config.timeout_s}
 
 
 def prepare_model(model):
@@ -81,3 +101,99 @@ def prepare_data_loader(loader):
         collate_fn=loader.collate_fn, drop_last=loader.drop_last,
         pin_memory=loader.pin_memory)
     return _EpochDataLoader(new_loader, sampler)
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """(reference: ray.train.torch.TorchConfig) ``backend`` must be
+    gloo here — this image has no CUDA, so nccl cannot initialize;
+    the error names the constraint instead of failing inside
+    torch.distributed."""
+
+    backend: str = "gloo"
+    timeout_s: int = 1800
+
+    def __post_init__(self):
+        if self.backend != "gloo":
+            raise ValueError(
+                f"TorchConfig.backend={self.backend!r}: only gloo is "
+                f"available (CPU-only torch in this image; TPU "
+                f"training is the JaxTrainer's job)")
+
+
+def get_device():
+    """(reference: train.torch.get_device) The device assigned to
+    this worker — CPU in this torch build (TPU compute goes through
+    jax, not torch)."""
+    import torch
+    return torch.device("cpu")
+
+
+def get_devices() -> list:
+    """(reference: train.torch.get_devices)"""
+    return [get_device()]
+
+
+def prepare_optimizer(optimizer):
+    """(reference: train.torch.prepare_optimizer — wraps for AMP;
+    identity here, where CPU gloo training has no AMP scaler)."""
+    return optimizer
+
+
+def backward(tensor) -> None:
+    """(reference: train.torch.backward — scales under AMP; plain
+    backward here)."""
+    tensor.backward()
+
+
+def enable_reproducibility(seed: int = 0) -> None:
+    """Seed torch/numpy/python and force deterministic algorithms
+    (reference: train.torch.enable_reproducibility)."""
+    import os
+    import random
+
+    import numpy as np
+    import torch
+    torch.manual_seed(seed)
+    random.seed(seed)
+    np.random.seed(seed)
+    torch.use_deterministic_algorithms(True, warn_only=True)
+    os.environ.setdefault("PYTHONHASHSEED", str(seed))
+
+
+class TorchCheckpoint(Checkpoint):
+    """Model-state checkpoint (reference:
+    ray.train.torch.TorchCheckpoint): ``from_model`` writes a
+    state_dict into a directory and returns a TorchCheckpoint, so the
+    reference idiom ``ckpt.get_model(model)`` works. The caller owns
+    the directory (``report(checkpoint=...)`` persists a COPY into the
+    trial dir — delete the local one after reporting in checkpoint-
+    per-epoch loops, or pass a ``directory=`` you manage)."""
+
+    FILE = "model_state.pt"
+
+    @classmethod
+    def from_model(cls, model, directory: str | None = None
+                   ) -> "TorchCheckpoint":
+        import os
+        import tempfile
+
+        import torch
+        directory = directory or tempfile.mkdtemp(
+            prefix="torch_ckpt_")
+        os.makedirs(directory, exist_ok=True)
+        state = model.state_dict() if hasattr(model, "state_dict") \
+            else model
+        torch.save(state, os.path.join(directory, cls.FILE))
+        return cls(directory)
+
+    def get_model(self, model):
+        """Load the stored state_dict into ``model`` (returned)."""
+        import os
+
+        import torch
+        path = getattr(self, "path", self)  # tolerates raw paths too
+        state = torch.load(os.path.join(path, TorchCheckpoint.FILE),
+                           weights_only=True)
+        model.load_state_dict(state)
+        return model

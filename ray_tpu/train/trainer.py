@@ -167,7 +167,13 @@ class JaxTrainer:
                 # (possibly remote) host — the driver's loopback means
                 # nothing to a gang spanning node daemons.
                 coordinator = group.coordinator()
-                group.run(self._backend_setup, coordinator,
+                payload = coordinator
+                extra = getattr(self, "_backend_setup_extra", None)
+                if extra:
+                    # backend knobs (e.g. TorchConfig.timeout_s) ride
+                    # the rendezvous payload
+                    payload = (coordinator, extra)
+                group.run(self._backend_setup, payload,
                           timeout=120)
             ctx_kwargs = {
                 "experiment_name": os.path.basename(trial_dir),

@@ -59,20 +59,31 @@ class TrainWorker:
                 process_id=self.rank)
         return True
 
-    def setup_torch_distributed(self, coordinator: str) -> bool:
+    def setup_torch_distributed(self, coordinator) -> bool:
         """torch.distributed gloo process group (reference:
-        _setup_torch_process_group, torch/config.py:115)."""
+        _setup_torch_process_group, torch/config.py:115). The payload
+        is the rendezvous address, optionally tupled with backend
+        knobs ({"timeout_s": ...} from TorchConfig)."""
         import os
 
         import torch.distributed as dist
+        extra: dict = {}
+        if isinstance(coordinator, tuple):
+            coordinator, extra = coordinator
         addr, port = coordinator.rsplit(":", 1)
         os.environ["MASTER_ADDR"] = addr
         os.environ["MASTER_PORT"] = port
         os.environ.setdefault("RANK", str(self.rank))
         os.environ.setdefault("WORLD_SIZE", str(self.world_size))
         if not dist.is_initialized():
+            kwargs = {}
+            if extra.get("timeout_s"):
+                from datetime import timedelta
+                kwargs["timeout"] = timedelta(
+                    seconds=float(extra["timeout_s"]))
             dist.init_process_group(
-                "gloo", rank=self.rank, world_size=self.world_size)
+                "gloo", rank=self.rank, world_size=self.world_size,
+                **kwargs)
         return True
 
     def start_loop(self, fn_and_config: tuple, context_kwargs: dict) -> bool:
