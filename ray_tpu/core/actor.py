@@ -21,7 +21,9 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         from ray_tpu.core.api import get_runtime
+        from ray_tpu.core.remote_function import _unwrap_duck_refs
         from ray_tpu.util.tracing import get_tracer
+        args, kwargs = _unwrap_duck_refs(args, kwargs)
         rt = get_runtime()
         tracer = get_tracer()
         if tracer.enabled:
@@ -123,6 +125,8 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         from ray_tpu.core.api import get_runtime
+        from ray_tpu.core.remote_function import _unwrap_duck_refs
+        args, kwargs = _unwrap_duck_refs(args, kwargs)
         rt = get_runtime()
         if self._cls_blob is None:
             self._cls_blob = ser.dumps(self._cls)

@@ -269,6 +269,15 @@ def put(value) -> ObjectRef:
 
 
 def get(refs, timeout: float | None = None):
+    # Duck-refs (serve DeploymentResponse) unwrap to their ObjectRef.
+    from ray_tpu.core.remote_function import (
+        _is_duck_ref, _unwrap_duck_ref,
+    )
+    if _is_duck_ref(refs):
+        refs = refs._to_object_ref()
+    elif isinstance(refs, (list, tuple)) and any(
+            _is_duck_ref(r) for r in refs):
+        refs = [_unwrap_duck_ref(r) for r in refs]
     # Channel-mode compiled DAGs hand back CompiledDAGRefs (values ride
     # shm channels, not the object store) — unwrap them here so
     # ``ray.get(dag.execute(x))`` works across both modes.
@@ -284,6 +293,8 @@ def get(refs, timeout: float | None = None):
 
 def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
          timeout: float | None = None):
+    from ray_tpu.core.remote_function import _unwrap_duck_ref
+    refs = [_unwrap_duck_ref(r) for r in refs]
     return get_runtime().wait(list(refs), num_returns, timeout)
 
 

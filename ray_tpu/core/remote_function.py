@@ -19,6 +19,29 @@ def _build_resources(num_cpus=None, num_tpus=None, resources=None,
     return out
 
 
+def _is_duck_ref(o) -> bool:
+    # TYPE-level lookup: an instance __getattr__ that answers every
+    # probe (mock objects) must not read as a duck-ref.
+    return getattr(type(o), "_to_object_ref", None) is not None
+
+
+def _unwrap_duck_ref(o):
+    """One duck-ref (serve DeploymentResponse et al) -> its
+    ObjectRef; everything else passes through. THE shared unwrap —
+    get/wait/submission all route here."""
+    return o._to_object_ref() if _is_duck_ref(o) else o
+
+
+def _unwrap_duck_refs(args: tuple, kwargs: dict):
+    """Duck-refs unwrap to their ObjectRef at submission so the
+    runtime's top-level arg resolution sees them."""
+    if any(_is_duck_ref(a) for a in args):
+        args = tuple(_unwrap_duck_ref(a) for a in args)
+    if kwargs and any(_is_duck_ref(v) for v in kwargs.values()):
+        kwargs = {k: _unwrap_duck_ref(v) for k, v in kwargs.items()}
+    return args, kwargs
+
+
 def make_task_options(**opts: Any) -> TaskOptions:
     resources = _build_resources(
         opts.get("num_cpus"), opts.get("num_tpus"), opts.get("resources"))
@@ -83,6 +106,7 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         from ray_tpu.core.api import get_runtime
+        args, kwargs = _unwrap_duck_refs(args, kwargs)
         rt = get_runtime()
         if self._fn_id is None:
             self._fn_id, self._fn_blob = rt.register_function(self._fn)

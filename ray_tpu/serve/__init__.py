@@ -25,6 +25,7 @@ from ray_tpu.serve.api import (
     Application,
     Deployment,
     DeploymentHandle,
+    DeploymentResponse,
     HTTPOptions,
 )
 from ray_tpu.serve.replica import get_replica_context, ReplicaContext
@@ -39,6 +40,6 @@ __all__ = [
     "deployment", "run", "shutdown", "get_deployment_handle", "batch",
     "deploy_config", "status",
     "grpc_ingress_token",
-    "Application", "Deployment", "DeploymentHandle",
+    "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]

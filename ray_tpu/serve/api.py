@@ -100,10 +100,41 @@ class Application:
     init_kwargs: dict
 
 
+def _unwrap_response(ref):
+    return ref
+
+
+class DeploymentResponse:
+    """The future a handle call returns (reference:
+    serve.handle.DeploymentResponse): ``.result(timeout_s=...)``
+    blocks for the value; ``ray_tpu.get(response)`` and passing the
+    response as a task/handle argument both behave exactly like the
+    underlying ObjectRef (it pickles AS the ref)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: float | None = None):
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __reduce__(self):
+        # serializes as the bare ref: downstream tasks/handles see the
+        # same resolution semantics as a plain ObjectRef argument
+        return (_unwrap_response, (self._ref,))
+
+    def __repr__(self):
+        return f"DeploymentResponse({self._ref!r})"
+
+
 class DeploymentHandle:
     """Client handle routing to a deployment's replicas (reference:
     handle.py:710). ``handle.remote(...)`` and
-    ``handle.method.remote(...)`` return ObjectRefs."""
+    ``handle.method.remote(...)`` return
+    :class:`DeploymentResponse` futures (streaming calls return the
+    generator directly)."""
 
     def __init__(self, deployment_name: str, controller=None,
                  multiplexed_model_id: str = "", stream: bool = False):
@@ -130,9 +161,10 @@ class DeploymentHandle:
         return h
 
     def remote(self, *args, **kwargs):
-        return self._router.assign("__call__", args, kwargs,
-                                   multiplexed_model_id=self._model_id,
-                                   stream=self._stream)
+        out = self._router.assign("__call__", args, kwargs,
+                                  multiplexed_model_id=self._model_id,
+                                  stream=self._stream)
+        return out if self._stream else DeploymentResponse(out)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -144,10 +176,12 @@ class DeploymentHandle:
                 self._name = name
 
             def remote(self, *args, **kwargs):
-                return self._outer._router.assign(
+                out = self._outer._router.assign(
                     self._name, args, kwargs,
                     multiplexed_model_id=self._outer._model_id,
                     stream=self._outer._stream)
+                return out if self._outer._stream \
+                    else DeploymentResponse(out)
 
         return _Method(self, method)
 
@@ -181,9 +215,13 @@ def _ensure_controller():
             max_concurrency=16).remote()
 
 
-def _deploy_tree(app: Application, controller) -> str:
+def _deploy_tree(app: Application, controller,
+                 root_name: str | None = None) -> str:
     """Deploy nested Applications depth-first; replace them with
-    DeploymentHandles in the parent's init args."""
+    DeploymentHandles in the parent's init args. ``root_name``
+    overrides the ROOT (ingress) deployment's name — the
+    serve.run(name=...) application name (apps and their ingress
+    deployments share a name here)."""
     def resolve(v):
         if isinstance(v, Application):
             child = _deploy_tree(v, controller)
@@ -193,24 +231,26 @@ def _deploy_tree(app: Application, controller) -> str:
     args = tuple(resolve(a) for a in app.init_args)
     kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
     d = app.deployment
+    name = root_name or d.name
     resources = dict(d.ray_actor_options.get("resources", {}))
     if "num_cpus" in d.ray_actor_options:
         resources["CPU"] = d.ray_actor_options["num_cpus"]
     if "num_tpus" in d.ray_actor_options:
         resources["TPU"] = d.ray_actor_options["num_tpus"]
     ray_tpu.get(controller.deploy.remote(
-        d.name, ser.dumps(d.cls), args, kwargs, d.num_replicas,
+        name, ser.dumps(d.cls), args, kwargs, d.num_replicas,
         resources, d.autoscaling_config), timeout=120)
-    return d.name
+    return name
 
 
-def run(app: Application, *, route_prefix: str = "/",
+def run(app: Application, *, name: str | None = None,
+        route_prefix: str = "/",
         http_port: int | None = None,
         grpc_port: int | None = None,
         blocking: bool = False) -> DeploymentHandle:
     global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
     controller = _ensure_controller()
-    name = _deploy_tree(app, controller)
+    name = _deploy_tree(app, controller, root_name=name)
     # wait until replicas are live
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:

@@ -58,6 +58,20 @@ class Replica:
             self._inflight += 1
             self._total += 1
         _set_current_model_id(multiplexed_model_id)
+        # Composition: DeploymentResponse args pickle as bare
+        # ObjectRefs nested in the request payload — resolve them to
+        # VALUES before user code runs (reference: Serve resolves
+        # response arguments before invoking the replica method).
+        from ray_tpu.core.object_ref import ObjectRef
+        if any(isinstance(a, ObjectRef) for a in args):
+            import ray_tpu as _ray
+            args = tuple(_ray.get(a) if isinstance(a, ObjectRef)
+                         else a for a in args)
+        if kwargs and any(isinstance(v, ObjectRef)
+                          for v in kwargs.values()):
+            import ray_tpu as _ray
+            kwargs = {k: _ray.get(v) if isinstance(v, ObjectRef)
+                      else v for k, v in kwargs.items()}
         streaming = False
         try:
             target = (self.callable if method_name == "__call__"

@@ -192,8 +192,8 @@ class TorchCheckpoint(Checkpoint):
         import os
 
         import torch
-        path = getattr(self, "path", self)  # tolerates raw paths too
-        state = torch.load(os.path.join(path, TorchCheckpoint.FILE),
-                           weights_only=True)
+        state = torch.load(
+            os.path.join(self.path, TorchCheckpoint.FILE),
+            weights_only=True)
         model.load_state_dict(state)
         return model

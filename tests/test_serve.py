@@ -193,3 +193,46 @@ def test_serve_compat_surface(rt):
     assert "CompatApp" not in serve.status()["deployments"]
     assert serve.delete("never_deployed") is False
     serve.shutdown()
+
+
+def test_deployment_response_surface(rt):
+    """handle.remote() returns a DeploymentResponse (reference:
+    serve.handle.DeploymentResponse): .result() blocks; ray_tpu.get
+    and composition-as-argument behave like the underlying ref."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def plus(self, x, y):
+            return x + y
+
+    h = serve.run(Doubler.bind(), name="resp_app")
+    resp = h.remote(21)
+    assert isinstance(resp, serve.DeploymentResponse)
+    assert resp.result(timeout_s=60) == 42
+    assert ray_tpu.get(h.plus.remote(1, 2), timeout=60) == 3
+    # a response passed as an argument resolves like a ref
+    @ray_tpu.remote
+    def consume(v):
+        return v + 1
+
+    assert ray_tpu.get(consume.remote(h.remote(10)), timeout=60) == 21
+    # composition: a response passed to ANOTHER handle call resolves
+    # to its value before the replica method runs
+    assert h.remote(h.remote(5)).result(timeout_s=60) == 20
+    # actor constructors resolve responses too
+    @ray_tpu.remote(num_cpus=0)
+    class Holder:
+        def __init__(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    a = Holder.remote(h.remote(7))
+    assert ray_tpu.get(a.get.remote(), timeout=60) == 14
+    ray_tpu.kill(a)
+    serve.delete("resp_app")
