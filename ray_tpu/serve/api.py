@@ -121,9 +121,12 @@ class DeploymentResponse:
         return self._ref
 
     def __reduce__(self):
-        # serializes as the bare ref: downstream tasks/handles see the
-        # same resolution semantics as a plain ObjectRef argument
-        return (_unwrap_response, (self._ref,))
+        # TYPE-PRESERVING: replicas must distinguish a composition
+        # response (resolve to value before user code) from a user-
+        # passed ObjectRef (pass through untouched). Top-level task/
+        # actor args never reach here — submission unwraps duck-refs
+        # first.
+        return (DeploymentResponse, (self._ref,))
 
     def __repr__(self):
         return f"DeploymentResponse({self._ref!r})"

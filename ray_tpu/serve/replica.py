@@ -58,20 +58,25 @@ class Replica:
             self._inflight += 1
             self._total += 1
         _set_current_model_id(multiplexed_model_id)
-        # Composition: DeploymentResponse args pickle as bare
-        # ObjectRefs nested in the request payload — resolve them to
-        # VALUES before user code runs (reference: Serve resolves
-        # response arguments before invoking the replica method).
-        from ray_tpu.core.object_ref import ObjectRef
-        if any(isinstance(a, ObjectRef) for a in args):
+        # Composition: DeploymentResponse args (type-preserved through
+        # pickling) resolve to VALUES before user code runs
+        # (reference: Serve resolves response arguments before
+        # invoking the replica method). Plain ObjectRef args pass
+        # through untouched — a deployment whose contract is
+        # "receives a ref" keeps its ref.
+        from ray_tpu.serve.api import DeploymentResponse
+        if any(isinstance(a, DeploymentResponse) for a in args):
             import ray_tpu as _ray
-            args = tuple(_ray.get(a) if isinstance(a, ObjectRef)
-                         else a for a in args)
-        if kwargs and any(isinstance(v, ObjectRef)
+            args = tuple(
+                _ray.get(a._to_object_ref())
+                if isinstance(a, DeploymentResponse) else a
+                for a in args)
+        if kwargs and any(isinstance(v, DeploymentResponse)
                           for v in kwargs.values()):
             import ray_tpu as _ray
-            kwargs = {k: _ray.get(v) if isinstance(v, ObjectRef)
-                      else v for k, v in kwargs.items()}
+            kwargs = {k: (_ray.get(v._to_object_ref())
+                          if isinstance(v, DeploymentResponse) else v)
+                      for k, v in kwargs.items()}
         streaming = False
         try:
             target = (self.callable if method_name == "__call__"

@@ -91,19 +91,53 @@ def _load1() -> float:
         return 0.0
 
 
+PYTEST_PID_DIR = "/tmp/ray_tpu_pytest_pids"
+
+
 def _pytest_running() -> bool:
     """load1 is a 1-minute EMA: a test suite that JUST started reads
     as an idle host, and a capture launched into that window both
     reads low AND starves the suite into timing failures (r5: 9
     test_data TaskErrors from a capture landing at suite start).
-    pgrep is instantaneous."""
-    import subprocess
+
+    Detection is a PIDFILE protocol (tests/conftest.py drops
+    <dir>/<pid> at session start), NOT pgrep -f: any unrelated
+    process whose cmdline merely CONTAINS 'pytest' (r5: the build
+    driver's own prompt text) would read as a live suite. Stale
+    files from killed suites are reaped by pid liveness."""
     try:
-        out = subprocess.run(["pgrep", "-fc", "pytest"],
-                             capture_output=True, timeout=10)
-        return int(out.stdout.strip() or 0) > 0
-    except Exception:  # noqa: BLE001
+        entries = os.listdir(PYTEST_PID_DIR)
+    except OSError:
         return False
+    alive = False
+    now = time.time()
+    for name in entries:
+        path = os.path.join(PYTEST_PID_DIR, name)
+        try:
+            pid = int(name)
+        except ValueError:
+            continue
+        # Pid REUSE bound: a SIGKILLed suite never removes its file;
+        # if the OS recycles that pid for a long-lived process the
+        # liveness probe would defer captures forever. No suite here
+        # runs 6 h — an older pidfile is stale by construction.
+        try:
+            if now - os.path.getmtime(path) > 6 * 3600:
+                os.unlink(path)
+                continue
+        except OSError:
+            continue
+        try:
+            os.kill(pid, 0)
+            alive = True
+        except ProcessLookupError:
+            try:  # dead suite: reap its pidfile
+                os.unlink(path)
+            except OSError:
+                pass
+        except PermissionError:
+            alive = True  # alive under another uid — NOT dead
+    return alive
 
 
 # A capture launched while other work owns the CPU reads 10-20% low

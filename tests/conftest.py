@@ -62,3 +62,29 @@ def rt_local():
     ray_tpu.init(local_mode=True)
     yield ray_tpu
     ray_tpu.shutdown()
+
+
+# -- bench-watcher coordination (scripts/bench_watch.py) ---------------
+# A pidfile marks "a pytest session is live on this host" so the
+# on-chip bench watcher defers captures (a capture starting alongside
+# a suite starves BOTH on this 1-core box). pgrep can't do this: the
+# build driver's own cmdline contains the word "pytest".
+
+_PYTEST_PID_DIR = "/tmp/ray_tpu_pytest_pids"
+
+
+def pytest_sessionstart(session):
+    try:
+        os.makedirs(_PYTEST_PID_DIR, exist_ok=True)
+        with open(os.path.join(_PYTEST_PID_DIR,
+                               str(os.getpid())), "w") as f:
+            f.write("1")
+    except OSError:
+        pass
+
+
+def pytest_sessionfinish(session, exitstatus):
+    try:
+        os.unlink(os.path.join(_PYTEST_PID_DIR, str(os.getpid())))
+    except OSError:
+        pass

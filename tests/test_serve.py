@@ -236,3 +236,25 @@ def test_deployment_response_surface(rt):
     assert ray_tpu.get(a.get.remote(), timeout=60) == 14
     ray_tpu.kill(a)
     serve.delete("resp_app")
+
+
+def test_plain_objectref_args_pass_through_to_replica(rt):
+    """Only DeploymentResponses resolve replica-side; a USER-passed
+    ObjectRef keeps its ref contract (review regression)."""
+    from ray_tpu import serve
+    from ray_tpu.core.object_ref import ObjectRef
+
+    @serve.deployment
+    class RefStore:
+        def kind(self, maybe_ref):
+            if isinstance(maybe_ref, ObjectRef):
+                return ("ref", ray_tpu.get(maybe_ref))
+            return ("value", maybe_ref)
+
+    h = serve.run(RefStore.bind(), name="refstore_app")
+    ref = ray_tpu.put(123)
+    assert h.kind.remote(ref).result(timeout_s=60) == ("ref", 123)
+    # while a composition response resolves to its value
+    assert h.kind.remote(h.kind.remote(ref)).result(timeout_s=60) == \
+        ("value", ("ref", 123))
+    serve.delete("refstore_app")
