@@ -92,6 +92,11 @@ def init(num_cpus: int | None = None,
          log_to_driver: bool = True,
          cluster_token: str | bytes | None = None,
          logging_config=None,
+         num_gpus: int | None = None,
+         object_store_memory: int | None = None,
+         namespace: str | None = None,
+         include_dashboard: bool | None = None,
+         dashboard_port: int | None = None,
          _system_config: dict[str, Any] | None = None):
     """Start the single-node runtime in this process (driver), or —
     with ``address`` — connect this process as a CLIENT of a running
@@ -122,8 +127,17 @@ def init(num_cpus: int | None = None,
             # workers/daemons inherit it (worker_entry applies it).
             logging_config._apply()
             logging_config._export_env()
+        if namespace is not None:
+            import warnings
+            warnings.warn(
+                "ray_tpu has no actor namespaces: named actors are "
+                "cluster-global; namespace=%r is ignored" % namespace,
+                stacklevel=2)
         if address is not None:
             bad = {"num_cpus": num_cpus, "num_tpus": num_tpus,
+                   "num_gpus": num_gpus,
+                   "object_store_memory": object_store_memory,
+                   "include_dashboard": include_dashboard,
                    "resources": resources,
                    "_system_config": _system_config}
             passed = [k for k, v in bad.items() if v]
@@ -155,6 +169,28 @@ def init(num_cpus: int | None = None,
                 _runtime.default_runtime_env = dict(runtime_env)
             atexit.register(_shutdown_at_exit)
             return _runtime
+        # Reference-signature compat kwargs with REAL mappings (driver
+        # path only — address-mode rejects them above). Conflicts with
+        # an explicit entry raise, never silently lose.
+        if num_gpus:
+            # no CUDA in this stack; schedulable as a plain resource
+            resources = dict(resources or {})
+            if "GPU" in resources and \
+                    resources["GPU"] != float(num_gpus):
+                raise ValueError(
+                    f"num_gpus={num_gpus} conflicts with "
+                    f"resources['GPU']={resources['GPU']}")
+            resources["GPU"] = float(num_gpus)
+        if object_store_memory is not None:
+            _system_config = dict(_system_config or {})
+            prior = _system_config.get("object_store_memory")
+            if prior is not None and prior != int(object_store_memory):
+                raise ValueError(
+                    f"object_store_memory={object_store_memory} "
+                    f"conflicts with _system_config"
+                    f"['object_store_memory']={prior}")
+            _system_config["object_store_memory"] = \
+                int(object_store_memory)
         cfg = Config.from_env(_system_config)
         set_config(cfg)
         from ray_tpu.core.runtime import DriverRuntime
@@ -165,6 +201,13 @@ def init(num_cpus: int | None = None,
             cfg, num_cpus=num_cpus, num_tpus=num_tpus,
             resources=resources, local_mode=local_mode,
             runtime_env=runtime_env, log_to_driver=log_to_driver)
+        if include_dashboard:
+            from ray_tpu.dashboard.head import start_dashboard
+            # kept on the runtime: callers reach the bound port via
+            # get_runtime()._dashboard.port
+            _runtime._dashboard = start_dashboard(
+                port=dashboard_port
+                if dashboard_port is not None else 8265)
         atexit.register(_shutdown_at_exit)
         return _runtime
 
@@ -232,6 +275,12 @@ def shutdown() -> None:
         rt = _runtime
         _runtime = None
         reset_config()
+    dash = getattr(rt, "_dashboard", None)
+    if dash is not None:
+        try:  # init(include_dashboard=True) owns this server
+            dash.stop()
+        except Exception:  # noqa: BLE001
+            pass
     rt.shutdown()
 
 

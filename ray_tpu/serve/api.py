@@ -100,16 +100,14 @@ class Application:
     init_kwargs: dict
 
 
-def _unwrap_response(ref):
-    return ref
-
-
 class DeploymentResponse:
     """The future a handle call returns (reference:
     serve.handle.DeploymentResponse): ``.result(timeout_s=...)``
-    blocks for the value; ``ray_tpu.get(response)`` and passing the
-    response as a task/handle argument both behave exactly like the
-    underlying ObjectRef (it pickles AS the ref)."""
+    blocks for the value; ``ray_tpu.get(response)``/``wait`` and
+    top-level task/actor arguments unwrap to the underlying
+    ObjectRef, and a response passed to ANOTHER handle call resolves
+    to its VALUE in the replica (composition) — while user-passed
+    plain ObjectRefs keep their ref contract."""
 
     def __init__(self, ref):
         self._ref = ref

@@ -134,3 +134,43 @@ def test_show_in_dashboard(rt):
     got = _kv_get(f"worker_msg:{os.getpid()}|phase",
                   namespace="dashboard")
     assert got == b"training step 7"
+
+
+def test_init_reference_kwargs():
+    """init() accepts the reference's common kwargs with real
+    mappings: num_gpus -> GPU resource, object_store_memory ->
+    system config, namespace -> loud warning (actors are global),
+    include_dashboard/dashboard_port -> dashboard on the runtime.
+    Runs in a subprocess — this module's shared runtime is live."""
+    script = textwrap.dedent("""
+        import urllib.request
+        import warnings
+
+        import ray_tpu
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            ray_tpu.init(num_cpus=2, num_gpus=2,
+                         object_store_memory=32 << 20,
+                         namespace="nsX",
+                         include_dashboard=True, dashboard_port=0)
+            assert any("namespace" in str(x.message) for x in w)
+        try:
+            assert ray_tpu.cluster_resources().get("GPU") == 2.0
+            from ray_tpu.core.config import get_config
+            assert get_config().object_store_memory == 32 << 20
+            from ray_tpu.core.api import get_runtime
+            dash = get_runtime()._dashboard
+            assert dash is not None and dash.port > 0
+            body = urllib.request.urlopen(
+                "http://127.0.0.1:%d/api/nodes" % dash.port,
+                timeout=10).read()
+            assert body.startswith(b"[") or body.startswith(b"{")
+        finally:
+            ray_tpu.shutdown()
+        print("INIT_KWARGS_OK")
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "INIT_KWARGS_OK" in out.stdout
