@@ -370,7 +370,16 @@ def kill(handle: ActorHandle, no_restart: bool = True) -> None:
     get_runtime().kill_actor(handle.actor_id, no_restart)
 
 
-def get_actor(name: str) -> ActorHandle:
+def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    """(reference: ray.get_actor) ``namespace`` is accepted for
+    signature compatibility and warned about — named actors are
+    cluster-global here (same contract as init(namespace=...))."""
+    if namespace is not None:
+        import warnings
+        warnings.warn(
+            "ray_tpu has no actor namespaces: named actors are "
+            "cluster-global; namespace=%r is ignored" % namespace,
+            stacklevel=2)
     actor_id = get_runtime().get_named_actor(name)
     return ActorHandle(actor_id)
 

@@ -40,6 +40,29 @@ class Checkpointable:
             f.write(blob)
         return path
 
+    def save(self, checkpoint_dir: str | None = None) -> str:
+        """Classic alias (reference: Algorithm.save, which writes
+        into the algorithm's logdir): with no dir, saves are numbered
+        under ONE stable per-instance directory — repeated save()
+        calls in a training loop don't scatter /tmp, and the returned
+        path of the latest call is always the newest checkpoint."""
+        if checkpoint_dir is None:
+            base = getattr(self, "_default_ckpt_dir", None)
+            if base is None:
+                import tempfile
+                base = tempfile.mkdtemp(prefix="rllib_ckpt_")
+                self._default_ckpt_dir = base
+                self._default_ckpt_seq = 0
+            self._default_ckpt_seq += 1
+            import os as _os
+            checkpoint_dir = _os.path.join(
+                base, f"checkpoint_{self._default_ckpt_seq:06d}")
+        return self.save_to_path(checkpoint_dir)
+
+    def restore(self, checkpoint_path: str) -> None:
+        """Classic alias (reference: Algorithm.restore)."""
+        self.restore_from_path(checkpoint_path)
+
     def restore_from_path(self, path: str) -> None:
         if is_uri(path):
             uri = uri_join(path, _STATE_FILE)

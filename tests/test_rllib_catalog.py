@@ -126,3 +126,26 @@ def test_algorithm_checkpoint_roundtrip(rt, tmp_path, monkeypatch):
             r2.stop()
     finally:
         algo.stop()
+
+
+def test_algorithm_save_restore_aliases(tmp_path):
+    """Classic Algorithm.save()/restore() aliases over the
+    Checkpointable path (reference: Algorithm.save/restore)."""
+    from ray_tpu.rllib.checkpoints import Checkpointable
+
+    class Toy(Checkpointable):
+        def __init__(self):
+            self.v = 0
+
+        def get_state(self):
+            return {"v": self.v}
+
+        def set_state(self, state):
+            self.v = state["v"]
+
+    t = Toy()
+    t.v = 41
+    path = t.save(str(tmp_path / "ck"))
+    t2 = Toy()
+    t2.restore(path)
+    assert t2.v == 41
