@@ -1,0 +1,59 @@
+"""Durable workflows: events, dynamic continuations, crash resume."""
+
+import os
+import tempfile
+import time
+
+import ray_tpu
+from ray_tpu import workflow
+
+ray_tpu.init(num_cpus=2)
+workflow.init(tempfile.mkdtemp())
+
+# -- event step: the workflow parks until the event fires ------------
+marker = tempfile.mktemp()
+
+
+class FileEvent(workflow.EventListener):
+    def poll_for_event(self, path):
+        while not os.path.exists(path):
+            time.sleep(0.05)
+        with open(path) as f:
+            return f.read()
+
+
+@ray_tpu.remote
+def announce(payload):
+    return f"event said: {payload}"
+
+
+wid = workflow.run_async(
+    announce.bind(workflow.wait_for_event(FileEvent, marker)))
+print("status while waiting:", workflow.get_status(wid))
+with open(marker, "w") as f:
+    f.write("go!")
+print(workflow.get_output(wid, timeout=60))
+
+# -- dynamic workflow: steps return continuations --------------------
+
+
+@ray_tpu.remote
+def fib(n):
+    if n <= 1:
+        return n
+    return workflow.continuation(add.bind(fib.bind(n - 1),
+                                          fib.bind(n - 2)))
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+print("fib(9) =", workflow.run(fib.bind(9), workflow_id="fib9",
+                               timeout=120))
+# completed steps are durable: this resume is a cache read
+print("resumed =", workflow.resume("fib9", timeout=60))
+
+ray_tpu.shutdown()
+print("ok")
