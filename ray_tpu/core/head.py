@@ -38,7 +38,8 @@ def run_head(port: int, token: bytes,
              journal_dir: str | None = None,
              journal_interval_s: float = 0.25,
              adopt_grace_s: float = 8.0,
-             host: str = "0.0.0.0"):
+             host: str = "0.0.0.0",
+             num_tpus: int | None = None):
     """Start the head runtime; returns (runtime, stop_event)."""
     from ray_tpu.core import api
     from ray_tpu.core.config import Config, set_config
@@ -46,7 +47,7 @@ def run_head(port: int, token: bytes,
     cfg = Config.from_env()
     set_config(cfg)
     from ray_tpu.core.runtime import DriverRuntime
-    rt = DriverRuntime(cfg, num_cpus=num_cpus)
+    rt = DriverRuntime(cfg, num_cpus=num_cpus, num_tpus=num_tpus)
     api._set_runtime(rt)
     rt.cluster_token = token
 

@@ -152,6 +152,144 @@ def _cmd_usage(args) -> int:
     return 1
 
 
+def _write_head_info(path: str, info: dict) -> None:
+    """Token inside: owner-only (0600 enforced via fchmod on OUR fd,
+    so a pre-existing world-readable file can't keep its mode) and
+    ATOMIC (temp + rename — pollers never observe a half-written
+    JSON)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL
+                 | getattr(os, "O_NOFOLLOW", 0), 0o600)
+    try:
+        os.fchmod(fd, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(info, f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _cmd_start(args) -> int:
+    """``ray-tpu start --head`` / ``--address`` (reference: ray start,
+    scripts.py — the manual-deployment pair to ``up``'s providers).
+
+    --head runs the standalone head daemon (core/head.py run_head —
+    fixed port, 0.0.0.0 bind, optional restart journal) in THIS
+    process (foreground; Ctrl-C / SIGTERM shuts down cleanly) and
+    writes a head-info file (client socket, TCP join address, cluster
+    token — 0600, atomic) that node joins and clients discover.
+    --address joins this machine to that head as a node daemon
+    (foreground)."""
+    import signal
+
+    if args.head:
+        import secrets
+
+        from ray_tpu.core.head import run_head
+        token_hex = os.environ.get("RAY_TPU_CLUSTER_TOKEN") \
+            or secrets.token_hex(16)
+        rt, stop = run_head(
+            args.port, bytes.fromhex(token_hex),
+            num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+            journal_dir=args.journal or None,
+            host=args.host)
+        if args.dashboard:
+            from ray_tpu.dashboard.head import start_dashboard
+            rt._dashboard = start_dashboard(port=args.dashboard_port)
+        path = args.head_info_file
+        _write_head_info(path, {
+            "client_address": rt.client_address,
+            "tcp_address": f"{args.host}:{args.port}",
+            "token": token_hex,
+            "pid": os.getpid(),
+        })
+        print(f"head up. clients: init(address="
+              f"{rt.client_address!r})  |  join a node:\n"
+              f"  ray-tpu start --address {args.host}:{args.port} "
+              f"--head-info-file {path}", flush=True)
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        try:
+            while not stop.is_set():
+                stop.wait(1.0)
+        except KeyboardInterrupt:
+            pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        from ray_tpu.core import api as _api
+        _api.shutdown()
+        return 0
+
+    if not args.address:
+        raise SystemExit("pass --head or --address HOST:PORT")
+    token = os.environ.get("RAY_TPU_CLUSTER_TOKEN")
+    if not token and os.path.exists(args.head_info_file):
+        with open(args.head_info_file) as f:
+            token = json.load(f).get("token")
+    if not token:
+        raise SystemExit(
+            "joining needs the cluster token: RAY_TPU_CLUSTER_TOKEN "
+            "env or --head-info-file written by `start --head`")
+    env = dict(os.environ)
+    env["RAY_TPU_CLUSTER_TOKEN"] = token
+    import subprocess
+    cmd = [sys.executable, "-m", "ray_tpu.core.node_daemon",
+           "--address", args.address,
+           "--resources", json.dumps({}),
+           "--labels", json.dumps({})]
+    # only forward what the operator set: the daemon autodetects
+    # cpus (and str(None) would crash its float parser)
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        cmd += ["--num-tpus", str(args.num_tpus)]
+    return subprocess.call(cmd, env=env)
+
+
+def _cmd_stop(args) -> int:
+    """``ray-tpu stop`` (reference: ray stop): SIGTERM every live
+    session head found under /tmp/ray_tpu_sessions (graceful —
+    daemons/workers shut down with their head)."""
+    import signal
+
+    stopped = 0
+    for sock in glob.glob("/tmp/ray_tpu_sessions/*/runtime.sock"):
+        pid_s = os.path.basename(os.path.dirname(sock))
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid == os.getpid():
+            continue
+        # Stale-dir guard against pid recycling: only signal a LIVE
+        # python process (a SIGKILLed head leaves its session dir;
+        # the recycled pid could be anything).
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read()
+        except OSError:
+            continue
+        if b"python" not in cmdline:
+            print(f"skipping {pid}: not a python process "
+                  f"(stale session dir?)", file=sys.stderr)
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped += 1
+            print(f"stopped session head {pid}")
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            print(f"no permission to stop {pid}", file=sys.stderr)
+    print(f"{stopped} session(s) signaled")
+    return 0
+
+
 def _cmd_doctor(args) -> int:
     print("== ray_tpu doctor ==")
     import ray_tpu
@@ -274,6 +412,30 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("metrics", help="prometheus metrics dump")
     p.set_defaults(fn=_cmd_metrics)
+
+    p = sub.add_parser(
+        "start", help="start a standalone head (--head) or join this "
+                      "machine to one (--address)")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None,
+                   help="head TCP address HOST:PORT to join")
+    p.add_argument("--port", type=int, default=6385,
+                   help="head TCP port (fixed, so daemons reconnect "
+                        "across head restarts)")
+    p.add_argument("--host", default="0.0.0.0",
+                   help="head TCP bind host")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--journal", default="",
+                   help="journal dir: head state survives restarts")
+    p.add_argument("--dashboard", action="store_true")
+    p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--head-info-file",
+                   default="/tmp/ray_tpu_head.json")
+    p.set_defaults(fn=_cmd_start)
+
+    p = sub.add_parser("stop", help="stop every live session head")
+    p.set_defaults(fn=_cmd_stop)
 
     p = sub.add_parser("doctor", help="environment checks")
     p.set_defaults(fn=_cmd_doctor)

@@ -1,0 +1,84 @@
+"""``ray-tpu start/stop`` manual deployment (reference: ray start
+--head / ray start --address / ray stop, scripts.py): standalone head
+in its own process, a node joins by TCP address + token from the
+head-info file, a client connects and uses the merged capacity, and
+``stop`` tears the head down (cleaning its info file)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_start_join_stop(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    info_file = str(tmp_path / "head_info.json")
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+         "--head", "--num-cpus", "2", "--port", "6391",
+         "--host", "127.0.0.1", "--head-info-file", info_file],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    node = None
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and \
+                not os.path.exists(info_file):
+            time.sleep(0.2)
+        assert os.path.exists(info_file), "head info never appeared"
+        info = json.load(open(info_file))
+        assert (os.stat(info_file).st_mode & 0o777) == 0o600
+
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+             "--address", info["tcp_address"], "--num-cpus", "3",
+             "--head-info-file", info_file],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+        client = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "import ray_tpu\n"
+             "import time\n"
+             "ray_tpu.init(address=sys.argv[1], "
+             "cluster_token=sys.argv[2])\n"
+             "deadline = time.monotonic() + 60\n"
+             "while time.monotonic() < deadline:\n"
+             "    if ray_tpu.cluster_resources().get('CPU', 0) >= 5:\n"
+             "        break\n"
+             "    time.sleep(0.3)\n"
+             "assert ray_tpu.cluster_resources()['CPU'] >= 5\n"
+             "@ray_tpu.remote\n"
+             "def f():\n"
+             "    return 7\n"
+             "assert ray_tpu.get(f.remote(), timeout=60) == 7\n"
+             "ray_tpu.shutdown()\n"
+             "print('CLIENT_OK')",
+             info["client_address"], info["token"]],
+            env=env, capture_output=True, text=True, timeout=240,
+            cwd=REPO_ROOT)
+        assert client.returncode == 0, client.stderr[-2000:]
+        assert "CLIENT_OK" in client.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "stop"],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert "session(s) signaled" in out.stdout, out.stdout
+        head.wait(timeout=60)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and os.path.exists(info_file):
+            time.sleep(0.2)
+        assert not os.path.exists(info_file), "head info not cleaned"
+    finally:
+        for p in (node, head):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
