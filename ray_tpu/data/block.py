@@ -19,6 +19,15 @@ def to_block(rows_or_batch) -> "pyarrow.Table":  # noqa: F821
 
     if isinstance(rows_or_batch, pa.Table):
         return rows_or_batch
+    # pandas DataFrames (batch_format="pandas" UDF outputs) convert
+    # directly; the MODULE is the marker — a polars/cuDF "DataFrame"
+    # must fall to the clear TypeError below, not into
+    # pa.Table.from_pandas's internals.
+    if type(rows_or_batch).__name__ == "DataFrame" and \
+            type(rows_or_batch).__module__.partition(".")[0] == \
+            "pandas":
+        return pa.Table.from_pandas(rows_or_batch,
+                                    preserve_index=False)
     if isinstance(rows_or_batch, dict):
         return pa.table({
             k: _to_arrow_array(v) for k, v in rows_or_batch.items()})

@@ -135,6 +135,7 @@ class _MapBatches:
     fn: Callable
     fn_kwargs: dict = field(default_factory=dict)
     compute: ActorPoolStrategy | None = None
+    batch_format: str = "numpy"   # numpy | pandas | pyarrow
 
 
 @dataclass
@@ -195,6 +196,45 @@ class _Union:
 _FUSABLE = (_MapBatches, _MapRows, _FlatMap, _Filter)
 
 
+def _convert_for(batch_format: str):
+    """Block -> batch converter for one batch_format (shared by
+    map_batches, Dataset.iter_batches, DataIterator.iter_batches and
+    the actor-pool workers — one definition of the format contract)."""
+    if batch_format == "numpy":
+        return block_to_batch
+    if batch_format == "pandas":
+        return lambda b: b.to_pandas()
+    if batch_format == "pyarrow":
+        return lambda b: b
+    raise ValueError(
+        f"batch_format must be numpy|pandas|pyarrow, got "
+        f"{batch_format!r}")
+
+
+def _batched_blocks(blocks, batch_size, drop_last, convert):
+    """THE batching loop (carry partial blocks across block
+    boundaries) — exists once; both iterator surfaces wrap it."""
+    carry = None
+    for block in blocks:
+        if block.num_rows == 0:
+            continue
+        if batch_size is None:
+            yield convert(block)
+            continue
+        block = block if carry is None else concat_blocks(
+            [carry, block])
+        carry = None
+        start = 0
+        while start + batch_size <= block.num_rows:
+            yield convert(slice_block(block, start,
+                                      start + batch_size))
+            start += batch_size
+        if start < block.num_rows:
+            carry = slice_block(block, start, block.num_rows)
+    if carry is not None and not drop_last:
+        yield convert(carry)
+
+
 def _concat_row_slices(picks: list, schema_block):
     """One block from (block, start, end) row slices; an empty pick
     list yields a zero-row block with the dataset's schema."""
@@ -211,7 +251,13 @@ def _apply_fused(block, ops: list):
     worker task)."""
     for op in ops:
         if isinstance(op, _MapBatches):
-            batch = block_to_batch(block)
+            fmt = getattr(op, "batch_format", "numpy")
+            if fmt == "pandas":
+                batch = block.to_pandas()
+            elif fmt == "pyarrow":
+                batch = block
+            else:
+                batch = block_to_batch(block)
             out = op.fn(batch, **op.fn_kwargs)
             block = to_block(out)
         elif isinstance(op, _MapRows):
@@ -257,6 +303,7 @@ class Dataset:
         return Dataset(self._plan + [op])
 
     def map_batches(self, fn: Callable, *, compute=None,
+                    batch_format: str = "numpy",
                     **fn_kwargs) -> "Dataset":
         # Legacy string forms (classic ray.data): "tasks" == default,
         # "actors" == a default-sized pool. Anything else must be an
@@ -270,7 +317,12 @@ class Dataset:
             raise TypeError(
                 f"compute= must be None, 'tasks', 'actors', or an "
                 f"ActorPoolStrategy; got {compute!r}")
-        return self._append(_MapBatches(fn, fn_kwargs, compute))
+        if batch_format not in ("numpy", "pandas", "pyarrow"):
+            raise ValueError(
+                f"batch_format must be numpy|pandas|pyarrow, got "
+                f"{batch_format!r}")
+        return self._append(_MapBatches(fn, fn_kwargs, compute,
+                                        batch_format))
 
     def map(self, fn: Callable) -> "Dataset":
         return self._append(_MapRows(fn))
@@ -471,27 +523,15 @@ class Dataset:
 
     def iter_batches(self, batch_size: int | None = None,
                      drop_last: bool = False,
-                     max_in_flight: int | None = None
-                     ) -> Iterator[dict[str, np.ndarray]]:
-        carry = None
-        for block in self.iter_blocks(max_in_flight):
-            if block.num_rows == 0:
-                continue
-            if batch_size is None:
-                yield block_to_batch(block)
-                continue
-            block = block if carry is None else concat_blocks(
-                [carry, block])
-            carry = None
-            start = 0
-            while start + batch_size <= block.num_rows:
-                yield block_to_batch(
-                    slice_block(block, start, start + batch_size))
-                start += batch_size
-            if start < block.num_rows:
-                carry = slice_block(block, start, block.num_rows)
-        if carry is not None and not drop_last:
-            yield block_to_batch(carry)
+                     max_in_flight: int | None = None,
+                     batch_format: str = "numpy"
+                     ) -> Iterator:
+        """Batches as numpy dicts (default), pandas DataFrames, or
+        pyarrow Tables per ``batch_format``. NOT a generator itself:
+        a bad batch_format raises HERE, at the call site."""
+        convert = _convert_for(batch_format)
+        return _batched_blocks(self.iter_blocks(max_in_flight),
+                               batch_size, drop_last, convert)
 
     def iter_rows(self) -> Iterator[dict]:
         for block in self.iter_blocks():
@@ -1014,27 +1054,11 @@ class DataIterator:
                 yield ref
 
     def iter_batches(self, batch_size: int | None = None,
-                     drop_last: bool = False):
-        carry = None
-        for ref in self._shard_refs():
-            block = ray_tpu.get(ref)
-            if block.num_rows == 0:
-                continue
-            if batch_size is None:
-                yield block_to_batch(block)
-                continue
-            block = block if carry is None else concat_blocks(
-                [carry, block])
-            carry = None
-            start = 0
-            while start + batch_size <= block.num_rows:
-                yield block_to_batch(
-                    slice_block(block, start, start + batch_size))
-                start += batch_size
-            if start < block.num_rows:
-                carry = slice_block(block, start, block.num_rows)
-        if carry is not None and not drop_last:
-            yield block_to_batch(carry)
+                     drop_last: bool = False,
+                     batch_format: str = "numpy"):
+        convert = _convert_for(batch_format)
+        blocks = (ray_tpu.get(ref) for ref in self._shard_refs())
+        return _batched_blocks(blocks, batch_size, drop_last, convert)
 
     def iter_device_batches(self, batch_size: int, mesh=None,
                             seq_sharded: bool = False,
@@ -1125,12 +1149,13 @@ class _PoolWorker:
     constructed once here (stateful UDFs: load the model once, apply
     per block — reference: ActorPoolMapOperator's actor UDFs)."""
 
-    def __init__(self, fn, fn_kwargs):
+    def __init__(self, fn, fn_kwargs, batch_format: str = "numpy"):
         self._fn = fn() if isinstance(fn, type) else fn
         self._kw = dict(fn_kwargs or {})
+        self._convert = _convert_for(batch_format)
 
     def apply(self, block):
-        out = self._fn(block_to_batch(block), **self._kw)
+        out = self._fn(self._convert(block), **self._kw)
         return to_block(out)
 
 
@@ -1146,7 +1171,9 @@ def _actor_map(upstream, op: _MapBatches):
     mn, mx = strat.resolve()
     per = max(1, strat.max_tasks_in_flight_per_actor)
     mk = lambda: _PoolWorker.options(  # noqa: E731
-        num_cpus=strat.num_cpus).remote(op.fn, op.fn_kwargs)
+        num_cpus=strat.num_cpus).remote(
+            op.fn, op.fn_kwargs,
+            getattr(op, "batch_format", "numpy"))
     pool: list = [mk() for _ in range(mn)]
     load: list[int] = [0] * mn
     order: deque = deque()            # (out_ref, actor_index)

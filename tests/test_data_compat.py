@@ -155,3 +155,55 @@ def test_minmax_and_label_and_concat(rt):
 def test_dataset_iterator_alias():
     assert data.DatasetIterator is data.DataIterator
     assert data.NodeIdStr is str
+
+
+def test_batch_format_pandas_and_pyarrow(rt):
+    """batch_format= on map_batches/iter_batches (reference:
+    ray.data batch_format — pandas/pyarrow UDFs and iteration)."""
+    import pandas as pd
+    import pyarrow as pa
+
+    ds = data.range(10, parallelism=2)
+
+    def pd_udf(df):
+        assert isinstance(df, pd.DataFrame)
+        df = df.copy()
+        df["double"] = df["id"] * 2
+        return df
+
+    out = ds.map_batches(pd_udf, batch_format="pandas")
+    rows = sorted(out.take_all(), key=lambda r: r["id"])
+    assert rows[3]["double"] == 6
+
+    def pa_udf(table):
+        assert isinstance(table, pa.Table)
+        return table.append_column(
+            "neg", pa.array([-x for x in
+                             table.column("id").to_pylist()]))
+
+    out2 = ds.map_batches(pa_udf, batch_format="pyarrow")
+    rows2 = sorted(out2.take_all(), key=lambda r: r["id"])
+    assert rows2[4]["neg"] == -4
+
+    dfs = list(ds.iter_batches(batch_size=5, batch_format="pandas"))
+    assert all(isinstance(d, pd.DataFrame) for d in dfs)
+    assert sum(len(d) for d in dfs) == 10
+    tables = list(ds.iter_batches(batch_format="pyarrow"))
+    assert all(isinstance(t, pa.Table) for t in tables)
+
+    # actor-pool path honors the format too (review regression)
+    out3 = ds.map_batches(pd_udf, batch_format="pandas",
+                          compute="actors")
+    rows3 = sorted(out3.take_all(), key=lambda r: r["id"])
+    assert rows3[3]["double"] == 6
+
+    # sharded trainer iterators expose batch_format as well
+    import pandas as pd2
+    shard = ds.streaming_split(2)[0]
+    for df in shard.iter_batches(batch_size=3, batch_format="pandas"):
+        assert isinstance(df, pd2.DataFrame)
+
+    with pytest.raises(ValueError, match="batch_format"):
+        ds.map_batches(lambda b: b, batch_format="polars")
+    with pytest.raises(ValueError, match="batch_format"):
+        ds.iter_batches(batch_format="polars")  # eager, at call site
