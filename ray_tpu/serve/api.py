@@ -81,14 +81,16 @@ class Deployment:
     def options(self, *, num_replicas: int | None = None,
                 name: str | None = None,
                 ray_actor_options: dict | None = None,
-                autoscaling_config: dict | None = None) -> "Deployment":
+                autoscaling_config: dict | None = None,
+                user_config=None) -> "Deployment":
         return Deployment(
             cls=self.cls,
             name=name or self.name,
             num_replicas=num_replicas or self.num_replicas,
             ray_actor_options=ray_actor_options
             or self.ray_actor_options,
-            user_config=self.user_config,
+            user_config=(self.user_config if user_config is None
+                         else user_config),
             autoscaling_config=autoscaling_config
             or self.autoscaling_config)
 
@@ -194,13 +196,15 @@ class DeploymentHandle:
 def deployment(cls: type | None = None, *, name: str | None = None,
                num_replicas: int = 1,
                ray_actor_options: dict | None = None,
-               autoscaling_config: dict | None = None):
+               autoscaling_config: dict | None = None,
+               user_config=None):
     """Decorator turning a class (or function) into a Deployment."""
     def wrap(target):
         return Deployment(
             cls=target, name=name or target.__name__,
             num_replicas=num_replicas,
             ray_actor_options=ray_actor_options or {},
+            user_config=user_config,
             autoscaling_config=autoscaling_config)
     if cls is not None:
         return wrap(cls)
@@ -233,6 +237,14 @@ def _deploy_tree(app: Application, controller,
     kwargs = {k: resolve(v) for k, v in app.init_kwargs.items()}
     d = app.deployment
     name = root_name or d.name
+    if d.user_config is not None and not callable(
+            getattr(d.cls, "reconfigure", None)):
+        # eager, driver-side (reference validates at deployment
+        # creation): a replica crash-loop is the silent alternative
+        raise ValueError(
+            f"deployment {name!r} has a user_config but "
+            f"{getattr(d.cls, '__name__', d.cls)!r} defines no "
+            f"reconfigure(config) method")
     resources = dict(d.ray_actor_options.get("resources", {}))
     if "num_cpus" in d.ray_actor_options:
         resources["CPU"] = d.ray_actor_options["num_cpus"]
@@ -240,7 +252,7 @@ def _deploy_tree(app: Application, controller,
         resources["TPU"] = d.ray_actor_options["num_tpus"]
     ray_tpu.get(controller.deploy.remote(
         name, ser.dumps(d.cls), args, kwargs, d.num_replicas,
-        resources, d.autoscaling_config), timeout=120)
+        resources, d.autoscaling_config, d.user_config), timeout=120)
     return name
 
 

@@ -52,13 +52,52 @@ class ServeController:
 
     def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
                num_replicas: int, resources: dict,
-               autoscaling_config: dict | None = None) -> bool:
+               autoscaling_config: dict | None = None,
+               user_config=None) -> bool:
         from ray_tpu.core import serialization as ser
+        old = self.desired.get(name)
+        if (old is not None
+                and old.get("cls_blob") == cls_blob
+                and old["args"] == init_args
+                and old["kwargs"] == init_kwargs
+                and old["resources"] == (resources or {})
+                and (autoscaling_config or None)
+                == old.get("autoscaling_raw")
+                and user_config != old.get("user_config")):
+            # Lightweight update (reference: user_config semantics —
+            # a redeploy changing ONLY user_config reconfigures live
+            # replicas in place, no restart). APPLY first, commit
+            # after: a raising reconfigure must not leave the desired
+            # state carrying a config that crash-loops every future
+            # replica spawn.
+            errs = []
+            for r in self.replicas.get(name, []):
+                try:
+                    ray_tpu.get(r.reconfigure.remote(user_config),
+                                timeout=30)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(str(e))
+            if errs:
+                raise RuntimeError(
+                    f"reconfigure failed on {len(errs)} replica(s) "
+                    f"(desired state keeps the previous user_config; "
+                    f"replicas may be mixed until redeploy): "
+                    f"{errs[0]}")
+            old["user_config"] = user_config
+            if name not in self.autoscaling:
+                # an autoscaler owns the replica count; the static
+                # number must not clobber its decision
+                old["num_replicas"] = num_replicas
+            self._bump_version(name)
+            return True
         self.desired[name] = {
             "cls": ser.loads(cls_blob),
+            "cls_blob": cls_blob,
             "args": init_args, "kwargs": init_kwargs,
             "num_replicas": num_replicas,
             "resources": resources or {},
+            "user_config": user_config,
+            "autoscaling_raw": autoscaling_config or None,
         }
         if autoscaling_config:
             cfg = AutoscalingConfig.from_dict(autoscaling_config)
@@ -190,7 +229,8 @@ class ServeController:
                     num_tpus=resources.pop("TPU", 0) or None,
                     resources=resources or None,
                     max_concurrency=8,
-                ).remote(spec["cls"], spec["args"], spec["kwargs"], tag))
+                ).remote(spec["cls"], spec["args"], spec["kwargs"],
+                         tag, spec.get("user_config")))
                 changed = True
             while len(live) > spec["num_replicas"]:
                 # Graceful scale-down: stop routing to the victim (it

@@ -19,7 +19,7 @@ from ray_tpu.serve.replica_ctx import (     # noqa: F401 — re-export
 @ray_tpu.remote
 class Replica:
     def __init__(self, cls_or_fn, init_args, init_kwargs,
-                 replica_tag: str):
+                 replica_tag: str, user_config=None):
         self.tag = replica_tag
         # Import at CALL time: this class ships by value (see
         # replica_ctx docstring), so only a runtime import reaches
@@ -35,6 +35,21 @@ class Replica:
             self.callable = cls_or_fn(*init_args, **init_kwargs)
         else:
             self.callable = cls_or_fn
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config) -> bool:
+        """Apply a user_config (reference: Deployment user_config —
+        the replica class defines ``reconfigure(config)``; called at
+        startup with the initial config and again, WITHOUT a restart,
+        on every redeploy that changes only user_config)."""
+        fn = getattr(self.callable, "reconfigure", None)
+        if fn is None:
+            raise RuntimeError(
+                f"deployment class {type(self.callable).__name__} "
+                f"got a user_config but defines no reconfigure()")
+        fn(user_config)
+        return True
 
     def _stream_wrapper(self, gen, multiplexed_model_id: str):
         """Owns the inflight count for a streaming response: the
@@ -115,11 +130,6 @@ class Replica:
         return {"tag": self.tag, "inflight": self._inflight,
                 "total": self._total,
                 "model_ids": resident_model_ids(self.callable)}
-
-    def reconfigure(self, user_config) -> bool:
-        if hasattr(self.callable, "reconfigure"):
-            self.callable.reconfigure(user_config)
-        return True
 
     def health_check(self) -> str:
         if hasattr(self.callable, "check_health"):

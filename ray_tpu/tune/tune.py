@@ -84,6 +84,7 @@ class Trial:
     error: str | None = None
     restore_from: str | None = None     # PBT exploit checkpoint
     perturbations: int = 0
+    failures: int = 0        # FailureConfig.max_failures retries used
 
 
 class ResultGrid:
@@ -174,7 +175,8 @@ class Tuner:
                       state=row["state"], metrics=row["metrics"],
                       history=row["history"],
                       checkpoint_dir=ckpt,
-                      error=row["error"])
+                      error=row["error"],
+                      failures=int(row.get("failures", 0)))
             was_error = t.state == "ERROR"
             resume_errored = (resume_config is None
                               or getattr(resume_config,
@@ -352,7 +354,8 @@ class Tuner:
              "state": t.state, "metrics": t.metrics,
              "history": t.history,
              "checkpoint_dir": rel_ckpt(t.checkpoint_dir),
-             "error": t.error} for t in trials]}
+             "error": t.error,
+             "failures": t.failures} for t in trials]}
         tmp = os.path.join(exp_dir, ".experiment_state.tmp")
         try:
             with open(tmp, "w") as f:
@@ -419,6 +422,9 @@ class Tuner:
                         break
                     p["results"].extend(extra["results"])
         except Exception as e:  # noqa: BLE001 — actor died
+            if self._maybe_retry_trial(t, str(e), fn, exp_dir, tc,
+                                       scheduler):
+                return True, True
             t.state = "ERROR"
             t.error = str(e)
             if searcher:
@@ -468,17 +474,46 @@ class Tuner:
             self._cb("on_trial_complete", t)
             return False, True
         if p["done"]:
+            if p["error"]:
+                ray_tpu.kill(t.actor)
+                if self._maybe_retry_trial(t, p["error"], fn,
+                                           exp_dir, tc, scheduler):
+                    return True, True
             t.state = "ERROR" if p["error"] else "COMPLETED"
             t.error = p["error"]
             scheduler.on_trial_complete(t.trial_id)
             if searcher:
                 searcher.on_trial_complete(t.trial_id, t.metrics,
                                            error=bool(p["error"]))
-            ray_tpu.kill(t.actor)
+            if not p["error"]:
+                ray_tpu.kill(t.actor)
             self._cb("on_trial_error" if p["error"]
                      else "on_trial_complete", t)
             return False, True
         return True, changed
+
+    def _maybe_retry_trial(self, t: Trial, error: str, fn,
+                           exp_dir: str, tc: TuneConfig,
+                           scheduler) -> bool:
+        """FailureConfig.max_failures (reference: failed trials
+        restart from their latest checkpoint up to max_failures;
+        -1 = unlimited)."""
+        max_failures = self.run_config.failure_config.max_failures
+        if max_failures != -1 and t.failures >= max_failures:
+            return False
+        t.failures += 1
+        import warnings
+        warnings.warn(
+            f"trial {t.trial_id} failed "
+            f"({t.failures}/{max_failures}): {error!r}; restarting "
+            f"from {t.checkpoint_dir or 'scratch'}")
+        try:
+            ray_tpu.kill(t.actor)
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+        t.restore_from = t.checkpoint_dir
+        self._start_trial(t, fn, exp_dir, tc, scheduler)
+        return True
 
 
 def _as_function_trainable(trainable) -> Callable:

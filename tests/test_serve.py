@@ -258,3 +258,49 @@ def test_plain_objectref_args_pass_through_to_replica(rt):
     assert h.kind.remote(h.kind.remote(ref)).result(timeout_s=60) == \
         ("value", ("ref", 123))
     serve.delete("refstore_app")
+
+
+def test_user_config_reconfigure_in_place(rt):
+    """user_config (reference: Deployment user_config semantics):
+    applied at replica startup via reconfigure(), and a redeploy
+    changing ONLY user_config reconfigures LIVE replicas in place —
+    same replica object, no restart."""
+    from ray_tpu import serve
+
+    @serve.deployment(user_config={"threshold": 5})
+    class Thresholder:
+        def __init__(self):
+            self.threshold = None
+            self.ident = id(self)
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, x):
+            return (x > self.threshold, self.ident)
+
+    app = Thresholder.bind()
+    h = serve.run(app, name="ucfg")
+    over, ident1 = h.remote(7).result(timeout_s=60)
+    assert over is True  # startup config applied
+
+    # redeploy with ONLY user_config changed: in-place reconfigure
+    h2 = serve.run(
+        Thresholder.options(user_config={"threshold": 10}).bind(),
+        name="ucfg")
+    over2, ident2 = h2.remote(7).result(timeout_s=60)
+    assert over2 is False          # new threshold live
+    assert ident2 == ident1        # SAME replica object - no restart
+    serve.delete("ucfg")
+
+
+def test_user_config_without_reconfigure_errors(rt):
+    from ray_tpu import serve
+
+    @serve.deployment(user_config={"x": 1})
+    class NoReconf:
+        def __call__(self):
+            return 1
+
+    with pytest.raises(ValueError, match="reconfigure"):
+        serve.run(NoReconf.bind(), name="noreconf")

