@@ -193,3 +193,40 @@ def test_rest_deploy_api(serve_rt, tmp_path):
         assert "applications" in ei.value.read().decode()
     finally:
         dash.stop()
+
+
+@serve.deployment(name="Cfg")
+class Cfg:
+    def __init__(self):
+        self.val = None
+        self.ident = id(self)
+
+    def reconfigure(self, config):
+        self.val = config["val"]
+
+    def __call__(self, _):
+        return (self.val, self.ident)
+
+
+cfg_app = Cfg.bind()
+
+
+def test_deploy_config_user_config_reconfigures_in_place(serve_rt):
+    """Config-file user_config flows to replicas, and a config change
+    touching ONLY user_config reconfigures live replicas in place
+    (reference: serve config user_config semantics)."""
+    def config(val):
+        return {"applications": [
+            {"name": "cfgapp", "import_path": "ignored:ignored",
+             "deployments": [{"name": "Cfg", "num_replicas": 1,
+                              "user_config": {"val": val}}]}]}
+
+    handles = serve.deploy_config(
+        config(1), _import_override=lambda s: cfg_app)
+    v1, ident1 = handles["cfgapp"].remote(0).result(timeout_s=60)
+    assert v1 == 1
+    handles = serve.deploy_config(
+        config(2), _import_override=lambda s: cfg_app)
+    v2, ident2 = handles["cfgapp"].remote(0).result(timeout_s=60)
+    assert v2 == 2
+    assert ident2 == ident1   # same replica object — no restart
