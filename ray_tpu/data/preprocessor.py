@@ -127,6 +127,102 @@ class LabelEncoder(Preprocessor):
         return out
 
 
+class OneHotEncoder(Preprocessor):
+    """Categorical columns -> one-hot vector columns (reference:
+    ray.data.preprocessors.OneHotEncoder): each listed column becomes
+    a ``{col}_onehot`` float vector over the classes seen at fit."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = list(columns)
+        self.classes_: dict[str, list] = {}
+        self._index: dict[str, dict] = {}
+
+    def _fit(self, ds) -> None:
+        self.classes_ = {c: sorted(ds.unique(c))
+                         for c in self.columns}
+        self._index = {c: {v: i for i, v in enumerate(vals)}
+                       for c, vals in self.classes_.items()}
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            index = self._index[c]
+            n = len(index)
+            vals = batch[c]
+            mat = np.zeros((len(vals), n), dtype=np.float64)
+            try:
+                rows = [index[v] for v in vals]
+            except KeyError as e:
+                raise ValueError(
+                    f"OneHotEncoder({c!r}): unseen value "
+                    f"{e.args[0]!r}") from None
+            mat[np.arange(len(vals)), rows] = 1.0
+            out[f"{c}_onehot"] = mat
+            del out[c]
+        return out
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing values (NaN/None) per column (reference:
+    ray.data.preprocessors.SimpleImputer): strategy mean|most_frequent
+    |constant (with ``fill_value``)."""
+
+    def __init__(self, columns: list[str], *,
+                 strategy: str = "mean", fill_value=None):
+        if strategy not in ("mean", "most_frequent", "constant"):
+            raise ValueError(
+                f"strategy must be mean|most_frequent|constant, "
+                f"got {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' needs fill_value")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: dict = {}
+
+    def _fit(self, ds) -> None:
+        if self.strategy == "constant":
+            self.stats_ = {c: self.fill_value for c in self.columns}
+            return
+        # ONE pass over the dataset for every listed column
+        rows = ds.select_columns(self.columns).take_all()
+        for c in self.columns:
+            present = [r[c] for r in rows
+                       if r[c] is not None and not (
+                           isinstance(r[c], float)
+                           and np.isnan(r[c]))]
+            if self.strategy == "mean":
+                self.stats_[c] = (float(np.mean(
+                    np.asarray(present, dtype=np.float64)))
+                    if present else 0.0)
+            else:  # most_frequent
+                from collections import Counter
+                self.stats_[c] = (Counter(present).most_common(1)[0][0]
+                                  if present else None)
+
+    def _transform_batch(self, batch: dict) -> dict:
+        out = dict(batch)
+        for c in self.columns:
+            fill = self.stats_[c]
+            orig = np.asarray(batch[c])
+            arr = orig.astype(object)
+            mask = np.array(
+                [v is None or (isinstance(v, float) and np.isnan(v))
+                 for v in arr])
+            if not mask.any():
+                out[c] = orig      # untouched column keeps its dtype
+                continue
+            arr = arr.copy()
+            arr[mask] = fill
+            if np.issubdtype(orig.dtype, np.floating) or all(
+                    isinstance(v, (int, float)) and
+                    not isinstance(v, bool) for v in arr):
+                out[c] = arr.astype(np.float64)
+            else:
+                out[c] = arr       # strings/mixed stay object
+        return out
+
+
 class Concatenator(Preprocessor):
     """Concatenate numeric columns into one vector column (reference:
     ray.data.preprocessors.Concatenator) — the feed-the-model step."""

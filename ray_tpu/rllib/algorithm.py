@@ -130,6 +130,34 @@ class PPO(Checkpointable, SupportsEvaluation):
             state["learner"]["opt_state"])
         self.runners.set_weights(self.learner.get_weights())
 
+    def compute_single_action(self, obs, explore: bool = False):
+        """Inference on one RAW observation (reference:
+        Algorithm.compute_single_action): the configured
+        env_to_module connectors run first — the model must see the
+        same transformed inputs it trained on; greedy argmax by
+        default, sampled (seeded, reproducible) with
+        ``explore=True``."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.rllib.connectors import ConnectorPipelineV2
+        if not hasattr(self, "_inference_pipeline"):
+            self._inference_pipeline = ConnectorPipelineV2(
+                self.config.env_to_module)
+            # persistent split-key, same convention as sac/cql
+            self._action_key = jax.random.key(self.config.seed + 2)
+        obs = np.asarray(self._inference_pipeline(obs))
+        obs_b = jnp.asarray(obs, dtype=jnp.float32)[None]
+        logits, _ = self.learner.model.apply(
+            {"params": self.learner.params}, obs_b)
+        logits = np.asarray(logits)[0]
+        if explore:
+            self._action_key, sub = jax.random.split(self._action_key)
+            return int(jax.random.categorical(sub,
+                                              jnp.asarray(logits)))
+        return int(np.argmax(logits))
+
     def stop(self) -> None:
         self.runners.shutdown()
 

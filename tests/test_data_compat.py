@@ -207,3 +207,46 @@ def test_batch_format_pandas_and_pyarrow(rt):
         ds.map_batches(lambda b: b, batch_format="polars")
     with pytest.raises(ValueError, match="batch_format"):
         ds.iter_batches(batch_format="polars")  # eager, at call site
+
+
+def test_one_hot_encoder(rt):
+    from ray_tpu.data.preprocessor import OneHotEncoder
+    ds = data.from_items([{"c": v, "x": 1.0}
+                          for v in ("a", "b", "a", "c")])
+    enc = OneHotEncoder(["c"]).fit(ds)
+    assert enc.classes_["c"] == ["a", "b", "c"]
+    rows = enc.transform(ds).take_all()
+    assert "c" not in rows[0] and rows[0]["c_onehot"].shape == (3,)
+    totals = np.sum([r["c_onehot"] for r in rows], axis=0)
+    assert totals.tolist() == [2.0, 1.0, 1.0]
+    with pytest.raises(ValueError, match="unseen"):
+        enc.transform_batch({"c": np.array(["zzz"], dtype=object),
+                             "x": np.array([1.0])})
+
+
+def test_simple_imputer(rt):
+    from ray_tpu.data.preprocessor import SimpleImputer
+    ds = data.from_items([{"v": 1.0}, {"v": float("nan")},
+                          {"v": 3.0}, {"v": float("nan")}])
+    imp = SimpleImputer(["v"], strategy="mean").fit(ds)
+    assert imp.stats_["v"] == pytest.approx(2.0)
+    vals = sorted(r["v"] for r in imp.transform(ds).take_all())
+    assert vals == [1.0, 2.0, 2.0, 3.0]
+    const = SimpleImputer(["v"], strategy="constant", fill_value=9.0)
+    out = const.fit_transform(ds).take_all()
+    assert sorted(r["v"] for r in out) == [1.0, 3.0, 9.0, 9.0]
+    with pytest.raises(ValueError, match="strategy"):
+        SimpleImputer(["v"], strategy="median")
+    with pytest.raises(ValueError, match="fill_value"):
+        SimpleImputer(["v"], strategy="constant")
+
+
+def test_simple_imputer_preserves_string_dtype(rt):
+    """Review regression: non-numeric columns must come back as
+    strings, and untouched columns keep their dtype."""
+    from ray_tpu.data.preprocessor import SimpleImputer
+    ds = data.from_items([{"s": "1"}, {"s": "2"},
+                          {"s": None}, {"s": "1"}])
+    imp = SimpleImputer(["s"], strategy="most_frequent").fit(ds)
+    vals = [r["s"] for r in imp.transform(ds).take_all()]
+    assert sorted(vals) == ["1", "1", "1", "2"]   # strings, not 1.0
