@@ -254,9 +254,20 @@ def _cmd_start(args) -> int:
 def _cmd_stop(args) -> int:
     """``ray-tpu stop`` (reference: ray stop): SIGTERM every live
     session head found under /tmp/ray_tpu_sessions (graceful —
-    daemons/workers shut down with their head)."""
+    daemons/workers shut down with their head). With
+    ``--head-info-file``, stop ONLY the head that wrote that file —
+    the targeted form for hosts running unrelated sessions."""
     import signal
 
+    only_pid = None
+    if args.head_info_file:
+        try:
+            with open(args.head_info_file) as f:
+                only_pid = int(json.load(f)["pid"])
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(
+                f"cannot read head pid from "
+                f"{args.head_info_file}: {e}")
     stopped = 0
     for sock in glob.glob("/tmp/ray_tpu_sessions/*/runtime.sock"):
         pid_s = os.path.basename(os.path.dirname(sock))
@@ -265,6 +276,8 @@ def _cmd_stop(args) -> int:
         except ValueError:
             continue
         if pid == os.getpid():
+            continue
+        if only_pid is not None and pid != only_pid:
             continue
         # Stale-dir guard against pid recycling: only signal a LIVE
         # python process (a SIGKILLed head leaves its session dir;
@@ -434,7 +447,10 @@ def main(argv: list[str] | None = None) -> int:
                    default="/tmp/ray_tpu_head.json")
     p.set_defaults(fn=_cmd_start)
 
-    p = sub.add_parser("stop", help="stop every live session head")
+    p = sub.add_parser("stop", help="stop every live session head "
+                                    "(or one, via --head-info-file)")
+    p.add_argument("--head-info-file", default=None,
+                   help="stop only the head that wrote this file")
     p.set_defaults(fn=_cmd_stop)
 
     p = sub.add_parser("doctor", help="environment checks")

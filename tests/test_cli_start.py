@@ -65,8 +65,12 @@ def test_start_join_stop(tmp_path):
         assert client.returncode == 0, client.stderr[-2000:]
         assert "CLIENT_OK" in client.stdout
 
+        # TARGETED stop: a bare `stop` would SIGTERM every live
+        # session on the host — including the sibling xdist worker's
+        # driver-embedded runtime (this killed gw1 in the r5 suite)
         out = subprocess.run(
-            [sys.executable, "-m", "ray_tpu.scripts.cli", "stop"],
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "stop",
+             "--head-info-file", info_file],
             env=env, capture_output=True, text=True, timeout=60)
         assert "session(s) signaled" in out.stdout, out.stdout
         head.wait(timeout=60)
