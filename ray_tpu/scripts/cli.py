@@ -264,7 +264,7 @@ def _cmd_stop(args) -> int:
         try:
             with open(args.head_info_file) as f:
                 only_pid = int(json.load(f)["pid"])
-        except (OSError, ValueError, KeyError) as e:
+        except (OSError, ValueError, KeyError, TypeError) as e:
             raise SystemExit(
                 f"cannot read head pid from "
                 f"{args.head_info_file}: {e}")

@@ -56,11 +56,15 @@ class ServeController:
                user_config=None) -> bool:
         from ray_tpu.core import serialization as ser
         old = self.desired.get(name)
-        if (old is not None
-                and old.get("cls_blob") == cls_blob
-                and old["args"] == init_args
-                and old["kwargs"] == init_kwargs
-                and old["resources"] == (resources or {})
+        # ONE definition of "the replica-visible spec is unchanged":
+        # both the lightweight-update test and the drain-replace test
+        # below negate the same flag.
+        same_spec = (old is not None
+                     and old.get("cls_blob") == cls_blob
+                     and old["args"] == init_args
+                     and old["kwargs"] == init_kwargs
+                     and old["resources"] == (resources or {}))
+        if (same_spec
                 and (autoscaling_config or None)
                 == old.get("autoscaling_raw")
                 and user_config != old.get("user_config")):
@@ -90,6 +94,16 @@ class ServeController:
                 old["num_replicas"] = num_replicas
             self._bump_version(name)
             return True
+        if old is not None and not same_spec:
+            # CODE/arg change: existing replicas run the old
+            # deployment — drain-replace them (reference: redeploys
+            # roll replicas to the new version; without this a
+            # redeploy silently keeps serving old code forever).
+            # Under _rec_lock: the reconcile thread must not write a
+            # stale `live` list back and resurrect popped replicas.
+            with self._rec_lock:
+                for r in self.replicas.pop(name, []):
+                    self._start_draining(name, r)
         self.desired[name] = {
             "cls": ser.loads(cls_blob),
             "cls_blob": cls_blob,
@@ -238,19 +252,35 @@ class ServeController:
                 # only kill it once its in-flight requests drain —
                 # killing a busy replica fails user requests.
                 victim = live.pop()
-                self.draining.setdefault(name, []).append(
-                    (victim, time.time() + 30.0))
+                self._start_draining(name, victim)
                 changed = True
             self.replicas[name] = live
             self._reap_draining(name)
             if changed:
                 self._bump_version(name)
 
+    DRAIN_DEADLINE_S = 30.0
+    # routers hold the previous replica list until their long-poll
+    # refreshes: even an idle victim stays alive this long so a
+    # request routed on the stale list doesn't hit a killed actor
+    DRAIN_MIN_GRACE_S = 2.0
+
+    def _start_draining(self, name: str, replica) -> None:
+        """One definition of 'leave the routing set, die after
+        draining' — used by scale-down AND code-redeploy
+        replacement."""
+        now = time.time()
+        self.draining.setdefault(name, []).append(
+            (replica, now + self.DRAIN_DEADLINE_S,
+             now + self.DRAIN_MIN_GRACE_S))
+
     def _reap_draining(self, name: str) -> None:
         still = []
-        for victim, deadline in self.draining.get(name, []):
-            done = time.time() > deadline
-            if not done:
+        now = time.time()
+        for entry in self.draining.get(name, []):
+            victim, deadline, not_before = entry
+            done = now > deadline
+            if not done and now >= not_before:
                 try:
                     done = ray_tpu.get(victim.queue_len.remote(),
                                        timeout=5) == 0
@@ -262,7 +292,7 @@ class ServeController:
                 except Exception:  # noqa: BLE001
                     pass
             else:
-                still.append((victim, deadline))
+                still.append(entry)
         if still:
             self.draining[name] = still
         else:

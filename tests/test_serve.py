@@ -304,3 +304,31 @@ def test_user_config_without_reconfigure_errors(rt):
 
     with pytest.raises(ValueError, match="reconfigure"):
         serve.run(NoReconf.bind(), name="noreconf")
+
+
+def test_redeploy_with_new_code_replaces_replicas(rt):
+    """A redeploy whose CODE changed must roll replicas — old ones
+    drain out, new requests see the new deployment (caught during r5:
+    redeploys silently kept serving old code forever)."""
+    from ray_tpu import serve
+
+    def make_app(version):
+        @serve.deployment(name="Roller")
+        class Roller:
+            def __call__(self, _):
+                return version
+        return Roller.bind()
+
+    h = serve.run(make_app("v1"), name="roll_app")
+    assert h.remote(0).result(timeout_s=60) == "v1"
+
+    h2 = serve.run(make_app("v2"), name="roll_app")
+    deadline = time.time() + 60
+    seen = None
+    while time.time() < deadline:
+        seen = h2.remote(0).result(timeout_s=60)
+        if seen == "v2":
+            break
+        time.sleep(0.3)
+    assert seen == "v2", f"still serving {seen}"
+    serve.delete("roll_app")
