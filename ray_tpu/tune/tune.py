@@ -58,6 +58,10 @@ class TuneConfig:
     # (stop when result[metric] >= threshold — classic tune.run
     # semantics).
     stop: Any = None
+    # Wall-clock budget for the WHOLE experiment (reference:
+    # TuneConfig.time_budget_s): once exceeded, no new trials are
+    # admitted and running trials are stopped at their next result.
+    time_budget_s: float | None = None
 
 
 @dataclass
@@ -253,7 +257,31 @@ class Tuner:
             return (searcher is None or exhausted
                     or searcher.is_finished())
 
+        budget_t0 = time.monotonic()
+
+        def budget_spent() -> bool:
+            return (tc.time_budget_s is not None
+                    and time.monotonic() - budget_t0
+                    >= tc.time_budget_s)
+
         while True:
+            if budget_spent():
+                # time_budget_s: admit nothing further; stop whatever
+                # is still running at its next poll.
+                pending.clear()
+                exhausted = True
+                for t in running:
+                    t.state = "STOPPED"
+                    try:
+                        ray_tpu.kill(t.actor)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    scheduler.on_trial_complete(t.trial_id)
+                    if searcher:
+                        searcher.on_trial_complete(t.trial_id,
+                                                   t.metrics)
+                    self._cb("on_trial_complete", t)
+                running = []
             # Admit: restored pending trials first, then fresh
             # suggestions — lazily, so ConcurrencyLimiter-style
             # searchers see live trial counts.

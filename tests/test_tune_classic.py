@@ -317,3 +317,30 @@ def test_failure_config_exhausted(rt, tmp_path):
             failure_config=FailureConfig(max_failures=1)),
     ).fit()
     assert grid[0].state == "ERROR"
+
+
+def test_time_budget_s(rt, tmp_path):
+    """TuneConfig.time_budget_s (reference): the experiment stops
+    admitting and halts running trials once the wall budget is
+    spent."""
+    import time as _t
+
+    def slow(config):
+        from ray_tpu.train import report
+        for i in range(1000):
+            _t.sleep(0.1)
+            report({"i": i})
+
+    t0 = _t.monotonic()
+    grid = tune.Tuner(
+        slow,
+        tune_config=tune.TuneConfig(num_samples=50,
+                                    time_budget_s=3.0),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             name="budget"),
+    ).fit()
+    wall = _t.monotonic() - t0
+    assert wall < 30, f"budget ignored: ran {wall:.0f}s"
+    assert len(grid) < 50                      # admission stopped
+    assert all(r.state in ("STOPPED", "COMPLETED", "ERROR")
+               for r in grid)
