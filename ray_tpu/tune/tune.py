@@ -266,22 +266,14 @@ class Tuner:
 
         while True:
             if budget_spent():
-                # time_budget_s: admit nothing further; stop whatever
-                # is still running at its next poll.
+                # time_budget_s: admit nothing further; running
+                # trials stop THROUGH the normal poll path (queued
+                # results and checkpoints drain before the kill).
+                self._budget_exhausted = True
+                for t in pending:
+                    t.state = "STOPPED"   # terminal, never admitted
                 pending.clear()
                 exhausted = True
-                for t in running:
-                    t.state = "STOPPED"
-                    try:
-                        ray_tpu.kill(t.actor)
-                    except Exception:  # noqa: BLE001
-                        pass
-                    scheduler.on_trial_complete(t.trial_id)
-                    if searcher:
-                        searcher.on_trial_complete(t.trial_id,
-                                                   t.metrics)
-                    self._cb("on_trial_complete", t)
-                running = []
             # Admit: restored pending trials first, then fresh
             # suggestions — lazily, so ConcurrencyLimiter-style
             # searchers see live trial counts.
@@ -483,6 +475,11 @@ class Tuner:
             if decision in (STOP, EXPLOIT):
                 break
         changed = bool(p["results"])
+        if getattr(self, "_budget_exhausted", False) \
+                and decision not in (STOP, EXPLOIT):
+            # time_budget_s spent: force the normal STOP path (the
+            # results above were already drained and recorded)
+            decision = STOP
         if decision == EXPLOIT and not p["done"]:
             # PBT: restart this trial from a donor's checkpoint with a
             # mutated config. Counts as the same trial (same id).
