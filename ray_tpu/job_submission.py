@@ -314,6 +314,11 @@ class JobSubmissionClient:
             return (raw or b"").decode("utf-8", "replace")
 
     def stop_job(self, submission_id: str) -> bool:
+        """(reference: JobSubmissionClient.stop_job returns whether a
+        stop was actually delivered — False for already-terminal
+        jobs)."""
+        if self.get_job_status(submission_id) in JobStatus.TERMINAL:
+            return False
         self._ray.get(self._handle(submission_id).stop.remote(),
                       timeout=60)
         return True

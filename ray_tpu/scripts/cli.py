@@ -324,7 +324,15 @@ def _cmd_doctor(args) -> int:
 def _cmd_job_submit(args) -> int:
     import ray_tpu
     from ray_tpu.job_submission import JobStatus, JobSubmissionClient
-    ray_tpu.init(ignore_reinit_error=True)
+    # Attach to a live session when one exists (or --address says so):
+    # jobs submitted here stay visible to `job list/status/logs` runs
+    # against that session. A fresh private session (the old always-on
+    # behavior) is the fallback when nothing is running.
+    try:
+        addr = _discover_address(getattr(args, "address", None))
+        ray_tpu.init(address=addr)
+    except SystemExit:
+        ray_tpu.init(ignore_reinit_error=True)
     client = JobSubmissionClient()
     entrypoint = " ".join(args.entrypoint)
     runtime_env = {}
@@ -339,6 +347,46 @@ def _cmd_job_submit(args) -> int:
     sys.stdout.write(client.get_job_logs(sid))
     print(f"job {sid} finished: {status}")
     return 0 if status == JobStatus.SUCCEEDED else 1
+
+
+def _job_client(args):
+    """Attach to the session the job table lives in (same discovery
+    as every other cluster command)."""
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+    addr = _discover_address(getattr(args, "address", None))
+    ray_tpu.init(address=addr)
+    return JobSubmissionClient()
+
+
+def _cmd_job_list(args) -> int:
+    client = _job_client(args)
+    rows = client.list_jobs()
+    for info in rows:
+        print(f"{info.submission_id}  {info.status:<10} "
+              f"{info.entrypoint}")
+    if not rows:
+        print("(no jobs)")
+    return 0
+
+
+def _cmd_job_status(args) -> int:
+    client = _job_client(args)
+    print(client.get_job_status(args.submission_id))
+    return 0
+
+
+def _cmd_job_stop(args) -> int:
+    client = _job_client(args)
+    ok = client.stop_job(args.submission_id)
+    print("stopped" if ok else "not running")
+    return 0
+
+
+def _cmd_job_logs(args) -> int:
+    client = _job_client(args)
+    sys.stdout.write(client.get_job_logs(args.submission_id))
+    return 0
 
 
 def _cmd_up(args) -> int:
@@ -482,12 +530,23 @@ def main(argv: list[str] | None = None) -> int:
     pjob = sub.add_parser("job", help="job submission")
     jsub = pjob.add_subparsers(dest="jobcmd", required=True)
     p = jsub.add_parser("submit")
+    p.add_argument("--address", default=None)
     p.add_argument("--working-dir", default=None)
     p.add_argument("--no-wait", action="store_true")
     p.add_argument("--timeout", type=float, default=3600.0)
     p.add_argument("entrypoint", nargs=argparse.REMAINDER,
                    help="command after --")
     p.set_defaults(fn=_cmd_job_submit)
+    for sub_name, sub_fn, needs_id in (
+            ("list", _cmd_job_list, False),
+            ("status", _cmd_job_status, True),
+            ("stop", _cmd_job_stop, True),
+            ("logs", _cmd_job_logs, True)):
+        p = jsub.add_parser(sub_name)
+        p.add_argument("--address", default=None)
+        if needs_id:
+            p.add_argument("submission_id")
+        p.set_defaults(fn=sub_fn)
 
     args = parser.parse_args(argv)
     if getattr(args, "entrypoint", None):

@@ -135,6 +135,7 @@ def run(trainable, *, config: dict | None = None,
         callbacks: list | None = None,
         progress_reporter=None,
         resources_per_trial=None,
+        resume: bool = False,
         **ignored: Any):
     """Classic entry point: builds a Tuner and fits it. Unknown
     keyword arguments are rejected loudly rather than silently
@@ -173,6 +174,33 @@ def run(trainable, *, config: dict | None = None,
         rc_kwargs["name"] = name
     if cbs:
         rc_kwargs["callbacks"] = cbs
+    if resume:
+        # classic tune.run(resume=True): continue the named
+        # experiment from its journal — with the SAME wrapped
+        # trainable and tune settings as the original call (resources
+        # wrap and TuneConfig built above). Loud contract: what
+        # restore cannot carry is rejected, not dropped.
+        import os as _os
+
+        from ray_tpu.util.storage import is_uri
+        if not (name and storage_path):
+            raise ValueError(
+                "tune.run(resume=True) needs name= and storage_path= "
+                "to locate the experiment journal")
+        if cbs:
+            raise ValueError(
+                "tune.run(resume=True) does not carry callbacks/"
+                "progress_reporter through restore; use the Tuner "
+                "API or drop them")
+        if is_uri(storage_path):
+            exp_dir = storage_path.rstrip("/") + "/" + name
+        else:
+            exp_dir = _os.path.join(storage_path, name)
+            if not _os.path.exists(
+                    _os.path.join(exp_dir, "experiment_state.json")):
+                raise ValueError(
+                    f"resume=True but no journal at {exp_dir!r}")
+        return Tuner.restore(exp_dir, fn, tune_config=tc).fit()
     tuner = Tuner(
         fn,
         param_space=config or {},

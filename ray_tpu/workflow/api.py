@@ -362,8 +362,11 @@ def _run_thread(workflow_id: str, dag: DAGNode, input_val: Any) -> None:
 
 
 def run_async(dag: DAGNode, *, workflow_id: str | None = None,
-              args: Any = None) -> str:
-    """Start a workflow; returns its id immediately."""
+              args: Any = None,
+              metadata: dict | None = None) -> str:
+    """Start a workflow; returns its id immediately. ``metadata`` is
+    recorded in the durable record (reference: workflow.run's
+    user-metadata — surfaced by get_metadata)."""
     workflow_id = workflow_id or f"workflow_{uuid.uuid4().hex[:12]}"
     store = wf_storage.WorkflowStorage(workflow_id)
     meta = {
@@ -387,6 +390,8 @@ def run_async(dag: DAGNode, *, workflow_id: str | None = None,
         meta["step_metadata"] = step_md
     import os
     meta["executor_pid"] = os.getpid()
+    if metadata:
+        meta["user_metadata"] = dict(metadata)
     store.save_meta(meta)
     with _lock:
         _cancel_flags[workflow_id] = threading.Event()
@@ -400,8 +405,10 @@ def run_async(dag: DAGNode, *, workflow_id: str | None = None,
 
 
 def run(dag: DAGNode, *, workflow_id: str | None = None,
-        args: Any = None, timeout: float | None = None) -> Any:
-    wid = run_async(dag, workflow_id=workflow_id, args=args)
+        args: Any = None, timeout: float | None = None,
+        metadata: dict | None = None) -> Any:
+    wid = run_async(dag, workflow_id=workflow_id, args=args,
+                    metadata=metadata)
     return get_output(wid, timeout=timeout)
 
 

@@ -321,3 +321,56 @@ def test_job_details_schema(rt):
     assert d.type == JobType.SUBMISSION
     assert d.job_id == d.submission_id == sid
     assert d.status == JobStatus.SUCCEEDED and d.end_time
+
+
+def test_job_cli_subcommands(rt):
+    """job list/status/stop/logs subcommands (reference: ray job
+    CLI family) against a live session."""
+    import os
+
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    c = JobSubmissionClient()
+    sid = c.submit_job(entrypoint="python -c 'print(6*7)'")
+    assert c.wait_until_finished(sid, timeout=120) == \
+        JobStatus.SUCCEEDED
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    addr = ray_tpu.client_address()
+
+    def cli(*args):
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr[-1500:]
+        return out.stdout
+
+    assert sid in cli("job", "list", "--address", addr)
+    assert "SUCCEEDED" in cli("job", "status", "--address", addr, sid)
+    assert "42" in cli("job", "logs", "--address", addr, sid)
+    assert "not running" in cli("job", "stop", "--address", addr, sid)
+
+
+def test_job_submit_attaches_to_live_session(rt):
+    """CLI submit attaches to the running session, so the new
+    list/status subcommands see its jobs (review regression: submit
+    always started a private session)."""
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    addr = ray_tpu.client_address()
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "job",
+         "submit", "--address", addr, "--no-wait", "--",
+         "echo", "attached"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-1500:]
+    sid = out.stdout.split("submitted job ")[1].split(":")[0]
+    from ray_tpu.job_submission import JobSubmissionClient
+    c = JobSubmissionClient()
+    c.wait_until_finished(sid, timeout=120)
+    assert "attached" in c.get_job_logs(sid)

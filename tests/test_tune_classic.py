@@ -344,3 +344,28 @@ def test_time_budget_s(rt, tmp_path):
     assert len(grid) < 50                      # admission stopped
     assert all(r.state in ("STOPPED", "COMPLETED", "ERROR")
                for r in grid)
+
+
+def test_tune_run_resume(rt, tmp_path):
+    """classic tune.run(resume=True) continues the named experiment
+    from its journal."""
+    marker = str(tmp_path / "attempted")
+
+    def flaky(config):
+        from ray_tpu.train import report
+        if not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("x")
+            raise RuntimeError("first run dies")
+        report({"ok": 1})
+
+    g1 = tune.run(flaky, storage_path=str(tmp_path), name="res")
+    assert g1[0].state == "ERROR"
+    g2 = tune.run(flaky, storage_path=str(tmp_path), name="res",
+                  resume=True)
+    assert g2[0].state == "COMPLETED"
+    with pytest.raises(ValueError, match="name"):
+        tune.run(flaky, resume=True)
+    with pytest.raises(ValueError, match="journal"):
+        tune.run(flaky, storage_path=str(tmp_path), name="ghost",
+                 resume=True)

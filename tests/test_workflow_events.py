@@ -266,3 +266,14 @@ def test_step_options_name_and_metadata(rt):
     # explicitly-named steps get position-independent keys
     assert md["step_metadata"] == {
         "named_stable_step": {"owner": "team-x"}}
+
+
+def test_run_metadata_recorded(rt):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="wf_md",
+                 metadata={"team": "x"}, timeout=60)
+    assert workflow.get_metadata("wf_md")["user_metadata"] == \
+        {"team": "x"}
