@@ -74,6 +74,27 @@ class Config:
     # Inline (single-message) ship objects up to this size; larger
     # ones go through the chunked pull protocol.
     object_transfer_inline_max: int = 8 * 1024 * 1024
+    # Pipelined chunk pulls: chunks k+1..k+W are requested while
+    # chunk k is being assembled (reference: PullManager keeps
+    # multiple chunk requests in flight per pull). 1 = serial
+    # req/resp per chunk (the pre-vectorized behavior).
+    object_transfer_window: int = 8
+    # Bounded width of the remote-pull fan-out inside a batched get:
+    # node-homed refs in one get([...]) are fetched on up to this
+    # many threads instead of a serial loop.
+    get_parallelism: int = 8
+    # Max refs per OP_GET_MANY wire round; a larger get([...]) is
+    # split client-side so one reply frame stays bounded. The wire-
+    # round guardrail in tests/test_perf.py is ceil(N/this) + 1.
+    get_many_batch_size: int = 512
+    # Per-process deserialization cache (immutable objects only):
+    # repeated get() of the same ObjectID returns the cached value
+    # instead of re-deserializing. 0 disables the cache.
+    deser_cache_max_bytes: int = 256 * 1024 * 1024
+    # Only objects at or above this size are cached — matches the
+    # shm threshold by default, so only shared-memory-resident
+    # (read-only page-backed) objects are ever served from cache.
+    deser_cache_min_bytes: int = 100 * 1024
 
     # --- fault tolerance ---
     # Default task max retries (reference: max_retries=3 default).

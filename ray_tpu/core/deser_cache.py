@@ -1,0 +1,112 @@
+"""Per-process deserialization cache for the object plane.
+
+Repeated ``get()`` of the same ObjectID (actor broadcast weights, Tune
+trial configs, a shared dataset block) pays the full unpickle each
+time even though stored objects are immutable. This LRU keeps the
+*deserialized* value keyed by ObjectID so a repeat get is a dict
+lookup — and because native-store reads hand back zero-copy
+``PinnedBuffer`` views (object_store.py), a cached numpy array keeps
+serving straight from the shared arena pages with no copy at all.
+
+Safety model: stored objects are immutable by contract (reference:
+plasma-backed arrays are read-only to readers), and the default
+``min_bytes`` equals the shm threshold so only shared-memory-resident
+objects — whose buffers are already read-only views — are cached.
+Owners invalidate on delete (``DriverRuntime._delete_object``) and on
+re-store; borrowers invalidate when their last local ref is
+collected. ObjectIDs are never reused, so a stale entry can only
+serve the value the id always named.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+
+class DeserializationCache:
+    """Byte-budget LRU of deserialized values, keyed by ObjectID.
+
+    Thread-safe. ``hits`` / ``misses`` are plain counters exposed for
+    tests and the perf harness (acceptance: repeated get of a large
+    ref must be observable as cache hits on the runtime).
+    """
+
+    def __init__(self, max_bytes: int, min_bytes: int = 0):
+        self._max = max_bytes
+        self._min = min_bytes
+        # oid -> (value, nbytes)
+        self._entries: "OrderedDict[Any, tuple]" = OrderedDict()
+        self._bytes = 0
+        # RLock, and evicted values are deallocated OUTSIDE the lock:
+        # dropping a cached value can run arbitrary finalizers (an
+        # ObjectRef nested in it re-enters invalidate() from its
+        # weakref.finalize), which a plain lock would deadlock on.
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._max > 0
+
+    def lookup(self, oid) -> tuple[bool, Any]:
+        """(hit, value). A miss returns (False, None) and counts —
+        the miss counter is the denominator for hit-rate telemetry."""
+        with self._lock:
+            entry = self._entries.get(oid)
+            if entry is None:
+                self.misses += 1
+                return False, None
+            self._entries.move_to_end(oid)
+            self.hits += 1
+            return True, entry[0]
+
+    def offer(self, oid, value, nbytes: int) -> bool:
+        """Cache ``value`` if it qualifies (size window, budget).
+        Returns True when cached. Oversized values are rejected
+        outright rather than evicting the whole cache for one entry."""
+        if self._max <= 0 or nbytes < self._min or nbytes > self._max:
+            return False
+        evicted = []                 # keeps values alive past the lock
+        with self._lock:
+            old = self._entries.pop(oid, None)
+            if old is not None:
+                self._bytes -= old[1]
+                evicted.append(old)
+            self._entries[oid] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self._max and self._entries:
+                _, entry = self._entries.popitem(last=False)
+                self._bytes -= entry[1]
+                evicted.append(entry)
+        del evicted
+        return True
+
+    def invalidate(self, oid) -> None:
+        with self._lock:
+            entry = self._entries.pop(oid, None)
+            if entry is not None:
+                self._bytes -= entry[1]
+        del entry                    # value dealloc outside the lock
+
+    def clear(self) -> None:
+        with self._lock:
+            dropped = self._entries
+            self._entries = OrderedDict()
+            self._bytes = 0
+        del dropped
+
+    def __contains__(self, oid) -> bool:
+        with self._lock:
+            return oid in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._bytes

@@ -851,13 +851,43 @@ class NodeDaemon:
                                        buffers=list(meta[2]))
                 ok = True
                 return obj
-            obj = ser.reassemble_chunked(
+
+            # Pipelined pull over the (strictly in-order) peer
+            # connection: keep up to ``window`` chunk requests on the
+            # wire; replies come back in request order. On error the
+            # connection is desynced — _peer_release(ok=False)
+            # discards it and the peer's transfer expires idle.
+            def recv_piece():
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not conn.poll(left):
+                        from ray_tpu.core.exceptions import (
+                            GetTimeoutError,
+                        )
+                        raise GetTimeoutError("peer pull timed out")
+                status, payload = conn.recv()
+                if status == P.ST_ERR:
+                    raise ser.loads(payload)
+                return payload
+
+            def end(tid):
+                # A failed end leaves the conn desynced (its reply
+                # unconsumed): the object is complete, so return it —
+                # but ok stays False and the conn is discarded
+                # instead of rejoining the pool.
+                try:
+                    self._peer_call(conn, ("end", tid), deadline)
+                except Exception:  # noqa: BLE001
+                    end_ok[0] = False
+
+            end_ok = [True]
+            obj = ser.reassemble_chunked_stream(
                 meta,
-                lambda tid, i: self._peer_call(
-                    conn, ("chunk", tid, i), deadline),
-                lambda tid: self._peer_call(conn, ("end", tid),
-                                            deadline))
-            ok = True
+                lambda tid, i: conn.send(("chunk", tid, i)),
+                recv_piece,
+                end,
+                window=max(1, self.config.object_transfer_window))
+            ok = end_ok[0]
             return obj
         finally:
             self._peer_release(addr, conn, ok)
@@ -1350,9 +1380,21 @@ class NodeDaemon:
             return ("inline", data, bufs)
         if op == P.OP_GET_MANY:
             oid_list, timeout, allow_desc = payload
-            return [self._handle_worker_object_op(
-                        P.OP_GET, (ob, timeout, allow_desc))
-                    for ob in oid_list]
+            # Same reply-frame byte budget as the head's handler:
+            # inline entries past the cap defer to a follow-up round.
+            from ray_tpu.core.runtime import _entry_inline_bytes
+            budget = self.config.object_transfer_inline_max
+            spent = 0
+            outs = []
+            for ob in oid_list:
+                if spent > budget and outs:
+                    outs.append(("defer",))
+                    continue
+                e = self._handle_worker_object_op(
+                    P.OP_GET, (ob, timeout, allow_desc))
+                spent += _entry_inline_bytes(e)
+                outs.append(e)
+            return outs
         if op == P.OP_PULL:
             action, tid, *prest = payload
             if action == "chunk":

@@ -519,7 +519,11 @@ class PinnedBuffer:
     deserialized out-of-band) keep this exporter alive through the
     buffer protocol; the shared ``_Pin`` holds the reader refcount
     until every buffer of the object is garbage-collected — only then
-    may the owner's delete actually reclaim the pages."""
+    may the owner's delete actually reclaim the pages.
+
+    Requires the PEP 688 python-level buffer protocol (3.12+); on
+    older interpreters ``_pinned_view`` below builds the same
+    lifetime chain out of a ctypes exporter."""
 
     def __init__(self, view: memoryview, pin: _Pin):
         self._view = view
@@ -535,6 +539,26 @@ class PinnedBuffer:
 
     def __len__(self):
         return len(self._view)
+
+
+import sys as _sys  # noqa: E402
+
+_PEP688 = _sys.version_info >= (3, 12)
+
+
+def _pinned_view(view: memoryview, pin: _Pin) -> memoryview:
+    """Pre-3.12 zero-copy pinned buffer: a read-only memoryview whose
+    exporter chain owns the pin. ``memoryview`` can't be subclassed
+    and only 3.12+ honors ``__buffer__`` on python classes, so the
+    chain is built from a ctypes array exported OVER the arena view
+    (no copy): consumer array -> read-only memoryview -> ctypes
+    exporter (holds ``_pin`` + the slice) -> arena mmap. The pin's
+    release fires when the last consumer is collected — exactly the
+    PinnedBuffer contract."""
+    import ctypes
+    exporter = (ctypes.c_char * len(view)).from_buffer(view)
+    exporter._pin = pin
+    return memoryview(exporter).toreadonly()
 
 
 def _decode_pinned(record: memoryview, store,
@@ -559,9 +583,15 @@ def _decode_pinned(record: memoryview, store,
             pos += 8
         buffers: list = []
         for ln in lens:
-            buffers.append(PinnedBuffer(mv[pos:pos + ln], pin))
+            if ln == 0:
+                buffers.append(b"")
+            elif _PEP688:
+                buffers.append(PinnedBuffer(mv[pos:pos + ln], pin))
+            else:
+                buffers.append(_pinned_view(mv[pos:pos + ln], pin))
             pos += ln
-        if not buffers:
+        if not any(isinstance(b, (PinnedBuffer, memoryview))
+                   for b in buffers):
             pin.release()
         return SerializedObject(data=data, buffers=buffers)
     except Exception:

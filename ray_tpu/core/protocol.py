@@ -91,7 +91,15 @@ OP_GET_MANY = "get_many"        # ([oid_bytes], timeout, allow_desc)
                                 # -> [per-ref OP_GET-shaped entries];
                                 # ONE round trip for a whole ref list
                                 # (a client get([...]) used to pay one
-                                # blocking RTT per ref)
+                                # blocking RTT per ref). Replies cap
+                                # their inline payload bytes at
+                                # object_transfer_inline_max: entries
+                                # past the budget come back as
+                                # ("defer",) and the client re-requests
+                                # them in follow-up rounds (>= 1 entry
+                                # served per round). A daemon answering
+                                # for a worker may reply ("fallback",)
+                                # -> client uses per-ref OP_GET.
 OP_WAIT = "wait"
 OP_KILL = "kill"
 OP_CANCEL = "cancel"

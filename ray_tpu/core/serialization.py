@@ -174,26 +174,10 @@ def loads(data: bytes):
     return pickle.loads(data)
 
 
-def reassemble_chunked(meta: tuple, fetch_chunk, end) -> SerializedObject:
-    """Rebuild one object from a chunked-transfer announcement
-    (("chunked", tid, data_len, buf_lens, chunk)) by calling
-    ``fetch_chunk(tid, index) -> bytes`` for each chunk and
-    ``end(tid)`` when done (always, also on error). Shared by every
-    puller — head<-node, daemon<-daemon, client<-head — so the
-    reassembly logic exists exactly once."""
-    _, tid, data_len, buf_lens, chunk = meta
-    total = data_len + sum(buf_lens)
-    nchunks = -(-total // chunk) if total else 0
-    buf = bytearray(total)
-    try:
-        for i in range(nchunks):
-            piece = fetch_chunk(tid, i)
-            buf[i * chunk:i * chunk + len(piece)] = piece
-    finally:
-        try:
-            end(tid)
-        except Exception:  # noqa: BLE001
-            pass
+def _split_record(buf: bytearray, data_len: int,
+                  buf_lens: list) -> SerializedObject:
+    """Slice one reassembled transfer buffer back into
+    (data, buffers) without copying the buffer payloads."""
     mv = memoryview(buf)
     buffers = []
     pos = data_len
@@ -201,6 +185,106 @@ def reassemble_chunked(meta: tuple, fetch_chunk, end) -> SerializedObject:
         buffers.append(mv[pos:pos + ln])
         pos += ln
     return SerializedObject(data=bytes(mv[:data_len]), buffers=buffers)
+
+
+def reassemble_chunked(meta: tuple, fetch_chunk, end,
+                       window: int = 1) -> SerializedObject:
+    """Rebuild one object from a chunked-transfer announcement
+    (("chunked", tid, data_len, buf_lens, chunk)) by calling
+    ``fetch_chunk(tid, index) -> bytes`` for each chunk and
+    ``end(tid)`` when done (always, also on error). Shared by every
+    puller — head<-node, daemon<-daemon, client<-head — so the
+    reassembly logic exists exactly once.
+
+    ``window`` > 1 keeps that many chunk fetches in flight at once
+    (each on its own thread, writing its disjoint slice of the
+    buffer) — valid only for transports whose fetch_chunk is safe to
+    call concurrently (request-id-demuxed channels: the client socket
+    and the head<->daemon channel). In-order req/resp connections use
+    ``reassemble_chunked_stream`` instead. On error the lowest-index
+    failure is raised after in-flight fetches drain."""
+    _, tid, data_len, buf_lens, chunk = meta
+    total = data_len + sum(buf_lens)
+    nchunks = -(-total // chunk) if total else 0
+    buf = bytearray(total)
+    try:
+        if window <= 1 or nchunks <= 1:
+            for i in range(nchunks):
+                piece = fetch_chunk(tid, i)
+                buf[i * chunk:i * chunk + len(piece)] = piece
+        else:
+            _fetch_windowed(tid, nchunks, chunk, buf, fetch_chunk,
+                            window)
+    finally:
+        try:
+            end(tid)
+        except Exception:  # noqa: BLE001
+            pass
+    return _split_record(buf, data_len, buf_lens)
+
+
+def _fetch_windowed(tid: str, nchunks: int, chunk: int,
+                    buf: bytearray, fetch_chunk, window: int) -> None:
+    import threading
+    next_lock = threading.Lock()
+    counter = iter(range(nchunks))
+    errors: list = []
+    stop = threading.Event()
+
+    def run():
+        while not stop.is_set():
+            with next_lock:
+                i = next(counter, None)
+            if i is None:
+                return
+            try:
+                piece = fetch_chunk(tid, i)
+                # Disjoint equal-length slice writes: safe under the
+                # GIL (the bytearray never resizes).
+                buf[i * chunk:i * chunk + len(piece)] = piece
+            except BaseException as e:  # noqa: BLE001
+                errors.append((i, e))
+                stop.set()
+                return
+
+    threads = [threading.Thread(target=run, daemon=True,
+                                name=f"chunk_pull_{k}")
+               for k in range(min(window, nchunks))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        errors.sort(key=lambda pair: pair[0])
+        raise errors[0][1]
+
+
+def reassemble_chunked_stream(meta: tuple, send_req, recv_piece, end,
+                              window: int = 1) -> SerializedObject:
+    """Pipelined reassembly over ONE in-order request/response
+    connection (the daemon<->daemon peer object plane): keep up to
+    ``window`` chunk requests on the wire — request chunk k+1..k+W
+    while assembling chunk k. Replies arrive in request order, so no
+    demuxing is needed. ``send_req(tid, i)`` fires one request;
+    ``recv_piece() -> bytes`` consumes the next in-order reply;
+    ``end(tid)`` runs only on success (an error path abandons the
+    desynced connection to the caller's discard logic)."""
+    _, tid, data_len, buf_lens, chunk = meta
+    total = data_len + sum(buf_lens)
+    nchunks = -(-total // chunk) if total else 0
+    buf = bytearray(total)
+    window = max(1, window)
+    sent = 0
+    recvd = 0
+    while recvd < nchunks:
+        while sent < nchunks and sent - recvd < window:
+            send_req(tid, sent)
+            sent += 1
+        piece = recv_piece()
+        buf[recvd * chunk:recvd * chunk + len(piece)] = piece
+        recvd += 1
+    end(tid)
+    return _split_record(buf, data_len, buf_lens)
 
 
 def materialize(obj: SerializedObject) -> SerializedObject:

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.util.jax_compat import shard_map as _shard_map
+
 _NEG_INF = -1e30
 
 
@@ -247,9 +249,9 @@ def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
         # dense path.
         spec = P(batch if batch else None, None, heads, None)
         local = functools.partial(causal_attention, force_flash=True)
-        sharded = jax.shard_map(local, mesh=mesh,
-                                in_specs=(spec, spec, spec),
-                                out_specs=spec, check_vma=False)
+        sharded = _shard_map(local, mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)
         n_batch = 1
         for a in batch:
             n_batch *= mesh.shape[a]
@@ -270,5 +272,5 @@ def make_sharded_causal_attention(mesh, batch_axes=("dp", "fsdp"),
     local_impl = (ulysses_attention if impl == "ulysses"
                   else ring_attention)
     fn = functools.partial(local_impl, axis_name=seq_axis)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)

@@ -115,8 +115,9 @@ def make_mesh(spec: MeshSpec | dict[str, int] | None = None,
         auto = (jax.sharding.AxisType.Auto,) * len(names)
         return jax.make_mesh(shape, names, devices=devices,
                              axis_types=auto)
-    except TypeError:
-        # older signature without devices/axis_types kwargs
+    except (TypeError, AttributeError):
+        # older jax: no AxisType (0.4.x) and/or a make_mesh signature
+        # without devices/axis_types kwargs
         import numpy as np
         from jax.sharding import Mesh
         return Mesh(np.asarray(devices).reshape(shape), names)

@@ -207,13 +207,19 @@ class RecurrentActorCritic(nn.Module):
         flat = obs_seq.reshape(B * T, *obs_seq.shape[2:])
         feat = self.encoder(flat).reshape(B, T, -1)
 
-        def one(carry, x_t):
-            carry, y = self.cell(carry, x_t)
+        def one(cell, carry, x_t):
+            carry, y = cell(carry, x_t)
             return carry, (y, carry)
 
         # scan over time; cell wants batch leading, so feed [T, B, F].
-        _, (ys, cs) = jax.lax.scan(one, carry0,
-                                   feat.transpose(1, 0, 2))
+        # Lifted nn.scan (not raw jax.lax.scan): calling a flax
+        # submodule from inside a raw jax transform trips flax's
+        # trace-level check (JaxTransformError).
+        scan = nn.scan(one, variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=0, out_axes=0)
+        _, (ys, cs) = scan(self.cell, carry0,
+                           feat.transpose(1, 0, 2))
         x = ys.transpose(1, 0, 2)              # [B, T, H]
         logits, value = self._heads(x)
         return logits, value, cs.transpose(1, 0, 2)

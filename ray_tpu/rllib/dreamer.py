@@ -171,23 +171,29 @@ class DreamerModule(nn.Module):
         z0 = jnp.zeros((B, c.z_dim), obs.dtype)
         keys = jax.random.split(key, T)
 
-        def step(carry, xt):
+        def step(mdl, carry, xt):
             h, z, a_prev = carry
             embed_t, a_t, first_t, k_t = xt
             # Episode starts reset the latent state AND the incoming
             # action (no dynamics across an env reset).
             mask = (1.0 - first_t)[:, None]
             h, z, a_prev = h * mask, z * mask, a_prev * mask
-            h2 = self._core(h, z, a_prev)
-            prior = self._prior_logp(h2)
-            post = self._post_logp(h2, embed_t)
+            h2 = mdl._core(h, z, a_prev)
+            prior = mdl._prior_logp(h2)
+            post = mdl._post_logp(h2, embed_t)
             z2 = _st_sample(post, k_t)
             return (h2, z2, a_t), (h2, z2, prior, post)
 
         xs = (embeds.transpose(1, 0, 2), a_onehot.transpose(1, 0, 2),
               is_first.transpose(1, 0), keys)
-        _, (hs, zs, priors, posts) = jax.lax.scan(
-            step, (h0, z0, jnp.zeros_like(a_onehot[:, 0])), xs)
+        # Lifted nn.scan: the body calls flax submodules, which raw
+        # jax.lax.scan inside a module context trips the flax
+        # trace-level check on (JaxTransformError).
+        scan = nn.scan(step, variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=0, out_axes=0)
+        _, (hs, zs, priors, posts) = scan(
+            self, (h0, z0, jnp.zeros_like(a_onehot[:, 0])), xs)
         hs = hs.transpose(1, 0, 2)                        # [B, T, H]
         zs = zs.transpose(1, 0, 2)
         feat = self._feat(hs, zs)

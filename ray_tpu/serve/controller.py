@@ -64,16 +64,15 @@ class ServeController:
                      and old["args"] == init_args
                      and old["kwargs"] == init_kwargs
                      and old["resources"] == (resources or {}))
-        if (same_spec
-                and (autoscaling_config or None)
-                == old.get("autoscaling_raw")
-                and user_config != old.get("user_config")):
+        if same_spec and user_config != old.get("user_config"):
             # Lightweight update (reference: user_config semantics —
             # a redeploy changing ONLY user_config reconfigures live
             # replicas in place, no restart). APPLY first, commit
             # after: a raising reconfigure must not leave the desired
             # state carrying a config that crash-loops every future
-            # replica spawn.
+            # replica spawn. Runs even when autoscaling_config ALSO
+            # changed — skipping it left live replicas silently
+            # serving the old user_config (the redeploy dead zone).
             errs = []
             for r in self.replicas.get(name, []):
                 try:
@@ -88,12 +87,17 @@ class ServeController:
                     f"replicas may be mixed until redeploy): "
                     f"{errs[0]}")
             old["user_config"] = user_config
-            if name not in self.autoscaling:
-                # an autoscaler owns the replica count; the static
-                # number must not clobber its decision
-                old["num_replicas"] = num_replicas
-            self._bump_version(name)
-            return True
+            if (autoscaling_config or None) \
+                    == old.get("autoscaling_raw"):
+                if name not in self.autoscaling:
+                    # an autoscaler owns the replica count; the static
+                    # number must not clobber its decision
+                    old["num_replicas"] = num_replicas
+                self._bump_version(name)
+                return True
+            # autoscaling changed too: fall through to rebuild the
+            # desired state and autoscaling below (same_spec holds, so
+            # no drain-replace — replicas are already reconfigured).
         if old is not None and not same_spec:
             # CODE/arg change: existing replicas run the old
             # deployment — drain-replace them (reference: redeploys

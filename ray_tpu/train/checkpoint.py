@@ -41,6 +41,14 @@ def _save_local(tree: Any, directory: str) -> str:
     os.makedirs(directory, exist_ok=True)
     try:
         import orbax.checkpoint as ocp
+        import jax
+        import numpy as np
+        # Older orbax StandardCheckpointHandlers reject bare numpy
+        # scalars (np.int32 step counters etc.) — store them as 0-d
+        # arrays, which restore comparably.
+        tree = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic)
+            else x, tree)
         path = os.path.join(os.path.abspath(directory), "state")
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(path, tree, force=True)

@@ -1,0 +1,44 @@
+"""Forward-compat shims for older jax installs.
+
+The repo targets the current jax surface (``jax.shard_map`` with
+``check_vma``, ``jax.sharding.AxisType``); the baked-in toolchain may
+carry jax 0.4.x, where ``shard_map`` still lives in
+``jax.experimental`` and the replication-check kwarg is named
+``check_rep``. Nothing here imports jax at module load — processes
+that don't own the device runtime must not pull it in (see
+core/serialization.py).
+"""
+
+from __future__ import annotations
+
+
+def _legacy_shard_map(*args, **kwargs):
+    """0.4.x ``jax.experimental.shard_map.shard_map`` behind the
+    current keyword surface (check_vma -> check_rep)."""
+    from jax.experimental.shard_map import shard_map as fn
+    if "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return fn(*args, **kwargs)
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` where available, else the legacy shim."""
+    import jax
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        return _legacy_shard_map(*args, **kwargs)
+    return fn(*args, **kwargs)
+
+
+def ensure_jax_compat() -> None:
+    """Install missing top-level aliases on an already-imported older
+    jax so code written against the current API (including the test
+    suite) runs unchanged. Call only from processes that already own
+    a jax import (tests, model code)."""
+    import jax
+    if not hasattr(jax, "shard_map"):
+        try:
+            import jax.experimental.shard_map  # noqa: F401
+            jax.shard_map = _legacy_shard_map
+        except ImportError:
+            pass

@@ -548,6 +548,11 @@ def _pid_alive(pid) -> bool:
     try:
         os.kill(int(pid), 0)
         return True
+    except PermissionError:
+        # EPERM: the pid exists but belongs to another user — alive.
+        # Treating it as dead would let a second concurrent executor
+        # double-run steps against the shared storage root.
+        return True
     except (OSError, TypeError, ValueError):
         return False
 

@@ -24,6 +24,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Object-plane hot-path metrics (ray_tpu/perf.py): every snapshot
+# must carry them so future PRs have a trajectory for fan-in get and
+# the deserialization cache. A run missing one (crashed mid-bench,
+# older checkout) is reported loudly rather than silently thinning
+# the series.
+OBJECT_PLANE_METRICS = (
+    "fanin_get_64x1MiB_serial",
+    "fanin_get_64x1MiB_batched",
+    "fanin_get_wire_64x1MiB_serial",
+    "fanin_get_wire_64x1MiB_batched",
+    "repeated_get_64MiB_cached",
+    "repeated_get_64MiB_cache_hits",
+)
+
 
 def one_run(path: str, serve: bool, timeout: float,
             quick: bool = False) -> list[dict]:
@@ -81,6 +95,11 @@ def main() -> None:
                        quick=args.quick)
         print(f"run {i+1}: {len(rows)} metrics in {time.time()-t0:.0f}s",
               file=sys.stderr)
+        got = {r.get("metric") for r in rows}
+        missing = [m for m in OBJECT_PLANE_METRICS if m not in got]
+        if missing:
+            print(f"run {i+1}: WARNING missing object-plane metrics "
+                  f"{missing} (crashed mid-bench?)", file=sys.stderr)
         all_runs.append(rows)
 
     by_metric: dict[str, list[dict]] = {}

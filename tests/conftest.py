@@ -29,7 +29,13 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (0.4.x) has no jax_num_cpu_devices flag; the
+    # --xla_force_host_platform_device_count=8 XLA_FLAGS fallback set
+    # above provides the 8-device CPU mesh instead.
+    pass
 try:
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
@@ -37,6 +43,12 @@ try:
                       0.5)
 except Exception:  # noqa: BLE001 — older jax without the knobs
     pass
+
+# Older jax (0.4.x): alias the current API names the suite and the
+# model layer are written against (jax.shard_map et al).
+from ray_tpu.util.jax_compat import ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()
 
 import pytest  # noqa: E402
 

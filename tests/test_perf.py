@@ -6,8 +6,11 @@ host vs the reference bar of 952 / 1,950 from SURVEY §6) — this guards
 against order-of-magnitude control-plane regressions, not noise.
 """
 
+import math
+
 import pytest
 
+import ray_tpu
 from ray_tpu.perf import run_all
 
 
@@ -18,3 +21,31 @@ def test_microbench_floors(rt):
     assert results["1_1_actor_calls_sync"] > 500
     assert results["1_1_actor_calls_async"] > 1000
     assert results["single_client_put_calls_1KiB"] > 1000
+
+
+def test_batched_get_wire_round_guardrail(rt):
+    """A worker-side get of N remote refs must stay within
+    1 + ceil(N / get_many_batch_size) blocking wire rounds — the
+    vectorized object plane's core promise. A regression back to the
+    per-ref OP_GET loop (N rounds) trips this immediately."""
+    from ray_tpu.core.config import get_config
+
+    n = 40
+    refs = [ray_tpu.put(b"g%d" % i) for i in range(n)]
+
+    @ray_tpu.remote(num_cpus=1)
+    def counted_get(ref_lists):
+        from ray_tpu.core.api import get_runtime
+        runtime = get_runtime()
+        inner = ref_lists[0]
+        before = runtime.wire_rounds
+        vals = ray_tpu.get(inner)
+        return runtime.wire_rounds - before, len(vals)
+
+    rounds, count = ray_tpu.get(counted_get.remote([refs]),
+                                timeout=120)
+    assert count == n
+    batch = get_config().get_many_batch_size
+    assert rounds <= 1 + math.ceil(n / batch), (
+        f"{rounds} wire rounds for a {n}-ref batched get "
+        f"(budget {1 + math.ceil(n / batch)})")
