@@ -38,6 +38,12 @@ class AutoscalerConfig:
     # Upper bound on nodes launched per update (reference:
     # upscaling_speed).
     max_launches_per_update: int = 8
+    # Scale-down drains the victim node (migrating any straggler
+    # work and evacuating its stored objects) before the provider
+    # terminates it; this bounds that drain (reference: autoscaler
+    # termination hooks run DrainNode first).
+    drain_before_terminate: bool = True
+    drain_deadline_s: float = 30.0
 
 
 def _fits(avail: dict[str, float], need: dict[str, float]) -> bool:
@@ -157,9 +163,13 @@ class Autoscaler:
         if not demand:
             return launched
 
-        # 2) first-fit pending demand onto current free capacity
+        # 2) first-fit pending demand onto current free capacity.
+        # Draining nodes are about to disappear — counting their free
+        # capacity would suppress the replacement launch until after
+        # they die.
         free = [dict(n["Available"])
-                for n in self.runtime.nodes() if n["Alive"]]
+                for n in self.runtime.nodes()
+                if n["Alive"] and not n.get("Draining")]
         unmet: list[dict[str, float]] = []
         for req in demand:
             for avail in free:
@@ -235,6 +245,22 @@ class Autoscaler:
                       <= nt.min_workers)
             if not at_min and now - first_idle \
                     >= self.config.idle_timeout_s:
+                # Drain first: the node looked idle at the last poll,
+                # but work may have landed since (and its store may
+                # hold task results other nodes still reference) —
+                # terminating with anything in flight would burn
+                # retry budget and trigger lineage reconstruction on
+                # a failure we scheduled ourselves.
+                if self.config.drain_before_terminate:
+                    drain = getattr(self.runtime, "drain_node", None)
+                    if drain is not None:
+                        try:
+                            drain(node.node_id,
+                                  reason="autoscaler scale-down",
+                                  deadline_s=self.config
+                                  .drain_deadline_s)
+                        except Exception:  # noqa: BLE001
+                            pass
                 self.provider.terminate_node(node.node_id)
                 counts[node.node_type] = counts.get(
                     node.node_type, 1) - 1

@@ -126,6 +126,17 @@ class Config:
     # ray_syncer.h:88).
     rview_period_s: float = 1.0
 
+    # --- node drain / preemption (reference: DrainNode protocol,
+    # gcs_node_manager.cc DrainNode + autoscaler termination hooks) ---
+    # Grace window for in-flight tasks on a draining node before they
+    # are preempted and retried elsewhere (preemption refunds the
+    # attempt — an anticipated failure must not burn retry budget).
+    drain_grace_period_s: float = 5.0
+    # Default total drain deadline when the caller (or the preemption
+    # notice) does not specify one: object evacuation, actor
+    # migration, and task preemption must all finish inside it.
+    drain_deadline_s: float = 30.0
+
     # --- memory monitor / OOM killer (reference: MemoryMonitor
     # memory_monitor.h:52 + worker_killing_policy_retriable_fifo) ---
     # Kill a retriable task when system memory usage crosses this
@@ -137,6 +148,11 @@ class Config:
     # --- timeouts ---
     get_timeout_default_s: float = 0.0  # 0 = no timeout
     actor_creation_timeout_s: float = 120.0
+    # How long a client's async-submit drainer waits for an ack
+    # before treating the op as lost and replaying it (dd-deduped)
+    # through the reconnect fence. Drain/preemption tests and
+    # flaky-head deployments tighten this.
+    client_ack_replay_timeout_s: float = 300.0
 
     # --- logging / events ---
     # Task lifecycle events ring-buffer capacity per worker

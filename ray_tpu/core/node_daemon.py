@@ -157,6 +157,14 @@ class NodeDaemon:
         self._upcall_lock = threading.Lock()
         self._upcall_fid = itertools.count(1)
 
+        # Graceful-drain state: a termination notice (SIGTERM from
+        # the platform, a spot/preemption metadata flip) turns into
+        # ONE ND_DRAIN to the head instead of an abrupt socket drop;
+        # the head migrates our work/objects off and answers with
+        # ND_SHUTDOWN when it is safe to exit.
+        self._drain_requested = False
+        self._drain_lock = threading.Lock()
+
         # Node channel to the head. On head death the daemon buffers
         # outbound traffic and re-registers against the restarted head
         # (raylet reconnect after NotifyGCSRestart).
@@ -339,6 +347,35 @@ class NodeDaemon:
                 # by serve_forever's recv EOF) re-establishes us.
                 self._conn_down = True
                 self._buffer_outbox(msg)
+
+    def request_drain(self, reason: str,
+                      deadline_s: float | None = None) -> None:
+        """Initiate a deadline-bounded graceful drain of THIS node:
+        tell the head (ND_DRAIN) so it migrates tasks/actors/objects
+        off before terminating us. Idempotent — the first notice
+        wins. A watchdog guarantees exit by the deadline even if the
+        head never answers (the platform's terminator won't wait)."""
+        if deadline_s is None:
+            deadline_s = self.config.drain_deadline_s
+        with self._drain_lock:
+            if self._drain_requested or self._shutdown:
+                return
+            self._drain_requested = True
+        print(f"ray_tpu node daemon: drain requested ({reason}); "
+              f"deadline {deadline_s}s", flush=True)
+        self.head_send((P.ND_DRAIN, reason, float(deadline_s)))
+
+        def _watchdog():
+            deadline = time.monotonic() + float(deadline_s)
+            while not self._shutdown and time.monotonic() < deadline:
+                time.sleep(0.2)
+            if not self._shutdown:
+                print("ray_tpu node daemon: drain deadline lapsed "
+                      "without head ack — exiting", flush=True)
+                self.shutdown()
+
+        threading.Thread(target=_watchdog, daemon=True,
+                         name="nd_drain_watchdog").start()
 
     def _head_call(self, op: str, payload, timeout: float = 60.0):
         fid = next(self._upcall_fid)
@@ -1451,6 +1488,69 @@ class NodeDaemon:
         self.shm_store.shutdown()
 
 
+def gce_preemption_probe() -> str | None:
+    """Default termination-notice probe: the GCE metadata server's
+    ``instance/preempted`` flag (spot/preemptible TPU VMs flip it to
+    TRUE when the ~30 s termination notice lands). Returns a reason
+    string when preemption is imminent, else None. Unreachable
+    metadata (non-GCE host, test box) reads as "no notice"."""
+    import urllib.request
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/"
+        "instance/preempted",
+        headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=1.0) as resp:
+            body = resp.read().decode().strip()
+    except Exception:  # noqa: BLE001 — no metadata server here
+        return None
+    return "GCE preemption notice" if body.upper() == "TRUE" else None
+
+
+class PreemptionWatcher:
+    """Polls an injectable termination-notice probe and turns the
+    first positive answer into a graceful drain — the same
+    injectable-transport pattern as ``autoscaler/gce_tpu.py``'s
+    runner, so tests drive the whole drain path with a lambda and
+    zero egress. ``probe()`` returns a truthy reason (str) when the
+    node is about to be reclaimed."""
+
+    def __init__(self, daemon: "NodeDaemon", probe=None,
+                 interval_s: float = 1.0,
+                 deadline_s: float | None = None):
+        self.daemon = daemon
+        self.probe = probe or gce_preemption_probe
+        self.interval_s = interval_s
+        self.deadline_s = deadline_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "PreemptionWatcher":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="nd_preempt_watch")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self.daemon._shutdown:
+                return
+            try:
+                notice = self.probe()
+            except Exception:  # noqa: BLE001 — a flaky probe must
+                continue       # not kill the watcher
+            if notice:
+                reason = (notice if isinstance(notice, str)
+                          else "preemption notice")
+                self.daemon.request_drain(reason, self.deadline_s)
+                return
+
+
 def main(argv: list[str] | None = None) -> int:
     import argparse
     import json
@@ -1471,6 +1571,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--reconnect-window", type=float, default=60.0,
                     help="seconds to retry the head after a lost "
                          "connection before giving up")
+    ap.add_argument("--watch-preemption", action="store_true",
+                    help="poll the cloud metadata server for a "
+                         "spot/preemption termination notice and "
+                         "drain gracefully when it lands")
+    ap.add_argument("--drain-deadline", type=float, default=None,
+                    help="seconds a notice-triggered drain may take "
+                         "before the daemon exits regardless "
+                         "(default: RAY_TPU_DRAIN_DEADLINE_S)")
     args = ap.parse_args(argv)
 
     host, _, port = args.address.rpartition(":")
@@ -1490,6 +1598,27 @@ def main(argv: list[str] | None = None) -> int:
         resources=resources, labels=json.loads(args.labels),
         object_store_memory=args.object_store_memory)
     daemon.reconnect_window_s = args.reconnect_window
+
+    # SIGTERM = anticipated termination (k8s pod delete, instance
+    # stop, operator kill): drain through the head instead of dying
+    # with work in flight. SIGKILL remains the crash path the
+    # lineage/retry machinery covers.
+    import signal
+
+    def _on_sigterm(_signum, _frame):
+        threading.Thread(
+            target=daemon.request_drain,
+            args=("SIGTERM",), kwargs={"deadline_s":
+                                       args.drain_deadline},
+            daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass               # embedded in a non-main thread
+    if args.watch_preemption:
+        PreemptionWatcher(daemon,
+                          deadline_s=args.drain_deadline).start()
     print(f"ray_tpu node daemon up: node_id={daemon.node_id} "
           f"head={args.address}", flush=True)
     daemon.serve_forever()

@@ -184,6 +184,16 @@ ND_NODEMAP = "nd_nodemap"     # (ND_NODEMAP, [(node_id, tag_hex,
                               #   obj_addr)]) head -> daemons: owner
                               #   routing table for owner-minted ids
                               #   (pushed on membership change)
+ND_DRAIN = "nd_drain"         # (ND_DRAIN, reason, deadline_s) daemon ->
+                              #   head: this node received a
+                              #   termination notice (SIGTERM, spot/
+                              #   preemption metadata) — drain me
+                              #   within deadline_s instead of letting
+                              #   the sockets drop. The head migrates
+                              #   work/objects off the node, then
+                              #   answers with ND_SHUTDOWN (reference:
+                              #   the DrainNode RPC,
+                              #   gcs_node_manager.cc)
 ND_RSYNC = "nd_rsync"         # (ND_RSYNC, version, report) daemon ->
                               #   head: versioned node load report
                               #   (observed worker count etc.), sent

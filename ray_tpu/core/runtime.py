@@ -184,6 +184,12 @@ class NodeRecord:
     alive: bool = True
     is_head: bool = False
     started_at: float = field(default_factory=time.time)
+    # Drain state (reference: the DrainNode protocol — a draining
+    # node is excluded from scheduling while its work and objects
+    # migrate off, then terminates without losing anything).
+    draining: bool = False
+    drain_reason: str = ""
+    drain_deadline: float = 0.0     # monotonic
     # Daemon-backed nodes (a real ray_tpu.core.node_daemon process on
     # the other end of a TCP connection). conn is None for the head
     # node and for logical test nodes.
@@ -278,6 +284,9 @@ class ActorRecord:
     # Resolved once at creation; restarts reuse it.
     env_key: str = ""
     env_vars: dict[str, str] | None = None
+    # Set when a drain kills a non-restartable actor so the death
+    # error names the real cause instead of "process exited".
+    drain_reason: str = ""
 
 
 @dataclass
@@ -875,6 +884,17 @@ class DriverRuntime:
         # consumer — the relay traffic the p2p object plane exists to
         # eliminate (asserted zero in tests/test_p2p_transfer.py).
         self._relay_chunks = 0
+
+        # Drain / recovery observability. lineage_reconstructions
+        # counts launched re-executions — a graceful drain must leave
+        # it flat (asserted in tests/test_node_drain.py); the drain
+        # counters prove the proactive paths actually ran.
+        self.lineage_reconstructions = 0
+        self.drains_started = 0
+        self.drains_completed = 0
+        self.drain_objects_evacuated = 0
+        self.drain_tasks_preempted = 0
+        self.drain_actors_migrated = 0
 
         # Events / timeline
         self._events: deque = deque(maxlen=config.task_event_buffer_size)
@@ -2039,6 +2059,14 @@ class DriverRuntime:
     def _alive_nodes(self) -> list[NodeRecord]:
         return [n for n in self._nodes.values() if n.alive]
 
+    def _schedulable_nodes(self) -> list[NodeRecord]:
+        """Alive nodes that accept NEW work: a draining node keeps
+        serving its objects and finishing its grace-window tasks but
+        is excluded from every placement decision (reference: a
+        draining raylet rejects new leases)."""
+        return [n for n in self._nodes.values()
+                if n.alive and not n.draining]
+
     def _try_place_locked(self, need: dict[str, float],
                           options: TaskOptions) -> tuple[str, int] | None:
         """Pick (node, pg_bundle) for the request and ACQUIRE the
@@ -2068,7 +2096,10 @@ class DriverRuntime:
                     else range(len(pg_rec.bundle_avail)))
             for bi in idxs:
                 node = self._nodes.get(pg_rec.bundle_nodes[bi])
-                if node is None or not node.alive:
+                if node is None or not node.alive or node.draining:
+                    # A draining node's bundles stop taking new work;
+                    # they re-home through the node-death path once
+                    # the drain completes.
                     continue
                 if self._fits_pool(pg_rec.bundle_avail[bi], need):
                     for k, v in need.items():
@@ -2080,8 +2111,8 @@ class DriverRuntime:
         strategy = options.scheduling_strategy or "DEFAULT"
         if strategy == "NODE_AFFINITY" and options.node_id:
             node = self._nodes.get(options.node_id)
-            if node is not None and node.alive and self._fits_pool(
-                    node.avail, need):
+            if (node is not None and node.alive and not node.draining
+                    and self._fits_pool(node.avail, need)):
                 self._take_from_node(node, need)
                 return node.node_id, -1
             if not options.soft:
@@ -2093,10 +2124,17 @@ class DriverRuntime:
                         f"node {options.node_id!r} is "
                         f"{'dead' if node is not None else 'unknown'} "
                         f"and scheduling is not soft")
+                if node.draining:
+                    # The node is on its way out — a hard pin to it
+                    # can never be satisfied again.
+                    raise PlacementError(
+                        f"node {options.node_id!r} is draining "
+                        f"({node.drain_reason or 'no reason'}) and "
+                        f"scheduling is not soft")
                 return None
             # soft: fall through to DEFAULT below
 
-        candidates = [n for n in self._alive_nodes()
+        candidates = [n for n in self._schedulable_nodes()
                       if self._fits_pool(n.avail, need)
                       and self._fits_pool(n.resources, need)]
         if not candidates:
@@ -2205,6 +2243,218 @@ class DriverRuntime:
             except (OSError, BrokenPipeError):
                 pass
         self._handle_node_death(node_id)
+
+    # -- graceful drain (DrainNode protocol analog) ---------------------
+
+    def drain_node(self, node_id: str, reason: str = "",
+                   deadline_s: float | None = None,
+                   remove: bool = False) -> bool:
+        """Gracefully drain a node ahead of an anticipated failure
+        (spot preemption notice, autoscaler scale-down, maintenance):
+
+        1. mark the node ``draining`` — it leaves every scheduling
+           decision immediately (visible in ``nodes()`` and
+           ``util.state.list_nodes``);
+        2. give in-flight tasks a grace window, then preempt the
+           stragglers — they retry elsewhere through the existing
+           retry path with the interrupted attempt refunded;
+        3. migrate restartable actors to surviving nodes without
+           consuming restart budget; non-restartable actors die with
+           an ActorDiedError naming the drain;
+        4. evacuate primary object copies homed on the node (promote
+           a live replica, else pull to the head) so NO lineage
+           reconstruction fires when the node goes away.
+
+        Blocks until the drain completes or the deadline lapses.
+        ``remove=True`` terminates the node afterwards (the
+        preemption-notice path). Returns False for unknown/dead/head
+        nodes."""
+        cfg = self.config
+        if deadline_s is None:
+            deadline_s = cfg.drain_deadline_s
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        with self._res_cv:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive or node.is_head:
+                return False
+            if not node.draining:
+                node.draining = True
+                node.drain_reason = reason
+                node.drain_deadline = deadline
+                self.drains_started += 1
+            self._res_cv.notify_all()
+        # Tasks first (they may still store results on the node),
+        # then actors, then the object evacuation sweeps everything
+        # that remains.
+        grace = min(cfg.drain_grace_period_s, deadline_s)
+        grace_end = time.monotonic() + grace
+        self._drain_tasks(node_id, grace_end)
+        self._drain_actors(node_id, reason, deadline, grace_end)
+        self._drain_objects(node_id, deadline)
+        self.drains_completed += 1
+        if remove:
+            self.remove_node(node_id)
+        return True
+
+    def _drain_tasks(self, node_id: str, grace_deadline: float) -> None:
+        """Wait out the grace window for tasks running on the node,
+        then preempt the rest: their workers are killed with the
+        drain flag set, so the worker-exit path requeues them with
+        the attempt refunded."""
+        while time.monotonic() < grace_deadline:
+            with self._task_lock:
+                busy = any(rec.node_id == node_id
+                           and rec.state == "RUNNING"
+                           for rec in self._tasks.values())
+            if not busy:
+                return
+            time.sleep(0.05)
+        with self._task_lock:
+            victims = {rec.worker for rec in self._tasks.values()
+                       if rec.node_id == node_id
+                       and rec.state == "RUNNING"
+                       and rec.worker is not None
+                       and not rec.worker.is_actor}
+        for w in victims:
+            w.drain_preempted = True
+            try:
+                w.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _drain_actors(self, node_id: str, reason: str,
+                      deadline: float, grace_end: float) -> None:
+        with self._actor_lock:
+            recs = [r for r in self._actors.values()
+                    if r.node_id == node_id and r.state == "ALIVE"]
+        threads = []
+        for rec in recs:
+            t = threading.Thread(
+                target=self._migrate_actor,
+                args=(rec, reason, deadline, grace_end),
+                daemon=True,
+                name=f"drain_actor_{rec.actor_id.hex()[:8]}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()) + 2.0)
+
+    def _migrate_actor(self, rec: ActorRecord, reason: str,
+                       deadline: float, grace_end: float) -> None:
+        """Move one actor off a draining node. Restartable actors are
+        restarted on a surviving node WITHOUT consuming restart
+        budget (the failure was anticipated); non-restartable actors
+        die with the drain named as the reason. In-flight calls get
+        the remainder of the drain deadline to finish first, so a
+        well-timed drain is invisible to callers."""
+        w = rec.worker
+        restartable = rec.restart_count < rec.max_restarts
+        if not restartable:
+            # Hold the kill until the grace window lapses AND the
+            # actor's in-flight calls drained: higher-level
+            # controllers reacting to the DRAINING state (the serve
+            # controller drain-replaces replicas, routers refresh
+            # their sets) get a bounded window to redirect traffic
+            # before the actor disappears.
+            while (time.monotonic() < grace_end
+                   or rec.in_flight) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            rec.drain_reason = reason or "node drained"
+            if w is not None:
+                try:
+                    w.proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+            return
+        # Stop the pusher from shipping new calls to the doomed
+        # incarnation: clear the ready gate and detach the worker
+        # (the pusher parks until the replacement is up; the old
+        # worker's eventual reader-thread death handler sees a stale
+        # worker and no-ops — same contract as _start_actor's
+        # cleanup path). THEN wait out in-flight calls: the old
+        # incarnation stays alive to finish them, and results flow
+        # back through its still-open exec channel.
+        rec.state = "RESTARTING"
+        rec.ready_event.clear()
+        rec.worker = None
+        while rec.in_flight and time.monotonic() < deadline:
+            time.sleep(0.02)
+        leftovers = dict(rec.in_flight)
+        rec.in_flight.clear()
+        if leftovers:
+            # Calls that outran the whole drain deadline cannot be
+            # transparently replayed (they may have side effects):
+            # surface the drain as the cause.
+            blob = ser.dumps(ActorDiedError(
+                rec.actor_id.hex(),
+                f"node {rec.node_id} drained: {reason or 'drain'} "
+                f"(call did not finish within the drain deadline)"))
+            for task_id, (return_ids, _m) in leftovers.items():
+                for oid in return_ids:
+                    self._store_error(oid, blob)
+                self._finish_stream(task_id, blob)
+        if w is not None:
+            with self._pool_lock:
+                if w in self._workers:
+                    self._workers.remove(w)
+            try:
+                w.proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        self._release(self._effective_resources(rec.options),
+                      rec.options.placement_group,
+                      node_id=rec.node_id, bundle=rec.pg_bundle)
+        self.drain_actors_migrated += 1
+        # No restart_count += 1: migration is free — budget is
+        # reserved for real crashes.
+        self._start_actor(rec)
+
+    def _drain_objects(self, node_id: str, deadline: float) -> None:
+        """Re-home every primary object copy living on the draining
+        node: promote a live replica where one exists, else pull the
+        bytes to the head — so the node's eventual death loses
+        nothing and no lineage reconstruction fires."""
+        oids = list(self._node_objects.get(node_id, set()))
+        for oid in oids:
+            promoted = None
+            with self._obj_cv:
+                if self._obj_locations.get(oid) != ("node", node_id):
+                    continue      # replica only / already moved
+                for nid in self._obj_replicas.get(oid, set()):
+                    n = self._nodes.get(nid)
+                    if n is not None and n.alive and not n.draining:
+                        promoted = nid
+                        break
+                if promoted is not None:
+                    self._obj_replicas[oid].discard(promoted)
+                    if not self._obj_replicas[oid]:
+                        self._obj_replicas.pop(oid, None)
+                    # The draining node's copy survives until the
+                    # node actually dies — keep it as a replica so a
+                    # delete still frees it.
+                    self._obj_replicas.setdefault(oid, set()).add(
+                        node_id)
+                    self._obj_locations[oid] = ("node", promoted)
+                    self._node_objects.setdefault(
+                        promoted, set()).add(oid)
+                    self._obj_cv.notify_all()
+            if promoted is not None:
+                self._node_objects.get(node_id, set()).discard(oid)
+                self.drain_objects_evacuated += 1
+                continue
+            try:
+                obj = self._fetch_from_node(node_id, oid, deadline)
+            except Exception:  # noqa: BLE001
+                # Unreachable mid-drain (node died under us): the
+                # death path's lineage recovery remains the backstop.
+                continue
+            with self._obj_cv:
+                if self._obj_locations.get(oid) != ("node", node_id):
+                    continue      # deleted/moved while we pulled
+            self._store_value(oid, obj)
+            self._node_objects.get(node_id, set()).discard(oid)
+            self.drain_objects_evacuated += 1
 
     def _handle_node_death(self, node_id: str) -> None:
         with self._res_cv:
@@ -2638,6 +2888,7 @@ class DriverRuntime:
         # Charge the budget only for a rebuild that actually launched.
         with self._lineage_lock:
             lin.reconstructions += 1
+        self.lineage_reconstructions += 1
         self._event(rec, "RECONSTRUCTING")
         with self._res_cv:
             self._pending_add_locked(rec)
@@ -2880,7 +3131,7 @@ class DriverRuntime:
             need = rec.need or self._effective_resources(rec.options)
             if any(self._fits_pool(n.avail, need)
                    and self._fits_pool(n.resources, need)
-                   for n in self._alive_nodes()):
+                   for n in self._schedulable_nodes()):
                 return
             i = 0
             while i < len(self._pending) and len(extras) < room:
@@ -3115,6 +3366,14 @@ class DriverRuntime:
             # cancel(force=True): error already stored; never retry.
             self._prune_task(victim)
             return
+        if getattr(w, "drain_preempted", False):
+            # The worker was killed by a node drain, not a crash: the
+            # preemption was anticipated, so the interrupted attempt
+            # is refunded — retry budget is reserved for real
+            # failures (reference: drained leases are rescheduled,
+            # not failed).
+            victim.attempts = max(0, victim.attempts - 1)
+            self.drain_tasks_preempted += 1
         max_retries = (victim.options.max_retries
                        if victim.options.max_retries >= 0
                        else self.config.task_max_retries)
@@ -3417,6 +3676,26 @@ class DriverRuntime:
                         # the replacement.
                         flush()
                         w = rec.worker
+                    if w is None:
+                        # Mid-migration (node drain detached the
+                        # worker after we passed the ready gate):
+                        # re-park until the replacement is up.
+                        parked = time.monotonic() + \
+                            self.config.actor_creation_timeout_s
+                        while w is None:
+                            if not rec.ready_event.wait(0.2):
+                                if time.monotonic() > parked:
+                                    raise ActorDiedError(
+                                        rec.actor_id.hex(),
+                                        "actor failed to restart "
+                                        "in time")
+                                continue
+                            if rec.state == "DEAD":
+                                raise rec.creation_error or \
+                                    ActorDiedError(
+                                        rec.actor_id.hex(),
+                                        "actor is dead")
+                            w = rec.worker
                     if arg_refs:
                         # An arg may BE an earlier call's result from
                         # this very batch (x = a.f.remote();
@@ -3483,7 +3762,10 @@ class DriverRuntime:
         # gcs_actor_manager.cc:1358).
         was_alive = rec.state in ("ALIVE", "RESTARTING")
         # Fail all in-flight calls.
-        err = ActorDiedError(actor_id.hex(), "actor process exited")
+        err = ActorDiedError(
+            actor_id.hex(),
+            f"node {rec.node_id} drained: {rec.drain_reason}"
+            if rec.drain_reason else "actor process exited")
         blob = ser.dumps(err)
         for task_id, (return_ids, _m) in rec.in_flight.items():
             for oid in return_ids:
@@ -3907,12 +4189,29 @@ class DriverRuntime:
             "NodeID": n.node_id,
             "Alive": n.alive,
             "IsHead": n.is_head,
+            "Draining": n.draining,
+            "DrainReason": n.drain_reason,
             "Resources": dict(n.resources),
             "Available": dict(n.avail),
             "Labels": dict(n.labels),
             "alive_workers": per_node.get(n.node_id, 0),
             "Observed": dict(n.observed),
         } for n in recs]
+
+    def list_state(self, kind: str, filters=None):
+        """State-API read usable from the driver process (workers
+        reach the same tables through OP_STATE)."""
+        from ray_tpu.util import state as state_api
+        if kind == "raw_nodes":
+            return self.nodes()
+        fns = {
+            "tasks": state_api.list_tasks,
+            "actors": state_api.list_actors,
+            "objects": state_api.list_objects,
+            "nodes": state_api.list_nodes,
+            "placement_groups": state_api.list_placement_groups,
+        }
+        return fns[kind](filters)
 
     def _event(self, rec: TaskRecord, state: str) -> None:
         # Raw tuple on the hot path (3 appends per task); formatted
@@ -4464,6 +4763,19 @@ class DriverRuntime:
                         event, slot, _nid = entry
                         slot.append((status, payload))
                         event.set()
+                elif kind == P.ND_DRAIN:
+                    # The daemon saw a termination notice (SIGTERM /
+                    # preemption metadata): drain on its behalf, then
+                    # terminate it — remove_node's ND_SHUTDOWN is the
+                    # "drain complete, you may exit" ack.
+                    _, reason, deadline_s = msg
+                    threading.Thread(
+                        target=self.drain_node, args=(node_id,),
+                        kwargs={"reason": reason,
+                                "deadline_s": deadline_s,
+                                "remove": True},
+                        daemon=True,
+                        name=f"drain_{node_id[:12]}").start()
                 elif kind == P.ND_UPCALL:
                     _, fid, op, payload = msg
                     threading.Thread(
@@ -5238,6 +5550,11 @@ class DriverRuntime:
                 return state_api.summarize_tasks()
             if kind == "timeline":
                 return self.timeline()
+            if kind == "raw_nodes":
+                # Full NodeID/Alive/Draining rows for consumers (e.g.
+                # the serve controller actor) that need the real node
+                # table, not the worker-side single-node stub.
+                return self.nodes()
             return fns[kind](filters)
         if op == P.OP_PG_CREATE:
             bundles, strategy, name = (payload if len(payload) == 3

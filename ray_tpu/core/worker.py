@@ -738,11 +738,16 @@ class ClientRuntime:
             (req_id, event, slot, op, payload,
              dd) = self._async_q.popleft()
             replay = False
-            if not event.wait(300.0):
-                # No ack in 5 minutes: the submit may or may not have
-                # applied — drop the leaked pending slot and replay
-                # under the SAME dd (the head coalesces/dedupes, so a
-                # merely-slow original still wins).
+            from ray_tpu.core.config import get_config
+            if not event.wait(
+                    get_config().client_ack_replay_timeout_s):
+                # No ack within the replay window (default 5 min;
+                # drain/preemption tests and flaky-head deployments
+                # tighten client_ack_replay_timeout_s): the submit
+                # may or may not have applied — drop the leaked
+                # pending slot and replay under the SAME dd (the head
+                # coalesces/dedupes, so a merely-slow original still
+                # wins).
                 with self._pending_lock:
                     self._pending.pop(req_id, None)
                 replay = True
@@ -928,8 +933,19 @@ class ClientRuntime:
         return self._call(P.OP_RESOURCES, None)[1]
 
     def nodes(self):
-        return [{"NodeID": "local", "Alive": True,
-                 "Resources": self.cluster_resources()}]
+        try:
+            # Real node-table rows (incl. Alive/Draining) so cluster
+            # consumers running inside actors — the serve controller's
+            # drain-replace scan, autoscalers hosted off-head — see
+            # the same view as the driver.
+            return self._call(P.OP_STATE, ("raw_nodes", None))
+        except Exception:  # noqa: BLE001 — old head: degrade to the
+            # single-node stub rather than break callers
+            return [{"NodeID": "local", "Alive": True,
+                     "Resources": self.cluster_resources()}]
+
+    def list_state(self, kind, filters=None):
+        return self._call(P.OP_STATE, (kind, filters))
 
     def timeline(self):
         return []

@@ -202,6 +202,29 @@ class ServeController:
         with self._rec_lock:
             self._reconcile_locked()
 
+    @staticmethod
+    def _draining_node_ids() -> set:
+        """Nodes mid-drain (preemption notice / scale-down): their
+        replicas must be replaced AHEAD of the node's termination so
+        capacity never dips (reference: serve proactively migrates
+        replicas off draining nodes)."""
+        try:
+            rt = ray_tpu.core.api.get_runtime()
+            return {n["NodeID"] for n in rt.nodes()
+                    if n.get("Alive") and n.get("Draining")}
+        except Exception:  # noqa: BLE001
+            return set()
+
+    @staticmethod
+    def _replica_nodes() -> dict:
+        """actor_id hex -> node_id for every live actor."""
+        try:
+            rt = ray_tpu.core.api.get_runtime()
+            return {row["actor_id"]: row["node_id"]
+                    for row in rt.list_state("actors", None)}
+        except Exception:  # noqa: BLE001
+            return {}
+
     def _reconcile_locked(self):
         # remove deleted deployments
         for name in list(self.replicas):
@@ -212,8 +235,27 @@ class ServeController:
                     except Exception:  # noqa: BLE001
                         pass
                 self._bump_version(name)
+        drain_nodes = self._draining_node_ids()
+        actor_nodes = self._replica_nodes() if drain_nodes else {}
         for name, spec in self.desired.items():
             live = self.replicas.setdefault(name, [])
+            # Drain-replace: a replica on a draining node leaves the
+            # routing set NOW (replacements spawn below on surviving
+            # nodes — the scheduler already excludes draining nodes)
+            # and dies only after its in-flight requests finish,
+            # reusing the scale-down drain machinery.
+            if drain_nodes:
+                keep = []
+                for r in live:
+                    nid = actor_nodes.get(r._actor_id.hex())
+                    if nid in drain_nodes:
+                        self._start_draining(name, r)
+                    else:
+                        keep.append(r)
+                if len(keep) != len(live):
+                    live = keep
+                    self.replicas[name] = live
+                    self._bump_version(name)
             # probe replicas: liveness + stats (queue lens, models)
             alive, stats = [], []
             changed = False

@@ -89,7 +89,12 @@ class LongPollClient:
             except Exception:  # noqa: BLE001 — controller down/busy
                 if self._stop:
                     return
-                time.sleep(backoff)
+                # Full jitter on the reconnect backoff: a fleet of
+                # routers that all lost the same controller (restart,
+                # head failover, drain) must not re-dial it in
+                # lockstep — synchronized retries stampede a
+                # controller that is still warming up.
+                time.sleep(backoff * random.uniform(0.5, 1.5))
                 backoff = min(backoff * 2, 5.0)
                 continue
             with self._lock:

@@ -109,13 +109,26 @@ class JaxTrainer:
             preexisting = frozenset(os.listdir(trial_dir))
         except OSError:
             preexisting = frozenset()
+        drain_restarts = 0
         while True:
             try:
                 return self._mirror(trial_dir, remote_uri,
                                     self._fit_once(trial_dir,
                                                    restored))
             except _WorkerGroupError as e:
-                attempt += 1
+                # A drain-triggered interruption (the gang's node was
+                # preempted/scaled down WITH notice — worker deaths
+                # carry a "drained" reason) is an anticipated,
+                # checkpoint-covered migration: restart elastically
+                # from the latest checkpoint WITHOUT consuming the
+                # FailureConfig.max_failures budget, which is
+                # reserved for real crashes. Bounded only by a large
+                # safety cap against a pathological drain loop.
+                drained = _is_drain_interruption(e.error)
+                if drained:
+                    drain_restarts += 1
+                else:
+                    attempt += 1
                 # Workers persist checkpoints to storage before the
                 # driver polls the matching report, so on actor death
                 # the on-disk record can be ahead of e.latest_ckpt —
@@ -123,7 +136,9 @@ class JaxTrainer:
                 latest = _latest_complete_checkpoint(
                     trial_dir, e.latest_ckpt, exclude=preexisting,
                     world_size=self.scaling.num_workers)
-                if max_failures >= 0 and attempt > max_failures:
+                exhausted = (max_failures >= 0
+                             and attempt > max_failures)
+                if (exhausted and not drained) or drain_restarts > 100:
                     return self._mirror(trial_dir, remote_uri, Result(
                         metrics={}, checkpoint_dir=latest,
                         path=trial_dir, error=e.error))
@@ -219,6 +234,13 @@ class JaxTrainer:
             raise _WorkerGroupError(str(e), latest_ckpt) from e
         finally:
             group.shutdown()
+
+
+def _is_drain_interruption(error: str | None) -> bool:
+    """True when a worker-group failure was caused by a graceful
+    node drain (ActorDiedError carries a ``node ... drained: ...``
+    reason from the runtime's drain path) rather than a crash."""
+    return bool(error) and "drained" in error
 
 
 def _latest_complete_checkpoint(

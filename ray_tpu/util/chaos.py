@@ -21,17 +21,29 @@ class ResourceKiller:
           "actor"   — SIGKILL a random actor's worker process
           "node"    — remove a random non-head node (simulated node
                       failure; reference NodeKillerBase)
+          "preempt" — gracefully drain-then-terminate a random
+                      non-head node, exactly as a spot/preemption
+                      termination notice would: work and objects
+                      migrate off first, so a healthy drain path
+                      shows ZERO user-visible failures and zero
+                      lineage reconstructions
+
+    ``drain_deadline_s`` bounds each "preempt" drain (the kill loop
+    blocks while it runs, mimicking the real notice-to-termination
+    window).
     """
 
     def __init__(self, kind: str = "worker",
                  interval_s: float = 0.5,
                  max_kills: int | None = None,
-                 seed: int | None = None, runtime=None):
+                 seed: int | None = None, runtime=None,
+                 drain_deadline_s: float = 10.0):
         if runtime is None:
             from ray_tpu.core.api import get_runtime
             runtime = get_runtime()
-        if kind not in ("worker", "actor", "node"):
+        if kind not in ("worker", "actor", "node", "preempt"):
             raise ValueError(f"unknown kill target {kind!r}")
+        self.drain_deadline_s = drain_deadline_s
         self.kind = kind
         self.interval = interval_s
         self.max_kills = max_kills
@@ -66,12 +78,18 @@ class ResourceKiller:
 
     def _kill_one(self) -> bool:
         rt = self.runtime
-        if self.kind == "node":
+        if self.kind in ("node", "preempt"):
             nodes = [n for n in rt.nodes()
-                     if n["Alive"] and not n["IsHead"]]
+                     if n["Alive"] and not n["IsHead"]
+                     and not n.get("Draining")]
             if not nodes:
                 return False
-            rt.remove_node(self._rng.choice(nodes)["NodeID"])
+            victim = self._rng.choice(nodes)["NodeID"]
+            if self.kind == "preempt":
+                return bool(rt.drain_node(
+                    victim, reason="chaos preemption notice",
+                    deadline_s=self.drain_deadline_s, remove=True))
+            rt.remove_node(victim)
             return True
         with rt._pool_lock:
             if self.kind == "worker":

@@ -103,10 +103,19 @@ def list_nodes(filters=None, limit: int = 10_000) -> list[dict]:
     import ray_tpu
     out = []
     for n in ray_tpu.nodes():
+        if not n["Alive"]:
+            state = "DEAD"
+        elif n.get("Draining"):
+            # Mid-drain (reference: DrainNode): excluded from
+            # scheduling, still serving its objects until removal.
+            state = "DRAINING"
+        else:
+            state = "ALIVE"
         row = {
             "node_id": n["NodeID"],
-            "state": "ALIVE" if n["Alive"] else "DEAD",
+            "state": state,
             "is_head_node": n.get("IsHead", False),
+            "drain_reason": n.get("DrainReason", ""),
             "resources_total": n["Resources"],
             "labels": n.get("Labels", {}),
         }

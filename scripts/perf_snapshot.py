@@ -38,6 +38,13 @@ OBJECT_PLANE_METRICS = (
     "repeated_get_64MiB_cache_hits",
 )
 
+# Robustness metrics (ray_tpu/perf.py): graceful-drain latency over a
+# 64-task fan-out. Same must-be-present contract as the object-plane
+# rows.
+ROBUSTNESS_METRICS = (
+    "drain_node_64_tasks",
+)
+
 
 def one_run(path: str, serve: bool, timeout: float,
             quick: bool = False) -> list[dict]:
@@ -96,7 +103,8 @@ def main() -> None:
         print(f"run {i+1}: {len(rows)} metrics in {time.time()-t0:.0f}s",
               file=sys.stderr)
         got = {r.get("metric") for r in rows}
-        missing = [m for m in OBJECT_PLANE_METRICS if m not in got]
+        missing = [m for m in OBJECT_PLANE_METRICS
+                   + ROBUSTNESS_METRICS if m not in got]
         if missing:
             print(f"run {i+1}: WARNING missing object-plane metrics "
                   f"{missing} (crashed mid-bench?)", file=sys.stderr)

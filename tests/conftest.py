@@ -56,6 +56,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running learning/e2e test")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection run (ResourceKiller / drain / "
+        "preemption)")
 
 
 @pytest.fixture

@@ -87,6 +87,7 @@ def test_head_state_snapshot_and_restore():
         ray_tpu.shutdown()
 
 
+@pytest.mark.chaos
 def test_chaos_worker_killer_tasks_still_complete(rt):
     @ray_tpu.remote
     def flaky_sleep(i):
@@ -104,6 +105,7 @@ def test_chaos_worker_killer_tasks_still_complete(rt):
     assert kills >= 1, "chaos never killed anything"
 
 
+@pytest.mark.chaos
 def test_chaos_actor_killer_restarts(rt):
     @ray_tpu.remote
     class Resilient:
