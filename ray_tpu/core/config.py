@@ -160,6 +160,20 @@ class Config:
     task_event_buffer_size: int = 10000
     log_dir: str = "/tmp/ray_tpu_sessions/logs"
 
+    # --- observability (reference: metrics_report_interval_ms +
+    # task_events_report_interval_ms feeding the per-node metrics
+    # agent and GcsTaskManager, SURVEY.md §5.5) ---
+    # Master switch for the cluster metrics/event pipeline: worker
+    # exporters, head-side ingestion, and task-event recording. Off =
+    # near-zero hot-path overhead (guardrail in tests/test_perf.py).
+    metrics_export_enabled: bool = True
+    # Seconds between exporter flushes (registry snapshot + buffered
+    # task events + finished spans -> one OP_METRICS_PUSH frame).
+    metrics_report_interval_s: float = 5.0
+    # Max task events / spans shipped per flush frame; the remainder
+    # stays ring-buffered for the next interval.
+    metrics_flush_batch: int = 2048
+
     # --- workers ---
     # Env vars CLEARED in CPU-only workers' environments (comma
     # separated). Default: the ambient TPU-plugin sitecustomize

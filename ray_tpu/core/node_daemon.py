@@ -204,6 +204,18 @@ class NodeDaemon:
                 (P.ND_UPCALL, -1, "agent_report", stats)),
             node_id="", worker_pids_fn=_pids).start()
 
+        # This daemon process's own observability exporter: its
+        # registry (object-plane counters, anything a library records
+        # in-daemon) and task-event ring ride the node channel as
+        # fire-and-forget metrics_push upcalls, attributed to this
+        # node by the head.
+        from ray_tpu.observability.exporter import (
+            start_process_exporter,
+        )
+        self.metrics_exporter = start_process_exporter(
+            lambda snap: self.head_send(
+                (P.ND_UPCALL, -1, "metrics_push", snap)))
+
         # Resource-view sync (ray_syncer analog, ray_syncer.h:88):
         # the head broadcasts a versioned cluster snapshot (ND_RVIEW)
         # this daemon serves resource queries from locally, and this
@@ -1446,6 +1458,10 @@ class NodeDaemon:
         if self._shutdown:
             return
         self._shutdown = True
+        exporter = getattr(self, "metrics_exporter", None)
+        if exporter is not None:     # None: disabled, or __init__
+            exporter.stop()          # died before it started
+            exporter.flush_on_exit()
         try:
             self._object_listener.close()
         except Exception:  # noqa: BLE001

@@ -127,6 +127,17 @@ OP_STREAM_NEXT = "stream_next"  # (task_id_bytes, timeout) ->
                                 #   ("item", oid_bytes) | ("done",)
 OP_STREAM_DROP = "stream_drop"  # task_id_bytes
 OP_SPANS = "spans"              # list of finished span dicts (tracing)
+OP_METRICS_PUSH = "metrics_push"
+                                # observability exporter flush
+                                # (fire-and-forget, usually req_id -1
+                                # via the notify channel): one dict
+                                # {node_id, worker_id, ts, metrics,
+                                # task_events, spans} — the worker-side
+                                # metric/TaskEventBuffer batch pushed
+                                # to the head aggregator (reference:
+                                # per-worker metric export + the
+                                # TaskEventBuffer flush RPC into
+                                # GcsTaskManager, SURVEY.md §5.5)
 OP_KV = "kv"                    # (action, key, value, namespace)
 OP_PUBSUB = "pubsub"            # ("publish", topic, blob) -> seq;
                                 # ("poll", topic, epoch, cursor,

@@ -898,6 +898,11 @@ class DriverRuntime:
 
         # Events / timeline
         self._events: deque = deque(maxlen=config.task_event_buffer_size)
+        # Cluster observability plane (SURVEY.md §5.5): aggregates
+        # worker/daemon metric pushes, keeps the GcsTaskManager-style
+        # task-event store, renders cluster /metrics + timeline.
+        from ray_tpu.observability.plane import ObservabilityPlane
+        self.observability = ObservabilityPlane(self)
 
         # Client listener (worker -> driver API proxy + exec channels)
         # NB not /tmp/ray_tpu: a directory named exactly like the
@@ -2283,6 +2288,10 @@ class DriverRuntime:
                 node.drain_deadline = deadline
                 self.drains_started += 1
             self._res_cv.notify_all()
+        # A draining node's series go stale immediately: its workers
+        # are on their way out, and a scrape must not keep reporting
+        # them as live capacity.
+        self.observability.mark_node_stale(node_id)
         # Tasks first (they may still store results on the node),
         # then actors, then the object evacuation sweeps everything
         # that remains.
@@ -2464,6 +2473,9 @@ class DriverRuntime:
             node.alive = False
             node.avail = {}
             self._res_cv.notify_all()
+        # Its metric series must stop at the last observed value
+        # instead of freezing in the scrape forever.
+        self.observability.mark_node_stale(node_id)
         self._broadcast_node_map()
         # Local worker processes pinned to the (logical) node die by
         # signal; daemon-hosted workers are marked dead here and fail
@@ -4204,6 +4216,10 @@ class DriverRuntime:
         from ray_tpu.util import state as state_api
         if kind == "raw_nodes":
             return self.nodes()
+        if kind == "tasks_detail":
+            return state_api.list_tasks(filters, detail=True)
+        if kind == "cluster_metrics":
+            return self.observability.prometheus_text()
         fns = {
             "tasks": state_api.list_tasks,
             "actors": state_api.list_actors,
@@ -4216,7 +4232,9 @@ class DriverRuntime:
     def _event(self, rec: TaskRecord, state: str) -> None:
         # Raw tuple on the hot path (3 appends per task); formatted
         # into dicts lazily by task_events() at read time.
-        self._events.append((rec.task_id, rec.name, state, time.time()))
+        now = time.time()
+        self._events.append((rec.task_id, rec.name, state, now))
+        self.observability.record_head_event(rec, state, now)
 
     @staticmethod
     def _format_event(ev) -> dict:
@@ -4231,7 +4249,10 @@ class DriverRuntime:
 
     def timeline(self) -> list[dict]:
         # Chrome-trace "X" events derived from task records
-        # (reference: chrome_tracing_dump, _private/state.py:438).
+        # (reference: chrome_tracing_dump, _private/state.py:438),
+        # plus the cluster half: worker-side execution slices pushed
+        # through the observability plane and every collected span —
+        # one trace covers driver, head workers, and remote nodes.
         out = []
         with self._task_lock:
             records = list(self._done_tasks) + list(self._tasks.values())
@@ -4244,6 +4265,7 @@ class DriverRuntime:
                     "dur": (rec.finished_at - rec.started_at) * 1e6,
                     "cat": "task",
                 })
+        out.extend(self.observability.timeline_events())
         return out
 
     # ---------------- client service (worker -> driver API) -----------
@@ -4430,6 +4452,21 @@ class DriverRuntime:
                 for sub_op, sub_payload in payload:
                     if sub_op == P.OP_BORROW:
                         do_borrow(-1, sub_payload)
+                    elif sub_op == P.OP_METRICS_PUSH:
+                        try:
+                            self.observability.ingest_push(
+                                sub_payload)
+                        except Exception:  # noqa: BLE001 — a bad
+                            pass           # frame must not kill the
+                                           # connection's reader
+                return
+            if op == P.OP_METRICS_PUSH and req_id == -1:
+                # Fire-and-forget exporter flush that arrived solo
+                # (unbatched notify): ingest without a reply frame.
+                try:
+                    self.observability.ingest_push(payload)
+                except Exception:  # noqa: BLE001
+                    pass
                 return
             self._client_op_pool.submit(handle, req_id, op, payload)
 
@@ -4683,6 +4720,8 @@ class DriverRuntime:
             node.last_pong = time.monotonic()
             node.ping_inflight = False
             self._res_cv.notify_all()
+        # A (re)registered node is a live scrape target again.
+        self.observability.mark_node_live(node_id)
         self._ensure_health_thread()
         try:
             # The registration ack MUST be the first message on the
@@ -4796,6 +4835,12 @@ class DriverRuntime:
                 payload = dict(payload or {})
                 payload["node_id"] = node.node_id
                 self._agent_stats[node.node_id] = payload
+                result = None
+            elif op == "metrics_push":
+                # The daemon's own exporter flush (its process-local
+                # registry + events), attributed to its node.
+                self.observability.ingest_push(
+                    payload, node_id_hint=node.node_id)
                 result = None
             elif op == "put_loc_at":
                 oid_bytes, size, refs, *pn = payload
@@ -5482,6 +5527,9 @@ class DriverRuntime:
             from ray_tpu.util.tracing import get_tracer
             get_tracer().add_spans(payload)
             return None
+        if op == P.OP_METRICS_PUSH:
+            self.observability.ingest_push(payload)
+            return None
         if op == P.OP_PUBSUB:
             action = payload[0]
             if action == "publish":
@@ -5550,6 +5598,13 @@ class DriverRuntime:
                 return state_api.summarize_tasks()
             if kind == "timeline":
                 return self.timeline()
+            if kind == "tasks_detail":
+                return state_api.list_tasks(filters, detail=True)
+            if kind == "cluster_metrics":
+                # Cluster-aggregated Prometheus text over the client
+                # protocol — what the CLI scrapes without needing the
+                # HTTP dashboard up.
+                return self.observability.prometheus_text()
             if kind == "raw_nodes":
                 # Full NodeID/Alive/Draining rows for consumers (e.g.
                 # the serve controller actor) that need the real node

@@ -15,6 +15,7 @@ Analog of the reference's worker side (SURVEY.md §3.1-3.3):
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 import traceback
@@ -232,6 +233,20 @@ class ClientRuntime:
         _recv_loop."""
         self._notify_buf.append((op, payload))
         self._notify_event.set()
+
+    def _metrics_push(self, snapshot: dict,
+                      blocking: bool = False) -> None:
+        """Observability exporter transport: fire-and-forget on the
+        periodic path (a dropped frame just waits for the next
+        interval — cumulative snapshots make loss harmless); blocking
+        on the exit flush so a short-lived worker's last snapshot
+        lands before the connection closes. The exit flush's wait is
+        short: a busy head must delay a worker's exit by at most a
+        few seconds, never the full op timeout."""
+        if blocking:
+            self._call(P.OP_METRICS_PUSH, snapshot, timeout=3.0)
+        else:
+            self._notify(P.OP_METRICS_PUSH, snapshot)
 
     def _enqueue_wire(self, triple) -> None:
         """Ship a wire triple through the outbox. Inline fast path
@@ -1128,6 +1143,33 @@ def worker_main(conn, client_address: str) -> None:
     client = ClientRuntime(client_address)
     api._set_runtime(client)
 
+    # Observability exporter (reference: per-worker metric export +
+    # TaskEventBuffer flush): a periodic thread batching this
+    # process's registry snapshot, task-event ring, and finished
+    # spans into fire-and-forget OP_METRICS_PUSH frames. Recording is
+    # a deque append on the exec hot path; everything else happens on
+    # the exporter thread at metrics_report_interval_s.
+    from ray_tpu.observability import task_events as _te
+    from ray_tpu.observability.exporter import start_process_exporter
+
+    def _obs_pre_flush():
+        # Wire/object-plane counters for this process, sampled into
+        # gauges right before each flush. Tagged by pid: gauges merge
+        # last-write-wins per tag set, so same-node workers must not
+        # share a series.
+        from ray_tpu.util.metrics import Gauge
+        Gauge("ray_tpu_worker_wire_rounds",
+              "blocking client-channel round trips made by this "
+              "process", tag_keys=("pid",)).set(
+            float(client.wire_rounds), tags={"pid": str(os.getpid())})
+
+    metrics_exporter = start_process_exporter(
+        client._metrics_push, pre_flush=_obs_pre_flush,
+        final_push_fn=lambda s: client._metrics_push(s,
+                                                     blocking=True))
+    _record_event = (_te.record_task_event if metrics_exporter
+                     else None)
+
     fn_cache: dict[str, object] = {}
     actor_instance = None
     actor_lock = threading.Lock()
@@ -1256,24 +1298,33 @@ def worker_main(conn, client_address: str) -> None:
             tr.enable()
         else:
             tr.disable()
+        name = "task"
         try:
             if fn_id not in fn_cache:
                 fn_cache[fn_id] = ser.loads(fn_blob)
             fn = fn_cache[fn_id]
+            name = getattr(fn, "__name__", "task")
+            if _record_event is not None:
+                _record_event(task_id_bytes, name, "RUNNING")
             args, kwargs = _materialize_args(args_blob, resolved)
             with tr.remote_parent(trace_ctx), \
-                    tr.span(f"task::{getattr(fn, '__name__', 'task')}"):
+                    tr.span(f"task::{name}"):
                 result = _run_maybe_async(fn, args, kwargs)
                 if num_returns == "streaming":
                     stream_out(task_id_bytes, result)
+                    if _record_event is not None:
+                        _record_event(task_id_bytes, name, "FINISHED")
                     return
             send((P.RESULT_OK, task_id_bytes,
                   _serialize_returns(result, num_returns)))
+            if _record_event is not None:
+                _record_event(task_id_bytes, name, "FINISHED")
         except BaseException as e:  # noqa: BLE001
-            name = getattr(fn_cache.get(fn_id), "__name__", "task")
             err = TaskError(name, traceback.format_exc(), None) \
                 if not isinstance(e, TaskError) else e
             send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
+            if _record_event is not None:
+                _record_event(task_id_bytes, name, "FAILED")
         finally:
             api._clear_task_context()
             if trace_ctx is not None:
@@ -1314,6 +1365,8 @@ def worker_main(conn, client_address: str) -> None:
             # call on another thread, so concurrent actors only ever
             # enable.
             tr.disable()
+        if _record_event is not None:
+            _record_event(task_id_bytes, f"actor.{method}", "RUNNING")
         try:
             args, kwargs = _materialize_args(args_blob, resolved)
             if method == "__ray_call__":
@@ -1340,12 +1393,21 @@ def worker_main(conn, client_address: str) -> None:
                 else:
                     result = run_and_maybe_stream()
                 if num_returns == "streaming":
+                    if _record_event is not None:
+                        _record_event(task_id_bytes,
+                                      f"actor.{method}", "FINISHED")
                     return
             send((P.RESULT_OK, task_id_bytes,
                   _serialize_returns(result, num_returns)))
+            if _record_event is not None:
+                _record_event(task_id_bytes, f"actor.{method}",
+                              "FINISHED")
         except BaseException:  # noqa: BLE001
             err = ActorError(method, traceback.format_exc(), None)
             send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
+            if _record_event is not None:
+                _record_event(task_id_bytes, f"actor.{method}",
+                              "FAILED")
         finally:
             api._clear_task_context()
             if trace_ctx is not None:
@@ -1490,6 +1552,11 @@ def worker_main(conn, client_address: str) -> None:
         # Results produced by executor/loop threads in the last instant
         # must reach the wire before the process exits.
         _flush_outbox()
+        if metrics_exporter is not None:
+            # Ship the final snapshot so a short-lived worker's
+            # metrics/events aren't lost with its process.
+            metrics_exporter.stop()
+            metrics_exporter.flush_on_exit()
         # Give the actor a chance to clean up (reference: atexit handlers
         # + __ray_terminate__).
         if actor_instance is not None:

@@ -108,8 +108,22 @@ class NodeAgent:
         }
 
     def _loop(self) -> None:
-        while not self._stop.wait(self._interval):
+        # A raising report_fn (head mid-restart, broken node channel,
+        # a bad sampler on an exotic host) must never kill the
+        # sampling thread: log the first failure, back off
+        # exponentially (capped at 16x the interval), and resume the
+        # normal cadence on the first success.
+        failures = 0
+        while True:
+            delay = self._interval * min(2 ** failures, 16)
+            if self._stop.wait(delay):
+                return
             try:
                 self._report(self.sample())
-            except Exception:  # noqa: BLE001 — reporting must never
-                pass           # kill the daemon
+                failures = 0
+            except Exception:  # noqa: BLE001
+                failures += 1
+                from ray_tpu.util.log_once import log_once
+                if log_once("node_agent_report_failed"):
+                    import traceback
+                    traceback.print_exc()

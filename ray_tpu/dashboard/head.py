@@ -84,7 +84,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # module feeding dashboard node cards). The head node
                 # samples itself on demand.
                 self._send_json(self._agent_stats())
-            elif path == "/api/timeline":
+            elif path in ("/api/timeline", "/api/v1/timeline"):
+                # Cluster-wide Chrome-trace JSON: head task slices +
+                # remote worker execution slices + collected spans
+                # (the ray.timeline() surface; load in
+                # chrome://tracing or Perfetto).
                 self._send_json(rt.timeline())
             elif path == "/api/spans":
                 from ray_tpu.util.tracing import get_tracer
@@ -117,8 +121,19 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(404, json.dumps(
                         {"error": str(e)}).encode())
             elif path == "/metrics":
-                from ray_tpu.util.metrics import prometheus_text
-                self._send(200, prometheus_text().encode(),
+                # Cluster-aggregated Prometheus exposition: remote
+                # worker/daemon snapshots (node_id-tagged, stale
+                # series of dead/draining nodes dropped) merged with
+                # the head's live registry. Falls back to the
+                # process-local registry when the runtime has no
+                # observability plane (bare scrape without init).
+                plane = getattr(rt, "observability", None)
+                if plane is not None:
+                    text = plane.prometheus_text()
+                else:
+                    from ray_tpu.util.metrics import prometheus_text
+                    text = prometheus_text()
+                self._send(200, text.encode(),
                            "text/plain; version=0.0.4")
             else:
                 self._send(404, b'{"error": "not found"}')

@@ -47,6 +47,41 @@ class SystemMetricsCollector:
             "node_mem": Gauge(
                 "ray_tpu_node_mem_used_bytes",
                 "per-node memory in use", tag_keys=("node",)),
+            # Object plane (PR-1 counters surfaced as metrics).
+            "deser_hits": Gauge(
+                "ray_tpu_deser_cache_hits",
+                "deserialization-cache hits (driver process)"),
+            "deser_misses": Gauge(
+                "ray_tpu_deser_cache_misses",
+                "deserialization-cache misses (driver process)"),
+            # Robustness / drain (PR-2 counters surfaced as metrics).
+            "lineage_recon": Gauge(
+                "ray_tpu_lineage_reconstructions",
+                "lineage re-executions launched for object recovery"),
+            "drains_started": Gauge(
+                "ray_tpu_drains_started", "node drains started"),
+            "drains_completed": Gauge(
+                "ray_tpu_drains_completed", "node drains completed"),
+            "drain_preempted": Gauge(
+                "ray_tpu_drain_tasks_preempted",
+                "tasks preempted (attempt refunded) by drains"),
+            "drain_migrated": Gauge(
+                "ray_tpu_drain_actors_migrated",
+                "actors migrated off draining nodes"),
+            "drain_evacuated": Gauge(
+                "ray_tpu_drain_objects_evacuated",
+                "primary objects evacuated off draining nodes"),
+            # The observability plane watching itself.
+            "obs_pushes": Gauge(
+                "ray_tpu_metrics_pushes_ingested",
+                "exporter flush frames ingested by the head"),
+            "obs_tasks": Gauge(
+                "ray_tpu_task_event_store_tasks",
+                "distinct tasks tracked by the cluster event store"),
+            "obs_stale": Gauge(
+                "ray_tpu_metrics_stale_series",
+                "series hidden from the scrape (owning node dead or "
+                "draining)"),
         }
         self._g = g
         self._stop = threading.Event()
@@ -90,6 +125,28 @@ class SystemMetricsCollector:
                 if stats.get("mem_used"):
                     g["node_mem"].set(
                         float(stats["mem_used"]), tags=tag)
+            g["deser_hits"].set(float(
+                getattr(rt, "deser_cache_hits", 0)))
+            g["deser_misses"].set(float(
+                getattr(rt, "deser_cache_misses", 0)))
+            g["lineage_recon"].set(float(
+                getattr(rt, "lineage_reconstructions", 0)))
+            g["drains_started"].set(float(
+                getattr(rt, "drains_started", 0)))
+            g["drains_completed"].set(float(
+                getattr(rt, "drains_completed", 0)))
+            g["drain_preempted"].set(float(
+                getattr(rt, "drain_tasks_preempted", 0)))
+            g["drain_migrated"].set(float(
+                getattr(rt, "drain_actors_migrated", 0)))
+            g["drain_evacuated"].set(float(
+                getattr(rt, "drain_objects_evacuated", 0)))
+            plane = getattr(rt, "observability", None)
+            if plane is not None:
+                g["obs_pushes"].set(float(plane.pushes_ingested))
+                g["obs_tasks"].set(float(len(plane.task_events)))
+                g["obs_stale"].set(float(
+                    plane.aggregator.stale_series_count()))
         except Exception:  # noqa: BLE001 — sampling must never kill
             pass           # the thread; partial samples are fine
 

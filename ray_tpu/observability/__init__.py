@@ -1,0 +1,46 @@
+"""Cluster observability plane.
+
+Reference analog (SURVEY.md §5.5): per-worker metric export and the
+``TaskEventBuffer`` ring flow over the wire into a per-node metrics
+agent and the GCS ``GcsTaskManager``, backing Prometheus scrape, the
+state API, and ``ray.timeline()``. Here the same pipeline rides the
+existing client/node protocol:
+
+- every worker process (and node daemon) runs a
+  :class:`~ray_tpu.observability.exporter.MetricsExporter` thread that
+  batches registry snapshots + task-event/span ring entries and pushes
+  them to the head (``OP_METRICS_PUSH`` / ``ND_UPCALL metrics_push``);
+- the head's :class:`~ray_tpu.observability.plane.ObservabilityPlane`
+  merges counters/gauges/histograms across processes (tagged
+  ``node_id``, buckets summed, series marked stale when the owning
+  node dies or drains) and keeps a ``GcsTaskManager``-style
+  :class:`~ray_tpu.observability.task_events.TaskEventStore`;
+- export surfaces: dashboard ``GET /metrics`` (cluster-aggregated
+  Prometheus text), ``GET /api/v1/timeline`` (Chrome-trace JSON),
+  ``util.state.list_tasks(detail=True)``, and the
+  ``ray_tpu metrics`` CLI.
+"""
+
+from ray_tpu.observability.aggregator import ClusterMetricsAggregator
+from ray_tpu.observability.exporter import MetricsExporter
+from ray_tpu.observability.plane import ObservabilityPlane
+from ray_tpu.observability.snapshot import snapshot_registry
+from ray_tpu.observability.task_events import (
+    TaskEventStore,
+    drain_events,
+    record_task_event,
+    recording_enabled,
+    set_recording,
+)
+
+__all__ = [
+    "ClusterMetricsAggregator",
+    "MetricsExporter",
+    "ObservabilityPlane",
+    "TaskEventStore",
+    "drain_events",
+    "record_task_event",
+    "recording_enabled",
+    "set_recording",
+    "snapshot_registry",
+]

@@ -1,0 +1,190 @@
+"""Head-side cluster metrics aggregator.
+
+Reference analog: the per-node metrics agent + Prometheus exporter
+chain (SURVEY.md §5.5) — every worker exports its OpenCensus registry,
+the agent aggregates, Prometheus scrapes one endpoint per node. Here
+the head is the single scrape target: it keeps the latest cumulative
+snapshot per (node_id, worker_id) process and merges at exposition
+time:
+
+- counters: summed across the workers of a node;
+- gauges: latest snapshot wins (per node, per tag set);
+- histograms: bucket counts / sums / totals summed element-wise;
+- every output series gains a ``node_id`` tag;
+- a node's series are marked STALE when it dies or drains — they drop
+  out of the scrape instead of freezing at their last value forever
+  (reference: Prometheus staleness handling for vanished targets).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+
+def _fmt_tags(tags: dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def _num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class ClusterMetricsAggregator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (node_id, worker_id) -> {"ts": float, "metrics": {name: row}}
+        self._procs: dict[tuple[str, str], dict] = {}
+        self._stale_nodes: set[str] = set()
+        self.pushes_ingested = 0
+
+    # -- ingest ---------------------------------------------------------
+
+    def ingest(self, node_id: str, worker_id: str,
+               metric_rows: list[dict], ts: float) -> None:
+        """Replace the cumulative snapshot for one process."""
+        by_name = {}
+        for row in metric_rows or []:
+            name = row.get("name")
+            if name:
+                by_name[name] = row
+        with self._lock:
+            self._procs[(node_id, worker_id)] = {
+                "ts": float(ts), "metrics": by_name}
+            self.pushes_ingested += 1
+
+    def forget_worker(self, node_id: str, worker_id: str) -> None:
+        with self._lock:
+            self._procs.pop((node_id, worker_id), None)
+
+    # -- staleness ------------------------------------------------------
+
+    def mark_node_stale(self, node_id: str) -> None:
+        with self._lock:
+            self._stale_nodes.add(node_id)
+
+    def mark_node_live(self, node_id: str) -> None:
+        with self._lock:
+            self._stale_nodes.discard(node_id)
+
+    def stale_nodes(self) -> set[str]:
+        with self._lock:
+            return set(self._stale_nodes)
+
+    def stale_series_count(self) -> int:
+        """Series currently excluded from the scrape because their
+        owning node is stale."""
+        with self._lock:
+            n = 0
+            for (node_id, _wid), proc in self._procs.items():
+                if node_id in self._stale_nodes:
+                    for row in proc["metrics"].values():
+                        n += len(row.get("series") or ())
+            return n
+
+    # -- merge / exposition --------------------------------------------
+
+    def merged(self, extra_procs=()) -> "OrderedDict[str, dict]":
+        """Merge live per-process snapshots (plus ``extra_procs``:
+        ``(node_id, worker_id, metric_rows, ts)`` tuples, e.g. the
+        head's own registry snapshotted at scrape time) into
+
+            name -> {"type", "desc", "boundaries"?,
+                     "series": {tags_items_tuple: value |
+                                [buckets, sum, count]}}
+        """
+        with self._lock:
+            procs = [(nid, wid, list(p["metrics"].values()), p["ts"])
+                     for (nid, wid), p in self._procs.items()
+                     if nid not in self._stale_nodes]
+            stale = set(self._stale_nodes)
+        for nid, wid, rows, ts in extra_procs:
+            if nid not in stale:
+                procs.append((nid, wid, rows, ts))
+
+        out: "OrderedDict[str, dict]" = OrderedDict()
+        # gauge conflict resolution: remember the winning ts per series
+        gauge_ts: dict[tuple[str, tuple], float] = {}
+        for nid, _wid, rows, ts in procs:
+            for row in rows:
+                name = row.get("name")
+                typ = row.get("type", "untyped")
+                if not name:
+                    continue
+                fam = out.get(name)
+                if fam is None:
+                    fam = {"type": typ, "desc": row.get("desc", ""),
+                           "series": {}}
+                    if typ == "histogram":
+                        fam["boundaries"] = list(
+                            row.get("boundaries") or [])
+                    out[name] = fam
+                elif fam["type"] != typ:
+                    continue       # conflicting redefinition: skip
+                for entry in row.get("series") or []:
+                    tags = dict(entry[0])
+                    tags.setdefault("node_id", nid)
+                    key = tuple(sorted(tags.items()))
+                    if typ == "histogram":
+                        if len(entry) < 4:
+                            continue
+                        buckets, s, n = entry[1], entry[2], entry[3]
+                        bounds = fam.get("boundaries") or []
+                        if len(buckets) != len(bounds) + 1:
+                            continue    # layout mismatch: unmergeable
+                        cur = fam["series"].get(key)
+                        if cur is None:
+                            fam["series"][key] = [list(buckets),
+                                                  float(s), int(n)]
+                        else:
+                            cur[0] = [a + b for a, b
+                                      in zip(cur[0], buckets)]
+                            cur[1] += float(s)
+                            cur[2] += int(n)
+                    elif typ == "gauge":
+                        prev_ts = gauge_ts.get((name, key))
+                        if prev_ts is None or ts >= prev_ts:
+                            fam["series"][key] = float(entry[1])
+                            gauge_ts[(name, key)] = ts
+                    else:          # counter / untyped: sum
+                        fam["series"][key] = fam["series"].get(
+                            key, 0.0) + float(entry[1])
+        return out
+
+    def prometheus_text(self, extra_procs=()) -> str:
+        """Cluster-wide Prometheus exposition of the merged view."""
+        lines: list[str] = []
+        for name, fam in sorted(self.merged(extra_procs).items()):
+            if fam["desc"]:
+                lines.append(f"# HELP {name} {fam['desc']}")
+            lines.append(f"# TYPE {name} {fam['type']}")
+            for key in sorted(fam["series"]):
+                base = dict(key)
+                val = fam["series"][key]
+                if fam["type"] == "histogram":
+                    buckets, total_sum, n = val
+                    cum = 0
+                    for b, c in zip(fam["boundaries"], buckets):
+                        cum += c
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_fmt_tags({**base, 'le': str(b)})} "
+                            f"{cum}")
+                    cum += buckets[-1]
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_tags({**base, 'le': '+Inf'})} {cum}")
+                    lines.append(f"{name}_sum{_fmt_tags(base)} "
+                                 f"{_num(total_sum)}")
+                    lines.append(f"{name}_count{_fmt_tags(base)} {n}")
+                else:
+                    lines.append(
+                        f"{name}{_fmt_tags(base)} {_num(val)}")
+        return "\n".join(lines) + "\n"
+
+
+__all__ = ["ClusterMetricsAggregator"]

@@ -1,0 +1,145 @@
+"""Worker/daemon-side metrics exporter.
+
+Reference analog: the per-worker metric export loop +
+``TaskEventBuffer::FlushEvents`` (task_event_buffer.h:220) — a
+periodic thread that batches everything observable in this process
+(registry snapshot, task-event ring entries, finished tracing spans)
+into ONE push frame so the execution hot path never touches the wire.
+
+The transport is injected (``push_fn``): worker processes push
+``OP_METRICS_PUSH`` through their fire-and-forget client-notify
+channel; node daemons push ``ND_UPCALL metrics_push`` over the node
+control channel. A raising push is caught, logged once, and backed
+off — the exporter must never kill its host process or spin on a dead
+head.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+
+
+class MetricsExporter:
+    def __init__(self, push_fn, interval_s: float = 5.0,
+                 flush_batch: int = 2048, node_id: str = "",
+                 worker_id: str = "", pre_flush=None,
+                 final_push_fn=None):
+        self._push = push_fn
+        self._final_push = final_push_fn or push_fn
+        self._interval = max(0.05, float(interval_s))
+        self._batch = max(1, int(flush_batch))
+        self._node_id = node_id
+        self._worker_id = worker_id or f"pid:{os.getpid()}"
+        self._pre_flush = pre_flush
+        self._stop = threading.Event()
+        self._failures = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="metrics_exporter")
+        self.flushes = 0
+        self.pushes = 0
+
+    def start(self) -> "MetricsExporter":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- flush ----------------------------------------------------------
+
+    def _build_snapshot(self) -> dict | None:
+        from ray_tpu.observability import task_events as te
+        from ray_tpu.observability.snapshot import snapshot_registry
+        from ray_tpu.util.tracing import get_tracer
+
+        if self._pre_flush is not None:
+            try:
+                self._pre_flush()
+            except Exception:  # noqa: BLE001 — gauge refresh is
+                pass           # best-effort
+        metrics = snapshot_registry()
+        events = te.drain_events(self._batch)
+        tracer = get_tracer()
+        spans = tracer.drain_dicts() if tracer.enabled else []
+        if len(spans) > self._batch:
+            spans = spans[-self._batch:]
+        if not metrics and not events and not spans:
+            return None
+        return {
+            "node_id": self._node_id,
+            "worker_id": self._worker_id,
+            "ts": time.time(),
+            "metrics": metrics,
+            "task_events": events,
+            "spans": spans,
+        }
+
+    def flush_once(self, final: bool = False) -> bool:
+        """Build and push one snapshot; True when something shipped."""
+        snap = self._build_snapshot()
+        self.flushes += 1
+        if snap is None:
+            return False
+        (self._final_push if final else self._push)(snap)
+        self.pushes += 1
+        return True
+
+    def flush_on_exit(self) -> None:
+        """Final flush (worker shutdown) through the blocking
+        transport when one was given: ship whatever is still buffered
+        so short-lived workers aren't invisible."""
+        try:
+            from ray_tpu.observability import task_events as te
+            for _ in range(4):    # bounded: exit must stay prompt
+                if not self.flush_once(final=True) \
+                        or te.pending_events() == 0:
+                    break
+        except Exception:  # noqa: BLE001 — exit path, head may be gone
+            pass
+
+    # -- loop -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            delay = self._interval * min(2 ** self._failures, 8)
+            if self._stop.wait(delay):
+                return
+            try:
+                self.flush_once()
+                self._failures = 0
+            except Exception:  # noqa: BLE001
+                self._failures += 1
+                from ray_tpu.util.log_once import log_once
+                if log_once("metrics_exporter_push_failed"):
+                    traceback.print_exc()
+
+
+def start_process_exporter(push_fn, pre_flush=None,
+                           final_push_fn=None
+                           ) -> MetricsExporter | None:
+    """Start the exporter for THIS process from config: reads the
+    observability knobs, seeds task-event recording, and tags
+    snapshots with this process's node identity. Returns None (and
+    disables event recording) when exporting is off."""
+    from ray_tpu.core.config import get_config
+    from ray_tpu.observability import task_events as te
+
+    cfg = get_config()
+    if not cfg.metrics_export_enabled:
+        te.set_recording(False)
+        return None
+    te.set_recording(True, maxlen=cfg.task_event_buffer_size)
+    return MetricsExporter(
+        push_fn,
+        interval_s=cfg.metrics_report_interval_s,
+        flush_batch=cfg.metrics_flush_batch,
+        node_id=os.environ.get("RAY_TPU_NODE_ID", ""),
+        pre_flush=pre_flush,
+        final_push_fn=final_push_fn,
+    ).start()
+
+
+__all__ = ["MetricsExporter", "start_process_exporter"]

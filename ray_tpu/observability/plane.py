@@ -1,0 +1,114 @@
+"""Head-side observability plane: one object tying the pipeline ends.
+
+Owned by the driver runtime (``runtime.observability``): routes every
+``OP_METRICS_PUSH`` / ``ND_UPCALL metrics_push`` frame to the metrics
+aggregator, the task-event store, and the tracer; stamps node
+liveness transitions (death/drain -> stale series); and renders the
+cluster-wide export surfaces (Prometheus text, Chrome-trace timeline)
+by merging the remote snapshots with the head process's own live
+registry.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu.observability.aggregator import ClusterMetricsAggregator
+from ray_tpu.observability.task_events import TaskEventStore
+
+
+class ObservabilityPlane:
+    def __init__(self, runtime):
+        self._rt = runtime
+        cfg = runtime.config
+        self.enabled = cfg.metrics_export_enabled
+        self.aggregator = ClusterMetricsAggregator()
+        self.task_events = TaskEventStore(
+            max_tasks=cfg.task_event_buffer_size)
+        self.pushes_ingested = 0
+
+    def set_enabled(self, on: bool) -> None:
+        """Runtime toggle for the head-side pipeline (the perf
+        instrumented-vs-disabled rows flip this)."""
+        self.enabled = bool(on)
+
+    # -- ingest ---------------------------------------------------------
+
+    def ingest_push(self, payload, node_id_hint: str = "") -> None:
+        """One exporter frame from a worker or node daemon. The
+        snapshot's own node_id (from RAY_TPU_NODE_ID) wins; a
+        daemon-channel hint covers processes spawned before the node
+        registered; empty means a head-local process."""
+        if not isinstance(payload, dict):
+            return
+        node_id = (payload.get("node_id") or node_id_hint
+                   or self._rt.head_node_id)
+        worker_id = str(payload.get("worker_id") or "unknown")
+        ts = float(payload.get("ts") or time.time())
+        metrics = payload.get("metrics") or []
+        if metrics:
+            self.aggregator.ingest(node_id, worker_id, metrics, ts)
+            # A push from a previously-stale node means it came back
+            # (head restart re-registration): only node death/drain
+            # may silence live series.
+            node = self._rt._nodes.get(node_id)
+            if node is not None and node.alive and not node.draining:
+                self.aggregator.mark_node_live(node_id)
+        events = payload.get("task_events") or []
+        if events:
+            self.task_events.add_batch(node_id, worker_id, events)
+        spans = payload.get("spans") or []
+        if spans:
+            from ray_tpu.util.tracing import get_tracer
+            try:
+                get_tracer().add_spans(spans)
+            except (TypeError, KeyError):
+                pass           # malformed remote spans: drop, don't die
+        self.pushes_ingested += 1
+
+    # -- head-local task events ----------------------------------------
+
+    def record_head_event(self, rec, state: str, ts: float) -> None:
+        """Scheduler-side lifecycle event (mirrors the head's legacy
+        ring): cheap enough for the submit hot path, and the
+        instrumented-vs-disabled perf rows pin its cost."""
+        if not self.enabled:
+            return
+        self.task_events.add(
+            rec.task_id.hex(), rec.name, state, ts,
+            node_id=rec.node_id, src="head")
+
+    # -- node liveness --------------------------------------------------
+
+    def mark_node_stale(self, node_id: str) -> None:
+        self.aggregator.mark_node_stale(node_id)
+
+    def mark_node_live(self, node_id: str) -> None:
+        self.aggregator.mark_node_live(node_id)
+
+    # -- export surfaces ------------------------------------------------
+
+    def _local_proc(self) -> tuple:
+        from ray_tpu.observability.snapshot import snapshot_registry
+        return (self._rt.head_node_id, "head", snapshot_registry(),
+                time.time())
+
+    def prometheus_text(self) -> str:
+        """Cluster-aggregated Prometheus exposition: remote worker /
+        daemon snapshots merged with the head's live registry."""
+        return self.aggregator.prometheus_text(
+            extra_procs=[self._local_proc()])
+
+    def timeline_events(self) -> list[dict]:
+        """The remote half of the cluster timeline: worker execution
+        slices + every collected span (local and remote — remote ones
+        arrived through span flushes)."""
+        from ray_tpu.util.tracing import get_tracer
+        out = self.task_events.timeline_events()
+        for ev in get_tracer().chrome_trace():
+            ev.setdefault("cat", "span")
+            out.append(ev)
+        return out
+
+
+__all__ = ["ObservabilityPlane"]

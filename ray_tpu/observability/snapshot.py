@@ -1,0 +1,54 @@
+"""Registry -> wire snapshot for the metrics push pipeline.
+
+The worker-side exporter serializes its process-local
+``ray_tpu.util.metrics`` registry into plain tuples/dicts (no Metric
+instances cross the wire) and the head-side aggregator merges them.
+Counters and histograms ship CUMULATIVE values: the aggregator keeps
+the latest cumulative per (node, worker, series) and sums across
+processes, so a lost push never double-counts and a restarted worker
+(new worker_id) starts a fresh series instead of corrupting the old
+one (reference: OpenCensus cumulative exports through the metrics
+agent).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util.metrics import Histogram, collect_all
+
+
+def snapshot_registry() -> list[dict]:
+    """Snapshot every registered metric into wire-shaped rows.
+
+    Row shapes::
+
+        {"name", "type": "counter"|"gauge"|"untyped", "desc",
+         "series": [(tags_items_tuple, value), ...]}
+        {"name", "type": "histogram", "desc", "boundaries": [...],
+         "series": [(tags_items_tuple, buckets, sum, count), ...]}
+    """
+    rows: list[dict] = []
+    for name, m in sorted(collect_all().items()):
+        if isinstance(m, Histogram):
+            series = [
+                (tuple(key), list(buckets), float(s), int(n))
+                for key, (buckets, s, n)
+                in m.collect_histogram().items()]
+            if series:
+                rows.append({
+                    "name": name, "type": m.TYPE,
+                    "desc": m.description,
+                    "boundaries": list(m.boundaries),
+                    "series": series,
+                })
+        else:
+            series = [(tuple(sorted(tags.items())), float(v))
+                      for tags, v in m.collect()]
+            if series:
+                rows.append({
+                    "name": name, "type": m.TYPE,
+                    "desc": m.description, "series": series,
+                })
+    return rows
+
+
+__all__ = ["snapshot_registry"]

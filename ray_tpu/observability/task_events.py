@@ -1,0 +1,184 @@
+"""Task lifecycle events: worker-side ring buffer + head-side store.
+
+Reference analogs: the per-worker ``TaskEventBuffer``
+(task_event_buffer.h:220) that batches lifecycle events off the
+execution hot path, and the GCS ``GcsTaskManager`` that aggregates
+them cluster-wide to back ``ray list tasks --detail`` and
+``ray.timeline()``.
+
+Worker side: :func:`record_task_event` appends a raw tuple to a
+bounded deque — no locks, no formatting — and the exporter drains it
+on its flush interval. When recording is disabled the call is a
+single attribute check (the perf guardrail pins this near zero).
+
+Head side: :class:`TaskEventStore` merges head-scheduler events and
+worker-execution events keyed by task id, bounded FIFO by task.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+# ---------------------------------------------------------------------------
+# worker-side ring buffer
+# ---------------------------------------------------------------------------
+
+_enabled = True
+_buffer: deque = deque(maxlen=10000)
+
+
+def set_recording(on: bool, maxlen: int | None = None) -> None:
+    """Flip event recording for this process (exporter start reads the
+    ``metrics_export_enabled`` config flag through this)."""
+    global _enabled, _buffer
+    _enabled = bool(on)
+    if maxlen is not None and maxlen != _buffer.maxlen:
+        _buffer = deque(_buffer, maxlen=maxlen)
+
+
+def recording_enabled() -> bool:
+    return _enabled
+
+
+def record_task_event(task_id_bytes: bytes, name: str, state: str,
+                      ts: float | None = None) -> None:
+    """Hot-path append: one tuple into the ring. Formatting (hex) is
+    deferred to drain time."""
+    if not _enabled:
+        return
+    _buffer.append((task_id_bytes, name, state,
+                    ts if ts is not None else time.time()))
+
+
+def drain_events(max_n: int = 0) -> list[tuple]:
+    """Take up to ``max_n`` buffered events (0 = all) as wire tuples
+    ``(task_id_hex, name, state, ts)``."""
+    out: list[tuple] = []
+    while _buffer and (max_n <= 0 or len(out) < max_n):
+        try:
+            tid, name, state, ts = _buffer.popleft()
+        except IndexError:      # racing producer on another thread
+            break
+        out.append((tid.hex() if isinstance(tid, (bytes, bytearray))
+                    else str(tid), name, state, ts))
+    return out
+
+
+def pending_events() -> int:
+    return len(_buffer)
+
+
+# ---------------------------------------------------------------------------
+# head-side store (GcsTaskManager analog)
+# ---------------------------------------------------------------------------
+
+class TaskEventStore:
+    """Cluster-wide task-event table: per task id, the merged list of
+    scheduler-side (head) and execution-side (worker) events with
+    node/worker attribution. Bounded: the oldest TASK is evicted once
+    ``max_tasks`` distinct ids are tracked."""
+
+    def __init__(self, max_tasks: int = 10000,
+                 max_events_per_task: int = 64):
+        self._max_tasks = max(1, max_tasks)
+        self._max_events = max(4, max_events_per_task)
+        self._tasks: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.events_ingested = 0
+
+    def _entry(self, task_id_hex: str, name: str) -> dict:
+        ent = self._tasks.get(task_id_hex)
+        if ent is None:
+            ent = {"task_id": task_id_hex, "name": name, "events": []}
+            self._tasks[task_id_hex] = ent
+            while len(self._tasks) > self._max_tasks:
+                self._tasks.popitem(last=False)
+        elif name and not ent["name"]:
+            ent["name"] = name
+        return ent
+
+    def add(self, task_id_hex: str, name: str, state: str, ts: float,
+            node_id: str = "", worker_id: str = "",
+            src: str = "head") -> None:
+        with self._lock:
+            ent = self._entry(task_id_hex, name)
+            evs = ent["events"]
+            if len(evs) < self._max_events:
+                evs.append({"state": state, "ts": ts,
+                            "node_id": node_id,
+                            "worker_id": worker_id, "src": src})
+            self.events_ingested += 1
+
+    def add_batch(self, node_id: str, worker_id: str,
+                  events: list[tuple]) -> None:
+        """Ingest one worker flush: ``(task_id_hex, name, state, ts)``
+        tuples, all attributed to (node_id, worker_id)."""
+        for ev in events:
+            try:
+                tid, name, state, ts = ev
+            except (TypeError, ValueError):
+                continue
+            self.add(str(tid), str(name), str(state), float(ts),
+                     node_id=node_id, worker_id=worker_id,
+                     src="worker")
+
+    def events_for(self, task_id_hex: str) -> list[dict]:
+        with self._lock:
+            ent = self._tasks.get(task_id_hex)
+            return [dict(e) for e in ent["events"]] if ent else []
+
+    def rows(self, limit: int = 10000) -> list[dict]:
+        with self._lock:
+            out = []
+            for ent in self._tasks.values():
+                out.append({"task_id": ent["task_id"],
+                            "name": ent["name"],
+                            "events": [dict(e) for e in ent["events"]]})
+                if len(out) >= limit:
+                    break
+            return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._tasks)
+
+    def timeline_events(self) -> list[dict]:
+        """Chrome-trace slices from worker-side execution events: one
+        "X" per RUNNING->FINISHED/FAILED pair, laned by node/worker —
+        the remote-execution view the head's TaskRecord slices (its
+        scheduler view) cannot provide."""
+        out: list[dict] = []
+        with self._lock:
+            snap = [(ent["task_id"], ent["name"],
+                     list(ent["events"]))
+                    for ent in self._tasks.values()]
+        for task_id, name, events in snap:
+            start = None
+            for ev in events:
+                if ev["src"] != "worker":
+                    continue
+                if ev["state"] == "RUNNING":
+                    start = ev
+                elif start is not None and ev["state"] in (
+                        "FINISHED", "FAILED"):
+                    out.append({
+                        "name": name or task_id[:8], "ph": "X",
+                        "pid": start["node_id"] or "worker",
+                        "tid": start["worker_id"],
+                        "ts": start["ts"] * 1e6,
+                        "dur": max(0.0,
+                                   (ev["ts"] - start["ts"]) * 1e6),
+                        "cat": "worker_task",
+                        "args": {"task_id": task_id,
+                                 "state": ev["state"]},
+                    })
+                    start = None
+        return out
+
+
+__all__ = [
+    "TaskEventStore", "record_task_event", "drain_events",
+    "set_recording", "recording_enabled", "pending_events",
+]

@@ -20,7 +20,7 @@ import ray_tpu
 
 
 def timeit(name: str, fn, batch: int = 1, *, seconds: float = 2.0,
-           quick: bool = False) -> dict:
+           quick: bool = False, unit: str = "calls/s") -> dict:
     """Run fn repeatedly for ~seconds, report batch*iters/elapsed."""
     if quick:
         seconds = 0.5
@@ -52,7 +52,7 @@ def timeit(name: str, fn, batch: int = 1, *, seconds: float = 2.0,
     elapsed = time.perf_counter() - start
     value = batch * iters / elapsed
     out = {"metric": name, "value": round(value, 1),
-           "unit": "calls/s"}
+           "unit": unit}
     print(json.dumps(out), flush=True)
     return out
 
@@ -484,6 +484,50 @@ def _run_benchmarks(rec, quick: bool) -> None:
                      rt_obj.lineage_reconstructions - recon0}}
     print(json.dumps(row), flush=True)
     rec(row)
+
+    # -- observability: metrics pipeline cost --------------------------
+    # metrics_flush_overhead: full exporter flush units/s for a
+    # 100-series registry — snapshot + head-side ingest + one cluster
+    # exposition render per unit. This is what every worker pays once
+    # per metrics_report_interval_s, and what the head pays per scrape.
+    from ray_tpu.observability.aggregator import (
+        ClusterMetricsAggregator,
+    )
+    from ray_tpu.observability.snapshot import snapshot_registry
+    from ray_tpu.util.metrics import Counter as _Counter
+
+    flush_counters = [
+        _Counter(f"perf_flush_metric_{i}", "flush-overhead probe",
+                 ("k",)) for i in range(100)]
+    for i, c in enumerate(flush_counters):
+        c.inc(tags={"k": str(i)})
+    agg = ClusterMetricsAggregator()
+
+    def one_flush():
+        agg.ingest("perf_node", "perf_worker",
+                   snapshot_registry(), time.time())
+        agg.prometheus_text()
+
+    rec(timeit("metrics_flush_overhead", one_flush,
+               unit="flushes/s", quick=quick))
+
+    # Instrumented vs disabled task submit: the same sync-task lap
+    # with the head-side observability pipeline on (session default)
+    # and off. The delta bounds what the plane costs the task hot
+    # path; the disabled row is the guardrail baseline (near-zero
+    # overhead is also pinned by tests/test_perf.py on the
+    # worker-side recording hot path).
+    rec(timeit("task_submit_instrumented",
+               lambda: ray_tpu.get(_small_task.remote()),
+               quick=quick))
+    plane = rt_obj.observability
+    plane.set_enabled(False)
+    try:
+        rec(timeit("task_submit_uninstrumented",
+                   lambda: ray_tpu.get(_small_task.remote()),
+                   quick=quick))
+    finally:
+        plane.set_enabled(True)
 
 
 def run_serve_bench(quick: bool = False) -> dict:

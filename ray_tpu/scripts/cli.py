@@ -104,8 +104,30 @@ def _cmd_timeline(args) -> int:
 
 
 def _cmd_metrics(args) -> int:
-    from ray_tpu.util.metrics import prometheus_text
-    sys.stdout.write(prometheus_text())
+    """Cluster-aggregated Prometheus dump: scrape the dashboard when
+    --url is given, else pull the same text from the live session's
+    head over the client protocol. --local keeps the old behavior
+    (this process's own registry) for headless use."""
+    if args.local:
+        from ray_tpu.util.metrics import prometheus_text
+        sys.stdout.write(prometheus_text())
+        return 0
+    if args.url:
+        import urllib.request
+        url = args.url.rstrip("/")
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        sys.stdout.write(urllib.request.urlopen(
+            url, timeout=30).read().decode())
+        return 0
+    try:
+        address = _discover_address(args.address)
+    except SystemExit:
+        raise SystemExit(
+            "no live ray_tpu session found; pass --address, --url "
+            "(dashboard), or --local for this process's registry")
+    c = _Client(address)
+    sys.stdout.write(c.state("cluster_metrics"))
     return 0
 
 
@@ -471,7 +493,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_timeline)
 
-    p = sub.add_parser("metrics", help="prometheus metrics dump")
+    p = sub.add_parser(
+        "metrics", help="cluster prometheus metrics dump")
+    p.add_argument("--address", default=None,
+                   help="session socket (default: newest live one)")
+    p.add_argument("--url", default=None,
+                   help="scrape a dashboard URL instead")
+    p.add_argument("--local", action="store_true",
+                   help="dump only this process's registry "
+                        "(headless fallback)")
     p.set_defaults(fn=_cmd_metrics)
 
     p = sub.add_parser(

@@ -31,6 +31,27 @@ class Replica:
         self._inflight = 0
         self._lock = threading.Lock()
         self._total = 0
+        # Built-in observability (reference: serve_deployment_*
+        # metrics recorded by every replica): request latency
+        # histogram + live queue depth, tagged by deployment/replica.
+        # Same-name registration across replicas in one process
+        # shares the accumulators; each instance keeps its own
+        # default tags. Shipped to the head by the worker's metrics
+        # exporter.
+        from ray_tpu.util.metrics import Gauge, Histogram
+        dep = replica_tag.split("#", 1)[0]
+        tags = {"deployment": dep, "replica": replica_tag}
+        self._m_latency = Histogram(
+            "ray_tpu_serve_request_latency_s",
+            "serve request latency (seconds) observed at the replica",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10],
+            tag_keys=("deployment", "replica"),
+        ).set_default_tags(tags)
+        self._m_queue = Gauge(
+            "ray_tpu_serve_replica_queue_depth",
+            "in-flight requests on the replica",
+            tag_keys=("deployment", "replica"),
+        ).set_default_tags(tags)
         if isinstance(cls_or_fn, type):
             self.callable = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -62,16 +83,20 @@ class Replica:
         finally:
             with self._lock:
                 self._inflight -= 1
+            self._m_queue.set(float(self._inflight))
 
     def handle_request(self, method_name: str, args, kwargs,
                        multiplexed_model_id: str = "",
                        stream: bool = False):
         import inspect
+        import time as _time
 
         from ray_tpu.serve.multiplex import _set_current_model_id
+        t_start = _time.perf_counter()
         with self._lock:
             self._inflight += 1
             self._total += 1
+        self._m_queue.set(float(self._inflight))
         _set_current_model_id(multiplexed_model_id)
         # Composition: DeploymentResponse args (type-preserved through
         # pickling) resolve to VALUES before user code runs
@@ -121,6 +146,8 @@ class Replica:
             if not streaming:
                 with self._lock:
                     self._inflight -= 1
+            self._m_latency.observe(_time.perf_counter() - t_start)
+            self._m_queue.set(float(self._inflight))
 
     def queue_len(self) -> int:
         return self._inflight

@@ -134,6 +134,11 @@ class Router:
         # Counts synchronous controller round-trips — steady state
         # must not grow this (asserted by tests/benchmarks).
         self.controller_rpcs = 0
+        # Built-in observability: routed-request counter (the
+        # router-side half of the serve request metrics; the
+        # replica-side latency histogram is the other). Created lazily
+        # so constructing a Router off a live session costs nothing.
+        self._m_requests = None
         self._longpoll = LongPollClient.for_controller(controller)
         self._longpoll.register(self)
 
@@ -201,6 +206,13 @@ class Router:
 
     def assign(self, method_name: str, args, kwargs,
                multiplexed_model_id: str = "", stream: bool = False):
+        if self._m_requests is None:
+            from ray_tpu.util.metrics import Counter
+            self._m_requests = Counter(
+                "ray_tpu_serve_router_requests_total",
+                "requests routed per deployment",
+                tag_keys=("deployment",))
+        self._m_requests.inc(tags={"deployment": self._name})
         replica = self.pick_replica(multiplexed_model_id)
         method = replica.handle_request
         if stream:

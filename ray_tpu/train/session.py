@@ -52,9 +52,33 @@ class _TrainSession:
         # names stay monotonic across slice restarts.
         self._index = checkpoint_index(context.restored_checkpoint_dir) + 1
         self._lock = threading.Lock()
+        # Built-in observability: report()-to-report() wall time per
+        # rank (the training step cadence) + a monotonically growing
+        # step counter, both shipped to the head by the train
+        # worker's metrics exporter.
+        self._last_report_ts: float | None = None
+        from ray_tpu.util.metrics import Counter, Histogram
+        tags = {"rank": str(context.world_rank)}
+        self._m_step_time = Histogram(
+            "ray_tpu_train_step_time_s",
+            "seconds between successive train.report() calls",
+            boundaries=[0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120],
+            tag_keys=("rank",),
+        ).set_default_tags(tags)
+        self._m_steps = Counter(
+            "ray_tpu_train_steps_total",
+            "train.report() calls (training steps) per rank",
+            tag_keys=("rank",),
+        ).set_default_tags(tags)
 
     def report(self, metrics: dict[str, Any],
                checkpoint: "Checkpoint | None" = None) -> None:
+        import time as _time
+        now = _time.perf_counter()
+        if self._last_report_ts is not None:
+            self._m_step_time.observe(now - self._last_report_ts)
+        self._last_report_ts = now
+        self._m_steps.inc()
         ckpt_dir = None
         if checkpoint is not None:
             ckpt_dir = checkpoint.persist(

@@ -43,7 +43,20 @@ class Metric:
                 raise ValueError(
                     f"metric {name!r} already registered with type "
                     f"{prev.TYPE}")
+            if prev is not None:
+                # Re-registration reuses the existing accumulators:
+                # constructing a same-name metric (library re-import,
+                # a second Serve replica in one process) must not
+                # zero the series already recorded. The new instance
+                # becomes a view onto the shared state.
+                self._adopt(prev)
             _registry[name] = self
+
+    def _adopt(self, prev: "Metric") -> None:
+        self._values = prev._values
+        self._lock = prev._lock
+        if not self.description:
+            self.description = prev.description
 
     def set_default_tags(self, tags: dict[str, str]) -> "Metric":
         self._default_tags = dict(tags)
@@ -88,12 +101,24 @@ class Histogram(Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: list[float] | None = None,
                  tag_keys: tuple = ()):
-        super().__init__(name, description, tag_keys)
+        # Bucket state before super().__init__: re-registration adopts
+        # an existing instance's accumulators there, and these fresh
+        # dicts must not clobber the adopted ones afterwards.
         self.boundaries = sorted(boundaries or
                                  [0.001, 0.01, 0.1, 1, 10, 100])
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
         self._totals: dict[tuple, int] = defaultdict(int)
+        super().__init__(name, description, tag_keys)
+
+    def _adopt(self, prev: "Metric") -> None:
+        super()._adopt(prev)
+        # Keep the established bucket layout: recorded counts are
+        # only meaningful against the boundaries they were binned by.
+        self.boundaries = prev.boundaries
+        self._counts = prev._counts
+        self._sums = prev._sums
+        self._totals = prev._totals
 
     def observe(self, value: float,
                 tags: dict[str, str] | None = None) -> None:

@@ -32,8 +32,20 @@ def _match(row: dict, filters) -> bool:
     return True
 
 
-def list_tasks(filters=None, limit: int = 10_000) -> list[dict]:
+def list_tasks(filters=None, limit: int = 10_000,
+               detail: bool = False) -> list[dict]:
+    """Task table rows. ``detail=True`` additionally attaches the
+    cluster task-event store's per-task lifecycle events (reference:
+    ``ray list tasks --detail`` backed by GcsTaskManager) — head
+    scheduler transitions AND worker-side execution events, each
+    stamped with node_id/worker_id/src."""
     rt = _rt()
+    if not hasattr(rt, "_task_lock"):
+        # Worker-side client runtime: the head executes this same
+        # function over OP_STATE.
+        return rt.list_state("tasks_detail" if detail else "tasks",
+                             filters)
+    store = rt.observability.task_events if detail else None
     with rt._task_lock:
         recs = list(rt._done_tasks) + list(rt._tasks.values())
     out = []
@@ -50,6 +62,8 @@ def list_tasks(filters=None, limit: int = 10_000) -> list[dict]:
             "finished_at": rec.finished_at,
             "required_resources": dict(rec.options.resources or {}),
         }
+        if detail:
+            row["events"] = store.events_for(row["task_id"])
         if _match(row, filters):
             out.append(row)
         if len(out) >= limit:

@@ -45,6 +45,15 @@ ROBUSTNESS_METRICS = (
     "drain_node_64_tasks",
 )
 
+# Observability-plane metrics (ray_tpu/perf.py): exporter flush cost
+# and the instrumented-vs-disabled task-submit pair that bounds the
+# pipeline's hot-path overhead. Same must-be-present contract.
+OBSERVABILITY_METRICS = (
+    "metrics_flush_overhead",
+    "task_submit_instrumented",
+    "task_submit_uninstrumented",
+)
+
 
 def one_run(path: str, serve: bool, timeout: float,
             quick: bool = False) -> list[dict]:
@@ -104,7 +113,8 @@ def main() -> None:
               file=sys.stderr)
         got = {r.get("metric") for r in rows}
         missing = [m for m in OBJECT_PLANE_METRICS
-                   + ROBUSTNESS_METRICS if m not in got]
+                   + ROBUSTNESS_METRICS
+                   + OBSERVABILITY_METRICS if m not in got]
         if missing:
             print(f"run {i+1}: WARNING missing object-plane metrics "
                   f"{missing} (crashed mid-bench?)", file=sys.stderr)

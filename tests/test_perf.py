@@ -49,3 +49,53 @@ def test_batched_get_wire_round_guardrail(rt):
     assert rounds <= 1 + math.ceil(n / batch), (
         f"{rounds} wire rounds for a {n}-ref batched get "
         f"(budget {1 + math.ceil(n / batch)})")
+
+
+def test_task_event_recording_disabled_near_zero():
+    """Observability guardrail: with reporting disabled the task-event
+    record call on the execution hot path must be a bare flag check —
+    budget 2µs/op on this deliberately slow box (the real cost is
+    ~100ns; a regression that formats/locks/allocates per call lands
+    well above the bound)."""
+    import time
+
+    from ray_tpu.observability import task_events as te
+
+    te.set_recording(False)
+    try:
+        n = 50_000
+        tid = b"\x01" * 16
+        record = te.record_task_event
+        t0 = time.perf_counter()
+        for _ in range(n):
+            record(tid, "guardrail", "RUNNING")
+        per_op = (time.perf_counter() - t0) / n
+        assert per_op < 2e-6, (
+            f"disabled task-event record costs {per_op * 1e9:.0f}ns/op"
+        )
+        assert te.pending_events() == 0, \
+            "disabled recording must not buffer events"
+    finally:
+        te.set_recording(True)
+
+
+def test_head_pipeline_disabled_skips_store(rt):
+    """With the plane disabled, the head-side task hot path must not
+    feed the event store (the other half of the near-zero-overhead
+    contract)."""
+    rt_obj = ray_tpu.core.api.get_runtime()
+    plane = rt_obj.observability
+    plane.set_enabled(False)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def noop():
+            return 1
+
+        assert ray_tpu.get(noop.remote(), timeout=60) == 1
+        head_events = [
+            e for row in plane.task_events.rows()
+            if row["name"] == "noop"
+            for e in row["events"] if e["src"] == "head"]
+        assert not head_events, head_events
+    finally:
+        plane.set_enabled(True)
