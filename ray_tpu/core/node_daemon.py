@@ -510,7 +510,14 @@ class NodeDaemon:
                                   if not w.dead)
                 with self._store_lock:
                     n_local = len(self._local_oids)
-                report = {"workers": running, "objects": n_local}
+                    local_bytes = sum(
+                        m[0] for m in self._local_obj_meta.values())
+                report = {"workers": running, "objects": n_local,
+                          # Local store occupancy for the head's
+                          # memory_summary per-node rows (arena bytes
+                          # + directory-attributed object bytes).
+                          "store_bytes": self.shm_store.used_bytes(),
+                          "object_bytes": local_bytes}
                 if report == self._rsync_last:
                     continue       # delta suppression
                 self._rsync_last = report
@@ -766,6 +773,13 @@ class NodeDaemon:
             elif op == "free":
                 self._drop_local(ObjectID(payload))
                 result = None
+            elif op in ("profile", "stack", "profile_device"):
+                # Introspection plane: sample/dump THIS daemon
+                # process (head fan-out → cluster flame graph). Runs
+                # on this call's own thread, so the node channel keeps
+                # serving while the sampler ticks.
+                from ray_tpu.observability import profiler as prof
+                result = prof.handle_profile_op(op, payload)
             else:
                 raise ValueError(f"unknown node call {op!r}")
             status, out = P.ST_OK, result

@@ -253,6 +253,15 @@ class SharedMemoryStore:
         with self._lock:
             return self._used
 
+    def object_info(self, object_id: ObjectID):
+        """(size_bytes, spilled) for one resident object, or None —
+        the memory-state debugger's per-object store probe."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return None
+            return entry.size, entry.spilled_path is not None
+
     def shutdown(self) -> None:
         with self._lock:
             ids = list(self._entries)
@@ -411,6 +420,20 @@ class NativeSharedMemoryStore:
 
     def used_bytes(self) -> int:
         return self._store.used_bytes()
+
+    def object_info(self, object_id: ObjectID):
+        """(size_bytes, spilled) or None (see SharedMemoryStore)."""
+        with self._lock:
+            size = self._lru.get(object_id)
+            if size is not None:
+                return int(size), False
+            path = self._spilled.get(object_id)
+        if path is None:
+            return None
+        try:
+            return os.path.getsize(path), True
+        except OSError:
+            return 0, True
 
     def reap_dead_pins(self) -> int:
         return self._store.reap_dead_pins()

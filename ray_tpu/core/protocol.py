@@ -138,6 +138,34 @@ OP_METRICS_PUSH = "metrics_push"
                                 # per-worker metric export + the
                                 # TaskEventBuffer flush RPC into
                                 # GcsTaskManager, SURVEY.md §5.5)
+OP_PROFILE = "profile"          # introspection / profiling plane
+                                # (SURVEY §L6 — ray stack / py-spy
+                                # flame graphs). Blocking forms
+                                # (real req_id, any client):
+                                #   ("capture", spec) -> merged
+                                #     cluster sample (collapsed stacks
+                                #     + per-proc rows); spec keys:
+                                #     duration_s, hz, target
+                                #   ("stack", spec) -> per-proc
+                                #     current-stack text dumps
+                                #   ("device", spec) -> trigger a
+                                #     jax.profiler capture on a node
+                                # Fire-and-forget forms (req_id -1,
+                                # worker processes only):
+                                #   ("register", info) — this client
+                                #     connection can execute profile
+                                #     upcalls (info: pid, node_id,
+                                #     worker_id)
+                                #   ("result", token, payload) — a
+                                #     finished srv_req upcall
+SRV_REQ = "srv_req"             # head -> worker push on the client
+                                # channel: (-1, SRV_REQ, (token, op,
+                                # args)). Client _recv_loop threads
+                                # are never blocked by task execution,
+                                # so a stuck worker still answers —
+                                # exactly what profiling it requires.
+                                # Workers reply with OP_PROFILE
+                                # ("result", token, ...) notifies.
 OP_KV = "kv"                    # (action, key, value, namespace)
 OP_PUBSUB = "pubsub"            # ("publish", topic, blob) -> seq;
                                 # ("poll", topic, epoch, cursor,
@@ -186,7 +214,12 @@ ND_CALL = "nd_call"           # (ND_CALL, fid, op, payload); fid -1 = no
                               #   reply. ops: fetch(oid) ->
                               #   ("inline", data, bufs) | chunked meta;
                               #   chunk(tid, i) -> bytes; end(tid);
-                              #   free(oid)
+                              #   free(oid); profile(args) -> sampled
+                              #   collapsed stacks of the daemon
+                              #   process; stack(args) -> current-
+                              #   stack text; profile_device(args) ->
+                              #   start a jax.profiler capture onto a
+                              #   logdir (introspection plane)
 ND_UPREPLY = "nd_upreply"     # (ND_UPREPLY, fid, status, payload)
 ND_SHUTDOWN = "nd_shutdown"   # (ND_SHUTDOWN,)
 ND_PING = "nd_ping"           # (ND_PING,) head -> daemon liveness probe

@@ -763,6 +763,10 @@ class DriverRuntime:
         self._obj_cv = threading.Condition()
         self._errors: dict[ObjectID, bytes] = {}   # oid -> error blob
         self._obj_locations: dict[ObjectID, str] = {}  # "mem" | "shm"
+        # Directory-side object sizes (guarded by _obj_cv with the
+        # location table): memory_summary attributes store bytes per
+        # node/object without touching the stores' own locks.
+        self._obj_sizes: dict[ObjectID, int] = {}
         self._put_counter = itertools.count()
         # Per-process deserialization cache for immutable objects
         # (repeated get of the same large ref skips the unpickle and
@@ -938,6 +942,16 @@ class DriverRuntime:
         self._orphan_direct: dict[bytes, float] = {}
         # node_id -> latest per-node agent sample (dashboard).
         self._agent_stats: dict[str, dict] = {}
+        # Introspection/profiling plane (SURVEY §L6): worker client
+        # connections that registered as profile-capable (the head
+        # pushes SRV_REQ frames down them), pending upcall tokens,
+        # and the one-capture-at-a-time cluster session guard.
+        self._profile_peers: dict[int, dict] = {}
+        self._profile_peers_lock = threading.Lock()
+        self._profile_peer_seq = itertools.count(1)
+        self._profile_results: dict[str, tuple] = {}
+        self._profile_results_lock = threading.Lock()
+        self._profile_session_lock = threading.Lock()
         # Reply cache for client-replayed mutating ops (see
         # protocol.wrap_dd): dd_id -> (status, payload), plus in-flight
         # events so a replay racing the original coalesces onto it.
@@ -1044,6 +1058,7 @@ class DriverRuntime:
         self._deser_cache.invalidate(oid)
         with self._obj_cv:
             loc = self._obj_locations.pop(oid, None)
+            self._obj_sizes.pop(oid, None)
             replica_nodes = self._obj_replicas.pop(oid, set())
         # Target the store the location names — an unconditional
         # native-store delete takes the arena's process-shared lock
@@ -1196,6 +1211,7 @@ class DriverRuntime:
             loc = "mem"
         with self._obj_cv:
             self._obj_locations[oid] = loc
+            self._obj_sizes[oid] = obj.total_size
             self._obj_cv.notify_all()
         self._wake_dispatcher_for_deps()
 
@@ -4220,6 +4236,12 @@ class DriverRuntime:
             return state_api.list_tasks(filters, detail=True)
         if kind == "cluster_metrics":
             return self.observability.prometheus_text()
+        if kind == "memory_summary":
+            opts = filters if isinstance(filters, dict) else {}
+            return self.memory_summary(
+                top_n=int(opts.get("top_n", 20)))
+        if kind == "cluster_status":
+            return self.cluster_status()
         fns = {
             "tasks": state_api.list_tasks,
             "actors": state_api.list_actors,
@@ -4267,6 +4289,218 @@ class DriverRuntime:
                 })
         out.extend(self.observability.timeline_events())
         return out
+
+    # ---------------- introspection / profiling plane -----------------
+    # (SURVEY §L6: the ray status / ray memory / ray stack + dashboard
+    # flame-graph surface, served over OP_STATE / OP_PROFILE.)
+
+    def memory_summary(self, top_n: int = 20) -> dict:
+        """Per-node object-store usage + top-N objects by size with
+        owner, ref counts, and primary/replica/pinned/spilled state
+        (reference: ray memory / memory_summary)."""
+        from ray_tpu.observability.introspect import memory_summary
+        return memory_summary(self, top_n=top_n)
+
+    def cluster_status(self) -> dict:
+        """Per-node resources/drain state, task/actor/worker counts,
+        and autoscaler intent (reference: ray status)."""
+        from ray_tpu.observability.introspect import cluster_status
+        return cluster_status(self)
+
+    def _profile_register(self, info: dict, push_fn) -> int:
+        """A worker client connection announced it can execute
+        profile upcalls; push_fn ships one SRV_REQ frame down it."""
+        peer_id = next(self._profile_peer_seq)
+        with self._profile_peers_lock:
+            self._profile_peers[peer_id] = {
+                "push": push_fn,
+                "pid": int(info.get("pid") or 0),
+                "node_id": str(info.get("node_id") or "")
+                or self.head_node_id,
+                "worker_id": str(info.get("worker_id") or ""),
+            }
+        return peer_id
+
+    def _profile_unregister(self, peer_id: int | None) -> None:
+        if peer_id is None:
+            return
+        with self._profile_peers_lock:
+            self._profile_peers.pop(peer_id, None)
+
+    def _on_profile_result(self, token: str, payload) -> None:
+        with self._profile_results_lock:
+            entry = self._profile_results.pop(token, None)
+        if entry is not None:
+            event, slot = entry
+            slot.append(payload)
+            event.set()
+
+    def _profile_target_match(self, target, node_id: str,
+                              kind: str, pid: int) -> bool:
+        """``target`` selects processes: None/"" = everything,
+        "head" = the head process, a node id (prefix) = that node's
+        daemon + workers, "pid:<n>" = one process."""
+        if not target:
+            return True
+        t = str(target)
+        if t == "head":
+            return kind == "head"
+        if t.startswith("pid:"):
+            return pid == int(t[4:])
+        return node_id.startswith(t)
+
+    def _profile_fanout(self, op: str, args: dict,
+                        target=None) -> list[dict]:
+        """Run one profile op on every matching process — the head
+        itself (inline thread), node daemons (ND_CALL), and
+        registered worker connections (SRV_REQ push) — and collect
+        ``{node_id, kind, pid, ok, value|error}`` rows."""
+        from ray_tpu.observability import profiler as prof
+        duration_s = float(args.get("duration_s", 2.0))
+        wait_s = duration_s + 30.0
+        rows: list[dict] = []
+        threads: list[threading.Thread] = []
+
+        def run(row, fn):
+            def _go():
+                try:
+                    row["value"] = fn()
+                    row["ok"] = True
+                except BaseException as e:  # noqa: BLE001
+                    row["ok"] = False
+                    row["error"] = f"{type(e).__name__}: {e}"
+            t = threading.Thread(target=_go, daemon=True,
+                                 name="profile_fanout")
+            t.start()
+            threads.append(t)
+
+        if self._profile_target_match(target, self.head_node_id,
+                                      "head", os.getpid()):
+            row = {"node_id": self.head_node_id, "kind": "head",
+                   "pid": os.getpid()}
+            rows.append(row)
+            run(row, lambda: prof.handle_profile_op(op, args))
+        with self._res_cv:
+            daemons = [n for n in self._nodes.values()
+                       if n.alive and n.is_daemon]
+        for node in daemons:
+            if not self._profile_target_match(target, node.node_id,
+                                              "daemon", node.pid):
+                continue
+            row = {"node_id": node.node_id, "kind": "daemon",
+                   "pid": node.pid}
+            rows.append(row)
+            run(row, lambda n=node: self._node_call(
+                n, op, args, timeout=wait_s))
+        with self._profile_peers_lock:
+            peers = list(self._profile_peers.values())
+        for peer in peers:
+            if not self._profile_target_match(
+                    target, peer["node_id"], "worker", peer["pid"]):
+                continue
+            row = {"node_id": peer["node_id"], "kind": "worker",
+                   "pid": peer["pid"]}
+            rows.append(row)
+            run(row, lambda p=peer: self._profile_peer_call(
+                p, op, args, wait_s))
+        deadline = time.monotonic() + wait_s
+        for t in threads:
+            t.join(max(0.1, deadline - time.monotonic()))
+        for row in rows:
+            if "ok" not in row:
+                row["ok"] = False
+                row["error"] = "timed out"
+        return rows
+
+    def _profile_peer_call(self, peer: dict, op: str, args: dict,
+                           wait_s: float):
+        """One SRV_REQ round trip to a registered worker: push the
+        request down its client channel, wait for the OP_PROFILE
+        ("result", token, ...) notify."""
+        import uuid
+        token = uuid.uuid4().hex
+        event = threading.Event()
+        slot: list = []
+        with self._profile_results_lock:
+            self._profile_results[token] = (event, slot)
+        try:
+            peer["push"](token, op, args)
+        except BaseException:
+            with self._profile_results_lock:
+                self._profile_results.pop(token, None)
+            raise
+        if not event.wait(wait_s):
+            with self._profile_results_lock:
+                self._profile_results.pop(token, None)
+            raise GetTimeoutError(
+                f"profile upcall to pid {peer['pid']} timed out")
+        payload = slot[0]
+        if isinstance(payload, dict) and payload.get("__error__"):
+            raise RuntimeError(payload["__error__"])
+        return payload
+
+    def profile_cluster(self, duration_s: float = 2.0,
+                        hz: float = 100.0, target=None) -> dict:
+        """Sample stacks across the cluster and merge them into one
+        flame graph (reference: the dashboard's py-spy flame-graph
+        capture, cluster-wide). One capture at a time — concurrent
+        captures would contend the per-process samplers and
+        double-count."""
+        from ray_tpu.observability import profiler as prof
+        if not self._profile_session_lock.acquire(blocking=False):
+            raise prof.ProfilerBusyError(
+                "a cluster profile capture is already in progress")
+        try:
+            args = {"duration_s": float(duration_s),
+                    "hz": float(hz)}
+            rows = self._profile_fanout("profile", args, target)
+            merged: dict[str, int] = {}
+            procs = []
+            for row in rows:
+                proc = {"node_id": row["node_id"],
+                        "kind": row["kind"], "pid": row["pid"],
+                        "ok": row["ok"]}
+                if row["ok"] and isinstance(row.get("value"), dict):
+                    val = row["value"]
+                    prefix = (f"{row['kind']}:"
+                              f"{row['node_id'][:12]}:pid"
+                              f"{val.get('pid', row['pid'])}")
+                    merged = prof.merge_collapsed(
+                        [merged,
+                         prof.merge_collapsed([val["collapsed"]],
+                                              prefix=prefix)])
+                    proc["samples"] = val.get("samples", 0)
+                    proc["threads"] = val.get("threads", 0)
+                    proc["collapsed"] = val.get("collapsed", {})
+                else:
+                    proc["error"] = row.get("error", "")
+                procs.append(proc)
+            return {"collapsed": merged, "procs": procs,
+                    "duration_s": float(duration_s),
+                    "hz": float(hz)}
+        finally:
+            self._profile_session_lock.release()
+
+    def stack_dump(self, target=None) -> list[dict]:
+        """Current stack traces of matching processes (reference:
+        ``ray stack``)."""
+        rows = self._profile_fanout("stack", {"duration_s": 0.0},
+                                    target)
+        return [{"node_id": r["node_id"], "kind": r["kind"],
+                 "pid": r["pid"], "ok": r["ok"],
+                 ("stacks" if r["ok"] else "error"):
+                 (r.get("value") if r["ok"]
+                  else r.get("error", ""))} for r in rows]
+
+    def profile_device(self, logdir: str = "/tmp/ray_tpu_profile",
+                       duration_s: float = 5.0,
+                       target=None) -> list[dict]:
+        """Trigger a ``jax.profiler`` capture on matching node
+        processes onto ``logdir`` (remote device profiling hook)."""
+        return self._profile_fanout(
+            "profile_device",
+            {"logdir": logdir, "duration_s": float(duration_s)},
+            target or "head")
 
     # ---------------- client service (worker -> driver API) -----------
 
@@ -4370,6 +4604,24 @@ class DriverRuntime:
         # aborted on disconnect so a crashed worker can't leak
         # reserved arena slots.
         conn_direct: set = set()
+        # Profile registration owed by THIS connection (a worker that
+        # announced it executes SRV_REQ profile upcalls): dropped on
+        # disconnect so captures never wait on a dead process.
+        profile_peer = [None]
+
+        def do_profile_notify(payload) -> None:
+            try:
+                action = payload[0]
+                if action == "register":
+                    if profile_peer[0] is None:
+                        profile_peer[0] = self._profile_register(
+                            payload[1],
+                            lambda token, op, args: reply(
+                                -1, P.SRV_REQ, (token, op, args)))
+                elif action == "result":
+                    self._on_profile_result(payload[1], payload[2])
+            except Exception:  # noqa: BLE001 — a malformed frame
+                pass           # must not kill the reader
 
         def record_conn_borrow(oid: ObjectID) -> None:
             # Implicit borrow taken during an owned submit (the head
@@ -4459,6 +4711,8 @@ class DriverRuntime:
                         except Exception:  # noqa: BLE001 — a bad
                             pass           # frame must not kill the
                                            # connection's reader
+                    elif sub_op == P.OP_PROFILE:
+                        do_profile_notify(sub_payload)
                 return
             if op == P.OP_METRICS_PUSH and req_id == -1:
                 # Fire-and-forget exporter flush that arrived solo
@@ -4467,6 +4721,11 @@ class DriverRuntime:
                     self.observability.ingest_push(payload)
                 except Exception:  # noqa: BLE001
                     pass
+                return
+            if op == P.OP_PROFILE and req_id == -1:
+                # Fire-and-forget profile plumbing (register/result);
+                # blocking capture requests fall through to the pool.
+                do_profile_notify(payload)
                 return
             self._client_op_pool.submit(handle, req_id, op, payload)
 
@@ -4545,6 +4804,7 @@ class DriverRuntime:
                         self.on_borrow_release(oid)
                     except Exception:  # noqa: BLE001
                         pass
+            self._profile_unregister(profile_peer[0])
 
     # ---------------- node daemon channel (raylet link) ---------------
 
@@ -5009,6 +5269,7 @@ class DriverRuntime:
             self._register_contained_refs(oid, shim)
         with self._obj_cv:
             self._obj_locations[oid] = ("node", node_id)
+            self._obj_sizes[oid] = int(size or 0)
             self._node_objects.setdefault(node_id, set()).add(oid)
             self._obj_cv.notify_all()
         with self._res_cv:
@@ -5074,6 +5335,7 @@ class DriverRuntime:
             self._register_contained_refs(oid, shim)
         with self._obj_cv:
             self._obj_locations[oid] = "shm"
+            self._obj_sizes[oid] = int(total)
             self._obj_cv.notify_all()
         self.on_ref_escaped(oid, nonce)
         with self._res_cv:
@@ -5610,7 +5872,29 @@ class DriverRuntime:
                 # the serve controller actor) that need the real node
                 # table, not the worker-side single-node stub.
                 return self.nodes()
+            if kind == "memory_summary":
+                opts = filters if isinstance(filters, dict) else {}
+                return self.memory_summary(
+                    top_n=int(opts.get("top_n", 20)))
+            if kind == "cluster_status":
+                return self.cluster_status()
             return fns[kind](filters)
+        if op == P.OP_PROFILE:
+            action, spec = payload
+            spec = dict(spec or {})
+            if action == "capture":
+                return self.profile_cluster(
+                    duration_s=float(spec.get("duration_s", 2.0)),
+                    hz=float(spec.get("hz", 100.0)),
+                    target=spec.get("target"))
+            if action == "stack":
+                return self.stack_dump(target=spec.get("target"))
+            if action == "device":
+                return self.profile_device(
+                    logdir=spec.get("logdir", "/tmp/ray_tpu_profile"),
+                    duration_s=float(spec.get("duration_s", 5.0)),
+                    target=spec.get("target"))
+            raise ValueError(f"unknown profile action {action!r}")
         if op == P.OP_PG_CREATE:
             bundles, strategy, name = (payload if len(payload) == 3
                                        else (*payload, ""))

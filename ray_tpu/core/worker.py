@@ -177,6 +177,13 @@ class ClientRuntime:
             threading.Thread(target=self._recv_loop, daemon=True,
                              name="client_recv").start()
             self._replay_async_after_reconnect()
+            if getattr(self, "_profiling_registered", False):
+                # The old connection's registration died with it; the
+                # restarted head must learn this worker is profilable.
+                try:
+                    self.enable_remote_profiling()
+                except Exception:  # noqa: BLE001
+                    pass
             return True
         return False
 
@@ -205,6 +212,15 @@ class ClientRuntime:
         try:
             while True:
                 req_id, status, payload = conn.recv()
+                if status == P.SRV_REQ:
+                    # Head-initiated upcall (profile/stack capture):
+                    # handled on its own thread so this pump — the
+                    # only channel into a worker that task execution
+                    # can never block — keeps serving replies.
+                    threading.Thread(
+                        target=self._handle_srv_req, args=(payload,),
+                        daemon=True, name="client_srv_req").start()
+                    continue
                 with self._pending_lock:
                     entry = self._pending.pop(req_id, None)
                 if entry is not None:
@@ -233,6 +249,33 @@ class ClientRuntime:
         _recv_loop."""
         self._notify_buf.append((op, payload))
         self._notify_event.set()
+
+    def _handle_srv_req(self, payload) -> None:
+        """Execute one head-pushed profile upcall and notify the
+        result back (introspection plane — a stuck or busy worker
+        still answers because the exec loop is not involved)."""
+        try:
+            token, op, args = payload
+        except (TypeError, ValueError):
+            return
+        from ray_tpu.observability import profiler as prof
+        try:
+            result = prof.handle_profile_op(op, args)
+        except BaseException as e:  # noqa: BLE001
+            result = {"__error__": f"{type(e).__name__}: {e}"}
+        self._notify(P.OP_PROFILE, ("result", token, result))
+
+    def enable_remote_profiling(self) -> None:
+        """Announce this process as a profile upcall target (workers
+        call this at boot; plain clients — CLI, drivers — stay
+        unregistered and never receive SRV_REQ pushes)."""
+        import os
+        self._profiling_registered = True
+        self._notify(P.OP_PROFILE, ("register", {
+            "pid": os.getpid(),
+            "node_id": os.environ.get("RAY_TPU_NODE_ID", ""),
+            "worker_id": f"pid:{os.getpid()}",
+        }))
 
     def _metrics_push(self, snapshot: dict,
                       blocking: bool = False) -> None:
@@ -1162,11 +1205,23 @@ def worker_main(conn, client_address: str) -> None:
               "blocking client-channel round trips made by this "
               "process", tag_keys=("pid",)).set(
             float(client.wire_rounds), tags={"pid": str(os.getpid())})
+        from ray_tpu.util.tracing import get_tracer
+        dropped = get_tracer().spans_dropped
+        if dropped:
+            Gauge("ray_tpu_tracing_spans_dropped",
+                  "tracing spans lost to ring overflow or bounded "
+                  "export-failure requeue (this process)",
+                  tag_keys=("pid",)).set(
+                float(dropped), tags={"pid": str(os.getpid())})
 
     metrics_exporter = start_process_exporter(
         client._metrics_push, pre_flush=_obs_pre_flush,
         final_push_fn=lambda s: client._metrics_push(s,
                                                      blocking=True))
+    # Introspection plane: this worker answers head-pushed profile/
+    # stack upcalls on its client recv thread (never blocked by task
+    # execution — profiling a stuck worker is the point).
+    client.enable_remote_profiling()
     _record_event = (_te.record_task_event if metrics_exporter
                      else None)
 

@@ -8,6 +8,11 @@ Endpoints (reference: dashboard modules python/ray/dashboard/modules/):
   GET /api/timeline           chrome-trace JSON (ray.timeline analog)
   GET /api/spans              tracing spans (util.tracing)
   GET /metrics                Prometheus exposition (util.metrics)
+  GET /api/v1/status          cluster_status (ray status analog)
+  GET /api/v1/memory          memory_summary (ray memory analog)
+  GET /api/v1/stack           live stack dumps (ray stack analog)
+  GET /api/v1/profile         remote flame graph (speedscope JSON;
+                              ?duration_s=&hz=&target=&format=)
 """
 
 from __future__ import annotations
@@ -84,6 +89,22 @@ class _Handler(BaseHTTPRequestHandler):
                 # module feeding dashboard node cards). The head node
                 # samples itself on demand.
                 self._send_json(self._agent_stats())
+            elif path in ("/api/status", "/api/v1/status"):
+                # Pull-side state debugger (reference: ray status /
+                # the dashboard cluster view).
+                self._send_json(rt.cluster_status())
+            elif path in ("/api/memory", "/api/v1/memory"):
+                self._send_json(rt.memory_summary(
+                    top_n=self._qint("top", 20)))
+            elif path in ("/api/stack", "/api/v1/stack"):
+                self._send_json(rt.stack_dump(
+                    target=self._qstr("target")))
+            elif path in ("/api/profile", "/api/v1/profile"):
+                # On-demand remote flame graph: samples the whole
+                # cluster (or ?target=) for ?duration_s at ?hz and
+                # returns speedscope JSON (open the response at
+                # speedscope.app) or ?format=collapsed text.
+                self._profile()
             elif path in ("/api/timeline", "/api/v1/timeline"):
                 # Cluster-wide Chrome-trace JSON: head task slices +
                 # remote worker execution slices + collected spans
@@ -205,6 +226,45 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._send(500, json.dumps({"error": str(e)}).encode())
 
+    def _query(self) -> dict:
+        from urllib.parse import parse_qs, urlparse
+        return parse_qs(urlparse(self.path).query)
+
+    def _qstr(self, key: str, default=None):
+        return self._query().get(key, [default])[0]
+
+    def _qint(self, key: str, default: int) -> int:
+        try:
+            return int(self._query().get(key, [default])[0])
+        except (TypeError, ValueError):
+            return default
+
+    def _qfloat(self, key: str, default: float) -> float:
+        try:
+            return float(self._query().get(key, [default])[0])
+        except (TypeError, ValueError):
+            return default
+
+    def _profile(self) -> None:
+        from ray_tpu.observability import profiler as prof
+        res = self.runtime.profile_cluster(
+            duration_s=min(120.0, self._qfloat("duration_s", 5.0)),
+            hz=min(1000.0, self._qfloat("hz", 100.0)),
+            target=self._qstr("target"))
+        if self._qstr("format") == "collapsed":
+            self._send(200,
+                       prof.collapsed_text(res["collapsed"]).encode(),
+                       "text/plain")
+            return
+        profiles = [("cluster (merged)", res["collapsed"],
+                     res["hz"])]
+        profiles += [
+            (f"{p['kind']} {p['node_id'][:12]} pid{p['pid']}",
+             p.get("collapsed", {}), res["hz"])
+            for p in res["procs"] if p["ok"]]
+        self._send_json(prof.to_speedscope(
+            profiles, name="ray_tpu cluster profile"))
+
     def _logs(self) -> dict:
         """Worker log files (list, or ?file=<name> tail) — the SPA's
         log viewer (reference: the dashboard log module). Shares the
@@ -222,7 +282,16 @@ class _Handler(BaseHTTPRequestHandler):
             tail = int(q.get("tail", ["65536"])[0])
         except ValueError:
             tail = 65536          # garbage query param -> default
-        return tail_log_file(log_dir, fname, tail)
+        offset = None
+        if "offset" in q:
+            # Incremental follow: the reply's "offset" field is the
+            # resume point for the next poll (only appended bytes
+            # ship — the CLI's --follow and any poller share this).
+            try:
+                offset = int(q["offset"][0])
+            except ValueError:
+                offset = None
+        return tail_log_file(log_dir, fname, tail, offset=offset)
 
     def _agent_stats(self) -> dict:
         """Daemon-reported samples + an on-demand head self-sample
@@ -278,7 +347,10 @@ padding:4px 10px}}</style></head><body>
 <a href="/api/placement_groups">placement_groups</a>
 <a href="/api/summary">summary</a>
 <a href="/api/timeline">timeline</a> <a href="/api/spans">spans</a>
-<a href="/metrics">metrics</a></p>
+<a href="/metrics">metrics</a>
+<a href="/api/v1/status">status</a>
+<a href="/api/v1/memory">memory</a>
+<a href="/api/v1/stack">stack</a></p>
 </body></html>"""
         return html.encode()
 

@@ -82,6 +82,10 @@ class SystemMetricsCollector:
                 "ray_tpu_metrics_stale_series",
                 "series hidden from the scrape (owning node dead or "
                 "draining)"),
+            "spans_dropped": Gauge(
+                "ray_tpu_tracing_spans_dropped",
+                "tracing spans lost to ring overflow or bounded "
+                "export-failure requeue (this process)"),
         }
         self._g = g
         self._stop = threading.Event()
@@ -147,6 +151,8 @@ class SystemMetricsCollector:
                 g["obs_tasks"].set(float(len(plane.task_events)))
                 g["obs_stale"].set(float(
                     plane.aggregator.stale_series_count()))
+            from ray_tpu.util.tracing import get_tracer
+            g["spans_dropped"].set(float(get_tracer().spans_dropped))
         except Exception:  # noqa: BLE001 — sampling must never kill
             pass           # the thread; partial samples are fine
 

@@ -19,11 +19,32 @@ existing client/node protocol:
   Prometheus text), ``GET /api/v1/timeline`` (Chrome-trace JSON),
   ``util.state.list_tasks(detail=True)``, and the
   ``ray_tpu metrics`` CLI.
+
+The PULL side (SURVEY §L6 — ray status / ray memory / ray stack /
+dashboard flame graphs) lives in
+:mod:`~ray_tpu.observability.introspect` (``memory_summary`` /
+``cluster_status`` over new ``OP_STATE`` verbs) and
+:mod:`~ray_tpu.observability.profiler` (dependency-free in-process
+stack sampler, fanned out by the head over ``OP_PROFILE`` / SRV_REQ /
+``ND_CALL profile`` and merged into a cluster flame graph exportable
+as collapsed stacks or speedscope JSON).
 """
 
 from ray_tpu.observability.aggregator import ClusterMetricsAggregator
 from ray_tpu.observability.exporter import MetricsExporter
+from ray_tpu.observability.introspect import (
+    cluster_status,
+    memory_summary,
+)
 from ray_tpu.observability.plane import ObservabilityPlane
+from ray_tpu.observability.profiler import (
+    ProfilerBusyError,
+    collapsed_text,
+    dump_stacks,
+    merge_collapsed,
+    sample_stacks,
+    to_speedscope,
+)
 from ray_tpu.observability.snapshot import snapshot_registry
 from ray_tpu.observability.task_events import (
     TaskEventStore,
@@ -37,10 +58,18 @@ __all__ = [
     "ClusterMetricsAggregator",
     "MetricsExporter",
     "ObservabilityPlane",
+    "ProfilerBusyError",
     "TaskEventStore",
+    "cluster_status",
+    "collapsed_text",
     "drain_events",
+    "dump_stacks",
+    "memory_summary",
+    "merge_collapsed",
     "record_task_event",
     "recording_enabled",
+    "sample_stacks",
     "set_recording",
     "snapshot_registry",
+    "to_speedscope",
 ]

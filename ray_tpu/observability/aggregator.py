@@ -155,8 +155,16 @@ class ClusterMetricsAggregator:
                             key, 0.0) + float(entry[1])
         return out
 
-    def prometheus_text(self, extra_procs=()) -> str:
-        """Cluster-wide Prometheus exposition of the merged view."""
+    def prometheus_text(self, extra_procs=(),
+                        quantiles: bool = False) -> str:
+        """Cluster-wide Prometheus exposition of the merged view.
+        ``quantiles=True`` additionally renders p50/p95/p99 gauge
+        series per histogram (bucket→quantile interpolation, see
+        util.metrics.histogram_quantile) so CLI and dashboard
+        consumers read latency percentiles without a PromQL engine."""
+        import math
+
+        from ray_tpu.util.metrics import histogram_quantile
         lines: list[str] = []
         for name, fam in sorted(self.merged(extra_procs).items()):
             if fam["desc"]:
@@ -184,6 +192,23 @@ class ClusterMetricsAggregator:
                 else:
                     lines.append(
                         f"{name}{_fmt_tags(base)} {_num(val)}")
+            if quantiles and fam["type"] == "histogram":
+                for q, label in ((0.5, "p50"), (0.95, "p95"),
+                                 (0.99, "p99")):
+                    emitted = False
+                    for key in sorted(fam["series"]):
+                        buckets = fam["series"][key][0]
+                        v = histogram_quantile(
+                            q, fam["boundaries"], buckets)
+                        if math.isnan(v):
+                            continue
+                        if not emitted:
+                            lines.append(
+                                f"# TYPE {name}_{label} gauge")
+                            emitted = True
+                        lines.append(
+                            f"{name}_{label}{_fmt_tags(dict(key))} "
+                            f"{round(v, 6)}")
         return "\n".join(lines) + "\n"
 
 

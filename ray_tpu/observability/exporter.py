@@ -83,7 +83,20 @@ class MetricsExporter:
         self.flushes += 1
         if snap is None:
             return False
-        (self._final_push if final else self._push)(snap)
+        try:
+            (self._final_push if final else self._push)(snap)
+        except BaseException:
+            # Metrics are cumulative (the next flush re-ships them)
+            # and task events re-drain, but drained SPANS exist only
+            # in this snapshot — requeue them (bounded, counted) so a
+            # transient head outage doesn't punch holes in traces.
+            if snap.get("spans"):
+                from ray_tpu.util.tracing import get_tracer
+                try:
+                    get_tracer().requeue_dicts(snap["spans"])
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         self.pushes += 1
         return True
 

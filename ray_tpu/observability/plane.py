@@ -95,9 +95,11 @@ class ObservabilityPlane:
 
     def prometheus_text(self) -> str:
         """Cluster-aggregated Prometheus exposition: remote worker /
-        daemon snapshots merged with the head's live registry."""
+        daemon snapshots merged with the head's live registry, plus
+        p50/p95/p99 gauge series per histogram (the CLI ``metrics``
+        and dashboard ``/metrics`` percentile surface)."""
         return self.aggregator.prometheus_text(
-            extra_procs=[self._local_proc()])
+            extra_procs=[self._local_proc()], quantiles=True)
 
     def timeline_events(self) -> list[dict]:
         """The remote half of the cluster timeline: worker execution

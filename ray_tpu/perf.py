@@ -529,6 +529,58 @@ def _run_benchmarks(rec, quick: bool) -> None:
     finally:
         plane.set_enabled(True)
 
+    # -- introspection plane (PR-4) ------------------------------------
+    # memory_summary_1k_objects: full cluster memory summaries per
+    # second over a 1000-object directory — the `ray_tpu memory` /
+    # /api/v1/memory serving cost at a realistic table size.
+    ms_refs = [ray_tpu.put(b"m" * 256) for _ in range(1000)]
+    rec(timeit("memory_summary_1k_objects",
+               lambda: rt_obj.memory_summary(top_n=20),
+               unit="calls/s", quick=quick))
+    del ms_refs
+
+    # profiler_sampling_overhead: % slowdown of a pure-Python spin
+    # loop while a 100 Hz in-process sampler runs, vs unprofiled.
+    # This is the price a LIVE capture puts on the target process;
+    # the no-session price is a bare flag (tests/test_perf.py pins
+    # it near zero).
+    import threading as _thr
+
+    from ray_tpu.observability import profiler as _prof
+
+    def _spin(n=200_000):
+        x = 0
+        for i in range(n):
+            x += i
+        return x
+
+    def _best_spin(reps=5):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _spin()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    _spin()                                   # warm
+    base = _best_spin()
+    sampler = _thr.Thread(
+        target=_prof.sample_stacks,
+        kwargs={"duration_s": 8.0 * base * 6 + 1.0, "hz": 100.0},
+        daemon=True)
+    sampler.start()
+    time.sleep(0.05)                          # sampler ticking
+    profiled = _best_spin()
+    sampler.join()
+    overhead_pct = max(0.0, (profiled - base) / base * 100.0)
+    row = {"metric": "profiler_sampling_overhead",
+           "value": round(overhead_pct, 1), "unit": "%",
+           "extra": {"spin_base_s": round(base, 5),
+                     "spin_profiled_s": round(profiled, 5),
+                     "hz": 100}}
+    print(json.dumps(row), flush=True)
+    rec(row)
+
 
 def run_serve_bench(quick: bool = False) -> dict:
     """Serve requests/s through a 2-replica deployment (steady-state

@@ -8,10 +8,14 @@ session and supervises the entrypoint.
 
 Usage:
     python -m ray_tpu.scripts.cli status [--address PATH]
+    python -m ray_tpu.scripts.cli memory [--top 20]
+    python -m ray_tpu.scripts.cli stack [head|<node-id>|pid:<n>]
+    python -m ray_tpu.scripts.cli profile [--duration 5] [-o out.json]
     python -m ray_tpu.scripts.cli list {tasks,actors,nodes,objects,pgs}
     python -m ray_tpu.scripts.cli summary
     python -m ray_tpu.scripts.cli timeline --output trace.json
     python -m ray_tpu.scripts.cli metrics
+    python -m ray_tpu.scripts.cli logs worker-0.log --follow
     python -m ray_tpu.scripts.cli doctor
     python -m ray_tpu.scripts.cli job submit -- python train.py
 """
@@ -67,16 +71,88 @@ class _Client:
 
 
 def _cmd_status(args) -> int:
+    """``ray_tpu status`` (reference: ray status): per-node resource
+    usage + drain state, task/actor/worker counts, and pending
+    autoscaler demand — the cluster_status OP_STATE verb rendered."""
+    c = _Client(_discover_address(args.address))
+    cs = c.state("cluster_status")
+    if args.json:
+        print(json.dumps(cs, indent=2, default=str))
+        return 0
+    from ray_tpu.observability.introspect import format_cluster_status
+    sys.stdout.write(format_cluster_status(cs))
+    return 0
+
+
+def _cmd_memory(args) -> int:
+    """``ray_tpu memory`` (reference: ray memory): per-node object
+    store usage and the top-N objects by size with owner/ref-count/
+    pin/spill state."""
+    c = _Client(_discover_address(args.address))
+    ms = c.state("memory_summary", {"top_n": args.top})
+    if args.json:
+        print(json.dumps(ms, indent=2, default=str))
+        return 0
+    from ray_tpu.observability.introspect import format_memory_summary
+    sys.stdout.write(format_memory_summary(ms))
+    return 0
+
+
+def _cmd_stack(args) -> int:
+    """``ray_tpu stack [target]`` (reference: ray stack): dump the
+    current Python stacks of matching cluster processes — head,
+    node daemons, workers. target: "head", a node-id prefix, or
+    "pid:<n>" (default: every process)."""
     from ray_tpu.core import protocol as P
     c = _Client(_discover_address(args.address))
-    avail, total = c.call(P.OP_RESOURCES, None)
-    nodes = c.state("nodes")
-    print("== ray_tpu cluster status ==")
-    alive = [n for n in nodes if n["state"] == "ALIVE"]
-    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
-    for k in sorted(total):
-        print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    rows = c.call(P.OP_PROFILE, ("stack", {"target": args.target}))
+    for r in rows:
+        hdr = (f"==== {r['kind']} {r['node_id'][:16]} "
+               f"pid={r['pid']} ====")
+        print(hdr)
+        if r["ok"]:
+            sys.stdout.write(r["stacks"])
+        else:
+            print(f"  <error: {r.get('error', 'unknown')}>")
+    if not rows:
+        print("no matching processes")
+        return 1
     return 0
+
+
+def _cmd_profile(args) -> int:
+    """``ray_tpu profile``: sample stacks across the cluster for
+    --duration at --hz, merge into one flame graph, and write
+    speedscope JSON (open at https://www.speedscope.app) or collapsed
+    stacks (any flamegraph renderer)."""
+    from ray_tpu.core import protocol as P
+    from ray_tpu.observability import profiler as prof
+    c = _Client(_discover_address(args.address))
+    res = c.call(P.OP_PROFILE, ("capture", {
+        "duration_s": args.duration, "hz": args.hz,
+        "target": args.target}))
+    ok = [p for p in res["procs"] if p["ok"]]
+    bad = [p for p in res["procs"] if not p["ok"]]
+    if args.format == "collapsed":
+        out = prof.collapsed_text(res["collapsed"])
+    else:
+        profiles = [("cluster (merged)", res["collapsed"],
+                     res["hz"])]
+        profiles += [
+            (f"{p['kind']} {p['node_id'][:12]} pid{p['pid']}",
+             p.get("collapsed", {}), res["hz"])
+            for p in ok]
+        out = json.dumps(prof.to_speedscope(
+            profiles, name="ray_tpu cluster profile"))
+    with open(args.output, "w") as f:
+        f.write(out)
+    print(f"sampled {len(ok)} process(es) for {res['duration_s']}s "
+          f"at {res['hz']:g} Hz -> {args.output} ({args.format})")
+    for p in bad:
+        print(f"  failed: {p['kind']} {p['node_id'][:12]} "
+              f"pid={p['pid']}: {p.get('error', '')}",
+              file=sys.stderr)
+    return 0 if ok else 1
 
 
 def _cmd_list(args) -> int:
@@ -109,8 +185,16 @@ def _cmd_metrics(args) -> int:
     head over the client protocol. --local keeps the old behavior
     (this process's own registry) for headless use."""
     if args.local:
-        from ray_tpu.util.metrics import prometheus_text
+        from ray_tpu.util.metrics import (
+            local_quantile_lines,
+            prometheus_text,
+        )
         sys.stdout.write(prometheus_text())
+        # p50/p95/p99 per histogram series (bucket→quantile
+        # interpolation; the cluster path renders these head-side).
+        q = local_quantile_lines()
+        if q:
+            sys.stdout.write("\n".join(q) + "\n")
         return 0
     if args.url:
         import urllib.request
@@ -152,6 +236,26 @@ def _cmd_logs(args) -> int:
                   f"(run `logs` with no argument to list)")
             return 1
         sys.stdout.write(out["content"])
+        if args.follow:
+            # Byte-offset incremental tailing (tail -f): each poll
+            # reads only what appended since the last one, so a
+            # long-running training log is never re-downloaded.
+            import time as _time
+            offset = out.get("offset", 0)
+            try:
+                while True:
+                    _time.sleep(max(0.1, args.poll_interval))
+                    out = tail_log_file(log_dir, args.file,
+                                        max_bytes=1 << 62,
+                                        offset=offset)
+                    if out.get("error"):
+                        return 1
+                    if out["content"]:
+                        sys.stdout.write(out["content"])
+                        sys.stdout.flush()
+                    offset = out.get("offset", offset)
+            except KeyboardInterrupt:
+                return 0
         if out.get("truncated"):
             print(f"\n[truncated to last {want} bytes; use "
                   f"--tail-bytes 0 for the whole file]",
@@ -462,9 +566,41 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="ray-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p = sub.add_parser("status", help="cluster resources + nodes")
+    p = sub.add_parser("status", help="cluster resources, nodes, "
+                                      "tasks, autoscaler demand")
     p.add_argument("--address", default=None)
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the text rendering")
     p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser("memory", help="object-store state debugger "
+                                      "(ray memory analog)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--top", type=int, default=20,
+                   help="top-N objects by size (default 20)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_memory)
+
+    p = sub.add_parser("stack", help="dump live Python stacks of "
+                                     "cluster processes (ray stack)")
+    p.add_argument("target", nargs="?", default=None,
+                   help='"head", a node-id prefix, or "pid:<n>" '
+                        "(default: all)")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_stack)
+
+    p = sub.add_parser(
+        "profile", help="capture a cluster flame graph (remote "
+                        "stack sampling)")
+    p.add_argument("target", nargs="?", default=None,
+                   help="same selector as `stack` (default: all)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--hz", type=float, default=100.0)
+    p.add_argument("--format", choices=["speedscope", "collapsed"],
+                   default="speedscope")
+    p.add_argument("--output", "-o", default="profile.speedscope.json")
+    p.set_defaults(fn=_cmd_profile)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", choices=["tasks", "actors", "nodes",
@@ -482,6 +618,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="log file name to print (empty = list)")
     p.add_argument("--address", default=None)
     p.add_argument("--tail-bytes", type=int, default=65536)
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling for appended bytes "
+                        "(incremental, offset-resumed)")
+    p.add_argument("--poll-interval", type=float, default=1.0)
     p.set_defaults(fn=_cmd_logs)
 
     p = sub.add_parser("usage", help="print local usage summary")

@@ -185,6 +185,69 @@ def _fmt_tags(tags: dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+def histogram_quantile(q: float, boundaries: list[float],
+                       bucket_counts: list[int]) -> float:
+    """Estimate the ``q``-quantile from cumulative histogram buckets
+    (the ``histogram_quantile()`` PromQL function, done head-side so
+    CLI/dashboard render p50/p95/p99 without a PromQL engine).
+
+    ``bucket_counts`` has ``len(boundaries) + 1`` entries (the last
+    is the +Inf bucket). Linear interpolation inside the winning
+    bucket; a quantile landing in the +Inf bucket returns the highest
+    finite boundary (the Prometheus convention — there is no upper
+    edge to interpolate toward). NaN for an empty histogram."""
+    total = sum(bucket_counts)
+    if total <= 0 or not boundaries:
+        return float("nan")
+    q = min(1.0, max(0.0, float(q)))
+    rank = q * total
+    cum = 0
+    for i, upper in enumerate(boundaries):
+        prev_cum = cum
+        cum += bucket_counts[i]
+        if cum >= rank:
+            lower = boundaries[i - 1] if i > 0 else 0.0
+            in_bucket = bucket_counts[i]
+            frac = ((rank - prev_cum) / in_bucket) if in_bucket else 0.0
+            return lower + (upper - lower) * frac
+    return float(boundaries[-1])
+
+
+_QUANTILE_LABELS = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
+
+
+def histogram_quantiles(boundaries: list[float],
+                        bucket_counts: list[int],
+                        qs=(0.5, 0.95, 0.99)) -> dict[float, float]:
+    return {q: histogram_quantile(q, boundaries, bucket_counts)
+            for q in qs}
+
+
+def local_quantile_lines() -> list[str]:
+    """p50/p95/p99 exposition lines for every histogram series in
+    THIS process's registry (the ``ray_tpu metrics --local`` tail;
+    the cluster path renders the same shape in the aggregator)."""
+    import math
+    lines: list[str] = []
+    for name, m in sorted(collect_all().items()):
+        if not isinstance(m, Histogram):
+            continue
+        series = m.collect_histogram()
+        for q, label in _QUANTILE_LABELS:
+            emitted_type = False
+            for key, (buckets, _s, _n) in sorted(series.items()):
+                val = histogram_quantile(q, m.boundaries, buckets)
+                if math.isnan(val):
+                    continue
+                if not emitted_type:
+                    lines.append(f"# TYPE {name}_{label} gauge")
+                    emitted_type = True
+                lines.append(
+                    f"{name}_{label}{_fmt_tags(dict(key))} "
+                    f"{round(val, 6)}")
+    return lines
+
+
 def reset_registry() -> None:
     """Test hook."""
     with _registry_lock:
@@ -192,4 +255,5 @@ def reset_registry() -> None:
 
 
 __all__ = ["Counter", "Gauge", "Histogram", "prometheus_text",
-           "collect_all", "reset_registry"]
+           "collect_all", "reset_registry", "histogram_quantile",
+           "histogram_quantiles", "local_quantile_lines"]

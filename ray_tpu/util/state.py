@@ -162,6 +162,27 @@ def list_placement_groups(filters=None, limit: int = 10_000
     return out
 
 
+def memory_summary(top_n: int = 20) -> dict[str, Any]:
+    """Cluster object-store debugger (reference: ``ray memory`` /
+    ``memory_summary``): per-node usage + top-N objects by size with
+    owner, ref counts, and primary/replica/pinned/spilled state.
+    Works from the driver AND from worker-side clients (served over
+    OP_STATE)."""
+    rt = _rt()
+    if not hasattr(rt, "_obj_cv"):
+        return rt.list_state("memory_summary", {"top_n": top_n})
+    return rt.memory_summary(top_n=top_n)
+
+
+def cluster_status() -> dict[str, Any]:
+    """``ray status`` analog: per-node resources/drain state, task,
+    actor and worker counts, autoscaler intent."""
+    rt = _rt()
+    if not hasattr(rt, "_res_cv"):
+        return rt.list_state("cluster_status", None)
+    return rt.cluster_status()
+
+
 def summarize_tasks() -> dict[str, Any]:
     """Counts by (name, state) — reference: ray summary tasks."""
     summary: dict[str, dict[str, int]] = {}
@@ -175,5 +196,6 @@ def summarize_tasks() -> dict[str, Any]:
 
 __all__ = [
     "list_tasks", "list_actors", "list_objects", "list_nodes",
-    "list_placement_groups", "summarize_tasks",
+    "list_placement_groups", "summarize_tasks", "memory_summary",
+    "cluster_status",
 ]

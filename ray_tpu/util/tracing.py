@@ -51,10 +51,21 @@ class Span:
 
 
 class Tracer:
-    def __init__(self):
+    def __init__(self, maxlen: int = 100_000):
         self.enabled = False
-        self._spans: deque = deque(maxlen=100_000)
+        self._spans: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
+        # Spans silently lost to ring overflow (or to a bounded
+        # requeue after a failed export) — surfaced as the
+        # ``ray_tpu_tracing_spans_dropped`` plane self-metric so a
+        # span-heavy workload can see its trace is incomplete.
+        self.spans_dropped = 0
+
+    def _append_locked(self, span: "Span") -> None:
+        if (self._spans.maxlen is not None
+                and len(self._spans) >= self._spans.maxlen):
+            self.spans_dropped += 1
+        self._spans.append(span)
 
     # -- lifecycle --
 
@@ -89,7 +100,7 @@ class Tracer:
             _current.reset(token)
             s.end = time.time()
             with self._lock:
-                self._spans.append(s)
+                self._append_locked(s)
 
     def current_context(self) -> tuple[str, str] | None:
         """(trace_id, span_id) to inject into an outgoing task."""
@@ -116,7 +127,7 @@ class Tracer:
     def add_spans(self, span_dicts: list[dict]) -> None:
         with self._lock:
             for d in span_dicts:
-                self._spans.append(Span(**d))
+                self._append_locked(Span(**d))
 
     def drain_dicts(self) -> list[dict]:
         """Take all finished spans (worker-side flush)."""
@@ -124,6 +135,28 @@ class Tracer:
             out = [s.to_dict() for s in self._spans]
             self._spans.clear()
         return out
+
+    def requeue_dicts(self, span_dicts: list[dict]) -> int:
+        """Put drained spans BACK after a failed export so they ride
+        the next flush instead of vanishing (reference: exporter
+        retry queues). Bounded by the ring's free space — the oldest
+        re-queued spans are dropped (and counted) first so live
+        recording is never displaced. Returns how many were kept."""
+        if not span_dicts:
+            return 0
+        with self._lock:
+            if self._spans.maxlen is None:
+                space = len(span_dicts)
+            else:
+                space = self._spans.maxlen - len(self._spans)
+            keep = span_dicts[-space:] if space > 0 else []
+            self.spans_dropped += len(span_dicts) - len(keep)
+            for d in reversed(keep):
+                try:
+                    self._spans.appendleft(Span(**d))
+                except TypeError:
+                    self.spans_dropped += 1
+        return len(keep)
 
     def get_spans(self, trace_id: str | None = None) -> list[Span]:
         with self._lock:

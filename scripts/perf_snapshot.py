@@ -54,6 +54,14 @@ OBSERVABILITY_METRICS = (
     "task_submit_uninstrumented",
 )
 
+# Introspection-plane metrics (ray_tpu/perf.py): the state-debugger
+# serving cost and the live-capture sampling tax. Same
+# must-be-present contract.
+INTROSPECTION_METRICS = (
+    "memory_summary_1k_objects",
+    "profiler_sampling_overhead",
+)
+
 
 def one_run(path: str, serve: bool, timeout: float,
             quick: bool = False) -> list[dict]:
@@ -114,7 +122,8 @@ def main() -> None:
         got = {r.get("metric") for r in rows}
         missing = [m for m in OBJECT_PLANE_METRICS
                    + ROBUSTNESS_METRICS
-                   + OBSERVABILITY_METRICS if m not in got]
+                   + OBSERVABILITY_METRICS
+                   + INTROSPECTION_METRICS if m not in got]
         if missing:
             print(f"run {i+1}: WARNING missing object-plane metrics "
                   f"{missing} (crashed mid-bench?)", file=sys.stderr)

@@ -99,3 +99,46 @@ def test_head_pipeline_disabled_skips_store(rt):
         assert not head_events, head_events
     finally:
         plane.set_enabled(True)
+
+
+def test_profiler_inactive_near_zero():
+    """Introspection guardrail: with no profile session active the
+    plane's only hot-path presence is the ``is_active`` flag read —
+    budget 2µs/op on this slow box (a regression that takes a lock
+    or walks frames per check lands far above it), and no sampler
+    thread may linger."""
+    import threading
+    import time
+
+    from ray_tpu.observability import profiler
+
+    assert profiler.is_active() is False
+    n = 50_000
+    check = profiler.is_active
+    t0 = time.perf_counter()
+    for _ in range(n):
+        check()
+    per_op = (time.perf_counter() - t0) / n
+    assert per_op < 2e-6, (
+        f"inactive profiler check costs {per_op * 1e9:.0f}ns/op")
+    assert not any(t.name == "profile_fanout"
+                   for t in threading.enumerate())
+
+
+def test_memory_summary_1k_objects_bounded(rt):
+    """memory_summary over a 1000-object directory must stay a
+    lock-scoped snapshot + sort — budget 0.5s/call on this box (the
+    perf row memory_summary_1k_objects records the real rate)."""
+    import time
+
+    import ray_tpu as rtpu
+    refs = [rtpu.put(b"p" * 64) for _ in range(1000)]
+    rt_obj = rtpu.core.api.get_runtime()
+    rt_obj.memory_summary(top_n=20)          # warm
+    t0 = time.perf_counter()
+    ms = rt_obj.memory_summary(top_n=20)
+    dt = time.perf_counter() - t0
+    assert ms["totals"]["objects"] >= 1000
+    assert len(ms["top_objects"]) == 20
+    assert dt < 0.5, f"memory_summary took {dt:.3f}s for 1k objects"
+    del refs
