@@ -16,7 +16,27 @@ class ActorMethod:
         self._name = name
         self._num_returns = num_returns
 
-    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+    # Options the backend understands today. Everything else raises
+    # instead of being swallowed — a typo like nm_returns=2 used to
+    # silently run with num_returns=1 and fail later at unpack time.
+    _SUPPORTED_OPTIONS = ("num_returns", "concurrency_group")
+
+    def options(self, num_returns: int | str | None = None,
+                concurrency_group: str | None = None,
+                **unknown) -> "ActorMethod":
+        if unknown:
+            raise TypeError(
+                f"unsupported actor-method option(s) "
+                f"{sorted(unknown)}; this backend supports "
+                f"{list(self._SUPPORTED_OPTIONS)}")
+        if concurrency_group is not None:
+            raise NotImplementedError(
+                "concurrency_group is not implemented by this "
+                "backend: actor concurrency is governed by "
+                "max_concurrency on the actor (one shared budget), "
+                "not per-method groups")
+        if num_returns is None:
+            num_returns = self._num_returns
         return ActorMethod(self._handle, self._name, num_returns)
 
     def remote(self, *args, **kwargs):

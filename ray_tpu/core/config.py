@@ -96,6 +96,28 @@ class Config:
     # (read-only page-backed) objects are ever served from cache.
     deser_cache_min_bytes: int = 100 * 1024
 
+    # --- direct actor calls (reference: direct actor call path +
+    # the ownership model taking the GCS out of steady-state actor
+    # submission, core_worker actor task submission; NSDI'21
+    # "Ownership" §3) ---
+    # Master switch: after a handle's first (head-routed) call
+    # resolves the actor's location, later calls go worker->worker
+    # over a peer connection, sending ZERO frames to the head. Off =
+    # every call takes the head-routed path (the pre-PR behavior).
+    direct_calls_enabled: bool = True
+    # Args at or under this pickled size ride inline in the direct
+    # call frame; larger calls fall back to head routing (which
+    # resolves/stages args through the object plane).
+    direct_call_inline_threshold: int = 100 * 1024
+    # Max unacked direct calls in flight per (caller, actor) channel;
+    # submits past the window block until acks drain (back-pressure,
+    # and a bound on the fallback replay buffer).
+    direct_call_window: int = 256
+    # Executed direct-call results retained per hosting worker for
+    # at-most-once replay dedupe (a fallback replay of an
+    # already-executed seqno gets the cached result, not a re-run).
+    direct_call_result_cache: int = 4096
+
     # --- fault tolerance ---
     # Default task max retries (reference: max_retries=3 default).
     task_max_retries: int = 3

@@ -540,6 +540,13 @@ class NodeDaemon:
         # Tell workers which address reaches the cluster head — the
         # routable-interface probe for multi-host rendezvous.
         env_vars.setdefault("RAY_TPU_HEAD_IP", self.head_addr[0])
+        # Advertise address for per-worker peer listeners (the direct
+        # actor-call plane): actors hosted on this node must announce
+        # an interface OTHER nodes' callers can dial, and the daemon's
+        # own routable-IP probe (the one its object listener already
+        # advertises) is authoritative for that.
+        env_vars.setdefault("RAY_TPU_DIRECT_BIND_IP",
+                            self.object_addr[0])
         try:
             w = WorkerHandle(self, env_key, env_vars,
                              node_id=self.node_id)

@@ -166,6 +166,33 @@ SRV_REQ = "srv_req"             # head -> worker push on the client
                                 # exactly what profiling it requires.
                                 # Workers reply with OP_PROFILE
                                 # ("result", token, ...) notifies.
+OP_ACTOR_LOCATION = "actor_location"
+                                # (actor_id_bytes) -> None | (addr,
+                                # token_hex, epoch) — the direct-call
+                                # location lease. None while the actor
+                                # is not ALIVE, its node is draining,
+                                # direct calls are disabled, or its
+                                # hosting worker has not announced a
+                                # listener yet; the caller keeps head
+                                # routing and re-asks later. epoch
+                                # increments on every (re)registration
+                                # so a stale lease is distinguishable.
+OP_DIRECT = "direct"            # fire-and-forget (req_id -1) worker
+                                # notify: ("register", {actor_id,
+                                # addr, token, pid}) — this worker
+                                # hosts the actor and accepts direct
+                                # call frames at addr (authkey token).
+                                # Re-sent after a head reconnect.
+OP_DIRECT_RESULT = "direct_result"
+                                # ("promote", oid_bytes, wire) — a
+                                # caller-held direct-call result is
+                                # escaping to another process: store
+                                # it at the head under its preminted
+                                # return id so any consumer can
+                                # resolve it (ownership promotion).
+                                # Idempotent: a second promote of an
+                                # available id is a no-op.
+
 OP_KV = "kv"                    # (action, key, value, namespace)
 OP_PUBSUB = "pubsub"            # ("publish", topic, blob) -> seq;
                                 # ("poll", topic, epoch, cursor,
@@ -184,6 +211,36 @@ OP_PULL = "pull"                # chunked object pull (ObjectManager
 # client channel, driver -> worker: (req_id, status, payload)
 ST_OK = "ok"
 ST_ERR = "err"
+
+# ---------------------------------------------------------------------------
+# direct call channel (caller worker <-> hosting worker), one
+# token-authenticated TCP connection per (caller, actor). The first
+# message is ("hello_direct", actor_id_bytes, session_id); the host
+# answers ("ok",) — or ("bad", reason) and closes, e.g. when a
+# recycled port now belongs to a different actor's worker. After the
+# handshake the caller sends call frames, the host replies acks; both
+# directions are strictly in-order, so a per-handle seqno plus the
+# connection's FIFO gives per-caller call ordering without a head hop.
+
+OP_CALL_DIRECT = "call_direct"  # (OP_CALL_DIRECT, seq, task_id_bytes,
+                                #  method, args_blob, num_returns) —
+                                # args are INLINE in the frame
+                                # (<= direct_call_inline_threshold;
+                                # larger calls head-route instead).
+OP_CALL_DIRECT_BATCH = "call_direct_batch"
+                                # (OP_CALL_DIRECT_BATCH, [frame, ...])
+                                # — pipelining: everything queued in
+                                # the caller's channel outbox when the
+                                # sender wakes ships as ONE frame (one
+                                # pickle, one syscall, one host-side
+                                # reader wakeup), exactly the
+                                # coalescing contract of EXEC_BATCH /
+                                # OP_REQ_BATCH.
+# host -> caller acks (one per executed call, in execution order):
+#   (seq, DC_OK, [wire_entry, ...])   wire_entry = ser.to_wire(...)
+#   (seq, DC_ERR, err_blob)
+DC_OK = "dc_ok"
+DC_ERR = "dc_err"
 
 # ---------------------------------------------------------------------------
 # node channel (head <-> node daemon), one TCP connection per node.

@@ -952,6 +952,20 @@ class DriverRuntime:
         self._profile_results: dict[str, tuple] = {}
         self._profile_results_lock = threading.Lock()
         self._profile_session_lock = threading.Lock()
+        # Direct actor-call plane: actor_id -> (addr, token_hex,
+        # epoch) announced by the hosting worker's listener; the
+        # OP_ACTOR_LOCATION lease hands it to callers. Epoch bumps on
+        # every (re)registration and the entry is dropped on actor
+        # death/kill/migration, so a stale lease can only ever point
+        # at a closed socket (callers fall back and re-resolve).
+        self._direct_registry: dict[ActorID, tuple] = {}
+        self._direct_epoch: dict[ActorID, int] = {}
+        self._direct_reg_lock = threading.Lock()
+        # Per-op counts of client-channel frames the head has served
+        # (oplog-style observability; tests/perf pin the zero-head-
+        # frames steady-state contract with it).
+        self.client_op_counts: dict[str, int] = {}
+        self._op_count_lock = threading.Lock()
         # Reply cache for client-replayed mutating ops (see
         # protocol.wrap_dd): dd_id -> (status, payload), plus in-flight
         # events so a replay racing the original coalesces onto it.
@@ -2374,6 +2388,10 @@ class DriverRuntime:
         well-timed drain is invisible to callers."""
         w = rec.worker
         restartable = rec.restart_count < rec.max_restarts
+        # Revoke the direct-call lease first: callers mid-stream fall
+        # back to head routing, whose pusher parks through the
+        # migration — zero-loss includes the bypass path.
+        self._direct_invalidate(rec.actor_id)
         if not restartable:
             # Hold the kill until the grace window lapses AND the
             # actor's in-flight calls drained: higher-level
@@ -3784,6 +3802,10 @@ class DriverRuntime:
             # is someone else — releasing resources or restarting on
             # its behalf would double-count.
             return
+        # The dead incarnation's direct-call listener died with it:
+        # revoke the lease so new resolves head-route until the
+        # replacement re-registers.
+        self._direct_invalidate(actor_id)
         # A kill landing mid-restart must keep consuming restart
         # budget, not permanently kill the actor (reference: the GCS
         # actor FSM keeps retrying RESTARTING actors,
@@ -4307,6 +4329,59 @@ class DriverRuntime:
         from ray_tpu.observability.introspect import cluster_status
         return cluster_status(self)
 
+    # ------------- direct actor-call plane (location leases) ----------
+
+    def _count_client_op(self, op: str) -> None:
+        with self._op_count_lock:
+            self.client_op_counts[op] = \
+                self.client_op_counts.get(op, 0) + 1
+
+    def _direct_register(self, info: dict) -> None:
+        """A hosting worker announced its direct-call listener.
+        Accepted whenever the actor record exists — RESULT_READY (exec
+        channel) and this notify (client channel) race, and a lease is
+        only ever GRANTED for an ALIVE actor."""
+        try:
+            actor_id = ActorID(info["actor_id"])
+            addr = tuple(info["addr"])
+            token = str(info["token"])
+        except (KeyError, TypeError, ValueError):
+            return
+        if self._actors.get(actor_id) is None:
+            return
+        with self._direct_reg_lock:
+            epoch = self._direct_epoch.get(actor_id, 0) + 1
+            self._direct_epoch[actor_id] = epoch
+            self._direct_registry[actor_id] = (addr, token, epoch)
+
+    def _direct_invalidate(self, actor_id: ActorID) -> None:
+        """Drop an actor's location lease (death, kill, restart,
+        drain migration): new resolves head-route until the next
+        incarnation's worker re-registers; existing callers notice
+        the closed socket and fall back on their own."""
+        with self._direct_reg_lock:
+            if self._direct_registry.pop(actor_id, None) is not None:
+                self._direct_epoch[actor_id] = \
+                    self._direct_epoch.get(actor_id, 0) + 1
+
+    def actor_location_lease(self, actor_id: ActorID):
+        """(addr, token_hex, epoch) for a direct-callable actor, or
+        None (caller keeps head routing). Draining nodes grant no
+        leases: mid-migration calls must park in the head's pusher,
+        not race the incarnation swap."""
+        if not self.config.direct_calls_enabled:
+            return None
+        rec = self._actors.get(actor_id)
+        if rec is None or rec.state != "ALIVE":
+            return None
+        node = self._nodes.get(rec.node_id)
+        if node is not None and getattr(node, "draining", False):
+            return None
+        with self._direct_reg_lock:
+            return self._direct_registry.get(actor_id)
+
+    # ------------- profiling plane ------------------------------------
+
     def _profile_register(self, info: dict, push_fn) -> int:
         """A worker client connection announced it can execute
         profile upcalls; push_fn ships one SRV_REQ frame down it."""
@@ -4653,6 +4728,15 @@ class DriverRuntime:
                 if req_id != -1:
                     reply(req_id, P.ST_ERR, ser.dumps(e))
         def handle_one(req_id, op, payload):
+            self._count_client_op(op)
+            if op == P.OP_DIRECT and req_id == -1:
+                # Fire-and-forget direct-call listener announcement.
+                try:
+                    if payload and payload[0] == "register":
+                        self._direct_register(payload[1])
+                except Exception:  # noqa: BLE001 — malformed frame
+                    pass           # must not kill the reader
+                return
             if op == P.OP_PUT_DIRECT:
                 dd, dp = P.unwrap_dd(payload)
                 if dd is not None:
@@ -4702,8 +4786,16 @@ class DriverRuntime:
                 # ordering guarantee, one reader wakeup for the
                 # whole burst.
                 for sub_op, sub_payload in payload:
+                    self._count_client_op(sub_op)
                     if sub_op == P.OP_BORROW:
                         do_borrow(-1, sub_payload)
+                    elif sub_op == P.OP_DIRECT:
+                        try:
+                            if sub_payload and \
+                                    sub_payload[0] == "register":
+                                self._direct_register(sub_payload[1])
+                        except Exception:  # noqa: BLE001
+                            pass
                     elif sub_op == P.OP_METRICS_PUSH:
                         try:
                             self.observability.ingest_push(
@@ -4740,6 +4832,7 @@ class DriverRuntime:
             to_run: list = []
             dds: list = []
             for req_id, _op, payload in subs:
+                self._count_client_op(_op)
                 dd, sp = P.unwrap_dd(payload)
                 if dd is not None and self._dd_begin(dd) is not None:
                     dd = None          # replayed: cached, skip run
@@ -5819,6 +5912,26 @@ class DriverRuntime:
             if action == "keys":
                 return self.kv_keys(key, namespace)
             raise ValueError(f"unknown kv action {action!r}")
+        if op == P.OP_ACTOR_LOCATION:
+            return self.actor_location_lease(ActorID(payload))
+        if op == P.OP_DIRECT:
+            # Blocking form of the listener announcement (rare — the
+            # notify path is the normal route).
+            if payload and payload[0] == "register":
+                self._direct_register(payload[1])
+            return None
+        if op == P.OP_DIRECT_RESULT:
+            action, oid_bytes, body = payload
+            oid = ObjectID(oid_bytes)
+            # Ownership promotion of a caller-local direct result:
+            # idempotent — replays and promote-vs-replay races keep
+            # whichever copy landed first.
+            if not self._object_available(oid):
+                if action == "promote":
+                    self._store_value(oid, _wire_to_serialized(body))
+                else:                      # "promote_err"
+                    self._store_error(oid, body)
+            return None
         if op == P.OP_GET_ACTOR:
             name = payload
             return self.get_named_actor(name).binary()

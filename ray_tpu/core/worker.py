@@ -45,6 +45,390 @@ def _args_blob(args, kwargs) -> bytes:
     return ser.dumps((args, kwargs))
 
 
+def _has_toplevel_refs(args, kwargs) -> bool:
+    """Top-level ObjectRef args need driver-side resolution before
+    execution (the direct path ships no ``resolved`` map) — such
+    calls head-route. Nested refs pass through as refs on BOTH paths
+    and keep their escape/borrow bookkeeping, so they don't disqualify
+    a call."""
+    return any(isinstance(a, ObjectRef) for a in args) or \
+        any(isinstance(v, ObjectRef) for v in kwargs.values())
+
+
+def _wire_entry_to_serialized(wire: tuple) -> SerializedObject:
+    """Decode one ser.to_wire tuple (data, buffers, [(rid, nonce)])
+    back into a SerializedObject, rehydrating contained-ref ids for a
+    later head promotion (mirror of runtime._wire_to_serialized)."""
+    refs = None
+    if len(wire) > 2 and wire[2]:
+        refs = [(ObjectID(b), n) for b, n in wire[2]]
+    return SerializedObject(data=wire[0], buffers=list(wire[1]),
+                            contained_refs=refs)
+
+
+def _set_nodelay(conn) -> None:
+    """Disable Nagle on a multiprocessing AF_INET connection. The
+    direct-call plane ships many small frames (call batches one way,
+    per-call acks the other); Nagle + delayed-ACK turns that into
+    ~40ms ping-pong stalls — measured 9x WORSE than head routing on
+    loopback before this. The unix-socket head channel never had the
+    problem, which is why the client wire sender doesn't need this."""
+    try:
+        import socket as _s
+        sd = _s.socket(fileno=os.dup(conn.fileno()))
+        try:
+            sd.setsockopt(_s.IPPROTO_TCP, _s.TCP_NODELAY, 1)
+        finally:
+            sd.close()
+    except (OSError, ValueError):
+        pass
+
+
+class _DirectChannelDead(Exception):
+    """The peer connection for a direct actor channel is gone; the
+    caller must fall back to head routing (and replay unacked calls)."""
+
+
+class _DirectChannel:
+    """Caller side of one (caller, actor) direct-call connection.
+
+    Owns the seqno counter, the unacked replay buffer, and a
+    coalescing outbox+sender (the direct-plane mirror of the client
+    channel's ``_wire_sender_loop``): a burst of async ``.remote()``
+    calls to one actor flushes as ONE ``OP_CALL_DIRECT_BATCH`` frame.
+    Acks complete the preminted return ids in the owning
+    ClientRuntime's local result table — the steady-state call path
+    never touches the head connection.
+    """
+
+    def __init__(self, client: "ClientRuntime", actor_id_bytes: bytes,
+                 addr, token_hex: str, epoch: int, window: int):
+        self._client = client
+        self.actor_id_bytes = actor_id_bytes
+        self.epoch = epoch
+        self.window = max(1, window)
+        self.session_id = os.urandom(8).hex()
+        self.dead = False
+        self.fell_back = False
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._seq = itertools.count()
+        # seq -> (task_id_bytes, method, args_blob, num_returns,
+        #         [rid_bytes], [nonces]); insertion order IS seq order,
+        # which the fallback replay relies on.
+        self.unacked: dict[int, tuple] = {}
+        self._outbox: deque = deque()
+        self._out_ev = threading.Event()
+        self._conn = mpc.Client(tuple(addr), family="AF_INET",
+                                authkey=bytes.fromhex(token_hex))
+        _set_nodelay(self._conn)
+        try:
+            self._conn.send(("hello_direct", actor_id_bytes,
+                             self.session_id))
+            ack = self._conn.recv()
+        except Exception:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            raise
+        if not (isinstance(ack, tuple) and ack and ack[0] == "ok"):
+            # Recycled port owned by someone else's listener: refuse
+            # the lease rather than ship calls to a stranger.
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(f"direct hello refused: {ack!r}")
+        threading.Thread(target=self._sender_loop, daemon=True,
+                         name="direct_call_sender").start()
+        threading.Thread(target=self._recv_loop, daemon=True,
+                         name="direct_call_recv").start()
+
+    def submit(self, task_id_bytes: bytes, method: str,
+               args_blob: bytes, num_returns: int,
+               rid_bytes: list, nonces: list) -> None:
+        """Enqueue one call frame; raises _DirectChannelDead instead
+        of silently losing a call. Blocks (briefly) when the unacked
+        window is full — back-pressure bounds the replay buffer."""
+        with self._cv:
+            while not self.dead and len(self.unacked) >= self.window:
+                self._cv.wait(0.5)
+            if self.dead:
+                raise _DirectChannelDead
+            seq = next(self._seq)
+            self.unacked[seq] = (task_id_bytes, method, args_blob,
+                                 num_returns, rid_bytes, nonces)
+            self._outbox.append(
+                (P.OP_CALL_DIRECT, seq, task_id_bytes, method,
+                 args_blob, num_returns))
+        self._out_ev.set()
+
+    def _sender_loop(self) -> None:
+        while not self.dead:
+            self._out_ev.wait(1.0)
+            self._out_ev.clear()
+            while self._outbox:
+                batch = []
+                while self._outbox and len(batch) < 128:
+                    batch.append(self._outbox.popleft())
+                if not batch:
+                    break
+                try:
+                    self._conn.send(
+                        batch[0] if len(batch) == 1
+                        else (P.OP_CALL_DIRECT_BATCH, batch))
+                except Exception:  # noqa: BLE001 — transport death;
+                    self._mark_dead()  # unacked replays via fallback
+                    return
+
+    def _recv_loop(self) -> None:
+        try:
+            while True:
+                seq, status, payload = self._conn.recv()
+                with self._cv:
+                    entry = self.unacked.pop(seq, None)
+                    self._cv.notify_all()
+                if entry is None:
+                    continue      # late re-ack of a replayed seq
+                rid_bytes = entry[4]
+                if status == P.DC_OK:
+                    for rb, wire in zip(rid_bytes, payload):
+                        self._client._direct_complete(
+                            rb, ("ok", wire))
+                else:
+                    for rb in rid_bytes:
+                        self._client._direct_complete(
+                            rb, ("err", payload))
+        except (EOFError, OSError, ValueError):
+            pass
+        finally:
+            self._mark_dead()
+            # No submit may be racing (it would land in a dead conn's
+            # buffer): hand the unacked calls to the head-routed
+            # fallback even if no new call ever comes.
+            self._client._direct_fallback(self.actor_id_bytes, self)
+
+    def _mark_dead(self) -> None:
+        with self._cv:
+            self.dead = True
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        self._mark_dead()
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class DirectCallServer:
+    """Callee side of the direct actor-call plane: a token-
+    authenticated TCP listener inside the actor's hosting worker
+    process. Call frames execute through the same machinery as
+    exec-channel pushes (same actor lock / concurrency budget), with
+    results acked straight back to the caller — the head is not on
+    the path.
+
+    Also the at-most-once ledger: executed task_ids keep their result
+    in a bounded cache, so a call replayed through the head after a
+    dropped peer connection (the caller can't know whether the ack or
+    the call itself was lost) gets the cached result instead of a
+    second execution.
+    """
+
+    def __init__(self, client: ClientRuntime, actor_id_bytes: bytes,
+                 execute, result_cache: int = 4096):
+        from collections import OrderedDict
+        self._client = client
+        self._actor_id_bytes = actor_id_bytes
+        self._execute = execute
+        self._token = os.urandom(16)
+        bind_ip, adv_ip = "127.0.0.1", "127.0.0.1"
+        forced = os.environ.get("RAY_TPU_DIRECT_BIND_IP")
+        head_ip = os.environ.get("RAY_TPU_HEAD_IP")
+        if forced:
+            # Daemon-hosted worker: the daemon hands down the
+            # interface its own peer object listener advertises.
+            adv_ip, bind_ip = forced, "0.0.0.0"
+        elif head_ip:
+            # Callers may live on other nodes — advertise the
+            # interface that routes toward the head.
+            from ray_tpu.util.net import routable_ip
+            adv_ip = routable_ip(head_ip)
+            bind_ip = "0.0.0.0"
+        self._listener = mpc.Listener((bind_ip, 0), family="AF_INET",
+                                      authkey=self._token)
+        self.addr = (adv_ip, self._listener.address[1])
+        self._completed: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._inflight: dict[bytes, threading.Event] = {}
+        self._state_lock = threading.Lock()
+        self._cache_cap = max(16, result_cache)
+        self._conns: list = []
+        self._shutdown = False
+        self.calls_served = 0
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="direct_call_accept").start()
+        self.register()
+
+    def register(self) -> None:
+        """Announce (addr, token) to the head — fire-and-forget on
+        the client channel; re-sent after a head reconnect."""
+        self._client._notify(P.OP_DIRECT, ("register", {
+            "actor_id": self._actor_id_bytes,
+            "addr": self.addr,
+            "token": self._token.hex(),
+            "pid": os.getpid(),
+        }))
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except Exception:  # noqa: BLE001
+                if self._shutdown:
+                    return
+                continue
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name="direct_call_serve").start()
+
+    def drop_connections(self) -> None:
+        """Chaos/test hook: sever every caller connection (the frames
+        in flight look exactly like a peer network loss)."""
+        conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.drop_connections()
+
+    def _serve_conn(self, conn) -> None:
+        _set_nodelay(conn)
+        try:
+            hello = conn.recv()
+            if not (isinstance(hello, tuple) and len(hello) == 3
+                    and hello[0] == "hello_direct"):
+                conn.close()
+                return
+            if hello[1] != self._actor_id_bytes:
+                # A stale lease resolving to a recycled port: refuse
+                # loudly so the caller falls back and re-resolves.
+                conn.send(("bad", "wrong actor"))
+                conn.close()
+                return
+            conn.send(("ok",))
+        except (EOFError, OSError):
+            return
+        self._conns.append(conn)
+        send_lock = threading.Lock()
+        try:
+            while True:
+                msg = conn.recv()
+                if msg[0] == P.OP_CALL_DIRECT_BATCH:
+                    for frame in msg[1]:
+                        self._handle_call(conn, send_lock, frame)
+                else:
+                    self._handle_call(conn, send_lock, msg)
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_call(self, conn, send_lock, frame) -> None:
+        _op, seq, tid, method, args_blob, num_returns = frame
+
+        def ack(status, payload):
+            try:
+                with send_lock:
+                    conn.send((seq, status, payload))
+            except Exception:  # noqa: BLE001 — caller gone: it will
+                pass           # replay via the head; dedupe holds
+
+        with self._state_lock:
+            cached = self._completed.get(tid)
+            ev = None if cached is not None \
+                else self._inflight.get(tid)
+            if cached is None and ev is None:
+                self._inflight[tid] = threading.Event()
+        if cached is not None:     # duplicate (replayed) seqno
+            ack(*cached)
+            return
+        if ev is not None:
+            # Executing right now via the other path: ack when done.
+            def _wait_ack(ev=ev, tid=tid):
+                ev.wait(600.0)
+                with self._state_lock:
+                    c = self._completed.get(tid)
+                if c is not None:
+                    ack(*c)
+
+            threading.Thread(target=_wait_ack, daemon=True).start()
+            return
+
+        def reply(msg):
+            out = (P.DC_OK, msg[2]) if msg[0] == P.RESULT_OK \
+                else (P.DC_ERR, msg[2])
+            self._finish(tid, out)
+            ack(*out)
+
+        self.calls_served += 1
+        self._execute(tid, method, args_blob, num_returns, reply)
+
+    def _finish(self, tid: bytes, out: tuple) -> None:
+        with self._state_lock:
+            self._completed[tid] = out
+            while len(self._completed) > self._cache_cap:
+                self._completed.popitem(last=False)
+            ev = self._inflight.pop(tid, None)
+        if ev is not None:
+            ev.set()
+
+    def try_replay_on_exec(self, tid: bytes, send_fn) -> bool:
+        """Exec-channel dedupe: a head-routed push for a task this
+        worker already executed directly replies the cached result
+        (re-serialized as a normal RESULT frame) instead of re-running
+        the method. Returns False for fresh tasks."""
+        with self._state_lock:
+            cached = self._completed.get(tid)
+            ev = None if cached is not None \
+                else self._inflight.get(tid)
+        if cached is None and ev is None:
+            return False
+
+        def _send_cached(c):
+            kind = P.RESULT_OK if c[0] == P.DC_OK else P.RESULT_ERR
+            send_fn((kind, tid, c[1]))
+
+        if cached is not None:
+            _send_cached(cached)
+            return True
+
+        def _wait_send(ev=ev, tid=tid):
+            ev.wait(600.0)
+            with self._state_lock:
+                c = self._completed.get(tid)
+            if c is not None:
+                _send_cached(c)
+
+        threading.Thread(target=_wait_send, daemon=True).start()
+        return True
+
 class ClientRuntime:
     """Worker-side proxy of the driver runtime over the unix socket.
 
@@ -141,6 +525,47 @@ class ClientRuntime:
             target=self._async_drain_loop, daemon=True,
             name="client_submit_drain")
         self._async_thread.start()
+        # Direct actor-call plane (caller side). After the first
+        # (head-routed) call resolves an actor's location lease,
+        # steady-state calls bypass the head entirely: frames go
+        # worker->worker, results come back on the same connection
+        # and complete the preminted return ids LOCALLY.
+        self._direct_chans: dict[bytes, _DirectChannel] = {}
+        self._direct_retry_at: dict[bytes, float] = {}
+        self._direct_resolving: set[bytes] = set()
+        self._direct_lock = threading.Lock()
+        # Per-actor submit serialization: seq assignment, the fallback
+        # replay, and post-fallback head submits must not interleave
+        # (per-handle call ORDER is part of the actor contract).
+        self._actor_locks: dict[bytes, threading.Lock] = {}
+        # oid_bytes -> ("ok", wire) | ("err", blob) | ("head",) — the
+        # caller-local result table; ("head",) marks a call whose
+        # result lives at the head (fallback/replay took it there).
+        self._direct_results: dict[bytes, tuple] = {}
+        self._direct_events: dict[bytes, threading.Event] = {}
+        self._direct_res_lock = threading.Lock()
+        self._direct_promoted: set[bytes] = set()
+        self._direct_promote_sent: set[bytes] = set()
+        # Refs whose local copy died with a promotion still owed (the
+        # escaping task's frame is torn down right after its return
+        # value pickles — the GC can beat the ack): cleanup defers to
+        # the ack so the promotion still fires.
+        self._direct_orphaned: set[bytes] = set()
+        # Path-switch ordering barrier: aid -> the LAST head-routed
+        # call's final return id. While set, the direct path stays
+        # off for that actor — a direct frame racing ahead of calls
+        # still queued in the head's pusher would break per-handle
+        # order. Cleared when this caller OBSERVES the result
+        # (get/wait), which proves the head-routed stream drained
+        # through the actor. Costs zero extra wire traffic; a caller
+        # that never gets its results simply stays head-routed.
+        self._direct_barrier: dict[bytes, bytes] = {}
+        self._barrier_oids: dict[bytes, bytes] = {}
+        # Bypass-ratio counters (sampled into the metrics registry by
+        # the worker exporter; cheap ints on the hot path).
+        self.actor_calls_direct = 0
+        self.actor_calls_head_routed = 0
+        self.direct_call_fallbacks = 0
         self.local_mode = False
 
     def _dial(self):
@@ -182,6 +607,14 @@ class ClientRuntime:
                 # restarted head must learn this worker is profilable.
                 try:
                     self.enable_remote_profiling()
+                except Exception:  # noqa: BLE001
+                    pass
+            if getattr(self, "_direct_server", None) is not None:
+                # Same for the direct-call listener: the restarted
+                # head's location registry is empty until we
+                # re-announce, and callers head-route meanwhile.
+                try:
+                    self._direct_server.register()
                 except Exception:  # noqa: BLE001
                     pass
             return True
@@ -431,7 +864,7 @@ class ClientRuntime:
     _MUTATING_OPS = frozenset({
         P.OP_SUBMIT, P.OP_SUBMIT_OWNED, P.OP_PUT, P.OP_CREATE_ACTOR,
         P.OP_SUBMIT_ACTOR, P.OP_SUBMIT_ACTOR_OWNED, P.OP_PG_CREATE,
-        P.OP_STREAM_NEXT, P.OP_PUT_DIRECT,
+        P.OP_STREAM_NEXT, P.OP_PUT_DIRECT, P.OP_DIRECT_RESULT,
     })
     _MUTATING_KV_ACTIONS = frozenset({"put", "put_if_absent", "del"})
 
@@ -568,10 +1001,54 @@ class ClientRuntime:
                     pass
             return None
 
+    def _direct_fetch(self, oid: ObjectID,
+                      timeout: float | None = None):
+        """Resolve a direct-call return id from the caller-local
+        result table: the SerializedObject once the ack landed, None
+        when the id is not direct-tracked (or was re-routed to the
+        head by a fallback), raising the stored error for a failed
+        call. Blocks on the in-flight ack like any get."""
+        b = oid.binary()
+        with self._direct_res_lock:
+            ent = self._direct_results.get(b)
+            ev = self._direct_events.get(b)
+        if ent is None and ev is None:
+            return None
+        if ent is None:
+            if not ev.wait(timeout):
+                raise GetTimeoutError(
+                    f"direct actor call result {oid.hex()} not ready "
+                    f"within {timeout}s")
+            with self._direct_res_lock:
+                ent = self._direct_results.get(b)
+            if ent is None:
+                return None
+        if ent[0] == "ok":
+            return _wire_entry_to_serialized(ent[1])
+        if ent[0] == "err":
+            raise ser.loads(ent[1])
+        return None                # ("head",) — fallback re-routed it
+
+    def _direct_probe(self, oid: ObjectID) -> str:
+        """Non-blocking wait() classification: "ready" (ack landed —
+        errors count, like head-stored errors), "pending" (in
+        flight), or "head" (not direct-tracked)."""
+        b = oid.binary()
+        with self._direct_res_lock:
+            ent = self._direct_results.get(b)
+            if ent is not None:
+                return "head" if ent[0] == "head" else "ready"
+            return "pending" if b in self._direct_events else "head"
+
     def get_serialized(self, oid: ObjectID,
                        timeout: float | None = None) -> SerializedObject:
+        so = self._direct_fetch(oid, timeout)
+        if so is not None:
+            return so
         out = self._call(P.OP_GET,
                          (oid.binary(), timeout, self._allow_desc))
+        if self._barrier_oids:
+            self._note_head_resolved(oid.binary())
         if out[0] == "chunked":
             return self._pull_chunked(out)
         return _resolved_to_serialized(out)
@@ -651,6 +1128,19 @@ class ClientRuntime:
                 values[o] = val
             else:
                 misses.append(o)
+        # Direct-call results resolve from the caller-local table
+        # first (zero wire traffic); only the remainder goes to the
+        # head through the batched path.
+        head_misses = []
+        for o in misses:
+            so = self._direct_fetch(o, timeout)
+            if so is None:
+                head_misses.append(o)
+            else:
+                val = ser.deserialize(so)
+                self._deser_cache.offer(o, val, so.total_size)
+                values[o] = val
+        misses = head_misses
         if len(misses) > 1:
             objs = self.get_serialized_many(misses, timeout)
         elif misses:
@@ -661,6 +1151,9 @@ class ClientRuntime:
             val = ser.deserialize(so)
             self._deser_cache.offer(o, val, so.total_size)
             values[o] = val
+        if misses and self._barrier_oids:
+            for o in misses:
+                self._note_head_resolved(o.binary())
         out = [values[o] for o in oids]
         return out[0] if single else out
 
@@ -684,11 +1177,49 @@ class ClientRuntime:
 
     def wait(self, refs, num_returns: int = 1,
              timeout: float | None = None):
-        done_b, rest_b = self._call(
-            P.OP_WAIT, ([r.id.binary() for r in refs], num_returns,
-                        timeout))
-        by_id = {r.id.binary(): r for r in refs}
-        return [by_id[b] for b in done_b], [by_id[b] for b in rest_b]
+        states = {r.id.binary(): self._direct_probe(r.id)
+                  for r in refs}
+        if all(s == "head" for s in states.values()):
+            # Fast path (no direct-tracked refs): one head round.
+            done_b, rest_b = self._call(
+                P.OP_WAIT, ([r.id.binary() for r in refs],
+                            num_returns, timeout))
+            if self._barrier_oids:
+                for b in done_b:
+                    self._note_head_resolved(b)
+            by_id = {r.id.binary(): r for r in refs}
+            return ([by_id[b] for b in done_b],
+                    [by_id[b] for b in rest_b])
+        # Mixed/direct set: poll local acks + (if any) the head in
+        # slices until enough refs are ready or the timeout lapses.
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            done_set = set()
+            head_b = []
+            for r in refs:
+                b = r.id.binary()
+                s = self._direct_probe(r.id)
+                if s == "ready":
+                    done_set.add(b)
+                elif s == "head":
+                    head_b.append(b)
+            if head_b:
+                d, _rest = self._call(P.OP_WAIT,
+                                      (head_b, len(head_b), 0))
+                if self._barrier_oids:
+                    for b in d:
+                        self._note_head_resolved(b)
+                done_set.update(d)
+            expired = deadline is not None \
+                and time.monotonic() >= deadline
+            if len(done_set) >= num_returns or expired:
+                done = [r for r in refs
+                        if r.id.binary() in done_set][:num_returns]
+                taken = {id(r) for r in done}
+                rest = [r for r in refs if id(r) not in taken]
+                return done, rest
+            time.sleep(0.01)
 
     # -- task / actor API --
 
@@ -933,16 +1464,252 @@ class ClientRuntime:
         return_ids = [ObjectID.for_return(task_id, i)
                       for i in range(num_returns)]
         nonces = [_new_nonce() for _ in return_ids]
+        aid = actor_id.binary()
+        rid_bytes = [o.binary() for o in return_ids]
+        # Direct fast path: worker->worker frame over the actor's
+        # peer listener, ZERO head frames. Eligibility mirrors the
+        # knobs documented in docs/actor_calls.md: a resolved lease,
+        # untraced, inline-size ref-free args. Everything else (and
+        # any channel failure) takes the head-routed path below.
+        blob = None
+        if trace_ctx is None:
+            chan = self._direct_channel_for(aid)
+            with self._direct_res_lock:
+                if aid in self._direct_barrier:
+                    chan = None     # head stream not yet drained
+            if chan is not None:
+                blob = _args_blob(args, kwargs)
+                from ray_tpu.core.config import get_config
+                if (len(blob)
+                        <= get_config().direct_call_inline_threshold
+                        and not _has_toplevel_refs(args, kwargs)):
+                    with self._actor_lock_for(aid):
+                        try:
+                            self._direct_register_pending(rid_bytes)
+                            chan.submit(task_id.binary(), method,
+                                        blob, num_returns, rid_bytes,
+                                        nonces)
+                            self.actor_calls_direct += 1
+                            return self._direct_make_refs(
+                                return_ids, nonces)
+                        except _DirectChannelDead:
+                            self._direct_unregister_pending(rid_bytes)
+                            self._direct_fallback(aid, chan)
+        self.actor_calls_head_routed += 1
         self._call_async(P.OP_SUBMIT_ACTOR_OWNED, (
-            actor_id.binary(), method, _args_blob(args, kwargs),
+            aid, method,
+            blob if blob is not None else _args_blob(args, kwargs),
             num_returns, trace_ctx, task_id.binary(),
-            [o.binary() for o in return_ids], nonces))
+            rid_bytes, nonces))
+        self._direct_barrier_set(aid, rid_bytes[-1])
         refs = []
         for oid, nonce in zip(return_ids, nonces):
             ref = ObjectRef(oid)
             self.on_ref_deserialized(ref, nonce, preregistered=True)
             refs.append(ref)
         return refs
+
+    # -- direct actor-call plane (caller side) --
+
+    def _actor_lock_for(self, aid: bytes):
+        with self._direct_lock:
+            lock = self._actor_locks.get(aid)
+            if lock is None:
+                # RLock: the submit path calls _direct_fallback while
+                # already holding it (channel died mid-submit).
+                lock = self._actor_locks[aid] = threading.RLock()
+            return lock
+
+    def _direct_channel_for(self, aid: bytes):
+        """The live channel for this actor, or None (head routing).
+        Missing channels trigger ONE background lease resolve,
+        throttled after failures — the resolve itself is off the
+        submit path, so the first calls stay head-routed and fast."""
+        from ray_tpu.core.config import get_config
+        if not get_config().direct_calls_enabled:
+            return None
+        with self._direct_lock:
+            chan = self._direct_chans.get(aid)
+            if chan is not None and not chan.dead:
+                return chan
+            if aid in self._direct_resolving or \
+                    time.monotonic() < self._direct_retry_at.get(
+                        aid, 0.0):
+                return None
+            self._direct_resolving.add(aid)
+        threading.Thread(target=self._direct_resolve, args=(aid,),
+                         daemon=True,
+                         name="direct_call_resolve").start()
+        return None
+
+    def _direct_resolve(self, aid: bytes) -> None:
+        chan = None
+        try:
+            lease = self._call(P.OP_ACTOR_LOCATION, aid, timeout=10.0)
+            if lease is not None:
+                addr, token_hex, epoch = lease
+                from ray_tpu.core.config import get_config
+                chan = _DirectChannel(
+                    self, aid, addr, token_hex, epoch,
+                    get_config().direct_call_window)
+        except Exception:  # noqa: BLE001 — no lease / dead addr:
+            chan = None    # stay head-routed, retry later
+        finally:
+            with self._direct_lock:
+                self._direct_resolving.discard(aid)
+                if chan is not None:
+                    self._direct_chans[aid] = chan
+                else:
+                    self._direct_retry_at[aid] = \
+                        time.monotonic() + 0.5
+
+    def _direct_barrier_set(self, aid: bytes, rid_b: bytes) -> None:
+        with self._direct_res_lock:
+            old = self._direct_barrier.get(aid)
+            if old is not None:
+                self._barrier_oids.pop(old, None)
+            self._direct_barrier[aid] = rid_b
+            self._barrier_oids[rid_b] = aid
+
+    def _note_head_resolved(self, oid_bytes: bytes) -> None:
+        """This caller observed a head-stored result: if it was an
+        actor's path-switch barrier, the head-routed stream has
+        drained through that actor — the direct path may open."""
+        with self._direct_res_lock:
+            aid = self._barrier_oids.pop(oid_bytes, None)
+            if aid is not None and \
+                    self._direct_barrier.get(aid) == oid_bytes:
+                del self._direct_barrier[aid]
+
+    def _direct_register_pending(self, rid_bytes: list) -> None:
+        with self._direct_res_lock:
+            for rb in rid_bytes:
+                self._direct_events[rb] = threading.Event()
+
+    def _direct_unregister_pending(self, rid_bytes: list) -> None:
+        with self._direct_res_lock:
+            for rb in rid_bytes:
+                self._direct_events.pop(rb, None)
+                self._direct_results.pop(rb, None)
+
+    def _direct_make_refs(self, return_ids, nonces) -> list:
+        """Refs for a direct call have a LOCAL lifecycle: the head
+        never saw the submit, so GC must not send it a release frame
+        (that notify would break the zero-head-frames contract). The
+        nonce stays unconsumed unless the ref escapes (promotion
+        re-enters the normal escape/borrow machinery)."""
+        import weakref
+        refs = []
+        for oid, _nonce in zip(return_ids, nonces):
+            ref = ObjectRef(oid)
+            weakref.finalize(ref, self._on_direct_ref_collected,
+                             oid.binary())
+            refs.append(ref)
+        return refs
+
+    def _on_direct_ref_collected(self, oid_bytes: bytes) -> None:
+        self._deser_cache.invalidate(ObjectID(oid_bytes))
+        with self._direct_res_lock:
+            if oid_bytes in self._direct_promoted \
+                    and oid_bytes not in self._direct_results:
+                # Escaped while the ack is still in flight and the
+                # local copy already died: keep the tracking alive —
+                # the ack's completion path promotes, then cleans up.
+                self._direct_orphaned.add(oid_bytes)
+                return
+            ent = self._direct_results.pop(oid_bytes, None)
+            self._direct_events.pop(oid_bytes, None)
+            self._direct_promoted.discard(oid_bytes)
+        if ent is not None and ent[0] == "head":
+            # A fallback replay moved this result to the head, which
+            # took escape+borrow on our behalf (the owned-submit
+            # contract): release our copy like any preregistered ref.
+            self._notify(P.OP_BORROW, ("release", oid_bytes))
+
+    def _direct_complete(self, rid_bytes: bytes, entry: tuple) -> None:
+        """Recv-thread completion of one return id; fires any local
+        waiter and a deferred escape promotion."""
+        promote = None
+        with self._direct_res_lock:
+            if rid_bytes not in self._direct_events:
+                return            # fallback already re-routed it
+            self._direct_results[rid_bytes] = entry
+            ev = self._direct_events.get(rid_bytes)
+            if rid_bytes in self._direct_promoted:
+                promote = entry
+            orphaned = rid_bytes in self._direct_orphaned
+            if orphaned:
+                # The local copy died before this ack: finish its
+                # deferred cleanup now that the promotion can fire.
+                self._direct_orphaned.discard(rid_bytes)
+                self._direct_results.pop(rid_bytes, None)
+                self._direct_events.pop(rid_bytes, None)
+                self._direct_promoted.discard(rid_bytes)
+        if promote is not None:
+            self._direct_promote(rid_bytes, promote)
+        if ev is not None and not orphaned:
+            ev.set()
+
+    def _direct_fallback(self, aid: bytes, chan) -> None:
+        """A direct channel died: replay its unacked calls (oldest
+        first) through the head and re-route their pending results
+        there. Idempotent; serialized against new submits by the
+        per-actor lock, so replays always land BEFORE any later call
+        — per-handle order survives the transport loss. The hosting
+        worker dedupes replayed task_ids it already executed, so
+        at-most-once survives too (an executed-but-unacked call gets
+        its cached result, not a re-run)."""
+        with self._actor_lock_for(aid):
+            with chan._cv:
+                if chan.fell_back:
+                    return
+                chan.fell_back = True
+                chan.dead = True
+                chan._cv.notify_all()
+                items = sorted(chan.unacked.items())
+                chan.unacked.clear()
+            with self._direct_lock:
+                if self._direct_chans.get(aid) is chan:
+                    del self._direct_chans[aid]
+                self._direct_retry_at[aid] = time.monotonic() + 0.5
+            if items:
+                self.direct_call_fallbacks += 1
+            for _seq, (tid_b, method, args_blob, num_returns,
+                       rid_bytes, nonces) in items:
+                # Re-route the pending results to the head BEFORE the
+                # replay lands: a concurrent get() must block on the
+                # head path, not on a local event no ack will fire.
+                dead_rids = []
+                with self._direct_res_lock:
+                    for rb in rid_bytes:
+                        ev = self._direct_events.get(rb)
+                        if ev is None or rb in self._direct_orphaned:
+                            # Ref already collected (possibly with a
+                            # promotion owed — the replay itself puts
+                            # the value at the head): replay, then
+                            # release the head borrow it registers.
+                            self._direct_orphaned.discard(rb)
+                            self._direct_results.pop(rb, None)
+                            self._direct_events.pop(rb, None)
+                            self._direct_promoted.discard(rb)
+                            dead_rids.append(rb)
+                            continue
+                        self._direct_results[rb] = ("head",)
+                        ev.set()
+                try:
+                    self._call_async(P.OP_SUBMIT_ACTOR_OWNED, (
+                        aid, method, args_blob, num_returns, None,
+                        tid_b, rid_bytes, nonces))
+                    for rb in dead_rids:
+                        self._notify(P.OP_BORROW, ("release", rb))
+                except Exception:  # noqa: BLE001 — head also down:
+                    pass           # reconnect fence owns the replay
+            if items:
+                # The replayed stream is head-routed: gate the direct
+                # path until this caller observes it drained, exactly
+                # like any other head-routed run.
+                self._direct_barrier_set(aid, items[-1][1][4][-1])
+            chan.close()
 
     def get_named_actor(self, name: str) -> ActorID:
         return ActorID(self._call(P.OP_GET_ACTOR, name))
@@ -960,7 +1727,41 @@ class ClientRuntime:
         self._call(P.OP_CANCEL, (ref.id.binary(), force))
 
     def on_ref_escaped(self, oid: ObjectID, nonce=None):
-        self._call(P.OP_BORROW, ("escape", oid.binary(), nonce))
+        b = oid.binary()
+        promote = None
+        with self._direct_res_lock:
+            ent = self._direct_results.get(b)
+            if ent is not None or b in self._direct_events:
+                if ent is not None and ent[0] in ("ok", "err"):
+                    promote = ent
+                elif ent is None:
+                    # In flight: promote when the ack lands — the
+                    # consumer's get blocks on head availability until
+                    # then (ownership promotion, NSDI'21 §4.2-style:
+                    # a borrowed object must be resolvable without
+                    # its owner's private state).
+                    self._direct_promoted.add(b)
+        if promote is not None:
+            self._direct_promote(b, promote)
+        self._call(P.OP_BORROW, ("escape", b, nonce))
+
+    def _direct_promote(self, b: bytes, ent: tuple) -> None:
+        """Publish one caller-local direct result to the head store
+        under its preminted id (async; the shared outbox FIFO lands it
+        before the escape/submit that made it necessary)."""
+        with self._direct_res_lock:
+            if b in self._direct_promote_sent:
+                return
+            if len(self._direct_promote_sent) > 65536:
+                # Bounded dedupe only — promotion is idempotent at
+                # the head, so forgetting old ids is always safe.
+                self._direct_promote_sent.clear()
+            self._direct_promote_sent.add(b)
+        action = "promote" if ent[0] == "ok" else "promote_err"
+        try:
+            self._call_async(P.OP_DIRECT_RESULT, (action, b, ent[1]))
+        except Exception:  # noqa: BLE001 — head down: the reconnect
+            pass           # fence replays the async op
 
     def on_ref_deserialized(self, ref: ObjectRef, nonce=None,
                             preregistered: bool = False):
@@ -1019,6 +1820,11 @@ class ClientRuntime:
         self._call(P.OP_PG_REMOVE, pg_id.binary())
 
     def shutdown(self):
+        with self._direct_lock:
+            chans = list(self._direct_chans.values())
+            self._direct_chans.clear()
+        for c in chans:
+            c.close()
         # shutdown(2) before close: our own recv thread is blocked in
         # read() on this fd, which keeps the open file description
         # alive past close() — the peer would never see EOF (and our
@@ -1129,6 +1935,12 @@ def _run_maybe_async(fn, args, kwargs):
 _actor_async_loop = None
 _actor_async_loop_lock = threading.Lock()
 
+# The hosting worker's direct-call listener (one per actor process;
+# None in task workers and before EXEC_ACTOR_INIT). Module-level so
+# chaos tests can reach it from inside actor methods
+# (``ray_tpu.core.worker._direct_server.drop_connections()``).
+_direct_server: DirectCallServer | None = None
+
 
 def _ensure_actor_loop():
     """One persistent event loop per worker process for async actor
@@ -1195,6 +2007,8 @@ def worker_main(conn, client_address: str) -> None:
     from ray_tpu.observability import task_events as _te
     from ray_tpu.observability.exporter import start_process_exporter
 
+    _direct_sampled = [0, 0, 0]
+
     def _obs_pre_flush():
         # Wire/object-plane counters for this process, sampled into
         # gauges right before each flush. Tagged by pid: gauges merge
@@ -1205,6 +2019,21 @@ def worker_main(conn, client_address: str) -> None:
               "blocking client-channel round trips made by this "
               "process", tag_keys=("pid",)).set(
             float(client.wire_rounds), tags={"pid": str(os.getpid())})
+        # Direct actor-call bypass ratio (plain ints on the submit
+        # hot path, promoted to registry counters here): deltas since
+        # the last flush, tagged by pid so the aggregator's per-node
+        # counter sum is exact.
+        from ray_tpu.util.metrics import direct_call_counters
+        now = (client.actor_calls_direct,
+               client.actor_calls_head_routed,
+               client.direct_call_fallbacks)
+        tags = {"pid": str(os.getpid())}
+        for counter, cur, i in zip(direct_call_counters(), now,
+                                   range(3)):
+            delta = cur - _direct_sampled[i]
+            if delta > 0:
+                counter.inc(delta, tags=tags)
+                _direct_sampled[i] = cur
         from ray_tpu.util.tracing import get_tracer
         dropped = get_tracer().spans_dropped
         if dropped:
@@ -1388,7 +2217,7 @@ def worker_main(conn, client_address: str) -> None:
     serialize_calls = True  # False when max_concurrency > 1
 
     def exec_actor_call(task_id_bytes, method, args_blob, resolved,
-                        num_returns, trace_ctx=None):
+                        num_returns, trace_ctx=None, reply=None):
         gated = loop_sem is not None and not serialize_calls
         if gated:
             # Borrow a slot from the shared budget: blocking this
@@ -1400,13 +2229,18 @@ def worker_main(conn, client_address: str) -> None:
                 loop_sem.acquire(), loop).result()
         try:
             _exec_actor_call_inner(task_id_bytes, method, args_blob,
-                                   resolved, num_returns, trace_ctx)
+                                   resolved, num_returns, trace_ctx,
+                                   reply)
         finally:
             if gated:
                 loop.call_soon_threadsafe(loop_sem.release)
 
     def _exec_actor_call_inner(task_id_bytes, method, args_blob,
-                               resolved, num_returns, trace_ctx=None):
+                               resolved, num_returns, trace_ctx=None,
+                               reply=None):
+        # ``reply``: result sink for direct-call frames (ack over the
+        # peer connection); None = the exec channel as always.
+        out = reply if reply is not None else send
         from ray_tpu.util.tracing import get_tracer
         tr = get_tracer()
         # Actor calls inherit the hosting actor's PG for
@@ -1452,14 +2286,14 @@ def worker_main(conn, client_address: str) -> None:
                         _record_event(task_id_bytes,
                                       f"actor.{method}", "FINISHED")
                     return
-            send((P.RESULT_OK, task_id_bytes,
-                  _serialize_returns(result, num_returns)))
+            out((P.RESULT_OK, task_id_bytes,
+                 _serialize_returns(result, num_returns)))
             if _record_event is not None:
                 _record_event(task_id_bytes, f"actor.{method}",
                               "FINISHED")
         except BaseException:  # noqa: BLE001
             err = ActorError(method, traceback.format_exc(), None)
-            send((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
+            out((P.RESULT_ERR, task_id_bytes, ser.dumps(err)))
             if _record_event is not None:
                 _record_event(task_id_bytes, f"actor.{method}",
                               "FAILED")
@@ -1538,6 +2372,34 @@ def worker_main(conn, client_address: str) -> None:
         asyncio.run_coroutine_threadsafe(_acall(), _ensure_actor_loop())
         return True
 
+    def start_direct_server(actor_id_bytes: bytes) -> None:
+        """Open this actor's direct-call listener and announce it to
+        the head (direct actor-call plane). Any failure degrades to
+        head routing — the fast path must never cost correctness."""
+        global _direct_server
+        from ray_tpu.core.config import get_config
+        cfg = get_config()
+        if not cfg.direct_calls_enabled:
+            return
+
+        def _direct_execute(tid, method, args_blob, num_returns,
+                            reply):
+            if executor is not None:
+                executor.submit(exec_actor_call, tid, method,
+                                args_blob, {}, num_returns, None,
+                                reply)
+            else:
+                exec_actor_call(tid, method, args_blob, {},
+                                num_returns, None, reply)
+
+        try:
+            _direct_server = DirectCallServer(
+                client, actor_id_bytes, _direct_execute,
+                cfg.direct_call_result_cache)
+            client._direct_server = _direct_server
+        except Exception:  # noqa: BLE001 — no listener: stay
+            _direct_server = None  # head-routed
+
     def handle_msg(msg) -> bool:
         """Returns False to exit the exec loop."""
         nonlocal actor_instance, executor, serialize_calls, loop_sem
@@ -1577,6 +2439,7 @@ def worker_main(conn, client_address: str) -> None:
                            if not n.startswith("__")):
                         import asyncio
                         loop_sem = asyncio.Semaphore(max_concurrency)
+                start_direct_server(actor_id_bytes)
                 send((P.RESULT_READY, actor_id_bytes, None))
             except BaseException:  # noqa: BLE001
                 err = ActorError("__init__", traceback.format_exc())
@@ -1585,7 +2448,14 @@ def worker_main(conn, client_address: str) -> None:
         elif kind == P.EXEC_ACTOR_CALL:
             (_, task_id_bytes, method, args_blob, resolved,
              num_returns, trace_ctx) = msg
-            if executor is not None:
+            if _direct_server is not None and \
+                    _direct_server.try_replay_on_exec(task_id_bytes,
+                                                      send):
+                # A fallback replay of a call this process already
+                # executed over the direct plane: the cached result
+                # was (or will be) re-sent — never run it twice.
+                pass
+            elif executor is not None:
                 if not try_exec_on_loop(task_id_bytes, method,
                                         args_blob, resolved,
                                         num_returns, trace_ctx):
@@ -1604,6 +2474,11 @@ def worker_main(conn, client_address: str) -> None:
     except (EOFError, OSError, KeyboardInterrupt):
         pass
     finally:
+        if _direct_server is not None:
+            # Prompt EOF for direct callers (they fall back and
+            # replay) instead of leaving them to notice the process
+            # death through the OS teardown.
+            _direct_server.close()
         # Results produced by executor/loop threads in the last instant
         # must reach the wire before the process exits.
         _flush_outbox()

@@ -238,6 +238,74 @@ def _run_benchmarks(rec, quick: bool) -> None:
                     for _ in range(25)]),
                batch=25 * n_actors, quick=quick))
 
+    # -- direct actor-call plane (worker->worker head bypass) ----------
+    # The caller must be a WORKER process (the driver talks to its
+    # in-process runtime; only ClientRuntime has the bypass): one
+    # driver task per caller does async 100-call laps against its
+    # actors and reports calls/s plus its own direct/head counters.
+    # Rows: direct vs head-routed 1:1 (same machine, same shapes —
+    # the pair is the bypass speedup), n:n fan-out, and an
+    # inline-arg lap (32 KiB payload rides IN the call frame).
+    @ray_tpu.remote(num_cpus=0)
+    def _actor_call_driver(handles, n_batches: int, batch: int,
+                           payload_kib: int):
+        from ray_tpu.core.api import get_runtime
+        rt_c = get_runtime()
+        arg = b"x" * (payload_kib << 10) if payload_kib else None
+
+        def lap():
+            if arg is None:
+                refs = [h.small_value.remote()
+                        for h in handles for _ in range(batch)]
+            else:
+                refs = [h.small_value_arg.remote(arg)
+                        for h in handles for _ in range(batch)]
+            ray_tpu.get(refs, timeout=120)
+
+        lap()                      # head-routed; fires lease resolve
+        time.sleep(1.2)            # lease lands; barrier cleared by
+        for _ in range(2):         # the lap's get — warm the channel
+            lap()
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            lap()
+        dt = time.perf_counter() - t0
+        return (n_batches * batch * len(handles) / dt,
+                rt_c.actor_calls_direct, rt_c.actor_calls_head_routed)
+
+    def _direct_bench(name, n_callers, n_actors_row, payload_kib,
+                      direct_on):
+        env = {} if direct_on else {
+            "env_vars": {"RAY_TPU_DIRECT_CALLS_ENABLED": "0"}}
+        drv = _actor_call_driver.options(runtime_env=env) \
+            if env else _actor_call_driver
+        row_actors = [_Actor.remote() for _ in range(n_actors_row)]
+        ray_tpu.get([a.small_value.remote() for a in row_actors])
+        nb, batch = (3, 30) if quick else (8, 100)
+        outs = ray_tpu.get(
+            [drv.remote(row_actors, nb, batch, payload_kib)
+             for _ in range(n_callers)], timeout=300)
+        rate = sum(o[0] for o in outs)
+        direct_calls = sum(o[1] for o in outs)
+        head_calls = sum(o[2] for o in outs)
+        row = {"metric": name, "value": round(rate, 1),
+               "unit": "calls/s",
+               "extra": {"callers": n_callers,
+                         "actors": n_actors_row * n_callers,
+                         "calls_direct": direct_calls,
+                         "calls_head_routed": head_calls}}
+        print(json.dumps(row), flush=True)
+        rec(row)
+        return row
+
+    d11 = _direct_bench("actor_calls_direct_1_1", 1, 1, 0, True)
+    h11 = _direct_bench("actor_calls_head_routed_1_1", 1, 1, 0,
+                        False)
+    d11["extra"]["speedup_vs_head_routed"] = round(
+        d11["value"] / max(h11["value"], 1.0), 2)
+    _direct_bench("actor_calls_direct_n_n", 4, 1, 0, True)
+    _direct_bench("actor_call_inline_small_args", 1, 1, 32, True)
+
     # Multiple client processes submitting tasks concurrently
     # (reference: multi_client_tasks_async — each client is its own
     # process with its own submission channel).

@@ -254,6 +254,38 @@ def reset_registry() -> None:
         _registry.clear()
 
 
+def direct_call_counters() -> tuple["Counter", "Counter", "Counter"]:
+    """The direct actor-call plane's bypass-ratio counters,
+    registered here so every process exposes the same series and the
+    cluster scrape can answer "what fraction of actor calls avoid the
+    head" in production:
+
+    - ``ray_tpu_actor_calls_direct``: calls that went worker->worker
+      over a peer connection (zero head frames);
+    - ``ray_tpu_actor_calls_head_routed``: calls that took the
+      classic head path (first call per handle, oversized/ref args,
+      traced or streaming calls, resolve failures);
+    - ``ray_tpu_direct_call_fallbacks``: peer-connection losses that
+      triggered a head-routed replay of unacked calls.
+
+    The worker exporter samples the ClientRuntime's hot-path ints
+    into these once per flush interval (pid-tagged deltas, so the
+    aggregator's per-node sums stay exact)."""
+    return (
+        Counter("ray_tpu_actor_calls_direct",
+                "actor calls submitted worker->worker over the "
+                "direct-call plane", tag_keys=("pid",)),
+        Counter("ray_tpu_actor_calls_head_routed",
+                "actor calls submitted through the head",
+                tag_keys=("pid",)),
+        Counter("ray_tpu_direct_call_fallbacks",
+                "direct-call channel losses that fell back to head "
+                "routing (unacked calls replayed)",
+                tag_keys=("pid",)),
+    )
+
+
 __all__ = ["Counter", "Gauge", "Histogram", "prometheus_text",
            "collect_all", "reset_registry", "histogram_quantile",
-           "histogram_quantiles", "local_quantile_lines"]
+           "histogram_quantiles", "local_quantile_lines",
+           "direct_call_counters"]

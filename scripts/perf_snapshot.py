@@ -62,6 +62,17 @@ INTROSPECTION_METRICS = (
     "profiler_sampling_overhead",
 )
 
+# Direct actor-call plane (ray_tpu/perf.py): worker->worker bypass
+# throughput vs the head-routed baseline (the pair is the control-
+# plane speedup the direct path exists for), the n:n fan-out, and
+# the inline-arg lap. Same must-be-present contract.
+DIRECT_CALL_METRICS = (
+    "actor_calls_direct_1_1",
+    "actor_calls_head_routed_1_1",
+    "actor_calls_direct_n_n",
+    "actor_call_inline_small_args",
+)
+
 
 def one_run(path: str, serve: bool, timeout: float,
             quick: bool = False) -> list[dict]:
@@ -123,7 +134,8 @@ def main() -> None:
         missing = [m for m in OBJECT_PLANE_METRICS
                    + ROBUSTNESS_METRICS
                    + OBSERVABILITY_METRICS
-                   + INTROSPECTION_METRICS if m not in got]
+                   + INTROSPECTION_METRICS
+                   + DIRECT_CALL_METRICS if m not in got]
         if missing:
             print(f"run {i+1}: WARNING missing object-plane metrics "
                   f"{missing} (crashed mid-bench?)", file=sys.stderr)

@@ -1,0 +1,429 @@
+"""Direct actor-call fast path (worker->worker head bypass).
+
+The control-plane analog of the vectorized object plane: after a
+handle's first (head-routed) call resolves the actor's location
+lease, steady-state ``.remote()`` calls travel caller-worker ->
+hosting-worker over a peer connection and send ZERO frames to the
+head (reference: Ray's direct actor calls + the ownership model of
+NSDI'21 "Ownership"). These tests pin the whole contract surface:
+
+- zero head frames per steady-state call (head op-counter delta);
+- per-handle ordering under pipelined batches AND across every path
+  switch (head->direct, direct->head fallback, replay);
+- the inline-arg threshold boundary (small args ride in the frame,
+  big args head-route);
+- at-most-once execution across a dropped peer connection (seqno /
+  task-id replay dedupe);
+- location-lease invalidation on actor restart;
+- zero-loss fallback during a node drain mid-call-stream (chaos);
+- result promotion when a direct-call ref escapes the caller.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+)
+
+
+@pytest.fixture
+def rt4():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu.core.api.get_runtime()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+@ray_tpu.remote(num_cpus=0)
+class Echo:
+    def __init__(self):
+        self.order = []
+        self.execs = {}
+
+    def ping(self):
+        return "pong"
+
+    def f(self, i, payload=None):
+        self.order.append(i)
+        self.execs[i] = self.execs.get(i, 0) + 1
+        return i * 2
+
+    def whoami(self):
+        import os
+        return os.getpid()
+
+    def drop_peers_and_f(self, i):
+        # Chaos hook: sever the direct-call connections from INSIDE
+        # the hosting worker — to the caller this is a peer network
+        # loss with this very call's ack in flight.
+        self.order.append(i)
+        self.execs[i] = self.execs.get(i, 0) + 1
+        import ray_tpu.core.worker as W
+        if W._direct_server is not None:
+            W._direct_server.drop_connections()
+        return i * 2
+
+    def stats(self):
+        return list(self.order), dict(self.execs)
+
+
+def _ensure_direct(handle, deadline_s: float = 15.0) -> bool:
+    """Inside a caller worker: loop pings until one goes direct (the
+    lease resolve is asynchronous and the path-switch barrier clears
+    on the first observed result)."""
+    rt = ray_tpu.core.api.get_runtime()
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        before = rt.actor_calls_direct
+        ray_tpu.get(handle.ping.remote(), timeout=60)
+        if rt.actor_calls_direct > before:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# steady state: zero head frames, ordering, pipelining
+# ---------------------------------------------------------------------------
+
+def test_steady_state_calls_send_zero_head_frames(rt4):
+    from ray_tpu.core import protocol as P
+
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle, n):
+        rt = ray_tpu.core.api.get_runtime()
+        assert _ensure_direct(handle)
+        d0 = rt.actor_calls_direct
+        refs = [handle.f.remote(i) for i in range(n)]
+        vals = ray_tpu.get(refs, timeout=120)
+        return vals, rt.actor_calls_direct - d0, \
+            rt.actor_calls_head_routed
+
+    a = Echo.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+
+    # Warm everything (incl. the caller worker boot), then measure
+    # the head's client-op counters across a steady-state burst.
+    ray_tpu.get(caller.remote(a, 5), timeout=120)
+    before = dict(rt4.client_op_counts)
+    vals, direct_calls, _ = ray_tpu.get(caller.remote(a, 50),
+                                        timeout=120)
+    after = dict(rt4.client_op_counts)
+
+    assert vals == [i * 2 for i in range(50)]
+    assert direct_calls >= 50
+    for op in (P.OP_SUBMIT_ACTOR_OWNED, P.OP_SUBMIT_ACTOR):
+        assert after.get(op, 0) == before.get(op, 0), (
+            f"steady-state direct calls leaked {op} frames to the "
+            f"head: {before.get(op, 0)} -> {after.get(op, 0)}")
+
+
+def test_ordering_under_pipelined_batches(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle, n):
+        assert _ensure_direct(handle)
+        # Async burst with no intermediate gets: the channel outbox
+        # coalesces these into OP_CALL_DIRECT_BATCH frames.
+        refs = [handle.f.remote(i) for i in range(n)]
+        return ray_tpu.get(refs, timeout=120)
+
+    a = Echo.remote()
+    assert ray_tpu.get(caller.remote(a, 120), timeout=180) == \
+        [i * 2 for i in range(120)]
+    order, execs = ray_tpu.get(a.stats.remote(), timeout=60)
+    body = [i for i in order if isinstance(i, int)]
+    assert body == sorted(body), "pipelined batch executed out of order"
+    assert all(v == 1 for v in execs.values())
+
+
+def test_direct_path_disabled_by_config(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle):
+        rt = ray_tpu.core.api.get_runtime()
+        for i in range(10):
+            ray_tpu.get(handle.f.remote(i), timeout=60)
+            time.sleep(0.05)
+        return rt.actor_calls_direct, rt.actor_calls_head_routed
+
+    a = Echo.remote()
+    off = caller.options(runtime_env={
+        "env_vars": {"RAY_TPU_DIRECT_CALLS_ENABLED": "0"}})
+    direct, head = ray_tpu.get(off.remote(a), timeout=120)
+    assert direct == 0
+    assert head == 10
+
+
+# ---------------------------------------------------------------------------
+# small-arg inlining threshold
+# ---------------------------------------------------------------------------
+
+def test_inline_threshold_boundary(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle):
+        rt = ray_tpu.core.api.get_runtime()
+        assert _ensure_direct(handle)
+        d0, h0 = rt.actor_calls_direct, rt.actor_calls_head_routed
+        # Well under the 4 KiB threshold: rides inline in the frame.
+        ray_tpu.get(handle.f.remote(1, b"x" * 256), timeout=60)
+        small = (rt.actor_calls_direct - d0,
+                 rt.actor_calls_head_routed - h0)
+        d0, h0 = rt.actor_calls_direct, rt.actor_calls_head_routed
+        # Over it: the call itself head-routes (args resolved/staged
+        # by the head exactly as before this PR).
+        ray_tpu.get(handle.f.remote(2, b"x" * 65536), timeout=60)
+        big = (rt.actor_calls_direct - d0,
+               rt.actor_calls_head_routed - h0)
+        return small, big
+
+    a = Echo.remote()
+    tuned = caller.options(runtime_env={
+        "env_vars": {"RAY_TPU_DIRECT_CALL_INLINE_THRESHOLD": "4096"}})
+    small, big = ray_tpu.get(tuned.remote(a), timeout=120)
+    assert small == (1, 0), f"small arg should go direct: {small}"
+    assert big == (0, 1), f"oversized arg should head-route: {big}"
+
+
+def test_ref_args_head_route(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle, dep_holder):
+        rt = ray_tpu.core.api.get_runtime()
+        assert _ensure_direct(handle)
+        d0, h0 = rt.actor_calls_direct, rt.actor_calls_head_routed
+        dep = ray_tpu.put(21)
+        # A top-level ObjectRef arg needs head-side resolution: the
+        # call must head-route (and still be correct).
+        val = ray_tpu.get(handle.f.remote(dep), timeout=60)
+        return val, rt.actor_calls_direct - d0, \
+            rt.actor_calls_head_routed - h0
+
+    a = Echo.remote()
+    val, direct, head = ray_tpu.get(caller.remote(a, None),
+                                    timeout=120)
+    assert val == 42
+    assert (direct, head) == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# fault surface: dropped peer connection, restart, drain
+# ---------------------------------------------------------------------------
+
+def test_seqno_replay_after_dropped_peer_connection(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle, n):
+        rt = ray_tpu.core.api.get_runtime()
+        assert _ensure_direct(handle)
+        refs = []
+        for i in range(n):
+            if i == n // 2:
+                refs.append(handle.drop_peers_and_f.remote(i))
+            else:
+                refs.append(handle.f.remote(i))
+        vals = ray_tpu.get(refs, timeout=120)
+        return vals, rt.direct_call_fallbacks
+
+    a = Echo.remote()
+    vals, fallbacks = ray_tpu.get(caller.remote(a, 40), timeout=180)
+    assert vals == [i * 2 for i in range(40)], "calls lost in fallback"
+    assert fallbacks >= 1, "the dropped connection never fell back"
+    order, execs = ray_tpu.get(a.stats.remote(), timeout=60)
+    dupes = {k: v for k, v in execs.items() if v != 1}
+    assert not dupes, f"replay double-executed calls: {dupes}"
+    body = [i for i in order if isinstance(i, int)]
+    assert body == sorted(body), \
+        "per-handle order violated across the fallback replay"
+
+
+def test_location_lease_invalidated_on_actor_restart(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle, stop_flag):
+        rt = ray_tpu.core.api.get_runtime()
+        assert _ensure_direct(handle)
+        pids, failures = set(), 0
+        for _ in range(200):
+            try:
+                pids.add(ray_tpu.get(handle.whoami.remote(),
+                                     timeout=60))
+            except Exception:  # noqa: BLE001 — calls in flight at
+                failures += 1  # the kill may die with the incarnation
+            if ray_tpu.get(stop_flag.read.remote(), timeout=60):
+                break
+            time.sleep(0.02)
+        # The lease must re-resolve to the NEW incarnation: direct
+        # traffic resumes after the restart.
+        before = rt.actor_calls_direct
+        assert _ensure_direct(handle)
+        deadline = time.monotonic() + 20
+        while rt.actor_calls_direct <= before \
+                and time.monotonic() < deadline:
+            ray_tpu.get(handle.ping.remote(), timeout=60)
+            time.sleep(0.1)
+        pids.add(ray_tpu.get(handle.whoami.remote(), timeout=60))
+        return sorted(pids), failures, rt.actor_calls_direct > before
+
+    @ray_tpu.remote(num_cpus=0)
+    class Flag:
+        def __init__(self):
+            self.v = False
+
+        def set(self):
+            self.v = True
+
+        def read(self):
+            return self.v
+
+    a = Echo.options(max_restarts=1).remote()
+    flag = Flag.remote()
+    ray_tpu.get(a.ping.remote(), timeout=60)
+    fut = caller.remote(a, flag)
+    time.sleep(3.0)                   # caller is mid-stream, direct
+    ray_tpu.kill(a, no_restart=False)
+    time.sleep(2.0)
+    ray_tpu.get(flag.set.remote(), timeout=60)
+    pids, _failures, direct_resumed = ray_tpu.get(fut, timeout=180)
+    assert len(pids) == 2, f"expected old+new incarnation pids: {pids}"
+    assert direct_resumed, "direct path never re-resolved after restart"
+
+
+@pytest.mark.chaos
+def test_drain_migration_zero_loss_mid_stream(cluster):
+    """PR-2 interplay: a node drain migrates the actor mid-call-
+    stream. Unacked direct calls replay through the head, the pusher
+    parks across the incarnation swap, and every call returns — the
+    bypass is invisible to the drain's zero-loss contract."""
+    n2 = cluster.add_node(num_cpus=2)
+    rt = ray_tpu.core.api.get_runtime()
+
+    a = Echo.options(
+        max_restarts=1,
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=True)).remote()
+    ray_tpu.get(a.ping.remote(), timeout=120)
+    assert rt._actors[a.actor_id].node_id == n2.node_id
+
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle, n):
+        rt_c = ray_tpu.core.api.get_runtime()
+        assert _ensure_direct(handle, deadline_s=30.0)
+        refs = []
+        for i in range(n):
+            refs.append(handle.f.remote(i))
+            time.sleep(0.01)           # keep the stream live while
+        vals = ray_tpu.get(refs, timeout=180)  # the drain lands
+        return vals, rt_c.actor_calls_direct, \
+            rt_c.direct_call_fallbacks
+
+    fut = caller.remote(a, 250)
+    time.sleep(3.0)                    # caller mid-stream
+    assert rt.drain_node(n2.node_id, reason="preemption notice",
+                         deadline_s=60.0)
+    vals, direct_calls, _fallbacks = ray_tpu.get(fut, timeout=300)
+    assert vals == [i * 2 for i in range(250)], \
+        "drain lost or corrupted in-flight direct calls"
+    assert direct_calls > 0, "stream never used the direct path"
+    # The actor left the drained node.
+    assert rt._actors[a.actor_id].node_id != n2.node_id
+
+
+def test_direct_calls_between_nodes_over_daemon(cluster):
+    """Worker->worker across a REAL process/node boundary: the actor
+    lives in a daemon-hosted worker; the caller runs on the head
+    node. The lease announcement rides the daemon's client splice and
+    the call frames go over a direct TCP peer connection."""
+    n2 = cluster.add_node(num_cpus=2)
+    rt = ray_tpu.core.api.get_runtime()
+
+    a = Echo.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            n2.node_id, soft=False)).remote()
+    ray_tpu.get(a.ping.remote(), timeout=120)
+    assert rt._actors[a.actor_id].node_id == n2.node_id
+
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle, n):
+        rt_c = ray_tpu.core.api.get_runtime()
+        assert _ensure_direct(handle, deadline_s=30.0)
+        d0 = rt_c.actor_calls_direct
+        vals = ray_tpu.get([handle.f.remote(i) for i in range(n)],
+                           timeout=180)
+        return vals, rt_c.actor_calls_direct - d0
+
+    vals, direct_calls = ray_tpu.get(caller.remote(a, 30),
+                                     timeout=300)
+    assert vals == [i * 2 for i in range(30)]
+    assert direct_calls >= 30
+
+
+# ---------------------------------------------------------------------------
+# result promotion, metrics, options validation
+# ---------------------------------------------------------------------------
+
+def test_direct_result_promoted_when_ref_escapes(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def produce(handle):
+        assert _ensure_direct(handle)
+        r1 = handle.f.remote(100)
+        ray_tpu.get(r1, timeout=60)    # completed before escaping
+        r2 = handle.f.remote(101)      # may still be in flight
+        return [r1, r2]                # both escape to the driver
+
+    a = Echo.remote()
+    refs = ray_tpu.get(produce.remote(a), timeout=120)
+    assert ray_tpu.get(refs, timeout=60) == [200, 202]
+
+
+def test_bypass_counters_reach_cluster_scrape(rt4):
+    @ray_tpu.remote(num_cpus=1)
+    def caller(handle):
+        assert _ensure_direct(handle)
+        ray_tpu.get([handle.f.remote(i) for i in range(20)],
+                    timeout=120)
+        time.sleep(1.0)                # one exporter flush interval
+        return True
+
+    a = Echo.remote()
+    fast_flush = caller.options(runtime_env={
+        "env_vars": {"RAY_TPU_METRICS_REPORT_INTERVAL_S": "0.3"}})
+    assert ray_tpu.get(fast_flush.remote(a), timeout=120)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        text = rt4.observability.prometheus_text()
+        if "ray_tpu_actor_calls_direct" in text:
+            break
+        time.sleep(0.3)
+    assert "ray_tpu_actor_calls_direct" in text
+    assert "ray_tpu_actor_calls_head_routed" in text
+
+
+def test_actor_method_options_validates_kwargs(rt4):
+    a = Echo.remote()
+    with pytest.raises(TypeError, match="nm_returns"):
+        a.f.options(nm_returns=2)
+    with pytest.raises(NotImplementedError, match="concurrency_group"):
+        a.f.options(concurrency_group="io")
+    # Supported option still works end to end.
+    assert ray_tpu.get(a.f.options(num_returns=1).remote(3),
+                       timeout=60) == 6
+
+
+def test_actor_method_options_preserves_declared_num_returns(rt4):
+    @ray_tpu.remote(num_cpus=0)
+    class Multi:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    m = Multi.remote()
+    # .options() without num_returns keeps the @method declaration
+    # (it used to silently reset to 1).
+    r1, r2 = m.pair.options().remote()
+    assert ray_tpu.get([r1, r2], timeout=60) == [1, 2]

@@ -21,6 +21,56 @@ def test_microbench_floors(rt):
     assert results["1_1_actor_calls_sync"] > 500
     assert results["1_1_actor_calls_async"] > 1000
     assert results["single_client_put_calls_1KiB"] > 1000
+    # Direct actor-call plane: the worker->worker bypass must beat
+    # the head-routed baseline measured in the SAME run on the same
+    # machine (the whole point of taking the head off the per-call
+    # critical path).
+    assert results["actor_calls_direct_1_1"] >= \
+        results["actor_calls_head_routed_1_1"], (
+        f"direct path slower than head routing: "
+        f"{results['actor_calls_direct_1_1']} vs "
+        f"{results['actor_calls_head_routed_1_1']} calls/s")
+
+
+def test_direct_calls_zero_head_frames_steady_state(rt):
+    """Direct-call plane guardrail: once a handle's lease is warm, a
+    burst of N calls must add ZERO submit frames on the head's client
+    channel (the head op counter is the oplog-side proof; the
+    caller-side counter proves the calls really took the bypass)."""
+    from ray_tpu.core import protocol as P
+
+    @ray_tpu.remote(num_cpus=0)
+    class Bounce:
+        def hit(self, i):
+            return i
+
+    @ray_tpu.remote(num_cpus=1)
+    def burst(handle, n):
+        import time as _t
+        runtime = ray_tpu.core.api.get_runtime()
+        deadline = _t.monotonic() + 15
+        while _t.monotonic() < deadline:
+            before = runtime.actor_calls_direct
+            ray_tpu.get(handle.hit.remote(-1), timeout=60)
+            if runtime.actor_calls_direct > before:
+                break
+            _t.sleep(0.2)
+        d0 = runtime.actor_calls_direct
+        vals = ray_tpu.get([handle.hit.remote(i) for i in range(n)],
+                           timeout=120)
+        return vals, runtime.actor_calls_direct - d0
+
+    a = Bounce.remote()
+    ray_tpu.get(burst.remote(a, 5), timeout=120)      # warm caller
+    rt_obj = ray_tpu.core.api.get_runtime()
+    before = {op: rt_obj.client_op_counts.get(op, 0)
+              for op in (P.OP_SUBMIT_ACTOR_OWNED, P.OP_SUBMIT_ACTOR)}
+    vals, direct = ray_tpu.get(burst.remote(a, 60), timeout=120)
+    assert vals == list(range(60))
+    assert direct >= 60, "burst did not take the direct path"
+    for op, n0 in before.items():
+        assert rt_obj.client_op_counts.get(op, 0) == n0, (
+            f"steady-state direct calls sent {op} frames to the head")
 
 
 def test_batched_get_wire_round_guardrail(rt):
