@@ -167,6 +167,31 @@ class Config:
     # Seconds between memory polls.
     memory_monitor_refresh_s: float = 1.0
 
+    # --- wire hardening (ray_tpu/core/wire.py — heartbeats,
+    # deadlines, frame checksums on every long-lived channel;
+    # reference: gRPC keepalive/deadline args + GcsHealthCheckManager
+    # probes) ---
+    # Ping a monitored channel after this long without ANY received
+    # frame (traffic itself proves liveness, so busy channels never
+    # pay a heartbeat frame).
+    heartbeat_interval_s: float = 5.0
+    # A monitored channel silent this long (pings unanswered) is
+    # declared dead: the socket is shut down, waking blocked readers
+    # into the existing reconnect/replay/fallback recovery paths.
+    heartbeat_timeout_s: float = 20.0
+    # Connect AND auth-handshake deadline for every dial site
+    # (client->head, daemon->head, worker->worker direct, object
+    # peer, CLI) — an unreachable peer raises a ConnectionError
+    # naming it instead of blocking uninterruptibly.
+    connect_timeout_s: float = 10.0
+    # Dial attempts (jittered exponential backoff between them).
+    connect_retries: int = 3
+    # CRC32 frame checksums: corrupted frames are refused before
+    # unpickling and surface as a channel reset + retry.
+    wire_checksum_enabled: bool = True
+    # Master switch for heartbeat monitoring (checksums/seq stay on).
+    wire_heartbeat_enabled: bool = True
+
     # --- timeouts ---
     get_timeout_default_s: float = 0.0  # 0 = no timeout
     actor_creation_timeout_s: float = 120.0

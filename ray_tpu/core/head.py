@@ -51,6 +51,14 @@ def run_head(port: int, token: bytes,
     api._set_runtime(rt)
     rt.cluster_token = token
 
+    # Standalone head under a chaos run: start the plan-file poll
+    # even before any monitored connection exists, so cluster-wide
+    # partition rules (RAY_TPU_CHAOS_FILE) reach this process on the
+    # same cadence as daemons/workers.
+    if os.environ.get("RAY_TPU_CHAOS_FILE"):
+        from ray_tpu.core import wire
+        wire.heartbeater().ensure_chaos_poll()
+
     # Restore BEFORE the listener opens: a daemon that reconnects
     # against an empty actor table would have its surviving named
     # actors treated as unknown incarnations instead of re-adopted.

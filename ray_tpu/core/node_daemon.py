@@ -32,10 +32,10 @@ import threading
 import time
 import traceback
 from collections import deque
-from multiprocessing import connection as mpc
 
 from ray_tpu.core import protocol as P
 from ray_tpu.core import serialization as ser
+from ray_tpu.core import wire
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_store import (
@@ -113,8 +113,9 @@ class NodeDaemon:
         # serving fetch/chunk/end from the local store. With it, the
         # head is directory-only for cross-node transfers — its NIC
         # never carries other nodes' object bytes.
-        self._object_listener = mpc.Listener(
-            ("0.0.0.0", 0), family="AF_INET", authkey=token)
+        self._object_listener = wire.WireListener(
+            ("0.0.0.0", 0), family="AF_INET", authkey=token,
+            kind=wire.K_OBJECT, crosses_nodes=True)
         self.object_addr = (self._routable_ip(),
                             self._object_listener.address[1])
         self._peer_pools: dict[tuple, list] = {}
@@ -185,8 +186,9 @@ class NodeDaemon:
         self.conn = self._dial_and_register()
 
         # Local listener for this node's workers.
-        self._listener = mpc.Listener(self.client_address,
-                                      family="AF_UNIX")
+        self._listener = wire.WireListener(self.client_address,
+                                           family="AF_UNIX",
+                                           kind=wire.K_CLIENT)
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="nd_accept").start()
 
@@ -244,8 +246,10 @@ class NodeDaemon:
         self.rview_serves = getattr(self, "rview_serves", 0)
         self._rsync_version = itertools.count()
         self._rsync_last = None
-        conn = mpc.Client(self.head_addr, family="AF_INET",
-                          authkey=self.token)
+        conn = wire.dial(self.head_addr, family="AF_INET",
+                         authkey=self.token, kind=wire.K_NODE,
+                         peer="head", peer_node="head",
+                         crosses_nodes=True)
         conn.send(("hello", "node", ""))
         info = {
             "resources": self.resources,
@@ -279,12 +283,34 @@ class NodeDaemon:
         # inline, buffer the rest for the serve loop.
         backlog: list = []
         while True:
+            # Registration deadline: a head that accepted the TCP
+            # connection but never answers (frozen/partitioned wire)
+            # must not wedge the reconnect loop — fail this attempt
+            # and let the caller retry within its window.
+            if not conn.poll(self.config.connect_timeout_s):
+                conn.close()
+                raise ConnectionError(
+                    "head did not answer ND_REGISTER within "
+                    f"connect_timeout_s="
+                    f"{self.config.connect_timeout_s}s")
             msg = conn.recv()
             if msg[0] == "registered":
                 self.node_id = msg[1]
                 from ray_tpu.core.ids import owner_tag_of
                 self.owner_tag = owner_tag_of(self.node_id)
                 self._pre_msgs = backlog
+                # Node-scoped chaos rules match this boundary; the
+                # daemon's workers inherit it via RAY_TPU_NODE_ID.
+                wire.set_local_node(self.node_id)
+                # Daemon-side stale-head detection: the head pings
+                # every health_check_period_s, so a healthy channel
+                # never goes idle; a silent partition stops the pings
+                # and this monitor kills the socket within
+                # heartbeat_timeout_s, driving serve_forever's EOF
+                # path into the reconnect window instead of leaving
+                # the recv blocked on a half-open connection forever.
+                wire.heartbeater().register(
+                    conn, name="head (node channel)")
                 return conn
             if msg[0] == P.ND_PING:
                 conn.send((P.ND_PONG,))
@@ -867,8 +893,10 @@ class NodeDaemon:
             pool = self._peer_pools.get(addr)
             if pool:
                 return pool.pop()
-        return mpc.Client(tuple(addr), family="AF_INET",
-                          authkey=self.token)
+        return wire.dial(tuple(addr), family="AF_INET",
+                         authkey=self.token, kind=wire.K_OBJECT,
+                         peer=f"object peer @{addr[0]}:{addr[1]}",
+                         crosses_nodes=True)
 
     def _peer_release(self, addr: tuple, conn, ok: bool) -> None:
         if not ok:
@@ -897,13 +925,24 @@ class NodeDaemon:
         except OSError:
             pass
 
+    def _peer_wait(self, conn, deadline: float | None) -> None:
+        """Bound one peer reply wait by the pull deadline AND the
+        wire inactivity deadline: a silently partitioned peer (no
+        RST, reads would hang) surfaces as a timeout that the caller
+        converts into the ordinary pull-failure fallback instead of
+        blocking the transfer forever."""
+        left = self.config.heartbeat_timeout_s or 20.0
+        if deadline is not None:
+            left = min(left, deadline - time.monotonic())
+        if left <= 0 or not conn.poll(left):
+            from ray_tpu.core.exceptions import GetTimeoutError
+            raise GetTimeoutError(
+                f"peer pull timed out (no reply within "
+                f"{left:.1f}s from {getattr(conn, 'peer', '?')})")
+
     def _peer_call(self, conn, msg: tuple, deadline: float | None):
         conn.send(msg)
-        if deadline is not None:
-            left = deadline - time.monotonic()
-            if left <= 0 or not conn.poll(left):
-                from ray_tpu.core.exceptions import GetTimeoutError
-                raise GetTimeoutError("peer pull timed out")
+        self._peer_wait(conn, deadline)
         status, payload = conn.recv()
         if status == P.ST_ERR:
             raise ser.loads(payload)
@@ -928,13 +967,7 @@ class NodeDaemon:
             # connection is desynced — _peer_release(ok=False)
             # discards it and the peer's transfer expires idle.
             def recv_piece():
-                if deadline is not None:
-                    left = deadline - time.monotonic()
-                    if left <= 0 or not conn.poll(left):
-                        from ray_tpu.core.exceptions import (
-                            GetTimeoutError,
-                        )
-                        raise GetTimeoutError("peer pull timed out")
+                self._peer_wait(conn, deadline)
                 status, payload = conn.recv()
                 if status == P.ST_ERR:
                     raise ser.loads(payload)
@@ -1122,6 +1155,9 @@ class NodeDaemon:
 
     def _handshake(self, conn) -> None:
         try:
+            if not conn.poll(self.config.connect_timeout_s):
+                conn.close()    # mute dialer: never sent its hello
+                return
             hello = conn.recv()
         except (EOFError, OSError):
             return
@@ -1148,10 +1184,20 @@ class NodeDaemon:
         deadline = time.monotonic() + self.reconnect_window_s
         while upstream is None and not self._shutdown:
             try:
-                upstream = mpc.Client(self.head_addr,
-                                      family="AF_INET",
-                                      authkey=self.token)
+                upstream = wire.dial(self.head_addr,
+                                     family="AF_INET",
+                                     authkey=self.token,
+                                     kind=wire.K_CLIENT,
+                                     peer="head (splice)",
+                                     peer_node="head",
+                                     crosses_nodes=True)
                 upstream.send(("hello", "client", ""))
+                # A silently partitioned head must not leave this
+                # worker's blocking ops hung on the splice: kill the
+                # upstream on heartbeat timeout so both pumps EOF and
+                # the worker's own reconnect machinery takes over.
+                wire.heartbeater().register(
+                    upstream, name="head (splice)")
             except Exception:  # noqa: BLE001
                 # Head mid-restart: keep trying within the window so
                 # worker API calls resume instead of failing.

@@ -13,6 +13,17 @@ CoreWorker -> GCS/raylet client path:
 Messages are tuples; multiprocessing.connection handles framing and
 pickling of the envelope. Payloads that must survive closures/lambdas
 are pre-serialized with cloudpickle by the sender (``blob`` fields).
+
+Every channel below rides the hardened wire layer
+(``core/wire.py``): each frame carries a (seq, crc32) envelope, so a
+corrupted frame is refused before unpickling, a lost/reordered frame
+surfaces as a channel reset into the reconnect/replay recovery paths,
+and a duplicated frame is delivered once. ``("__hb__", "ping"/"pong")``
+heartbeat frames are absorbed inside ``WireConnection.recv`` — they
+never reach the dispatch loops documented here — and give every
+long-lived channel a liveness deadline (``heartbeat_timeout_s``)
+against silent partitions. Chaos fault injection (drop/delay/dup/
+corrupt/freeze, per channel kind/peer/node) hooks the same layer.
 """
 
 from __future__ import annotations

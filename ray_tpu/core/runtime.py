@@ -33,11 +33,11 @@ import time
 import traceback
 from collections import deque
 from dataclasses import dataclass, field
-from multiprocessing import connection as mpc
 from typing import Any, Callable
 
 from ray_tpu.core import protocol as P
 from ray_tpu.core import serialization as ser
+from ray_tpu.core import wire
 from ray_tpu.core.accelerator import detect_tpu_chips
 from ray_tpu.core.config import Config
 from ray_tpu.core.exceptions import (
@@ -924,7 +924,14 @@ class DriverRuntime:
             os.makedirs(self.log_dir, exist_ok=True)
             from ray_tpu.core.log_monitor import LogMonitor
             self.log_monitor = LogMonitor(self.log_dir)
-        self._listener = mpc.Listener(self.client_address, family="AF_UNIX")
+        # All channels ride the hardened wire layer (core/wire.py):
+        # checksummed sequenced frames, heartbeat-aware, chaos-
+        # injectable. The head is the "head" node for fault rules
+        # scoped to node boundaries.
+        wire.set_local_node("head")
+        self._listener = wire.WireListener(
+            self.client_address, family="AF_UNIX",
+            kind=wire.K_CLIENT)
         self._pending_workers: dict[str, WorkerHandle] = {}
         self._pending_workers_lock = threading.Lock()
         self._client_threads: list[threading.Thread] = []
@@ -4591,9 +4598,10 @@ class DriverRuntime:
         the reference secures this hop with gRPC + cluster identity)."""
         if self._tcp_listener is not None:
             return self.tcp_address
-        self._tcp_listener = mpc.Listener(
+        self._tcp_listener = wire.WireListener(
             (host, port), family="AF_INET",
-            authkey=self.cluster_token)
+            authkey=self.cluster_token, kind=wire.K_CLIENT,
+            crosses_nodes=True)
         self.tcp_address = self._tcp_listener.address
         threading.Thread(
             target=self._accept_loop, args=(self._tcp_listener,),
@@ -4624,6 +4632,12 @@ class DriverRuntime:
         # ("hello", "node", _) registers a node daemon (the connection
         # becomes that node's control channel).
         try:
+            # Hello deadline: an accepted connection whose dialer
+            # never speaks (half-open, frozen wire) must not pin this
+            # handshake thread forever.
+            if not conn.poll(self.config.connect_timeout_s):
+                conn.close()
+                return
             hello = conn.recv()
         except (EOFError, OSError):
             return
@@ -4633,6 +4647,7 @@ class DriverRuntime:
             return
         _, kind, token = hello
         if kind == "exec":
+            conn.set_peer(kind=wire.K_EXEC)
             with self._pending_workers_lock:
                 w = self._pending_workers.pop(token, None)
             if w is None:
@@ -4640,6 +4655,7 @@ class DriverRuntime:
                 return
             w.attach_conn(conn)
         elif kind == "node":
+            conn.set_peer(kind=wire.K_NODE)
             self._serve_node(conn)
         else:
             self._serve_client(conn)
@@ -5075,6 +5091,10 @@ class DriverRuntime:
             self._res_cv.notify_all()
         # A (re)registered node is a live scrape target again.
         self.observability.mark_node_live(node_id)
+        if hasattr(conn, "set_peer"):
+            conn.set_peer(peer=f"node {node_id[:12]}",
+                          peer_node=node_id)
+            conn.crosses_nodes = True
         self._ensure_health_thread()
         try:
             # The registration ack MUST be the first message on the
@@ -5104,8 +5124,13 @@ class DriverRuntime:
             while True:
                 msg = conn.recv()
                 kind = msg[0]
+                # ANY frame proves the round trip (daemon send path +
+                # our recv path), not just an explicit pong — a busy
+                # channel must never be declared dead for answering
+                # pings late behind bulk traffic.
+                node.last_pong = time.monotonic()
                 if kind == P.ND_PONG:
-                    node.last_pong = time.monotonic()
+                    pass
                 elif kind == P.ND_RSYNC:
                     _, version, report = msg
                     # Stale reports (reordered behind a reconnect)

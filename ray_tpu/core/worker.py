@@ -20,10 +20,10 @@ import threading
 import time
 import traceback
 from collections import deque
-from multiprocessing import connection as mpc
 
 from ray_tpu.core import protocol as P
 from ray_tpu.core import serialization as ser
+from ray_tpu.core import wire as wirelib
 from ray_tpu.core.exceptions import ActorError, GetTimeoutError, TaskError
 from ray_tpu.core.ids import ActorID, ObjectID
 from ray_tpu.core.object_ref import ObjectRef
@@ -119,12 +119,22 @@ class _DirectChannel:
         self.unacked: dict[int, tuple] = {}
         self._outbox: deque = deque()
         self._out_ev = threading.Event()
-        self._conn = mpc.Client(tuple(addr), family="AF_INET",
-                                authkey=bytes.fromhex(token_hex))
+        self._conn = wirelib.dial(
+            tuple(addr), family="AF_INET",
+            authkey=bytes.fromhex(token_hex), kind=wirelib.K_DIRECT,
+            peer=f"actor@{addr[0]}:{addr[1]}", crosses_nodes=True)
         _set_nodelay(self._conn)
         try:
+            from ray_tpu.core.config import get_config
             self._conn.send(("hello_direct", actor_id_bytes,
                              self.session_id))
+            # Handshake deadline: a host that accepted but never
+            # answers (frozen wire, wedged process) must fail the
+            # lease fast — the caller just keeps head routing.
+            if not self._conn.poll(get_config().connect_timeout_s):
+                raise ConnectionError(
+                    "direct hello not answered within "
+                    "connect_timeout_s")
             ack = self._conn.recv()
         except Exception:
             try:
@@ -144,6 +154,15 @@ class _DirectChannel:
                          name="direct_call_sender").start()
         threading.Thread(target=self._recv_loop, daemon=True,
                          name="direct_call_recv").start()
+        # Liveness deadline, quiescent-exempt: heartbeats fire ONLY
+        # while calls are unacked AND no ack has arrived for a full
+        # interval — the steady-state fast path (acks flowing) and the
+        # idle channel both stay at zero heartbeat frames. A silent
+        # partition mid-call-stream kills the socket, and the recv
+        # loop's EOF path replays the unacked window through the head.
+        wirelib.heartbeater().register(
+            self._conn, expecting=lambda: bool(self.unacked),
+            name=f"direct actor @{addr[0]}:{addr[1]}")
 
     def submit(self, task_id_bytes: bytes, method: str,
                args_blob: bytes, num_returns: int,
@@ -257,8 +276,9 @@ class DirectCallServer:
             from ray_tpu.util.net import routable_ip
             adv_ip = routable_ip(head_ip)
             bind_ip = "0.0.0.0"
-        self._listener = mpc.Listener((bind_ip, 0), family="AF_INET",
-                                      authkey=self._token)
+        self._listener = wirelib.WireListener(
+            (bind_ip, 0), family="AF_INET", authkey=self._token,
+            kind=wirelib.K_DIRECT, crosses_nodes=True)
         self.addr = (adv_ip, self._listener.address[1])
         self._completed: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._inflight: dict[bytes, threading.Event] = {}
@@ -314,6 +334,10 @@ class DirectCallServer:
     def _serve_conn(self, conn) -> None:
         _set_nodelay(conn)
         try:
+            from ray_tpu.core.config import get_config
+            if not conn.poll(get_config().connect_timeout_s):
+                conn.close()    # mute dialer: never started hello
+                return
             hello = conn.recv()
             if not (isinstance(hello, tuple) and len(hello) == 3
                     and hello[0] == "hello_direct"):
@@ -567,20 +591,42 @@ class ClientRuntime:
         self.actor_calls_head_routed = 0
         self.direct_call_fallbacks = 0
         self.local_mode = False
+        self._monitor_conn(self._conn)
 
     def _dial(self):
         """Open the control connection: unix path for a same-host
-        head/daemon, host:port (authenticated) for a remote head."""
+        head/daemon, host:port (authenticated) for a remote head.
+        Connect + handshake are deadline-bounded (connect_timeout_s)
+        and name the peer on failure — an unreachable head raises
+        instead of blocking uninterruptibly."""
         addr = self._address
         if isinstance(addr, str) and ":" in addr \
                 and not addr.startswith("/"):
             host, _, port = addr.rpartition(":")
-            conn = mpc.Client((host or "127.0.0.1", int(port)),
-                              family="AF_INET", authkey=self._token)
+            host = host or "127.0.0.1"
+            conn = wirelib.dial((host, int(port)), family="AF_INET",
+                                authkey=self._token,
+                                kind=wirelib.K_CLIENT,
+                                peer=f"head@{host}:{port}",
+                                peer_node="head", crosses_nodes=True)
         else:
-            conn = mpc.Client(addr, family="AF_UNIX")
+            conn = wirelib.dial(addr, family="AF_UNIX",
+                                kind=wirelib.K_CLIENT, peer="head")
         conn.send(("hello", "client", ""))
         return conn
+
+    def _monitor_conn(self, conn) -> None:
+        """Liveness deadline on the head channel: while requests are
+        pending (a blocked get/wait/submit ack), a channel silent for
+        heartbeat_interval_s gets pinged; silent past
+        heartbeat_timeout_s it is killed, which fails the pending
+        requests into the reconnect + dd-replay path instead of a
+        hang. Quiescent-exempt: an idle channel costs zero frames."""
+        wirelib.heartbeater().register(
+            conn,
+            expecting=lambda: bool(self._pending)
+            or bool(self._async_q),
+            name="client->head")
 
     def _try_reconnect(self) -> bool:
         """Re-dial after the head connection dropped (head restart —
@@ -599,6 +645,7 @@ class ClientRuntime:
                 self._conn = conn
                 self._conn_gen += 1
                 self._conn_dead = False
+            self._monitor_conn(conn)
             threading.Thread(target=self._recv_loop, daemon=True,
                              name="client_recv").start()
             self._replay_async_after_reconnect()

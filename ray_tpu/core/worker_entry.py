@@ -10,7 +10,6 @@ multiprocessing-spawn hazard) and carry no inherited interpreter state.
 from __future__ import annotations
 
 import sys
-from multiprocessing import connection as mpc
 
 
 def main() -> None:
@@ -42,7 +41,9 @@ def main() -> None:
     apply_from_env()
 
     address, token = sys.argv[1], sys.argv[2]
-    conn = mpc.Client(address, family="AF_UNIX")
+    from ray_tpu.core import wire
+    conn = wire.dial(address, family="AF_UNIX", kind=wire.K_EXEC,
+                     peer="exec listener")
     conn.send(("hello", "exec", token))
     from ray_tpu.core.worker import worker_main
     worker_main(conn, address)

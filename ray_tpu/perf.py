@@ -306,6 +306,57 @@ def _run_benchmarks(rec, quick: bool) -> None:
     _direct_bench("actor_calls_direct_n_n", 4, 1, 0, True)
     _direct_bench("actor_call_inline_small_args", 1, 1, 32, True)
 
+    # -- wire hardening tax (partition-tolerant wire, core/wire.py) --
+    # The checksum + sequence + heartbeat envelope's no-fault cost,
+    # isolated on a loopback echo pair: added microseconds per
+    # roundtrip (2 wrapped sends + 2 wrapped recvs) over raw
+    # multiprocessing connections. Best-of-2 each side — the row
+    # tracks the envelope, not the host's scheduler. The e2e contract
+    # (direct-call and task rows within 2% of PERF_r07) is pinned by
+    # test_perf.py::test_microbench_floors.
+    def _echo_rate(wrap: bool, n: int) -> float:
+        import threading as _th
+        from multiprocessing import Pipe
+
+        from ray_tpu.core import wire as _w
+        a, b = Pipe(duplex=True)
+        if wrap:
+            a = _w.WireConnection(a, kind="perfecho", peer="b")
+            b = _w.WireConnection(b, kind="perfecho", peer="a")
+
+        def _echo():
+            try:
+                while True:
+                    b.send(b.recv())
+            except (EOFError, OSError):
+                pass
+
+        _th.Thread(target=_echo, daemon=True).start()
+        msg = ("req", 12345, b"x" * 128)
+        for _ in range(500):
+            a.send(msg)
+            a.recv()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            a.send(msg)
+            a.recv()
+        dt = time.perf_counter() - t0
+        a.close()
+        return n / dt
+
+    n_echo = 3000 if quick else 20000
+    raw_rt = max(_echo_rate(False, n_echo) for _ in range(2))
+    wire_rt = max(_echo_rate(True, n_echo) for _ in range(2))
+    ov_us = max(0.0, (1.0 / wire_rt - 1.0 / raw_rt) * 1e6)
+    hb_row = {"metric": "heartbeat_overhead",
+              "value": round(ov_us, 2), "unit": "us/roundtrip",
+              "extra": {"raw_echo_rt_s": round(raw_rt, 1),
+                        "wire_echo_rt_s": round(wire_rt, 1),
+                        "overhead_pct_of_echo": round(
+                            (raw_rt / wire_rt - 1.0) * 100, 1)}}
+    print(json.dumps(hb_row), flush=True)
+    rec(hb_row)
+
     # Multiple client processes submitting tasks concurrently
     # (reference: multi_client_tasks_async — each client is its own
     # process with its own submission channel).

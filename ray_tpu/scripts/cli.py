@@ -29,7 +29,6 @@ import json
 import os
 import sys
 import threading
-from multiprocessing import connection as mpc
 
 
 def _discover_address(explicit: str | None) -> str:
@@ -49,7 +48,12 @@ class _Client:
     """Minimal state client over the worker client protocol."""
 
     def __init__(self, address: str):
-        self._conn = mpc.Client(address, family="AF_UNIX")
+        from ray_tpu.core import wire
+        # Deadline-bounded dial: a dead session's leftover socket
+        # file must fail fast with the peer named, not hang the CLI.
+        self._conn = wire.dial(address, family="AF_UNIX",
+                               kind=wire.K_CLIENT,
+                               peer=f"head at {address}")
         self._conn.send(("hello", "client", ""))
         self._req = itertools.count()
         self._lock = threading.Lock()

@@ -49,6 +49,7 @@ class LongPollClient:
         self._routers: dict[str, list] = {}    # name -> [Router]
         self._lock = threading.Lock()
         self._stop = False
+        self._m_reconnects = None   # lazy scrape counter
         self._have_routers = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serve_longpoll")
@@ -89,6 +90,18 @@ class LongPollClient:
             except Exception:  # noqa: BLE001 — controller down/busy
                 if self._stop:
                     return
+                # Counted onto the cluster scrape next to the wire
+                # reset counters: a partitioned controller shows up
+                # as long-poll churn here, channel resets there.
+                try:
+                    from ray_tpu.util.metrics import Counter
+                    if self._m_reconnects is None:
+                        self._m_reconnects = Counter(
+                            "ray_tpu_serve_longpoll_reconnects_total",
+                            "serve long-poll error/reconnect cycles")
+                    self._m_reconnects.inc()
+                except Exception:  # noqa: BLE001
+                    pass
                 # Full jitter on the reconnect backoff: a fleet of
                 # routers that all lost the same controller (restart,
                 # head failover, drain) must not re-dial it in

@@ -9,6 +9,7 @@ retries, actor restarts, PG re-homing).
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -27,21 +28,43 @@ class ResourceKiller:
                       migrate off first, so a healthy drain path
                       shows ZERO user-visible failures and zero
                       lineage reconstructions
+          "partition" — sever a random non-head node from the rest of
+                      the cluster at the network level (one-way or
+                      symmetric, chosen by the seeded RNG) for
+                      ``partition_duration_s``, then heal. SILENT: no
+                      RST, sends are swallowed, reads hang — the
+                      failure mode the heartbeat/deadline hardening
+                      exists for. Rules publish cluster-wide through
+                      the ``RAY_TPU_CHAOS_FILE`` plan file (set the
+                      env var BEFORE starting the cluster so every
+                      daemon/worker polls it; pass ``plan_file`` to
+                      override).
 
     ``drain_deadline_s`` bounds each "preempt" drain (the kill loop
     blocks while it runs, mimicking the real notice-to-termination
     window).
+
+    Determinism: every decision (victim, partition mode) is drawn
+    only from the seeded RNG and the sorted candidate list, and is
+    appended to ``self.decisions`` — the same seed over the same
+    cluster membership replays the same kill/partition schedule
+    (regression-tested in tests/test_partition_chaos.py).
     """
+
+    _KINDS = ("worker", "actor", "node", "preempt", "partition")
+    _PARTITION_MODES = ("both", "send", "recv")
 
     def __init__(self, kind: str = "worker",
                  interval_s: float = 0.5,
                  max_kills: int | None = None,
                  seed: int | None = None, runtime=None,
-                 drain_deadline_s: float = 10.0):
+                 drain_deadline_s: float = 10.0,
+                 partition_duration_s: float = 2.0,
+                 plan_file: str | None = None):
         if runtime is None:
             from ray_tpu.core.api import get_runtime
             runtime = get_runtime()
-        if kind not in ("worker", "actor", "node", "preempt"):
+        if kind not in self._KINDS:
             raise ValueError(f"unknown kill target {kind!r}")
         self.drain_deadline_s = drain_deadline_s
         self.kind = kind
@@ -49,6 +72,17 @@ class ResourceKiller:
         self.max_kills = max_kills
         self.runtime = runtime
         self.kills = 0
+        self.partition_duration_s = partition_duration_s
+        self.plan_file = plan_file or os.environ.get(
+            "RAY_TPU_CHAOS_FILE")
+        if kind == "partition" and not self.plan_file:
+            raise ValueError(
+                "kind='partition' needs a chaos plan file: set "
+                "RAY_TPU_CHAOS_FILE before starting the cluster (so "
+                "daemons/workers inherit it) or pass plan_file=")
+        # Audit trail for the deterministic-replay contract:
+        # (kind, victim_node_id, mode) per fault.
+        self.decisions: list[tuple] = []
         self._rng = random.Random(seed)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -78,13 +112,22 @@ class ResourceKiller:
 
     def _kill_one(self) -> bool:
         rt = self.runtime
-        if self.kind in ("node", "preempt"):
-            nodes = [n for n in rt.nodes()
-                     if n["Alive"] and not n["IsHead"]
-                     and not n.get("Draining")]
+        if self.kind in ("node", "preempt", "partition"):
+            # Sorted for determinism: the RNG draw must depend only
+            # on the seed and the membership, never on dict order.
+            nodes = sorted(
+                (n["NodeID"] for n in rt.nodes()
+                 if n["Alive"] and not n["IsHead"]
+                 and not n.get("Draining")))
             if not nodes:
                 return False
-            victim = self._rng.choice(nodes)["NodeID"]
+            victim = self._rng.choice(nodes)
+            if self.kind == "partition":
+                mode = self._rng.choice(self._PARTITION_MODES)
+                self.decisions.append(("partition", victim, mode))
+                self._partition(victim, mode)
+                return True
+            self.decisions.append((self.kind, victim, ""))
             if self.kind == "preempt":
                 return bool(rt.drain_node(
                     victim, reason="chaos preemption notice",
@@ -106,3 +149,26 @@ class ResourceKiller:
         except Exception:  # noqa: BLE001
             return False
         return True
+
+    def _partition(self, node_id: str, mode: str) -> None:
+        """Silently sever ``node_id``'s network boundary for
+        ``partition_duration_s``, then heal. ``mode``: "both" is a
+        full isolation; "send"/"recv" are one-way links (the node can
+        hear but not speak / speak but not hear). The loop blocks for
+        the fault window, mirroring the real outage."""
+        from ray_tpu.core import wire
+        rule = wire.FaultRule(
+            "freeze", node=node_id, direction=mode,
+            id=f"chaos-partition-{node_id[:12]}")
+        wire.write_plan_file(self.plan_file, [rule])
+        # Our own process must see the rule immediately too (the
+        # driver's poll is best-effort otherwise).
+        wire.fault_plan().maybe_refresh(force=True)
+        try:
+            deadline = time.monotonic() + self.partition_duration_s
+            while not self._stop.wait(0.1):
+                if time.monotonic() >= deadline:
+                    break
+        finally:
+            wire.write_plan_file(self.plan_file, [])
+            wire.fault_plan().maybe_refresh(force=True)

@@ -73,6 +73,17 @@ DIRECT_CALL_METRICS = (
     "actor_call_inline_small_args",
 )
 
+# Wire-hardening metrics (ray_tpu/perf.py): the checksum/seq/
+# heartbeat envelope's no-fault tax on a loopback echo pair, in added
+# microseconds per roundtrip. The e2e contract is that
+# actor_calls_direct_1_1 and the tasks rows stay within 2% of the
+# pre-hardening round (PERF_r07) on an idle host; this row tracks the
+# isolated component cost across rounds. Same must-be-present
+# contract.
+WIRE_METRICS = (
+    "heartbeat_overhead",
+)
+
 
 def one_run(path: str, serve: bool, timeout: float,
             quick: bool = False) -> list[dict]:
@@ -133,6 +144,7 @@ def main() -> None:
         got = {r.get("metric") for r in rows}
         missing = [m for m in OBJECT_PLANE_METRICS
                    + ROBUSTNESS_METRICS
+                   + WIRE_METRICS
                    + OBSERVABILITY_METRICS
                    + INTROSPECTION_METRICS
                    + DIRECT_CALL_METRICS if m not in got]

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Chaos + partition lanes, run SERIALLY with seeds pinned.
+#
+# Serial on purpose: every lane kills processes, severs channels, or
+# floods the box with retry traffic — two lanes sharing one host
+# would chaos-test each other. Seeds are pinned inside the tests
+# (ResourceKiller(seed=...), FaultRule(seed=...)) so a red run
+# replays bit-identically; PYTHONHASHSEED pins the remaining ambient
+# randomness.
+#
+# Usage: scripts/run_chaos.sh [extra pytest args...]
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PYTHONHASHSEED=0
+
+PYTEST=(python -m pytest tests/ -q -p no:cacheprovider -p no:xdist
+        -p no:randomly --continue-on-collection-errors)
+
+rc=0
+
+echo "=== chaos lane (ResourceKiller / drain / preemption) ==="
+"${PYTEST[@]}" -m "chaos and not partition and not slow" "$@" || rc=1
+
+echo "=== partition lane (wire faults / silent partitions) ==="
+"${PYTEST[@]}" -m "partition and not slow" "$@" || rc=1
+
+exit $rc

@@ -53,6 +53,34 @@ ensure_jax_compat()
 import pytest  # noqa: E402
 
 
+# -- host-contention gate (tests import this from conftest) ------------
+# Perf floors measured on an idle box are meaningless under load: the
+# documented runner must stay green on a busy 1-core host. Floors
+# divide by ``relax`` when the load factor crosses SOFT; tests skip
+# outright past HARD (a number measured at 6x oversubscription guards
+# nothing).
+
+LOAD_SOFT, LOAD_HARD = 1.5, 4.0
+
+
+def host_load_factor() -> float:
+    """1-minute loadavg per core (0.0 where unavailable)."""
+    try:
+        return os.getloadavg()[0] / max(1, os.cpu_count() or 1)
+    except (OSError, AttributeError):
+        return 0.0
+
+
+def perf_floor_gate():
+    """-> relax divisor for perf floors; skips the calling test on a
+    hopelessly contended host."""
+    load = host_load_factor()
+    if load > LOAD_HARD:
+        pytest.skip(f"host load factor {load:.1f} > {LOAD_HARD}: "
+                    f"perf floors are meaningless here")
+    return 4.0 if load > LOAD_SOFT else 1.0
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running learning/e2e test")
@@ -60,6 +88,10 @@ def pytest_configure(config):
         "markers",
         "chaos: fault-injection run (ResourceKiller / drain / "
         "preemption)")
+    config.addinivalue_line(
+        "markers",
+        "partition: network-fault run (ChaosTransport frame faults "
+        "/ silent partitions)")
 
 
 @pytest.fixture

@@ -94,6 +94,10 @@ def test_100mb_allreduce_on_daemon_ranks():
     funnel itself in r4; the bar is now an absolute wall cap, set ~8x
     above the typical ~1.3 s so only a pathological regression —
     e.g. payload bytes relayed through the head again — trips it.)"""
+    # Load-gated before paying for the runs: skip on a hopelessly
+    # contended host, relax the wall cap under soft load.
+    from conftest import perf_floor_gate
+    relax = perf_floor_gate()
     cluster = Cluster(initialize_head=True,
                       head_node_args={"num_cpus": 0})
     try:
@@ -126,7 +130,9 @@ def test_100mb_allreduce_on_daemon_ranks():
         mesh_wall = min(run("ring_mesh_a", n_elem),
                         run("ring_mesh_b", n_elem))
         print(f"100MB allreduce x4 daemon ranks: {mesh_wall:.2f}s")
-        assert mesh_wall < 12.0, mesh_wall
+        # The 12s bar assumes the box is ours; under contention it
+        # would measure the neighbors, hence the gate above.
+        assert mesh_wall < 12.0 * relax, mesh_wall
     finally:
         cluster.shutdown()
 

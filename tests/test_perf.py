@@ -16,11 +16,17 @@ from ray_tpu.perf import run_all
 
 @pytest.mark.slow
 def test_microbench_floors(rt):
+    # Load-gated: floors relax 4x on a contended host and the test
+    # skips outright past hard oversubscription (the documented
+    # runner must be green on a busy 1-core box — absolute floors
+    # there measure the neighbors, not the runtime).
+    from conftest import perf_floor_gate
+    relax = perf_floor_gate()
     results = {r["metric"]: r["value"] for r in run_all(quick=True)}
-    assert results["single_client_tasks_sync"] > 300
-    assert results["1_1_actor_calls_sync"] > 500
-    assert results["1_1_actor_calls_async"] > 1000
-    assert results["single_client_put_calls_1KiB"] > 1000
+    assert results["single_client_tasks_sync"] > 300 / relax
+    assert results["1_1_actor_calls_sync"] > 500 / relax
+    assert results["1_1_actor_calls_async"] > 1000 / relax
+    assert results["single_client_put_calls_1KiB"] > 1000 / relax
     # Direct actor-call plane: the worker->worker bypass must beat
     # the head-routed baseline measured in the SAME run on the same
     # machine (the whole point of taking the head off the per-call
@@ -30,6 +36,19 @@ def test_microbench_floors(rt):
         f"direct path slower than head routing: "
         f"{results['actor_calls_direct_1_1']} vs "
         f"{results['actor_calls_head_routed_1_1']} calls/s")
+    # Wire-hardening no-fault guardrail: the checksum/seq/heartbeat
+    # envelope must not regress the steady-state rows vs the
+    # pre-hardening round (PERF_r07: direct 12.0k/s, sync tasks
+    # 5.75k/s). Floors at 0.85x absorb quick-mode jitter; the strict
+    # <2% contract is verified on idle-host medians by
+    # scripts/perf_snapshot.py (WIRE_METRICS). heartbeat_overhead is
+    # the isolated per-roundtrip envelope tax — single-digit us, or
+    # something hot-path broke.
+    assert results["actor_calls_direct_1_1"] > 0.85 * 12000 / relax
+    assert results["single_client_tasks_sync"] > 0.85 * 5754 / relax
+    assert results["heartbeat_overhead"] < 15.0 * relax, (
+        f"wire envelope tax {results['heartbeat_overhead']}us — "
+        f"hot path regressed")
 
 
 def test_direct_calls_zero_head_frames_steady_state(rt):
