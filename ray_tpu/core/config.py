@@ -221,6 +221,67 @@ class Config:
     # stays ring-buffered for the next interval.
     metrics_flush_batch: int = 2048
 
+    # --- serve request plane (reference: serve/_private/{router,
+    # replica,proxy}.py — request retries, deployment health checks,
+    # graceful draining, and proxy back-pressure) ---
+    # Master switch for the request retry/replay plane. Off = the
+    # pre-retry behavior: one dispatch, no request ids, no pending
+    # accounting (the ≤5% disabled-path guardrail in tests/test_perf.py
+    # measures this path against the enabled one).
+    serve_retry_enabled: bool = True
+    # Re-dispatch attempts after the first (so 3 = up to 4 total
+    # executions attempted) when a replica dies, is stopping, or
+    # sheds the request (reference: handle max_retries semantics).
+    serve_request_max_retries: int = 3
+    # Base of the jittered exponential backoff between re-dispatches.
+    serve_retry_backoff_s: float = 0.05
+    # How long a request waits out an EMPTY routing table (rolling
+    # redeploy gap: old replicas stopped, new ones not yet ready)
+    # before failing; does not consume retry attempts.
+    serve_no_replica_wait_s: float = 10.0
+    # Router long-poll: max time one listen_for_change call camps on
+    # the controller before re-arming (was hardcoded 60 s).
+    serve_longpoll_timeout_s: float = 60.0
+    # Router blocking refresh of the routing table (was hardcoded 30 s).
+    serve_refresh_timeout_s: float = 30.0
+    # Power-of-two-choices queue-depth probe of two candidate
+    # replicas (was hardcoded 5 s).
+    serve_queue_probe_timeout_s: float = 5.0
+    # Bound on one replica call from the proxies when the request
+    # carries no deadline of its own (was hardcoded 120 s).
+    serve_call_timeout_s: float = 120.0
+    # Controller-driven replica health probes: cadence, per-probe
+    # timeout, and consecutive failures before the replica is ejected
+    # from the pushed routing table and replaced (reference:
+    # DeploymentState health-check constants).
+    serve_health_check_period_s: float = 1.0
+    serve_health_check_timeout_s: float = 5.0
+    serve_health_check_failure_threshold: int = 3
+    # A spawned replica that never passes its first probe (readiness
+    # gate) within this window is torn down and respawned.
+    serve_replica_startup_timeout_s: float = 60.0
+    # Default end-to-end request deadline (0 = none). Proxies also
+    # honor per-request deadlines (X-Request-Timeout-S header / gRPC
+    # client deadline), which override this.
+    serve_request_deadline_s: float = 0.0
+    # Bounded per-replica request queue: a replica already holding
+    # this many accepted requests sheds new ones back to the router
+    # (deployments override via max_ongoing_requests).
+    serve_max_queue_len_per_replica: int = 64
+    # Proxy-side in-flight cap across all deployments: past it, HTTP
+    # answers 503 + Retry-After and gRPC answers UNAVAILABLE without
+    # touching the routing plane.
+    serve_proxy_max_inflight: int = 256
+    # Stopping replicas: total drain deadline, and the minimum grace
+    # during which a stopping replica still ACCEPTS new requests so
+    # routers on a stale table don't see errors (then it sheds with
+    # ReplicaStoppingError and the retry plane moves the traffic).
+    serve_drain_deadline_s: float = 30.0
+    serve_drain_min_grace_s: float = 2.0
+    # Executed-response ledger entries per replica for duplicate
+    # re-dispatch dedupe (mirrors direct_call_result_cache).
+    serve_result_ledger_size: int = 2048
+
     # --- workers ---
     # Env vars CLEARED in CPU-only workers' environments (comma
     # separated). Default: the ambient TPU-plugin sitecustomize

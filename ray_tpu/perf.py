@@ -701,28 +701,102 @@ def _run_benchmarks(rec, quick: bool) -> None:
     rec(row)
 
 
-def run_serve_bench(quick: bool = False) -> dict:
-    """Serve requests/s through a 2-replica deployment (steady-state
-    path: long-poll-cached routing + pow-2 probes, zero controller
-    RPCs per request)."""
+def run_serve_bench(quick: bool = False) -> list[dict]:
+    """Serve benchmarks: handle requests/s, HTTP proxy echo with the
+    retry plane on vs off (the ≤5% disabled-path guardrail pair,
+    tests/test_perf.py), and a mini chaos soak p99 with one seeded
+    replica kill mid-stream (the zero-loss latency row)."""
+    import http.client
+
     from ray_tpu import serve
+
+    results: list[dict] = []
 
     @serve.deployment(num_replicas=2)
     class Echo:
         def __call__(self, x):
             return x
 
-    handle = serve.run(Echo.bind())
-    ray_tpu.get(handle.remote(0), timeout=60)
+    http_port = 18731
+    handle = serve.run(Echo.bind(), http_port=http_port)
+    handle.remote(0).result(timeout_s=60)
     out = timeit(
         "serve_requests_per_s",
         lambda: ray_tpu.get([handle.remote(i) for i in range(20)],
                             timeout=60),
         batch=20, quick=quick)
     rpcs = handle._router.controller_rpcs
-    serve.shutdown()
     out["extra"] = {"controller_rpcs_during_bench": rpcs}
-    return out
+    results.append(out)
+
+    def _echo_loop(port: int, n: int = 20):
+        # One keep-alive connection per timing call: the row measures
+        # the proxy dispatch path, not TCP handshakes.
+        conn = http.client.HTTPConnection("127.0.0.1", port)
+
+        def fn():
+            for i in range(n):
+                conn.request("POST", "/", body=json.dumps(i))
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"proxy echo {resp.status}: {body[:200]!r}")
+        return fn
+
+    results.append(timeit("serve_proxy_echo",
+                          _echo_loop(http_port),
+                          batch=20, quick=quick))
+
+    # Second proxy, SAME replica set, retry plane hard-disabled: the
+    # overhead pair differs only in the router call path (config flips
+    # in the driver don't reach spawned actors, hence the explicit
+    # override).
+    from ray_tpu.serve.proxy import ProxyActor
+    noretry_port = 18732
+    noretry = ProxyActor.options(num_cpus=0, max_concurrency=32).remote(
+        noretry_port, retry_enabled=False)
+    ray_tpu.get(noretry.ready.remote(), timeout=30)
+    ray_tpu.get(noretry.set_routes.remote(
+        {"/": {"name": "Echo", "asgi": False}}))
+    results.append(timeit("serve_proxy_echo_noretry",
+                          _echo_loop(noretry_port),
+                          batch=20, quick=quick))
+
+    # Mini chaos soak: sequential handle requests with ONE seeded
+    # replica kill mid-stream; every request must succeed (the retry
+    # plane re-dispatches; the controller respawns). p99 in ms.
+    from ray_tpu.util.chaos import ResourceKiller
+    n_req = 120 if quick else 400
+    lat: list[float] = []
+    failed = 0
+    killer = None
+    for i in range(n_req):
+        if i == n_req // 3:
+            killer = ResourceKiller(kind="serve_replica",
+                                    interval_s=0.05, max_kills=1,
+                                    seed=42).start()
+        t0 = time.perf_counter()
+        try:
+            handle.remote(i).result(timeout_s=60)
+        except Exception:  # noqa: BLE001
+            failed += 1
+            continue
+        lat.append((time.perf_counter() - t0) * 1e3)
+    kills = killer.stop() if killer else 0
+    lat.sort()
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else -1.0
+    row = {"metric": "serve_soak_p99", "value": round(p99, 2),
+           "unit": "ms",
+           "extra": {"requests": n_req, "failed": failed,
+                     "kills": kills,
+                     "p50": round(lat[len(lat) // 2], 2) if lat
+                     else -1.0}}
+    print(json.dumps(row), flush=True)
+    results.append(row)
+
+    serve.shutdown()
+    return results
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -30,6 +30,16 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.replica import get_replica_context, ReplicaContext
 from ray_tpu.serve.autoscaling import AutoscalingConfig
+from ray_tpu.serve.exceptions import (
+    ServeError,
+    ReplicaUnavailableError,
+    ReplicaStoppingError,
+    ReplicaOverloadedError,
+    DeploymentOverloadedError,
+    RequestRetriesExhaustedError,
+    RequestDeadlineError,
+    ModelLoadError,
+)
 from ray_tpu.serve.multiplex import (
     get_multiplexed_model_id,
     multiplexed,
@@ -42,4 +52,8 @@ __all__ = [
     "grpc_ingress_token",
     "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
+    "ServeError", "ReplicaUnavailableError", "ReplicaStoppingError",
+    "ReplicaOverloadedError", "DeploymentOverloadedError",
+    "RequestRetriesExhaustedError", "RequestDeadlineError",
+    "ModelLoadError",
 ]

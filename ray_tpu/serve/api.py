@@ -74,6 +74,10 @@ class Deployment:
     ray_actor_options: dict = field(default_factory=dict)
     user_config: Any = None
     autoscaling_config: dict | None = None
+    # Bounded per-replica queue (reference: max_ongoing_requests): a
+    # replica already holding this many requests sheds new ones back
+    # to the router. None = config serve_max_queue_len_per_replica.
+    max_ongoing_requests: int | None = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -82,7 +86,8 @@ class Deployment:
                 name: str | None = None,
                 ray_actor_options: dict | None = None,
                 autoscaling_config: dict | None = None,
-                user_config=None) -> "Deployment":
+                user_config=None,
+                max_ongoing_requests: int | None = None) -> "Deployment":
         return Deployment(
             cls=self.cls,
             name=name or self.name,
@@ -92,7 +97,10 @@ class Deployment:
             user_config=(self.user_config if user_config is None
                          else user_config),
             autoscaling_config=autoscaling_config
-            or self.autoscaling_config)
+            or self.autoscaling_config,
+            max_ongoing_requests=(self.max_ongoing_requests
+                                  if max_ongoing_requests is None
+                                  else max_ongoing_requests))
 
 
 @dataclass
@@ -111,13 +119,49 @@ class DeploymentResponse:
     to its VALUE in the replica (composition) — while user-passed
     plain ObjectRefs keep their ref contract."""
 
-    def __init__(self, ref):
+    _SENTINEL = object()
+
+    def __init__(self, ref, retry_ctx=None):
         self._ref = ref
+        self._retry_ctx = retry_ctx
+        self._value = self._SENTINEL
+        if retry_ctx is not None:
+            import weakref
+            # A response dropped without .result() (fire-and-forget)
+            # must still release its router pending-count slot.
+            self._finalizer = weakref.finalize(self, retry_ctx.finish)
 
     def result(self, timeout_s: float | None = None):
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        """Block for the response value. With the retry plane on, a
+        first dispatch that failed retryably (replica died / was
+        stopping / shed the request) is re-dispatched under the same
+        request id — the replica-side ledger guarantees at most one
+        execution per replica even when the original call actually
+        finished."""
+        if self._value is not self._SENTINEL:
+            return self._value
+        ctx = self._retry_ctx
+        try:
+            out = ray_tpu.get(self._ref, timeout=timeout_s)
+            if ctx is not None:
+                ctx.finish()
+            self._value = out
+            return out
+        except Exception as e:
+            if ctx is None:
+                raise
+            from ray_tpu.serve.exceptions import is_retryable
+            if not is_retryable(e):
+                ctx.finish()
+                raise
+            out = ctx.retry(e, timeout=timeout_s)
+            self._value = out
+            return out
 
     def _to_object_ref(self):
+        # Raw-ref unwrap (ray_tpu.get(response) / wait / composition
+        # args): single-attempt — the retry plane rides .result() and
+        # the proxies; a raw ref has no replay context.
         return self._ref
 
     def __reduce__(self):
@@ -164,10 +208,11 @@ class DeploymentHandle:
         return h
 
     def remote(self, *args, **kwargs):
-        out = self._router.assign("__call__", args, kwargs,
-                                  multiplexed_model_id=self._model_id,
-                                  stream=self._stream)
-        return out if self._stream else DeploymentResponse(out)
+        out, ctx = self._router.assign_ctx(
+            "__call__", args, kwargs,
+            multiplexed_model_id=self._model_id,
+            stream=self._stream)
+        return out if self._stream else DeploymentResponse(out, ctx)
 
     def __getattr__(self, method: str):
         if method.startswith("_"):
@@ -179,12 +224,12 @@ class DeploymentHandle:
                 self._name = name
 
             def remote(self, *args, **kwargs):
-                out = self._outer._router.assign(
+                out, ctx = self._outer._router.assign_ctx(
                     self._name, args, kwargs,
                     multiplexed_model_id=self._outer._model_id,
                     stream=self._outer._stream)
                 return out if self._outer._stream \
-                    else DeploymentResponse(out)
+                    else DeploymentResponse(out, ctx)
 
         return _Method(self, method)
 
@@ -197,7 +242,8 @@ def deployment(cls: type | None = None, *, name: str | None = None,
                num_replicas: int = 1,
                ray_actor_options: dict | None = None,
                autoscaling_config: dict | None = None,
-               user_config=None):
+               user_config=None,
+               max_ongoing_requests: int | None = None):
     """Decorator turning a class (or function) into a Deployment."""
     def wrap(target):
         return Deployment(
@@ -205,7 +251,8 @@ def deployment(cls: type | None = None, *, name: str | None = None,
             num_replicas=num_replicas,
             ray_actor_options=ray_actor_options or {},
             user_config=user_config,
-            autoscaling_config=autoscaling_config)
+            autoscaling_config=autoscaling_config,
+            max_ongoing_requests=max_ongoing_requests)
     if cls is not None:
         return wrap(cls)
     return wrap
@@ -213,11 +260,29 @@ def deployment(cls: type | None = None, *, name: str | None = None,
 
 def _ensure_controller():
     try:
-        return ray_tpu.get_actor(CONTROLLER_NAME)
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
-        return ServeController.options(
-            name=CONTROLLER_NAME, num_cpus=0,
-            max_concurrency=16).remote()
+        controller = None
+    if controller is not None:
+        from ray_tpu.core.exceptions import ActorDiedError
+        try:
+            ray_tpu.get(controller.list_deployments.remote(),
+                        timeout=30)
+            return controller
+        except ActorDiedError:
+            # A controller that was killed (shutdown(), crash) can
+            # still hold the name for a beat — death observation is
+            # async. Wait it out, then start fresh.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    ray_tpu.get_actor(CONTROLLER_NAME)
+                except ValueError:
+                    break
+                time.sleep(0.05)
+    return ServeController.options(
+        name=CONTROLLER_NAME, num_cpus=0,
+        max_concurrency=16).remote()
 
 
 def _deploy_tree(app: Application, controller,
@@ -252,7 +317,8 @@ def _deploy_tree(app: Application, controller,
         resources["TPU"] = d.ray_actor_options["num_tpus"]
     ray_tpu.get(controller.deploy.remote(
         name, ser.dumps(d.cls), args, kwargs, d.num_replicas,
-        resources, d.autoscaling_config, d.user_config), timeout=120)
+        resources, d.autoscaling_config, d.user_config,
+        d.max_ongoing_requests), timeout=120)
     return name
 
 
@@ -264,12 +330,16 @@ def run(app: Application, *, name: str | None = None,
     global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
     controller = _ensure_controller()
     name = _deploy_tree(app, controller, root_name=name)
-    # wait until replicas are live
+    # Wait until the deployment is fully up: readiness gating keeps a
+    # spawned replica OUT of the routing set until its first healthy
+    # probe, so "non-empty" alone would return with stragglers still
+    # starting. Settle for partial availability only at the deadline.
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
-        version, replicas = ray_tpu.get(
-            controller.get_replicas.remote(name))
-        if replicas:
+        info = ray_tpu.get(
+            controller.list_deployments.remote()).get(name, {})
+        if info.get("num_replicas", 0) >= info.get("desired", 1) \
+                and not info.get("starting", 0):
             break
         time.sleep(0.1)
     from ray_tpu.serve.asgi import ASGI_MARKER
@@ -324,9 +394,11 @@ def get_app_handle(name: str) -> DeploymentHandle:
 class HTTPOptions:
     """HTTP proxy options (reference: serve.config.HTTPOptions).
     Honored fields: ``host`` and ``port`` (the proxy binds them);
-    ``location="NoServer"`` skips the proxy. The remaining reference
-    fields are accepted for signature compatibility and recorded but
-    have no effect in this proxy."""
+    ``location="NoServer"`` skips the proxy; ``request_timeout_s``
+    becomes the default end-to-end deadline for every request through
+    the proxy (per-request ``X-Request-Timeout-S`` headers override
+    it). The remaining reference fields are accepted for signature
+    compatibility and recorded but have no effect in this proxy."""
 
     host: str = "127.0.0.1"
     port: int = 8000
@@ -345,6 +417,7 @@ def start(*, http_port: int | None = None,
     global _proxy, _proxy_port, _grpc_proxy, _grpc_proxy_port
     _ensure_controller()
     host = "127.0.0.1"
+    request_timeout_s = None
     if http_options is not None:
         if isinstance(http_options, dict):
             http_options = HTTPOptions(**http_options)
@@ -353,6 +426,7 @@ def start(*, http_port: int | None = None,
             http_port = None
         else:
             host = http_options.host
+            request_timeout_s = http_options.request_timeout_s
             if http_port is None:
                 http_port = http_options.port
     if http_port is not None and _proxy is not None \
@@ -365,7 +439,8 @@ def start(*, http_port: int | None = None,
                                   or _proxy_port != http_port):
         from ray_tpu.serve.proxy import ProxyActor
         _proxy = ProxyActor.options(
-            num_cpus=0, max_concurrency=32).remote(http_port, host)
+            num_cpus=0, max_concurrency=32).remote(
+                http_port, host, request_timeout_s=request_timeout_s)
         _proxy_port = http_port
         ray_tpu.get(_proxy.ready.remote(), timeout=30)
     if grpc_port is not None and (_grpc_proxy is None
@@ -431,6 +506,11 @@ def deploy_config(config, *, _import_override: Callable | None = None):
 
     http_port = schema.http_options.get("port")
     grpc_port = schema.grpc_options.get("port")
+    if schema.http_options and http_port is not None:
+        # Boot the HTTP proxy through start() so http_options beyond
+        # the port (host, request_timeout_s) take effect; run() below
+        # reuses the proxy it finds bound on that port.
+        start(http_options=schema.http_options)
     handles: dict[str, DeploymentHandle] = {}
     deployed_names: set[str] = set()
     for app_schema in schema.applications:
@@ -496,7 +576,8 @@ def _apply_overrides(app: Application, app_schema) -> Application:
             d = d.options(
                 num_replicas=o.num_replicas,
                 ray_actor_options=o.ray_actor_options,
-                autoscaling_config=o.autoscaling_config)
+                autoscaling_config=o.autoscaling_config,
+                max_ongoing_requests=o.max_ongoing_requests)
             if o.user_config is not None:
                 d.user_config = o.user_config
         return Application(d, args, kwargs)
@@ -530,6 +611,16 @@ def shutdown() -> None:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         ray_tpu.get(controller.graceful_shutdown.remote(), timeout=30)
         ray_tpu.kill(controller)
+        # Block until the name unregisters (death observation is
+        # async): a serve.run() immediately after shutdown() must get
+        # a fresh controller, not a handle to the dying one.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get_actor(CONTROLLER_NAME)
+            except ValueError:
+                break
+            time.sleep(0.05)
     except ValueError:
         pass
     if _proxy is not None:

@@ -23,6 +23,21 @@ class AutoscalingConfig:
     downscale_delay_s: float = 2.0
     look_back_period_s: float = 5.0
 
+    def __post_init__(self) -> None:
+        if self.min_replicas < 0 or self.max_replicas < 1:
+            raise ValueError(
+                f"autoscaling bounds must satisfy min>=0, max>=1 "
+                f"(got min={self.min_replicas}, "
+                f"max={self.max_replicas})")
+        if self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f"autoscaling min_replicas={self.min_replicas} > "
+                f"max_replicas={self.max_replicas}")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError(
+                f"target_ongoing_requests must be > 0 "
+                f"(got {self.target_ongoing_requests})")
+
     @classmethod
     def from_dict(cls, d: dict) -> "AutoscalingConfig":
         return cls(**{k: v for k, v in d.items()

@@ -7,6 +7,24 @@ replicas to converge, respawns dead ones, and bumps a version so
 routers refresh their replica sets. Deployment autoscaling
 (autoscaling_state.py) runs inside the same loop: replica queue
 lengths recorded each pass drive the ceil(ongoing/target) policy.
+
+Replica health plane (reference: DeploymentState health checking):
+
+- **Readiness gating**: a spawned replica sits in ``starting`` —
+  receiving NO traffic — until its first successful ``probe()``
+  (stats + the user ``check_health()`` hook in one RPC) moves it into
+  the pushed routing table. One that never passes within
+  ``serve_replica_startup_timeout_s`` is torn down and respawned.
+- **Ejection**: ready replicas are probed every
+  ``serve_health_check_period_s``; ``serve_health_check_failure_threshold``
+  consecutive failures (probe error, timeout, or check_health raising)
+  eject the replica from the routing table, kill it, and respawn. A
+  replica whose actor is already DEAD is ejected immediately — there
+  is nothing to wait out.
+- **Graceful stopping**: scale-down / redeploy / node-drain victims
+  get ``prepare_stop()`` (replica sheds new work after a stale-router
+  grace, drains in-flight) and are reaped once idle or at the drain
+  deadline — both config knobs.
 """
 
 from __future__ import annotations
@@ -15,6 +33,8 @@ import threading
 import time
 
 import ray_tpu
+from ray_tpu.core.config import get_config
+from ray_tpu.core.exceptions import ActorDiedError
 from ray_tpu.serve.autoscaling import AutoscalingConfig, AutoscalingState
 from ray_tpu.serve.replica import Replica
 
@@ -27,15 +47,29 @@ class ServeController:
         # name -> spec dict(cls, args, kwargs, num_replicas, resources)
         self.desired: dict[str, dict] = {}
         self.replicas: dict[str, list] = {}
+        # name -> [(replica, spawn_ts)] — spawned, not yet past the
+        # readiness gate, receiving no traffic.
+        self.starting: dict[str, list] = {}
         self.versions: dict[str, int] = {}
         self.autoscaling: dict[str, AutoscalingState] = {}
         # name -> {model_id -> [replica indices]} from last probe
         self.model_map: dict[str, dict[str, list[int]]] = {}
+        # name -> {actor_id hex -> consecutive failed probes}
+        self.health: dict[str, dict[str, int]] = {}
+        # name -> {replica tag -> pid} from last probe (chaos tooling
+        # kills serve replicas by pid through this).
+        self.pids: dict[str, dict[str, int]] = {}
+        self._last_probe: dict[str, float] = {}
         # scale-down victims draining in-flight requests before kill:
-        # name -> [(replica, deadline)]
+        # name -> [(replica, deadline, not_before)]
         self.draining: dict[str, list] = {}
         self._stop = False
         self._rec_lock = threading.Lock()
+        from ray_tpu.util.metrics import Counter
+        self._m_ejections = Counter(
+            "ray_tpu_serve_health_ejections_total",
+            "replicas ejected from routing by failed health probes",
+            tag_keys=("deployment",))
         # Long-poll wakeups (reference: LongPollHost, long_poll.py:177)
         # — routers block in listen_for_change until a version bump.
         self._version_cv = threading.Condition()
@@ -53,7 +87,8 @@ class ServeController:
     def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
                num_replicas: int, resources: dict,
                autoscaling_config: dict | None = None,
-               user_config=None) -> bool:
+               user_config=None,
+               max_ongoing_requests: int | None = None) -> bool:
         from ray_tpu.core import serialization as ser
         old = self.desired.get(name)
         # ONE definition of "the replica-visible spec is unchanged":
@@ -63,7 +98,9 @@ class ServeController:
                      and old.get("cls_blob") == cls_blob
                      and old["args"] == init_args
                      and old["kwargs"] == init_kwargs
-                     and old["resources"] == (resources or {}))
+                     and old["resources"] == (resources or {})
+                     and old.get("max_ongoing_requests")
+                     == max_ongoing_requests)
         if same_spec and user_config != old.get("user_config"):
             # Lightweight update (reference: user_config semantics —
             # a redeploy changing ONLY user_config reconfigures live
@@ -73,8 +110,12 @@ class ServeController:
             # replica spawn. Runs even when autoscaling_config ALSO
             # changed — skipping it left live replicas silently
             # serving the old user_config (the redeploy dead zone).
+            # Starting replicas got the OLD config at construction,
+            # so they reconfigure too.
             errs = []
-            for r in self.replicas.get(name, []):
+            targets = list(self.replicas.get(name, [])) + \
+                [r for (r, _) in self.starting.get(name, [])]
+            for r in targets:
                 try:
                     ray_tpu.get(r.reconfigure.remote(user_config),
                                 timeout=30)
@@ -105,9 +146,13 @@ class ServeController:
             # redeploy silently keeps serving old code forever).
             # Under _rec_lock: the reconcile thread must not write a
             # stale `live` list back and resurrect popped replicas.
+            # Starting replicas never served: killed outright.
             with self._rec_lock:
                 for r in self.replicas.pop(name, []):
                     self._start_draining(name, r)
+                for (r, _) in self.starting.pop(name, []):
+                    self._kill_quietly(r)
+                self.health.pop(name, None)
         self.desired[name] = {
             "cls": ser.loads(cls_blob),
             "cls_blob": cls_blob,
@@ -116,6 +161,7 @@ class ServeController:
             "resources": resources or {},
             "user_config": user_config,
             "autoscaling_raw": autoscaling_config or None,
+            "max_ongoing_requests": max_ongoing_requests,
         }
         if autoscaling_config:
             cfg = AutoscalingConfig.from_dict(autoscaling_config)
@@ -185,8 +231,17 @@ class ServeController:
 
     def list_deployments(self) -> dict:
         return {name: {"num_replicas": len(self.replicas.get(name, [])),
+                       "starting": len(self.starting.get(name, [])),
                        "desired": spec["num_replicas"]}
                 for name, spec in self.desired.items()}
+
+    def replica_pids(self, name: str | None = None) -> dict:
+        """Pids of READY replicas — the seeded chaos killer's target
+        list (util/chaos.py kind="serve_replica"). One deployment:
+        ``{tag: pid}``; all: ``{deployment: {tag: pid}}``."""
+        if name is not None:
+            return dict(self.pids.get(name, {}))
+        return {n: dict(per) for n, per in self.pids.items()}
 
     # -- reconciliation --
 
@@ -225,25 +280,46 @@ class ServeController:
         except Exception:  # noqa: BLE001
             return {}
 
+    @staticmethod
+    def _kill_quietly(replica) -> None:
+        try:
+            ray_tpu.kill(replica)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _eject(self, name: str, replica, reason: str) -> None:
+        self.health.get(name, {}).pop(replica._actor_id.hex(), None)
+        self._m_ejections.inc(tags={"deployment": name})
+        self._kill_quietly(replica)
+
     def _reconcile_locked(self):
+        cfg = get_config()
         # remove deleted deployments
         for name in list(self.replicas):
             if name not in self.desired:
                 for r in self.replicas.pop(name):
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    self._kill_quietly(r)
+                for (r, _) in self.starting.pop(name, []):
+                    self._kill_quietly(r)
+                self.health.pop(name, None)
+                self.pids.pop(name, None)
                 self._bump_version(name)
+        for name in list(self.starting):
+            if name not in self.desired:
+                for (r, _) in self.starting.pop(name):
+                    self._kill_quietly(r)
         drain_nodes = self._draining_node_ids()
         actor_nodes = self._replica_nodes() if drain_nodes else {}
         for name, spec in self.desired.items():
             live = self.replicas.setdefault(name, [])
+            starting = self.starting.setdefault(name, [])
+            health = self.health.setdefault(name, {})
             # Drain-replace: a replica on a draining node leaves the
             # routing set NOW (replacements spawn below on surviving
             # nodes — the scheduler already excludes draining nodes)
             # and dies only after its in-flight requests finish,
-            # reusing the scale-down drain machinery.
+            # reusing the scale-down drain machinery. Starting
+            # replicas on a draining node never served: just killed.
             if drain_nodes:
                 keep = []
                 for r in live:
@@ -256,69 +332,145 @@ class ServeController:
                     live = keep
                     self.replicas[name] = live
                     self._bump_version(name)
-            # probe replicas: liveness + stats (queue lens, models)
-            alive, stats = [], []
+                keep_s = []
+                for (r, ts) in starting:
+                    if actor_nodes.get(r._actor_id.hex()) \
+                            in drain_nodes:
+                        self._kill_quietly(r)
+                    else:
+                        keep_s.append((r, ts))
+                starting[:] = keep_s
             changed = False
-            for r in live:
+            # Readiness gate: starting replicas are probed every pass;
+            # the first successful healthy probe admits them to the
+            # routing table. Never-ready ones are respawned after the
+            # startup timeout.
+            now = time.time()
+            still_starting = []
+            for (r, spawn_ts) in starting:
                 try:
-                    s = ray_tpu.get(r.stats.remote(), timeout=5)
-                    alive.append(r)
-                    stats.append(s)
-                except Exception:  # noqa: BLE001
+                    p = ray_tpu.get(
+                        r.probe.remote(),
+                        timeout=cfg.serve_health_check_timeout_s)
+                    if p.get("healthy"):
+                        live.append(r)
+                        health[r._actor_id.hex()] = 0
+                        changed = True
+                        continue
+                except ActorDiedError:
+                    changed = True      # crashed in __init__: respawn
+                    continue
+                except Exception:  # noqa: BLE001 — slow init: wait on
+                    pass
+                if now - spawn_ts > cfg.serve_replica_startup_timeout_s:
+                    self._kill_quietly(r)
                     changed = True
-            live = alive
-            # autoscaling decision from observed load
-            auto = self.autoscaling.get(name)
-            if auto is not None:
-                auto.record(sum(s["inflight"] for s in stats))
-                spec["num_replicas"] = auto.decide(spec["num_replicas"])
-            # model-locality map for the router; a residency change
-            # bumps the version so routers refresh their cached copy.
-            mmap: dict[str, list[int]] = {}
-            for i, s in enumerate(stats):
-                for mid in s.get("model_ids", []):
-                    mmap.setdefault(mid, []).append(i)
-            if mmap != self.model_map.get(name):
-                changed = True
-            self.model_map[name] = mmap
-            while len(live) < spec["num_replicas"]:
-                tag = f"{name}#{len(live)}_{int(time.time()*1e3)%100000}"
+                else:
+                    still_starting.append((r, spawn_ts))
+            starting[:] = still_starting
+            # Health plane for READY replicas, on its own cadence:
+            # consecutive probe failures up to the threshold keep the
+            # replica serving (one slow probe must not flap the
+            # table); a DEAD actor is ejected immediately.
+            probe_due = (now - self._last_probe.get(name, 0.0)
+                         >= cfg.serve_health_check_period_s)
+            stats = None
+            if probe_due and live:
+                self._last_probe[name] = now
+                alive, stats = [], []
+                refs = [(r, r.probe.remote()) for r in live]
+                for r, ref in refs:
+                    key = r._actor_id.hex()
+                    try:
+                        p = ray_tpu.get(
+                            ref,
+                            timeout=cfg.serve_health_check_timeout_s)
+                        if p.get("healthy"):
+                            health[key] = 0
+                            alive.append(r)
+                            stats.append(p)
+                            continue
+                        fails = health.get(key, 0) + 1
+                    except ActorDiedError:
+                        fails = cfg.serve_health_check_failure_threshold
+                    except Exception:  # noqa: BLE001
+                        fails = health.get(key, 0) + 1
+                    if fails >= cfg.serve_health_check_failure_threshold:
+                        self._eject(name, r, "failed health probes")
+                        changed = True
+                    else:
+                        health[key] = fails
+                        alive.append(r)     # still serving, on watch
+                live = alive
+                self.pids[name] = {
+                    s["tag"]: s["pid"] for s in stats if "pid" in s}
+                # autoscaling decision from observed load
+                auto = self.autoscaling.get(name)
+                if auto is not None:
+                    auto.record(sum(s["inflight"] for s in stats))
+                    spec["num_replicas"] = auto.decide(
+                        spec["num_replicas"])
+                # model-locality map for the router; a residency
+                # change bumps the version so routers refresh their
+                # cached copy.
+                mmap: dict[str, list[int]] = {}
+                for i, s in enumerate(stats):
+                    for mid in s.get("model_ids", []):
+                        mmap.setdefault(mid, []).append(i)
+                if mmap != self.model_map.get(name):
+                    changed = True
+                self.model_map[name] = mmap
+            while len(live) + len(starting) < spec["num_replicas"]:
+                n = len(live) + len(starting)
+                tag = f"{name}#{n}_{int(time.time()*1e3)%100000}"
                 resources = dict(spec["resources"])
-                live.append(Replica.options(
+                max_q = (spec.get("max_ongoing_requests")
+                         or cfg.serve_max_queue_len_per_replica)
+                starting.append((Replica.options(
                     num_cpus=resources.pop("CPU", 1.0),
                     num_tpus=resources.pop("TPU", 0) or None,
                     resources=resources or None,
-                    max_concurrency=8,
+                    # headroom over the queue bound so probe/control
+                    # calls never starve behind a full request queue
+                    max_concurrency=max(8, min(max_q, 64) + 4),
                 ).remote(spec["cls"], spec["args"], spec["kwargs"],
-                         tag, spec.get("user_config")))
-                changed = True
-            while len(live) > spec["num_replicas"]:
-                # Graceful scale-down: stop routing to the victim (it
-                # leaves the replica set now, version bump below) but
-                # only kill it once its in-flight requests drain —
-                # killing a busy replica fails user requests.
-                victim = live.pop()
-                self._start_draining(name, victim)
+                         tag, spec.get("user_config"),
+                         max_queue_len=spec.get("max_ongoing_requests")),
+                    time.time()))
+            while len(live) + len(starting) > spec["num_replicas"]:
+                # Graceful scale-down: never-ready spares die first;
+                # a serving victim stops routing NOW (version bump
+                # below) but is only killed once its in-flight
+                # requests drain — killing a busy replica fails user
+                # requests.
+                if starting:
+                    r, _ = starting.pop()
+                    self._kill_quietly(r)
+                elif live:
+                    victim = live.pop()
+                    health.pop(victim._actor_id.hex(), None)
+                    self._start_draining(name, victim)
                 changed = True
             self.replicas[name] = live
             self._reap_draining(name)
             if changed:
                 self._bump_version(name)
 
-    DRAIN_DEADLINE_S = 30.0
-    # routers hold the previous replica list until their long-poll
-    # refreshes: even an idle victim stays alive this long so a
-    # request routed on the stale list doesn't hit a killed actor
-    DRAIN_MIN_GRACE_S = 2.0
-
     def _start_draining(self, name: str, replica) -> None:
         """One definition of 'leave the routing set, die after
-        draining' — used by scale-down AND code-redeploy
-        replacement."""
+        draining' — used by scale-down, code-redeploy replacement AND
+        node drain. prepare_stop() flips the replica to stopping:
+        after the stale-router grace it sheds new requests (the retry
+        plane re-dispatches them) while in-flight ones finish."""
+        cfg = get_config()
+        try:
+            replica.prepare_stop.remote()    # fire-and-forget
+        except Exception:  # noqa: BLE001 — already dead
+            pass
         now = time.time()
         self.draining.setdefault(name, []).append(
-            (replica, now + self.DRAIN_DEADLINE_S,
-             now + self.DRAIN_MIN_GRACE_S))
+            (replica, now + cfg.serve_drain_deadline_s,
+             now + cfg.serve_drain_min_grace_s))
 
     def _reap_draining(self, name: str) -> None:
         still = []
@@ -328,15 +480,14 @@ class ServeController:
             done = now > deadline
             if not done and now >= not_before:
                 try:
-                    done = ray_tpu.get(victim.queue_len.remote(),
-                                       timeout=5) == 0
+                    done = ray_tpu.get(
+                        victim.queue_len.remote(),
+                        timeout=get_config().serve_queue_probe_timeout_s
+                    ) == 0
                 except Exception:  # noqa: BLE001 — already dead
                     done = True
             if done:
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:  # noqa: BLE001
-                    pass
+                self._kill_quietly(victim)
             else:
                 still.append(entry)
         if still:

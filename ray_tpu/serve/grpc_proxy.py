@@ -28,6 +28,14 @@ reference's generic gRPC ingress:
   (``/…/countsStreaming`` dispatches the replica method ``counts``
   as a generator) — gRPC's generic handler cannot see the client's
   call type, so the suffix IS the contract.
+
+Request robustness mirrors the HTTP proxy: unary calls ride the
+router's retry plane (``Router.call``), client deadlines
+(``context.time_remaining()``) propagate proxy → router → replica,
+overload / retries-exhausted aborts ``UNAVAILABLE``, expired deadlines
+abort ``DEADLINE_EXCEEDED``, and past ``serve_proxy_max_inflight``
+concurrent requests the proxy sheds with ``UNAVAILABLE`` before
+touching the routing plane.
 """
 
 from __future__ import annotations
@@ -40,6 +48,22 @@ import ray_tpu
 
 PICKLE_CTYPE = "application/x-pickle"
 JSON_CTYPE = "application/json"
+
+
+def grpc_code_name(e: BaseException) -> str:
+    """``grpc.StatusCode`` attribute name for a failed routed request.
+
+    Kept import-free (string names, not StatusCode members) so the
+    mapping is golden-testable without a grpc runtime; the servicer
+    resolves the name via ``getattr(grpc.StatusCode, name)``.
+    """
+    from ray_tpu.serve.exceptions import classify
+    kind = classify(e)
+    if kind in ("overload", "replica_busy"):
+        return "UNAVAILABLE"
+    if kind == "deadline":
+        return "DEADLINE_EXCEEDED"
+    return "INTERNAL"
 
 
 def _pickle_loads(b: bytes):
@@ -58,12 +82,29 @@ def _pickle_dumps(v) -> bytes:
 
 @ray_tpu.remote
 class GRPCProxyActor:
-    def __init__(self, port: int, auth_token: str = ""):
+    def __init__(self, port: int, auth_token: str = "",
+                 request_timeout_s: float | None = None,
+                 max_inflight: int | None = None):
+        from ray_tpu.core.config import get_config
+        cfg = get_config()
         self.port = port
         self.auth_token = auth_token
+        # Default end-to-end deadline when the client sets none
+        # (0/None = none); a client gRPC deadline always wins.
+        self._timeout_s = (request_timeout_s
+                           if request_timeout_s is not None
+                           else (cfg.serve_request_deadline_s or None))
+        self._max_inflight = (max_inflight if max_inflight is not None
+                              else cfg.serve_proxy_max_inflight)
+        self._inflight = 0      # event-loop-thread only
         self.routes: dict[str, str] = {}     # route_prefix -> deployment
         self._routers: dict[str, object] = {}
         self._controller = None
+        from ray_tpu.util.metrics import Counter
+        self._m_shed = Counter(
+            "ray_tpu_serve_proxy_shed_total",
+            "requests shed at the proxy in-flight cap",
+            tag_keys=("proxy",)).set_default_tags({"proxy": "grpc"})
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve_forever,
                                         daemon=True)
@@ -173,6 +214,18 @@ class GRPCProxyActor:
                 return _pickle_dumps(v)
             return json.dumps(v).encode()
 
+        def _deadline_ts(context) -> float:
+            """Absolute unix deadline for this call (0 = none): the
+            client's gRPC deadline (``time_remaining()``) wins, else
+            the proxy's configured default applies."""
+            import time as _time
+            remaining = context.time_remaining()
+            if remaining is not None:
+                return _time.time() + max(0.0, remaining)
+            if proxy._timeout_s:
+                return _time.time() + proxy._timeout_s
+            return 0.0
+
         def _make_unary(method_name: str):
             async def unary(request: bytes, context):
                 md = _md(context)
@@ -181,23 +234,36 @@ class GRPCProxyActor:
                     await context.abort(
                         grpc.StatusCode.NOT_FOUND,
                         "no matching application")
+                # In-flight cap: shed before decoding the body or
+                # touching the routing plane.
+                if proxy._inflight >= proxy._max_inflight:
+                    proxy._m_shed.inc()
+                    await context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"proxy at in-flight cap "
+                        f"({proxy._max_inflight}); retry later")
                 arg, ctype = await _decode(request, md, context)
                 router = proxy._router_for(target)
+                deadline_ts = _deadline_ts(context)
                 loop = asyncio.get_running_loop()
 
                 def call():
-                    ref = router.assign(
+                    return router.call(
                         method_name, (arg,), {},
                         multiplexed_model_id=md.get(
-                            "multiplexed_model_id", ""))
-                    return ray_tpu.get(ref, timeout=120)
+                            "multiplexed_model_id", ""),
+                        deadline_ts=deadline_ts)
 
+                proxy._inflight += 1
                 try:
                     result = await loop.run_in_executor(None, call)
-                    return _encode(result, ctype)
                 except Exception as e:  # noqa: BLE001
-                    await context.abort(grpc.StatusCode.INTERNAL,
-                                        str(e)[:500])
+                    await context.abort(
+                        getattr(grpc.StatusCode, grpc_code_name(e)),
+                        str(e)[:500])
+                finally:
+                    proxy._inflight -= 1
+                return _encode(result, ctype)
             return unary
 
         def _make_stream(method_name: str):
@@ -249,7 +315,8 @@ class GRPCProxyActor:
                             return
                         if tag is ERR:
                             await context.abort(
-                                grpc.StatusCode.INTERNAL,
+                                getattr(grpc.StatusCode,
+                                        grpc_code_name(item)),
                                 str(item)[:500])
                         try:
                             body = _encode(item, ctype)

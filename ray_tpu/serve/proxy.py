@@ -3,6 +3,22 @@
 Reference analog: ProxyActor/HTTPProxy (proxy.py:1140,766). Routes
 ``<route_prefix>`` to the matching deployment's router; request bodies
 parse as JSON (or raw bytes fall through), responses JSON-encode.
+
+Request robustness at the edge:
+
+- Every request goes through the router's retry plane
+  (``Router.call``): replica death / drain / shed mid-request is
+  re-dispatched transparently, with ledger dedupe replica-side.
+- **Load shedding**: past ``serve_proxy_max_inflight`` concurrent
+  requests the proxy answers 503 + ``Retry-After`` immediately,
+  without touching the routing plane — overload degrades to fast,
+  honest rejections instead of a timeout pile-up.
+- **Deadlines**: ``X-Request-Timeout-S`` header (or the proxy's
+  configured ``request_timeout_s``) becomes an end-to-end deadline
+  propagated proxy → router → replica; an expired request is answered
+  504 and never executed.
+- **Transport mapping**: overload / retries-exhausted → 503 with
+  Retry-After, deadline → 504, everything else (user exception) → 500.
 """
 
 from __future__ import annotations
@@ -12,15 +28,54 @@ import threading
 
 import ray_tpu
 
+_RETRY_AFTER_S = "1"
+
+
+def error_response(e: BaseException):
+    """(status, headers, body-dict) for a failed routed request —
+    shared by the JSON and ASGI paths and golden-tested."""
+    from ray_tpu.serve.exceptions import classify
+    kind = classify(e)
+    if kind in ("overload", "replica_busy"):
+        return (503, {"Retry-After": _RETRY_AFTER_S},
+                {"error": "overloaded", "detail": str(e)[:500]})
+    if kind == "deadline":
+        return (504, {},
+                {"error": "deadline exceeded", "detail": str(e)[:500]})
+    return (500, {}, {"error": str(e)[:500]})
+
 
 @ray_tpu.remote
 class ProxyActor:
-    def __init__(self, port: int, host: str = "127.0.0.1"):
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 request_timeout_s: float | None = None,
+                 max_inflight: int | None = None,
+                 retry_enabled: bool | None = None):
+        from ray_tpu.core.config import get_config
+        cfg = get_config()
         self.port = port
         self.host = host
+        # None = follow cfg.serve_retry_enabled; the perf guardrail
+        # spawns a second proxy with retry_enabled=False to measure
+        # the disabled-path overhead (config flips in the driver don't
+        # reach an already-spawned actor process).
+        self._retry = retry_enabled
+        # Default end-to-end deadline (0/None = none); per-request
+        # X-Request-Timeout-S headers override it.
+        self._timeout_s = (request_timeout_s
+                           if request_timeout_s is not None
+                           else (cfg.serve_request_deadline_s or None))
+        self._max_inflight = (max_inflight if max_inflight is not None
+                              else cfg.serve_proxy_max_inflight)
+        self._inflight = 0      # event-loop-thread only
         self.routes: dict[str, str] = {}     # route_prefix -> deployment
         self._routers: dict[str, object] = {}
         self._controller = None
+        from ray_tpu.util.metrics import Counter
+        self._m_shed = Counter(
+            "ray_tpu_serve_proxy_shed_total",
+            "requests shed at the proxy in-flight cap (HTTP 503)",
+            tag_keys=("proxy",)).set_default_tags({"proxy": "http"})
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve_forever,
                                         daemon=True)
@@ -41,9 +96,22 @@ class ProxyActor:
             if self._controller is None:
                 self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
             self._routers[deployment] = Router.for_deployment(
-                self._controller,
-                                               deployment)
+                self._controller, deployment)
         return self._routers[deployment]
+
+    def _deadline_for(self, request) -> float:
+        """Per-request deadline: header beats proxy default beats
+        none. Returned as an absolute unix timestamp (0 = none)."""
+        import time as _time
+        raw = request.headers.get("X-Request-Timeout-S")
+        if raw:
+            try:
+                return _time.time() + max(0.0, float(raw))
+            except ValueError:
+                pass
+        if self._timeout_s:
+            return _time.time() + self._timeout_s
+        return 0.0
 
     def _serve_forever(self):
         import asyncio
@@ -64,74 +132,29 @@ class ProxyActor:
             if target is None:
                 return web.json_response(
                     {"error": f"no route for {path}"}, status=404)
+            # In-flight cap: shed NOW, before reading the body or
+            # touching the router — an overloaded proxy must stay a
+            # fast 503 machine, not a growing queue of hung sockets.
+            if self._inflight >= self._max_inflight:
+                self._m_shed.inc()
+                return web.json_response(
+                    {"error": "overloaded",
+                     "detail": f"proxy at in-flight cap "
+                               f"({self._max_inflight})"},
+                    status=503,
+                    headers={"Retry-After": _RETRY_AFTER_S})
             # Route entries are {"name", "asgi"} dicts (legacy plain
             # strings still accepted).
             if isinstance(target, dict):
                 name, is_asgi = target["name"], target.get("asgi")
             else:
                 name, is_asgi = target, False
-            body = await request.read()
-            router = self._router_for(name)
-            loop = asyncio.get_running_loop()
-
-            if is_asgi:
-                # ASGI mount (reference: HTTPProxy ASGI path,
-                # proxy.py:766): ship the raw request; the replica
-                # drives the app and returns status/headers/body.
-                sub = path[len(matched_prefix.rstrip("/")):] or "/"
-                asgi_req = {
-                    "__asgi__": True,
-                    "method": request.method,
-                    "path": sub,
-                    "root_path": matched_prefix.rstrip("/"),
-                    "query_string":
-                        request.query_string.encode(),
-                    "headers": [(k, v) for k, v
-                                in request.headers.items()],
-                    "body": body,
-                }
-
-                def call_asgi():
-                    ref = router.assign("__call__", (asgi_req,), {})
-                    return ray_tpu.get(ref, timeout=120)
-
-                try:
-                    out = await loop.run_in_executor(None, call_asgi)
-                except Exception as e:  # noqa: BLE001
-                    return web.json_response(
-                        {"error": str(e)[:500]}, status=500)
-                resp = web.Response(status=out.get("status", 200),
-                                    body=out.get("body", b""))
-                for k, v in out.get("headers", []):
-                    if k.lower() not in ("content-length",
-                                         "transfer-encoding"):
-                        # add(), not assignment: duplicate headers
-                        # (multiple Set-Cookie) must all survive.
-                        resp.headers.add(k, v)
-                return resp
-
-            if body:
-                try:
-                    payload = json.loads(body)
-                except json.JSONDecodeError:
-                    payload = body.decode("utf-8", "replace")
-            else:
-                payload = dict(request.query)
-
-            def call():
-                ref = router.assign("__call__", (payload,), {})
-                return ray_tpu.get(ref, timeout=120)
-
+            self._inflight += 1
             try:
-                result = await loop.run_in_executor(None, call)
-            except Exception as e:  # noqa: BLE001
-                return web.json_response(
-                    {"error": str(e)[:500]}, status=500)
-            if isinstance(result, (bytes, str)):
-                return web.Response(
-                    body=result if isinstance(result, bytes)
-                    else result.encode())
-            return web.json_response(result)
+                return await self._dispatch(
+                    request, path, matched_prefix, name, is_asgi)
+            finally:
+                self._inflight -= 1
 
         async def run():
             app = web.Application()
@@ -145,3 +168,76 @@ class ProxyActor:
                 await asyncio.sleep(3600)
 
         asyncio.new_event_loop().run_until_complete(run())
+
+    async def _dispatch(self, request, path, matched_prefix, name,
+                        is_asgi):
+        import asyncio
+
+        from aiohttp import web
+        body = await request.read()
+        router = self._router_for(name)
+        deadline_ts = self._deadline_for(request)
+        loop = asyncio.get_running_loop()
+
+        if is_asgi:
+            # ASGI mount (reference: HTTPProxy ASGI path,
+            # proxy.py:766): ship the raw request; the replica
+            # drives the app and returns status/headers/body.
+            sub = path[len(matched_prefix.rstrip("/")):] or "/"
+            asgi_req = {
+                "__asgi__": True,
+                "method": request.method,
+                "path": sub,
+                "root_path": matched_prefix.rstrip("/"),
+                "query_string":
+                    request.query_string.encode(),
+                "headers": [(k, v) for k, v
+                            in request.headers.items()],
+                "body": body,
+            }
+
+            def call_asgi():
+                return router.call("__call__", (asgi_req,), {},
+                                   deadline_ts=deadline_ts,
+                                   retry=self._retry)
+
+            try:
+                out = await loop.run_in_executor(None, call_asgi)
+            except Exception as e:  # noqa: BLE001
+                status, headers, payload = error_response(e)
+                return web.json_response(payload, status=status,
+                                         headers=headers)
+            resp = web.Response(status=out.get("status", 200),
+                                body=out.get("body", b""))
+            for k, v in out.get("headers", []):
+                if k.lower() not in ("content-length",
+                                     "transfer-encoding"):
+                    # add(), not assignment: duplicate headers
+                    # (multiple Set-Cookie) must all survive.
+                    resp.headers.add(k, v)
+            return resp
+
+        if body:
+            try:
+                payload = json.loads(body)
+            except json.JSONDecodeError:
+                payload = body.decode("utf-8", "replace")
+        else:
+            payload = dict(request.query)
+
+        def call():
+            return router.call("__call__", (payload,), {},
+                               deadline_ts=deadline_ts,
+                               retry=self._retry)
+
+        try:
+            result = await loop.run_in_executor(None, call)
+        except Exception as e:  # noqa: BLE001
+            status, headers, out = error_response(e)
+            return web.json_response(out, status=status,
+                                     headers=headers)
+        if isinstance(result, (bytes, str)):
+            return web.Response(
+                body=result if isinstance(result, bytes)
+                else result.encode())
+        return web.json_response(result)

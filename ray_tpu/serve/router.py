@@ -1,13 +1,28 @@
-"""Request router: power-of-two-choices replica selection.
+"""Request router: power-of-two-choices replica selection + the
+request-level retry/replay plane.
 
 Reference analogs: PowerOfTwoChoicesReplicaScheduler
 (replica_scheduler/pow_2_scheduler.py:51) + LongPollClient
-(long_poll.py:64). Routing state is PUSHED: one process-wide
-LongPollClient keeps a single multiplexed ``listen_for_change`` call
-outstanding against the controller for ALL routers in this process and
-swaps their cached snapshots when it returns. The steady-state request
-path (pick_replica) touches only the cache and the two sampled
-replicas' queue-length probes: zero controller RPCs per request.
+(long_poll.py:64) + handle retry semantics (router.py request
+re-dispatch on replica failure). Routing state is PUSHED: one
+process-wide LongPollClient keeps a single multiplexed
+``listen_for_change`` call outstanding against the controller for ALL
+routers in this process and swaps their cached snapshots when it
+returns. The steady-state request path (pick_replica) touches only
+the cache and the two sampled replicas' queue-length probes: zero
+controller RPCs per request.
+
+Retry plane (``call``): each request gets an id + attempt budget; a
+dispatch that dies with the replica (ActorDiedError / channel reset)
+or is shed by a stopping/overloaded replica is re-dispatched to
+another healthy replica, skipping the one that just failed. The id
+rides to the replica's executed-response ledger so a replay whose
+first execution actually finished is answered from the ledger, not
+re-run. An EMPTY routing table (rolling redeploy gap) is waited out
+under ``serve_no_replica_wait_s`` without charging attempts. With
+``serve_retry_enabled`` off the dispatch path is byte-for-byte the
+pre-retry one — no ids, no pending accounting (the ≤5% overhead
+guardrail in tests/test_perf.py compares the two).
 """
 
 from __future__ import annotations
@@ -15,8 +30,23 @@ from __future__ import annotations
 import random
 import threading
 import time
+import uuid
 
 import ray_tpu
+from ray_tpu.core.config import get_config
+from ray_tpu.serve.exceptions import (
+    DeploymentOverloadedError,
+    ReplicaOverloadedError,
+    RequestDeadlineError,
+    RequestRetriesExhaustedError,
+    classify,
+)
+
+
+class NoReplicasError(RuntimeError):
+    """The routing table is (still) empty — deployment coming up,
+    scaled to zero, or mid-redeploy. Message kept compatible with the
+    pre-retry RuntimeError."""
 
 
 class LongPollClient:
@@ -85,7 +115,7 @@ class LongPollClient:
             try:
                 updates = ray_tpu.get(
                     self._controller.listen_for_change.remote(known),
-                    timeout=60)
+                    timeout=get_config().serve_longpoll_timeout_s)
                 backoff = 0.5
             except Exception:  # noqa: BLE001 — controller down/busy
                 if self._stop:
@@ -116,6 +146,45 @@ class LongPollClient:
                         r._apply(state)
 
 
+class RequestContext:
+    """Carries one routed request's retry state alongside its first
+    object ref (attached to DeploymentResponse): the same request id
+    (for ledger dedupe), the deadline, and the pending-count slot to
+    release exactly once."""
+
+    __slots__ = ("router", "method_name", "args", "kwargs",
+                 "model_id", "request_id", "deadline_ts",
+                 "_pending_key", "_done")
+
+    def __init__(self, router, method_name, args, kwargs, model_id,
+                 request_id, deadline_ts, pending_key):
+        self.router = router
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+        self.model_id = model_id
+        self.request_id = request_id
+        self.deadline_ts = deadline_ts
+        self._pending_key = pending_key
+        self._done = False
+
+    def finish(self) -> None:
+        if not self._done:
+            self._done = True
+            if self._pending_key is not None:
+                self.router._pending_dec(self._pending_key)
+
+    def retry(self, first_error, timeout=None):
+        """Continue the attempt budget after the first (assign-path)
+        dispatch failed retryably; same request id → ledger dedupe."""
+        self.finish()
+        return self.router.call(
+            self.method_name, self.args, self.kwargs,
+            multiplexed_model_id=self.model_id, timeout=timeout,
+            deadline_ts=self.deadline_ts, request_id=self.request_id,
+            attempts_used=1, first_error=first_error)
+
+
 class Router:
     # One router per (controller, deployment) per process: handles are
     # created freely (serve.run, get_deployment_handle, __reduce__ on
@@ -144,6 +213,10 @@ class Router:
         self._version = -1
         self._rng = random.Random()
         self._lock = threading.Lock()
+        # Locally-dispatched-but-unresolved requests per replica key:
+        # added to the probed queue depth so pow-2 sees work this
+        # process has in flight before the replica even received it.
+        self._pending: dict[str, int] = {}
         # Counts synchronous controller round-trips — steady state
         # must not grow this (asserted by tests/benchmarks).
         self.controller_rpcs = 0
@@ -152,6 +225,8 @@ class Router:
         # replica-side latency histogram is the other). Created lazily
         # so constructing a Router off a live session costs nothing.
         self._m_requests = None
+        self._m_retries = None
+        self._m_shed = None
         self._longpoll = LongPollClient.for_controller(controller)
         self._longpoll.register(self)
 
@@ -175,11 +250,35 @@ class Router:
         self.controller_rpcs += 1
         self._apply(ray_tpu.get(
             self._controller.get_routing_state.remote(self._name),
-            timeout=30))
+            timeout=get_config().serve_refresh_timeout_s))
+
+    def _invalidate(self) -> None:
+        with self._lock:
+            self._version = -1
+
+    # -- pending accounting (retry plane only) --
+
+    @staticmethod
+    def _key(replica) -> str:
+        aid = getattr(replica, "_actor_id", None)
+        return aid.hex() if hasattr(aid, "hex") else str(aid)
+
+    def _pending_inc(self, key: str) -> None:
+        with self._lock:
+            self._pending[key] = self._pending.get(key, 0) + 1
+
+    def _pending_dec(self, key: str) -> None:
+        with self._lock:
+            n = self._pending.get(key, 0) - 1
+            if n > 0:
+                self._pending[key] = n
+            else:
+                self._pending.pop(key, None)
 
     # -- hot path --
 
-    def pick_replica(self, multiplexed_model_id: str = ""):
+    def pick_replica(self, multiplexed_model_id: str = "",
+                     exclude: set | None = None):
         with self._lock:
             replicas = self._replicas
             model_map = self._model_map
@@ -191,9 +290,15 @@ class Router:
                 replicas = self._replicas
                 model_map = self._model_map
             if not replicas:
-                raise RuntimeError(
+                raise NoReplicasError(
                     f"deployment {self._name!r} has no replicas")
         pool = replicas
+        if exclude:
+            pool = [r for r in pool if self._key(r) not in exclude]
+            if not pool:
+                raise NoReplicasError(
+                    f"deployment {self._name!r} has no replicas "
+                    f"outside the excluded set")
         if multiplexed_model_id:
             # Model-locality-aware pick (reference: multiplex-aware
             # pow-2): prefer replicas with the model resident, from
@@ -201,6 +306,9 @@ class Router:
             idxs = model_map.get(multiplexed_model_id, [])
             with_model = [replicas[i] for i in idxs
                           if i < len(replicas)]
+            if exclude:
+                with_model = [r for r in with_model
+                              if self._key(r) not in exclude]
             if with_model:
                 pool = with_model
         if len(pool) == 1:
@@ -209,16 +317,17 @@ class Router:
         try:
             qa, qb = ray_tpu.get(
                 [a.queue_len.remote(), b.queue_len.remote()],
-                timeout=5)
+                timeout=get_config().serve_queue_probe_timeout_s)
         except Exception:  # noqa: BLE001 — probe failure: let the
             # long-poll (or next cold refresh) repair the set
-            with self._lock:
-                self._version = -1
+            self._invalidate()
             return a
+        with self._lock:
+            qa += self._pending.get(self._key(a), 0)
+            qb += self._pending.get(self._key(b), 0)
         return a if qa <= qb else b
 
-    def assign(self, method_name: str, args, kwargs,
-               multiplexed_model_id: str = "", stream: bool = False):
+    def _count_request(self) -> None:
         if self._m_requests is None:
             from ray_tpu.util.metrics import Counter
             self._m_requests = Counter(
@@ -226,14 +335,220 @@ class Router:
                 "requests routed per deployment",
                 tag_keys=("deployment",))
         self._m_requests.inc(tags={"deployment": self._name})
+
+    def _count_retry(self) -> None:
+        if self._m_retries is None:
+            from ray_tpu.util.metrics import Counter
+            self._m_retries = Counter(
+                "ray_tpu_serve_request_retries_total",
+                "request re-dispatches after a retryable failure",
+                tag_keys=("deployment",))
+        self._m_retries.inc(tags={"deployment": self._name})
+
+    def _count_shed(self) -> None:
+        if self._m_shed is None:
+            from ray_tpu.util.metrics import Counter
+            self._m_shed = Counter(
+                "ray_tpu_serve_requests_shed_total",
+                "requests shed as overloaded (503/UNAVAILABLE)",
+                tag_keys=("deployment",))
+        self._m_shed.inc(tags={"deployment": self._name})
+
+    @staticmethod
+    def _default_deadline(deadline_ts: float) -> float:
+        if deadline_ts:
+            return deadline_ts
+        d = get_config().serve_request_deadline_s
+        return time.time() + d if d > 0 else 0.0
+
+    def assign(self, method_name: str, args, kwargs,
+               multiplexed_model_id: str = "", stream: bool = False):
+        ref, _ctx = self.assign_ctx(
+            method_name, args, kwargs,
+            multiplexed_model_id=multiplexed_model_id, stream=stream)
+        return ref
+
+    def assign_ctx(self, method_name: str, args, kwargs,
+                   multiplexed_model_id: str = "",
+                   stream: bool = False, deadline_ts: float = 0.0):
+        """Dispatch once, returning (ref, RequestContext|None). The
+        context (non-streaming, retry plane on) lets
+        DeploymentResponse.result() continue the attempt budget with
+        the same request id if this first dispatch fails retryably."""
+        self._count_request()
+        cfg = get_config()
+        retry_on = cfg.serve_retry_enabled and not stream
+        deadline_ts = self._default_deadline(deadline_ts)
+        request_id = uuid.uuid4().hex if retry_on else ""
         replica = self.pick_replica(multiplexed_model_id)
         method = replica.handle_request
         if stream:
             # Streaming response (reference: serve generators /
             # StreamingResponse): the user method returns a generator
-            # and items flow back as they are produced.
+            # and items flow back as they are produced. No replay:
+            # a generator that died mid-stream is not re-dispatched.
             method = method.options(num_returns="streaming")
-        return method.remote(
+            return method.remote(
+                method_name, args, kwargs,
+                multiplexed_model_id=multiplexed_model_id,
+                stream=True), None
+        ctx = None
+        if retry_on:
+            key = self._key(replica)
+            self._pending_inc(key)
+            ctx = RequestContext(self, method_name, args, kwargs,
+                                 multiplexed_model_id, request_id,
+                                 deadline_ts, key)
+        ref = method.remote(
             method_name, args, kwargs,
             multiplexed_model_id=multiplexed_model_id,
-            stream=stream)
+            stream=False, request_id=request_id,
+            deadline_ts=deadline_ts)
+        return ref, ctx
+
+    def call(self, method_name: str, args, kwargs,
+             multiplexed_model_id: str = "", timeout: float | None = None,
+             deadline_ts: float = 0.0, retry: bool | None = None,
+             request_id: str | None = None, attempts_used: int = 0,
+             first_error=None):
+        """Blocking request with the full retry/replay plane — the
+        proxies' path, and DeploymentResponse.result()'s continuation
+        path. Returns the response value or raises a terminal error
+        (user exception, DeploymentOverloadedError,
+        RequestRetriesExhaustedError, RequestDeadlineError)."""
+        cfg = get_config()
+        retry_on = cfg.serve_retry_enabled if retry is None else retry
+        if attempts_used == 0:
+            self._count_request()
+        deadline_ts = self._default_deadline(deadline_ts)
+        per_call = timeout if timeout is not None \
+            else cfg.serve_call_timeout_s
+
+        if not retry_on:
+            # The measured "disabled path": one pick, one dispatch,
+            # no ids, no pending accounting — pre-retry behavior.
+            replica = self.pick_replica(multiplexed_model_id)
+            ref = replica.handle_request.remote(
+                method_name, args, kwargs,
+                multiplexed_model_id=multiplexed_model_id,
+                stream=False)
+            return ray_tpu.get(ref, timeout=per_call)
+
+        if request_id is None:
+            request_id = uuid.uuid4().hex
+        overall_deadline = time.time() + per_call
+        max_attempts = 1 + max(0, cfg.serve_request_max_retries)
+        attempt = attempts_used
+        last_err = first_error
+        # None = no failure observed yet; thereafter ANDed across
+        # failures — terminal overload (503) is only raised when every
+        # attempt was shed by a full queue, never for deaths.
+        overload_only: bool | None = None
+        if first_error is not None:
+            attempt = max(attempt, 1)
+            kind = classify(first_error)
+            overload_only = (kind == "replica_busy"
+                             and _is_overload(first_error))
+            if kind == "replica_died":
+                self._invalidate()
+            self._count_retry()
+        excluded: set[str] = set()
+        empty_until = None
+        while attempt < max_attempts:
+            now = time.time()
+            if deadline_ts and now > deadline_ts:
+                self._raise_deadline(request_id, last_err)
+            if now > overall_deadline:
+                break
+            try:
+                replica = self.pick_replica(multiplexed_model_id,
+                                            exclude=excluded or None)
+            except NoReplicasError as e:
+                # Rolling-redeploy gap: wait it out (bounded, not
+                # charged to the attempt budget) instead of failing
+                # an accepted request because the table is briefly
+                # empty between old replicas stopping and new ones
+                # passing readiness.
+                if empty_until is None:
+                    empty_until = time.time() + \
+                        cfg.serve_no_replica_wait_s
+                if time.time() >= empty_until or \
+                        (deadline_ts and time.time() > deadline_ts):
+                    last_err = last_err or e
+                    break
+                excluded.clear()
+                self._invalidate()
+                time.sleep(0.1)
+                continue
+            empty_until = None
+            key = self._key(replica)
+            self._pending_inc(key)
+            try:
+                budget = overall_deadline - time.time()
+                if deadline_ts:
+                    budget = min(budget, deadline_ts - time.time())
+                ref = replica.handle_request.remote(
+                    method_name, args, kwargs,
+                    multiplexed_model_id=multiplexed_model_id,
+                    stream=False, request_id=request_id,
+                    deadline_ts=deadline_ts)
+                return ray_tpu.get(ref, timeout=max(0.01, budget))
+            except Exception as e:  # noqa: BLE001 — classified below
+                kind = classify(e)
+                if kind == "deadline":
+                    self._raise_deadline(request_id, e)
+                if kind == "error":
+                    if _is_get_timeout(e) and deadline_ts and \
+                            time.time() > deadline_ts:
+                        self._raise_deadline(request_id, e)
+                    raise
+                # Retryable: skip this replica, note the flavor, and
+                # go around (replica death also invalidates the
+                # cached table so the refreshed one drops it).
+                last_err = e
+                excluded.add(key)
+                if kind == "replica_died":
+                    self._invalidate()
+                    overload_only = False
+                else:
+                    is_over = _is_overload(e)
+                    overload_only = (is_over if overload_only is None
+                                     else overload_only and is_over)
+                attempt += 1
+                self._count_retry()
+                if attempt < max_attempts:
+                    time.sleep(cfg.serve_retry_backoff_s
+                               * (2 ** (attempt - 1))
+                               * random.uniform(0.5, 1.5))
+            finally:
+                self._pending_dec(key)
+        if overload_only:
+            self._count_shed()
+            raise DeploymentOverloadedError(
+                f"deployment {self._name!r}: every replica shed "
+                f"request {request_id} ({attempt} attempts) — "
+                f"back off and retry") from last_err
+        self._count_shed()
+        raise RequestRetriesExhaustedError(
+            f"deployment {self._name!r}: request {request_id} failed "
+            f"after {attempt} attempts; last error: "
+            f"{type(last_err).__name__ if last_err else 'n/a'}: "
+            f"{str(last_err)[:300]}") from last_err
+
+    @staticmethod
+    def _raise_deadline(request_id: str, cause) -> None:
+        if isinstance(cause, RequestDeadlineError):
+            raise cause
+        raise RequestDeadlineError(
+            f"request {request_id} deadline expired") from cause
+
+
+def _is_overload(exc) -> bool:
+    if isinstance(exc, ReplicaOverloadedError):
+        return True
+    return "ReplicaOverloadedError" in \
+        (getattr(exc, "traceback_str", "") or "")
+
+
+def _is_get_timeout(exc) -> bool:
+    return type(exc).__name__ in ("GetTimeoutError", "TimeoutError")

@@ -42,6 +42,7 @@ class DeploymentOverride:
     ray_actor_options: dict | None = None
     autoscaling_config: dict | None = None
     user_config: Any = None
+    max_ongoing_requests: int | None = None
 
     @staticmethod
     def from_dict(d: dict, where: str) -> "DeploymentOverride":
@@ -49,7 +50,7 @@ class DeploymentOverride:
             raise ValueError(f"{where}: expected a mapping, got {d!r}")
         unknown = set(d) - {"name", "num_replicas",
                             "ray_actor_options", "autoscaling_config",
-                            "user_config"}
+                            "user_config", "max_ongoing_requests"}
         if unknown:
             raise ValueError(
                 f"{where}: unknown field(s) {sorted(unknown)}")
@@ -59,11 +60,17 @@ class DeploymentOverride:
         if nr is not None and (not isinstance(nr, int) or nr < 0):
             raise ValueError(
                 f"{where}.num_replicas: expected int >= 0, got {nr!r}")
+        moq = d.get("max_ongoing_requests")
+        if moq is not None and (not isinstance(moq, int) or moq < 1):
+            raise ValueError(
+                f"{where}.max_ongoing_requests: expected int >= 1, "
+                f"got {moq!r}")
         return DeploymentOverride(
             name=d["name"], num_replicas=nr,
             ray_actor_options=d.get("ray_actor_options"),
             autoscaling_config=d.get("autoscaling_config"),
-            user_config=d.get("user_config"))
+            user_config=d.get("user_config"),
+            max_ongoing_requests=moq)
 
 
 @dataclass

@@ -39,6 +39,12 @@ class ResourceKiller:
                       env var BEFORE starting the cluster so every
                       daemon/worker polls it; pass ``plan_file`` to
                       override).
+          "serve_replica" — SIGKILL a random READY serve replica
+                      process (pid from the serve controller's
+                      ``replica_pids()``). The serving zero-loss
+                      contract is that in-flight requests on the
+                      victim are re-dispatched by the router and the
+                      controller respawns the replica.
 
     ``drain_deadline_s`` bounds each "preempt" drain (the kill loop
     blocks while it runs, mimicking the real notice-to-termination
@@ -51,7 +57,8 @@ class ResourceKiller:
     (regression-tested in tests/test_partition_chaos.py).
     """
 
-    _KINDS = ("worker", "actor", "node", "preempt", "partition")
+    _KINDS = ("worker", "actor", "node", "preempt", "partition",
+              "serve_replica")
     _PARTITION_MODES = ("both", "send", "recv")
 
     def __init__(self, kind: str = "worker",
@@ -112,6 +119,8 @@ class ResourceKiller:
 
     def _kill_one(self) -> bool:
         rt = self.runtime
+        if self.kind == "serve_replica":
+            return self._kill_serve_replica()
         if self.kind in ("node", "preempt", "partition"):
             # Sorted for determinism: the RNG draw must depend only
             # on the seed and the membership, never on dict order.
@@ -147,6 +156,34 @@ class ResourceKiller:
         try:
             victim.proc.kill()
         except Exception:  # noqa: BLE001
+            return False
+        return True
+
+    def _kill_serve_replica(self) -> bool:
+        """SIGKILL a random ready serve replica, chosen by the seeded
+        RNG over the sorted (deployment, replica_tag) list so the same
+        seed replays the same kill schedule."""
+        import signal
+
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            pids = ray_tpu.get(controller.replica_pids.remote(),
+                               timeout=5)
+        except Exception:  # noqa: BLE001 — no serve controller yet
+            return False
+        candidates = sorted(
+            (name, tag, pid)
+            for name, tags in (pids or {}).items()
+            for tag, pid in tags.items() if pid)
+        if not candidates:
+            return False
+        name, tag, pid = self._rng.choice(candidates)
+        self.decisions.append(("serve_replica", f"{name}/{tag}", ""))
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
             return False
         return True
 

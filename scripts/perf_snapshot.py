@@ -73,6 +73,17 @@ DIRECT_CALL_METRICS = (
     "actor_call_inline_small_args",
 )
 
+# Serving metrics (ray_tpu/perf.py --serve): handle + proxy echo
+# throughput, the retry-plane on/off proxy pair behind the ≤5%
+# disabled-path guardrail (tests/test_perf.py), and the seeded
+# kill-mid-stream soak p99. Must-be-present only when --serve ran.
+SERVE_METRICS = (
+    "serve_requests_per_s",
+    "serve_proxy_echo",
+    "serve_proxy_echo_noretry",
+    "serve_soak_p99",
+)
+
 # Wire-hardening metrics (ray_tpu/perf.py): the checksum/seq/
 # heartbeat envelope's no-fault tax on a loopback echo pair, in added
 # microseconds per roundtrip. The e2e contract is that
@@ -147,7 +158,9 @@ def main() -> None:
                    + WIRE_METRICS
                    + OBSERVABILITY_METRICS
                    + INTROSPECTION_METRICS
-                   + DIRECT_CALL_METRICS if m not in got]
+                   + DIRECT_CALL_METRICS
+                   + (SERVE_METRICS if args.serve else ())
+                   if m not in got]
         if missing:
             print(f"run {i+1}: WARNING missing object-plane metrics "
                   f"{missing} (crashed mid-bench?)", file=sys.stderr)

@@ -27,4 +27,9 @@ echo "=== chaos lane (ResourceKiller / drain / preemption) ==="
 echo "=== partition lane (wire faults / silent partitions) ==="
 "${PYTEST[@]}" -m "partition and not slow" "$@" || rc=1
 
+echo "=== serve soak lane (zero-loss serving under replica kills," \
+     "redeploys, drains) ==="
+"${PYTEST[@]}" -m "chaos and slow" tests/test_serve_zero_loss.py \
+    "$@" || rc=1
+
 exit $rc

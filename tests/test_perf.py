@@ -51,6 +51,29 @@ def test_microbench_floors(rt):
         f"hot path regressed")
 
 
+@pytest.mark.slow
+def test_serve_retry_plane_disabled_path_overhead(rt):
+    """Zero-loss serving guardrail: with the retry plane DISABLED the
+    proxy echo path must be the pre-retry fast path — the enabled
+    path's throughput must stay within 5% of it (load-relaxed; the
+    idle-host contract is tracked by the serve_proxy_echo /
+    serve_proxy_echo_noretry pair in PERF snapshots)."""
+    from conftest import perf_floor_gate
+    relax = perf_floor_gate()
+    from ray_tpu.perf import run_serve_bench
+    rows = {r["metric"]: r for r in run_serve_bench(quick=True)}
+    on = rows["serve_proxy_echo"]["value"]
+    off = rows["serve_proxy_echo_noretry"]["value"]
+    assert on >= 0.95 * off / relax, (
+        f"retry plane costs more than 5% on the proxy echo path: "
+        f"{on} req/s enabled vs {off} req/s disabled")
+    # The mini soak inside the bench kills a replica mid-stream; the
+    # zero-loss contract is no failed requests.
+    soak = rows["serve_soak_p99"]
+    assert soak["extra"]["failed"] == 0, soak
+    assert soak["value"] > 0
+
+
 def test_direct_calls_zero_head_frames_steady_state(rt):
     """Direct-call plane guardrail: once a handle's lease is warm, a
     burst of N calls must add ZERO submit frames on the head's client

@@ -1,0 +1,492 @@
+"""Zero-loss serving: request-level fault tolerance units +
+integration.
+
+Covers the retry/replay plane's building blocks — exception
+classification, transport mapping goldens (HTTP 503+Retry-After /
+gRPC UNAVAILABLE), the replica executed-response ledger, controller
+readiness gating and consecutive-failure health ejection, multiplex
+eviction-vs-in-flight pinning, and the lifted router timeout knobs.
+The chaos soaks live in tests/test_serve_zero_loss.py.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.exceptions import (
+    DeploymentOverloadedError,
+    ModelLoadError,
+    ReplicaOverloadedError,
+    ReplicaStoppingError,
+    RequestDeadlineError,
+    RequestRetriesExhaustedError,
+    classify,
+    is_retryable,
+)
+
+
+@pytest.fixture
+def serve_rt(rt):
+    yield rt
+    serve.shutdown()
+
+
+# ---------- units: classification ----------
+
+def test_classify_serve_exceptions():
+    assert classify(ReplicaStoppingError("x")) == "replica_busy"
+    assert classify(ReplicaOverloadedError("x")) == "replica_busy"
+    assert classify(DeploymentOverloadedError("x")) == "overload"
+    assert classify(RequestRetriesExhaustedError("x")) == "overload"
+    assert classify(RequestDeadlineError("x")) == "deadline"
+    assert classify(ValueError("user bug")) == "error"
+    assert is_retryable(ReplicaStoppingError("x"))
+    assert not is_retryable(DeploymentOverloadedError("x"))
+
+
+def test_classify_get_timeout_is_not_retryable():
+    """THE double-execution trap: GetTimeoutError subclasses
+    TimeoutError which subclasses OSError (py3.3+) — a get() timeout
+    means the request may still be executing, so it must classify as
+    terminal, not as a dead-channel retry."""
+    from ray_tpu.core.exceptions import GetTimeoutError
+    assert isinstance(GetTimeoutError("t"), OSError)   # the trap
+    assert classify(GetTimeoutError("t")) == "error"
+    assert not is_retryable(GetTimeoutError("t"))
+
+
+def test_classify_channel_death_and_actor_death():
+    from ray_tpu.core.exceptions import ActorDiedError
+    assert classify(ActorDiedError("replica gone")) == "replica_died"
+    assert classify(ConnectionResetError("wire")) == "replica_died"
+    assert classify(EOFError()) == "replica_died"
+    assert is_retryable(ActorDiedError("x"))
+
+
+def test_classify_taskerror_by_traceback_marker():
+    """ActorError/TaskError.__reduce__ drops the cause object — the
+    remote traceback STRING is the classification contract."""
+    from ray_tpu.core.exceptions import TaskError
+
+    def te(tb):
+        e = TaskError("handle_request", tb)
+        assert getattr(e, "traceback_str", None) == tb
+        return e
+
+    assert classify(te("... ReplicaStoppingError: stopping")) \
+        == "replica_busy"
+    assert classify(te("... ReplicaOverloadedError: full")) \
+        == "replica_busy"
+    assert classify(te("... RequestDeadlineError: expired")) \
+        == "deadline"
+    assert classify(te("... ActorDiedError: died mid-exec")) \
+        == "replica_died"
+    assert classify(te("... ValueError: user bug")) == "error"
+
+
+# ---------- units: transport mapping goldens ----------
+
+def test_http_error_response_golden():
+    from ray_tpu.serve.proxy import error_response
+
+    status, headers, body = error_response(
+        DeploymentOverloadedError("every replica shed"))
+    assert (status, headers["Retry-After"]) == (503, "1")
+    assert body["error"] == "overloaded"
+
+    status, headers, _ = error_response(
+        RequestRetriesExhaustedError("budget gone"))
+    assert (status, headers["Retry-After"]) == (503, "1")
+
+    status, headers, body = error_response(
+        RequestDeadlineError("expired"))
+    assert status == 504 and "Retry-After" not in headers
+    assert body["error"] == "deadline exceeded"
+
+    status, _, body = error_response(ValueError("user bug"))
+    assert status == 500 and "user bug" in body["error"]
+
+
+def test_grpc_code_name_golden():
+    from ray_tpu.serve.grpc_proxy import grpc_code_name
+    assert grpc_code_name(DeploymentOverloadedError("x")) \
+        == "UNAVAILABLE"
+    assert grpc_code_name(RequestRetriesExhaustedError("x")) \
+        == "UNAVAILABLE"
+    assert grpc_code_name(ReplicaOverloadedError("x")) == "UNAVAILABLE"
+    assert grpc_code_name(RequestDeadlineError("x")) \
+        == "DEADLINE_EXCEEDED"
+    assert grpc_code_name(ValueError("x")) == "INTERNAL"
+
+
+# ---------- units: config knobs (lifted hardcoded timeouts) ----------
+
+def test_serve_timeout_knobs_exist_with_env_override():
+    from ray_tpu.core.config import Config
+    cfg = Config()
+    assert cfg.serve_longpoll_timeout_s == 60.0
+    assert cfg.serve_refresh_timeout_s == 30.0
+    assert cfg.serve_queue_probe_timeout_s == 5.0
+    assert cfg.serve_request_max_retries == 3
+    assert cfg.serve_retry_enabled is True
+    assert cfg.serve_max_queue_len_per_replica == 64
+    assert cfg.serve_proxy_max_inflight == 256
+    assert cfg.serve_health_check_failure_threshold == 3
+    os.environ["RAY_TPU_SERVE_LONGPOLL_TIMEOUT_S"] = "7.5"
+    os.environ["RAY_TPU_SERVE_REQUEST_MAX_RETRIES"] = "9"
+    try:
+        env_cfg = Config.from_env()
+        assert env_cfg.serve_longpoll_timeout_s == 7.5
+        assert env_cfg.serve_request_max_retries == 9
+    finally:
+        del os.environ["RAY_TPU_SERVE_LONGPOLL_TIMEOUT_S"]
+        del os.environ["RAY_TPU_SERVE_REQUEST_MAX_RETRIES"]
+
+
+# ---------- units: multiplex eviction vs in-flight requests ----------
+
+def test_multiplex_eviction_defers_unload_while_pinned():
+    from ray_tpu.serve.multiplex import (
+        multiplexed, pin_model, resident_model_ids, unpin_model,
+    )
+    unloaded = []
+
+    class Model:
+        def __init__(self, mid):
+            self.mid = mid
+
+        def unload(self):
+            unloaded.append(self.mid)
+
+    class Holder:
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return Model(model_id)
+
+    h = Holder()
+    h.get_model("a")
+    pin_model(h, "a")              # request using "a" in flight
+    h.get_model("b")
+    h.get_model("c")               # cap 2: must evict one
+    # Eviction prefers the unpinned victim: "b" goes, pinned "a"
+    # stays resident even though it is the LRU entry.
+    assert sorted(resident_model_ids(h)) == ["a", "c"]
+    assert unloaded == ["b"]
+    # With EVERY other resident pinned, eviction frees the LRU slot
+    # but defers the unload to the last unpin — the in-flight request
+    # using "a" must never lose its weights mid-request.
+    pin_model(h, "c")
+    h.get_model("d")
+    assert "a" not in resident_model_ids(h)
+    assert unloaded == ["b"]           # deferred, not yanked
+    unpin_model(h, "a")                # request done -> unload runs
+    assert unloaded == ["b", "a"]
+    unpin_model(h, "c")                # still resident: no unload
+    assert "c" in resident_model_ids(h)
+    assert unloaded == ["b", "a"]
+
+
+def test_multiplex_load_failure_leaves_no_poisoned_slot():
+    from ray_tpu.serve.multiplex import multiplexed, resident_model_ids
+    attempts = []
+
+    class Holder:
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            attempts.append(model_id)
+            if len(attempts) == 1:
+                raise RuntimeError("weights download failed")
+            return {"id": model_id}
+
+    h = Holder()
+    with pytest.raises(ModelLoadError, match="'m1'.*download failed"):
+        h.get_model("m1")
+    assert resident_model_ids(h) == []     # no poisoned entry
+    # The NEXT request for the same id retries the load cleanly.
+    assert h.get_model("m1") == {"id": "m1"}
+    assert attempts == ["m1", "m1"]
+
+
+# ---------- integration: executed-response ledger ----------
+
+class _Counting:
+    def __init__(self):
+        self.n = 0
+
+    def __call__(self, x):
+        self.n += 1
+        return {"x": x, "execution": self.n}
+
+    def boom(self, x):
+        self.n += 1
+        raise ValueError(f"boom on execution {self.n}")
+
+    def count(self):
+        return self.n
+
+
+def test_ledger_dedupe_executes_once(serve_rt):
+    """A duplicate re-dispatch with the same request id must be
+    answered from the ledger, not re-run — at-most-once per replica
+    for non-idempotent handlers."""
+    from ray_tpu.serve.replica import Replica
+    r = Replica.options(num_cpus=0, max_concurrency=8).remote(
+        _Counting, (), {}, "dep#ledger")
+    out1 = ray_tpu.get(r.handle_request.remote(
+        "__call__", (7,), {}, request_id="req-1"), timeout=60)
+    out2 = ray_tpu.get(r.handle_request.remote(
+        "__call__", (7,), {}, request_id="req-1"), timeout=60)
+    assert out1 == out2 == {"x": 7, "execution": 1}
+    assert ray_tpu.get(r.handle_request.remote(
+        "count", (), {}, request_id="req-2"), timeout=60) == 1
+    # A fresh id executes.
+    out3 = ray_tpu.get(r.handle_request.remote(
+        "__call__", (7,), {}, request_id="req-3"), timeout=60)
+    assert out3["execution"] == 2
+
+
+def test_ledger_replays_user_errors_without_reexecution(serve_rt):
+    from ray_tpu.core.exceptions import TaskError
+    from ray_tpu.serve.replica import Replica
+    r = Replica.options(num_cpus=0, max_concurrency=8).remote(
+        _Counting, (), {}, "dep#ledger_err")
+    for _ in range(2):
+        with pytest.raises(TaskError, match="boom on execution 1"):
+            ray_tpu.get(r.handle_request.remote(
+                "boom", (0,), {}, request_id="req-err"), timeout=60)
+    # Second raise came from the ledger: the handler ran ONCE.
+    assert ray_tpu.get(r.handle_request.remote(
+        "count", (), {}, request_id="req-c"), timeout=60) == 1
+
+
+def test_replica_admission_gates(serve_rt):
+    """Stopping (past grace) and expired-deadline requests are shed
+    before user code runs."""
+    from ray_tpu.core.exceptions import TaskError
+    from ray_tpu.serve.replica import Replica
+    r = Replica.options(num_cpus=0, max_concurrency=8).remote(
+        _Counting, (), {}, "dep#gates")
+    # Expired deadline: never executed.
+    with pytest.raises(TaskError, match="RequestDeadlineError"):
+        ray_tpu.get(r.handle_request.remote(
+            "__call__", (1,), {}, request_id="req-d",
+            deadline_ts=time.time() - 1.0), timeout=60)
+    assert ray_tpu.get(r.handle_request.remote(
+        "count", (), {}), timeout=60) == 0
+    # Stopping past its grace window: shed with ReplicaStoppingError.
+    ray_tpu.get(r.prepare_stop.remote(), timeout=60)
+    deadline = time.monotonic() + 30
+    i = 0
+    while time.monotonic() < deadline:
+        i += 1
+        try:
+            # Fresh id each attempt: a reused id would be answered
+            # from the ledger (by design — drained replicas still
+            # replay) instead of exercising the stopping gate.
+            ray_tpu.get(r.handle_request.remote(
+                "__call__", (1,), {}, request_id=f"req-s-{i}"),
+                timeout=60)
+        except TaskError as e:
+            if "ReplicaStoppingError" in (e.traceback_str or ""):
+                break
+            raise
+        time.sleep(0.3)     # still inside the stale-router grace
+    else:
+        pytest.fail("stopping replica never began shedding")
+
+
+# ---------- integration: readiness gating + health ejection ----------
+
+def test_readiness_gating_no_traffic_until_healthy(serve_rt, tmp_path):
+    """A spawned replica stays OUT of the routing set until its first
+    successful probe; flipping check_health healthy admits it."""
+    flag = str(tmp_path / "ready")
+
+    @serve.deployment(num_replicas=1)
+    class Gated:
+        def __init__(self, flag_path):
+            self.flag = flag_path
+
+        def check_health(self):
+            if not os.path.exists(self.flag):
+                raise RuntimeError("warming up")
+
+        def __call__(self, x):
+            return "ok"
+
+    done = {}
+
+    def deploy():
+        done["handle"] = serve.run(Gated.bind(flag))
+
+    t = threading.Thread(target=deploy, daemon=True)
+    t.start()
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    deadline = time.monotonic() + 30
+    controller = None
+    while controller is None and time.monotonic() < deadline:
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:  # noqa: BLE001 — controller still booting
+            time.sleep(0.1)
+    assert controller is not None
+    # The replica exists (starting) but serves NO traffic while its
+    # health hook fails.
+    saw_starting = False
+    for _ in range(20):
+        info = ray_tpu.get(controller.list_deployments.remote(),
+                           timeout=10).get("Gated", {})
+        assert info.get("num_replicas", 0) == 0
+        if info.get("starting", 0) >= 1:
+            saw_starting = True
+        time.sleep(0.1)
+    assert saw_starting
+    open(flag, "w").close()            # health hook goes green
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert done["handle"].remote(1).result(timeout_s=60) == "ok"
+
+
+def test_health_ejection_and_respawn(serve_rt, tmp_path):
+    """consecutive probe failures eject the replica from the routing
+    set and the controller respawns a fresh one."""
+    poison = str(tmp_path / "poison_pid")
+
+    @serve.deployment(num_replicas=1)
+    class Flappy:
+        def __init__(self, poison_path):
+            self.poison = poison_path
+
+        def check_health(self):
+            if os.path.exists(self.poison):
+                with open(self.poison) as f:
+                    if int(f.read()) == os.getpid():
+                        raise RuntimeError("degraded")
+
+        def __call__(self, x):
+            return os.getpid()
+
+    handle = serve.run(Flappy.bind(poison))
+    pid0 = handle.remote(0).result(timeout_s=60)
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    with open(poison, "w") as f:
+        f.write(str(pid0))             # only THIS pid reports sick
+    deadline = time.monotonic() + 45
+    new_pid = None
+    while time.monotonic() < deadline:
+        pids = ray_tpu.get(controller.replica_pids.remote("Flappy"),
+                           timeout=10)
+        alive = set(pids.values())
+        if alive and pid0 not in alive:
+            new_pid = next(iter(alive))
+            break
+        time.sleep(0.3)
+    assert new_pid is not None and new_pid != pid0, \
+        "sick replica was never ejected/replaced"
+    # Traffic flows to the replacement.
+    assert handle.remote(1).result(timeout_s=60) == new_pid
+
+
+# ---------- integration: HTTP shedding + deadlines ----------
+
+def test_http_overload_503_and_deadline_504(serve_rt):
+    http_port = 18741
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(float(x.get("sleep", 0)) if isinstance(x, dict)
+                       else 0)
+            return {"ok": True}
+
+    serve.run(Slow.bind(), http_port=http_port)
+    url = f"http://127.0.0.1:{http_port}/"
+
+    def post(body: dict, headers=None):
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers=headers or {}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
+
+    # Deadline: a 1.5s handler under a 0.2s request timeout -> 504.
+    status, _, body = post({"sleep": 1.5},
+                           {"X-Request-Timeout-S": "0.2"})
+    assert status == 504, body
+    assert b"deadline" in body
+    # The 504'd request's execution is already running and cannot be
+    # cancelled mid-handler — let it vacate the 1-slot queue so the
+    # overload phase below starts from an idle replica.
+    time.sleep(1.6)
+
+    # Overload: 1-slot replica + concurrent 1s requests -> the
+    # spillover is shed 503 + Retry-After, honest and fast; nothing
+    # hangs or resets.
+    results = []
+
+    def fire():
+        results.append(post({"sleep": 1.0}))
+
+    threads = [threading.Thread(target=fire) for _ in range(5)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert time.monotonic() - t0 < 90
+    statuses = sorted(s for s, _, _ in results)
+    assert set(statuses) <= {200, 503}, statuses
+    assert 200 in statuses, statuses
+    assert 503 in statuses, statuses
+    for s, headers, _ in results:
+        if s == 503:
+            assert headers.get("Retry-After") == "1"
+
+
+def test_proxy_inflight_cap_sheds_before_routing(serve_rt):
+    """Past the proxy's own in-flight cap requests are answered 503
+    immediately — without touching the router."""
+    http_port = 18742
+
+    @serve.deployment(num_replicas=1)
+    class Hold:
+        def __call__(self, x):
+            time.sleep(1.0)
+            return "done"
+
+    serve.run(Hold.bind(), http_port=http_port)
+    from ray_tpu.serve.proxy import ProxyActor
+    capped = ProxyActor.options(num_cpus=0, max_concurrency=32).remote(
+        18743, max_inflight=1)
+    ray_tpu.get(capped.ready.remote(), timeout=30)
+    ray_tpu.get(capped.set_routes.remote(
+        {"/": {"name": "Hold", "asgi": False}}))
+
+    url = "http://127.0.0.1:18743/"
+    codes = []
+
+    def fire():
+        req = urllib.request.Request(url, data=b"{}", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                codes.append(resp.status)
+        except urllib.error.HTTPError as e:
+            codes.append(e.code)
+
+    threads = [threading.Thread(target=fire) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert 503 in codes and 200 in codes, codes

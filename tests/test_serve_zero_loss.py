@@ -1,0 +1,354 @@
+"""Zero-loss serving chaos suite.
+
+Every accepted request survives replica crashes, rolling redeploys,
+autoscale-down and node drains — the retry/replay plane re-dispatches,
+the ledger dedupes, the health plane ejects and respawns — and
+overload degrades to honest 503s, never hangs or resets.
+
+Lanes (scripts/run_chaos.sh): the per-fault tests run in the chaos
+lane (``chaos and not slow``); the combined soak is the serve soak
+lane (``chaos and slow``). Kill schedules are seeded
+(ResourceKiller(seed=...)) so a red run replays deterministically.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.chaos import ResourceKiller
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def serve_rt(rt):
+    yield rt
+    serve.shutdown()
+
+
+@pytest.fixture
+def serve_cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield c
+    serve.shutdown()
+    c.shutdown()
+
+
+class _LoadClient:
+    """Client threads driving a handle; every .result() must succeed
+    for the zero-loss contract."""
+
+    def __init__(self, handle, n_threads: int = 3,
+                 model_ids: tuple = ()):
+        self.handle = handle
+        self.model_ids = model_ids
+        self.stop = threading.Event()
+        self.sent = 0
+        self.failures: list = []
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,), daemon=True)
+            for i in range(n_threads)]
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def _loop(self, tid: int):
+        i = 0
+        while not self.stop.is_set():
+            i += 1
+            h = self.handle
+            if self.model_ids:
+                h = h.options(multiplexed_model_id=self.model_ids[
+                    (tid + i) % len(self.model_ids)])
+            try:
+                out = h.remote({"v": i}).result(timeout_s=90)
+                assert out is not None
+            except Exception as e:  # noqa: BLE001 — tallied below
+                with self._lock:
+                    self.failures.append(f"t{tid} req{i}: "
+                                         f"{type(e).__name__}: {e}")
+            with self._lock:
+                self.sent += 1
+            time.sleep(0.02)
+
+    def finish(self, timeout: float = 120.0) -> None:
+        self.stop.set()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        assert not any(t.is_alive() for t in self._threads), \
+            "client threads hung — requests never resolved"
+
+
+def test_replica_kill_zero_loss(serve_rt):
+    """Two seeded SIGKILLs of serving replicas mid-load: every
+    request still succeeds (router re-dispatch + controller
+    respawn)."""
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            time.sleep(0.01)
+            return {"ok": x}
+
+    handle = serve.run(Echo.bind())
+    client = _LoadClient(handle, n_threads=3).start()
+    killer = ResourceKiller(kind="serve_replica", interval_s=2.0,
+                            max_kills=2, seed=7).start()
+    time.sleep(8.0)
+    kills = killer.stop()
+    client.finish()
+    assert kills >= 1, "chaos never found a replica to kill"
+    assert client.failures == [], client.failures[:5]
+    assert client.sent > 50
+    # Audit trail: every decision is a seeded serve_replica kill.
+    assert all(d[0] == "serve_replica" for d in killer.decisions)
+    assert len(killer.decisions) == kills
+
+
+def test_rolling_redeploy_zero_loss(serve_rt):
+    """A code redeploy drain-replaces every replica under load; no
+    request fails while the fleet rolls, and traffic lands on the new
+    version afterwards."""
+    def make_app(version):
+        @serve.deployment(name="Roll", num_replicas=2)
+        class Roll:
+            def __call__(self, x):
+                time.sleep(0.01)
+                return version
+        return Roll.bind()
+
+    handle = serve.run(make_app("v1"), name="roll")
+    client = _LoadClient(handle, n_threads=3).start()
+    time.sleep(1.0)
+    serve.run(make_app("v2"), name="roll")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if handle.remote({}).result(timeout_s=60) == "v2":
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("redeploy never took")
+    time.sleep(1.0)
+    client.finish()
+    assert client.failures == [], client.failures[:5]
+    assert client.sent > 30
+
+
+def test_autoscale_down_zero_loss(serve_rt):
+    """Autoscale-down drains victims gracefully: requests in flight
+    on a downscaled replica finish; none fail."""
+    @serve.deployment(
+        num_replicas=2,
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 2.0,
+                            "upscale_delay_s": 0.0,
+                            "downscale_delay_s": 0.3,
+                            "look_back_period_s": 1.0})
+    class Worky:
+        def __call__(self, x):
+            time.sleep(0.05)
+            return "ok"
+
+    handle = serve.run(Worky.bind())
+    controller = ray_tpu.get_actor("ray_tpu_serve_controller")
+    client = _LoadClient(handle, n_threads=2).start()
+    # Light trickle load -> the autoscaler shrinks to min while the
+    # trickle keeps flowing.
+    shrunk = False
+    deadline = time.monotonic() + 25
+    while time.monotonic() < deadline:
+        info = ray_tpu.get(controller.list_deployments.remote(),
+                           timeout=10)
+        if info["Worky"]["desired"] == 1 \
+                and info["Worky"]["num_replicas"] == 1:
+            shrunk = True
+            break
+        time.sleep(0.3)
+    client.finish()
+    assert shrunk, "deployment never scaled down"
+    assert client.failures == [], client.failures[:5]
+
+
+def test_node_drain_zero_loss(serve_cluster):
+    """Draining a node hosting serve replicas: they leave the routing
+    set, drain in-flight work, and the deployment keeps serving from
+    surviving capacity — zero failed requests."""
+    n2 = serve_cluster.add_node(num_cpus=2)
+
+    @serve.deployment(num_replicas=2,
+                      ray_actor_options={"num_cpus": 1})
+    class Spread:
+        def __call__(self, x):
+            time.sleep(0.01)
+            return "ok"
+
+    handle = serve.run(Spread.bind())
+    rt_obj = ray_tpu.core.api.get_runtime()
+    client = _LoadClient(handle, n_threads=3).start()
+    time.sleep(1.0)
+    assert rt_obj.drain_node(n2.node_id, reason="chaos drain",
+                             deadline_s=30, remove=True)
+    time.sleep(2.0)
+    client.finish()
+    assert client.failures == [], client.failures[:5]
+    assert client.sent > 30
+    row = next(n for n in ray_tpu.nodes()
+               if n["NodeID"] == n2.node_id)
+    assert not row["Alive"]
+
+
+def test_overload_sheds_503_never_hangs(serve_rt):
+    """Past capacity the system degrades to fast honest rejections:
+    every HTTP response is 200 or 503+Retry-After, none hang or
+    reset."""
+    http_port = 18751
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=2)
+    class Busy:
+        def __call__(self, x):
+            time.sleep(0.5)
+            return "ok"
+
+    serve.run(Busy.bind(), http_port=http_port)
+    url = f"http://127.0.0.1:{http_port}/"
+    results: list[tuple] = []
+    lock = threading.Lock()
+
+    def fire(i):
+        req = urllib.request.Request(url, data=b"{}", method="POST")
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                row = (resp.status,
+                       resp.headers.get("Retry-After"))
+        except urllib.error.HTTPError as e:
+            row = (e.code, e.headers.get("Retry-After"))
+        with lock:
+            results.append(row + (time.monotonic() - t0,))
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert not any(t.is_alive() for t in threads), \
+        "overloaded requests hung"
+    assert len(results) == 12
+    statuses = sorted(s for s, _, _ in results)
+    assert set(statuses) <= {200, 503}, statuses
+    assert 503 in statuses, "overload never shed"
+    for status, retry_after, _elapsed in results:
+        if status == 503:
+            assert retry_after == "1"
+
+
+@pytest.mark.slow
+def test_serve_soak_zero_loss(serve_cluster):
+    """The capstone soak: a multiplexed + batched + autoscaling app
+    under sustained load through BOTH the handle and the HTTP proxy,
+    while chaos injects a rolling redeploy, >=2 seeded replica kills
+    and one node drain. Zero failed requests; HTTP sees only 200/503;
+    the kill schedule replays from its seed."""
+    n2 = serve_cluster.add_node(num_cpus=2)
+    http_port = 18752
+
+    def make_app(version):
+        @serve.deployment(
+            name="Soak", num_replicas=2,
+            ray_actor_options={"num_cpus": 1},
+            autoscaling_config={"min_replicas": 2, "max_replicas": 3,
+                                "target_ongoing_requests": 4.0,
+                                "upscale_delay_s": 1.0,
+                                "downscale_delay_s": 3.0,
+                                "look_back_period_s": 2.0})
+        class Soak:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def load_model(self, model_id):
+                return {"id": model_id, "version": version}
+
+            @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.02)
+            def bump(self, xs):
+                return [x["v"] + 1 for x in xs]
+
+            def __call__(self, x):
+                mid = serve.get_multiplexed_model_id()
+                model = self.load_model(mid) if mid else None
+                return {"version": version,
+                        "model": model["id"] if model else "",
+                        "bumped": self.bump(x)}
+        return Soak.bind()
+
+    handle = serve.run(make_app("v1"), name="soak",
+                       http_port=http_port)
+    client = _LoadClient(handle, n_threads=4,
+                         model_ids=("m0", "m1", "m2")).start()
+
+    # HTTP side-channel: statuses must stay in {200, 503}; anything
+    # else (hang, reset, 500) breaks the graceful-overload contract.
+    http_stop = threading.Event()
+    http_statuses: list[int] = []
+    http_errors: list[str] = []
+
+    def http_loop():
+        url = f"http://127.0.0.1:{http_port}/"
+        while not http_stop.is_set():
+            req = urllib.request.Request(
+                url, data=json.dumps({"v": 1}).encode(),
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    http_statuses.append(resp.status)
+            except urllib.error.HTTPError as e:
+                http_statuses.append(e.code)
+            except Exception as e:  # noqa: BLE001
+                http_errors.append(f"{type(e).__name__}: {e}")
+            time.sleep(0.05)
+
+    http_thread = threading.Thread(target=http_loop, daemon=True)
+    http_thread.start()
+
+    killer = ResourceKiller(kind="serve_replica", interval_s=3.0,
+                            max_kills=2, seed=1234).start()
+    time.sleep(4.0)
+    serve.run(make_app("v2"), name="soak",
+              http_port=http_port)              # rolling redeploy
+    time.sleep(4.0)
+    rt_obj = ray_tpu.core.api.get_runtime()
+    assert rt_obj.drain_node(n2.node_id, reason="soak drain",
+                             deadline_s=30, remove=True)
+    # Let the fleet settle and the killer land its budget.
+    deadline = time.monotonic() + 12
+    while time.monotonic() < deadline and killer.kills < 2:
+        time.sleep(0.5)
+    kills = killer.stop()
+    http_stop.set()
+    client.finish()
+    http_thread.join(timeout=90)
+    assert not http_thread.is_alive(), "HTTP client hung"
+
+    # --- the zero-loss verdict ---
+    assert client.failures == [], client.failures[:10]
+    assert client.sent > 100, client.sent
+    assert kills >= 2, f"only {kills} seeded kills landed"
+    assert all(d[0] == "serve_replica" for d in killer.decisions)
+    assert http_errors == [], http_errors[:5]
+    assert http_statuses and set(http_statuses) <= {200, 503}, \
+        sorted(set(http_statuses))
+    # The redeploy took: new version serving.
+    assert handle.remote({"v": 0}).result(
+        timeout_s=60)["version"] == "v2"
+    # Multiplexing survived the churn.
+    out = handle.options(multiplexed_model_id="m1").remote(
+        {"v": 1}).result(timeout_s=60)
+    assert out["model"] == "m1"
