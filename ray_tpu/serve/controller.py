@@ -401,13 +401,18 @@ class ServeController:
                     else:
                         health[key] = fails
                         alive.append(r)     # still serving, on watch
+                        # Placeholder keeps stats index-aligned with
+                        # alive: model_map indices below must match
+                        # the routing table's replica positions.
+                        stats.append(None)
                 live = alive
                 self.pids[name] = {
-                    s["tag"]: s["pid"] for s in stats if "pid" in s}
+                    s["tag"]: s["pid"] for s in stats
+                    if s and "pid" in s}
                 # autoscaling decision from observed load
                 auto = self.autoscaling.get(name)
                 if auto is not None:
-                    auto.record(sum(s["inflight"] for s in stats))
+                    auto.record(sum(s["inflight"] for s in stats if s))
                     spec["num_replicas"] = auto.decide(
                         spec["num_replicas"])
                 # model-locality map for the router; a residency
@@ -415,6 +420,8 @@ class ServeController:
                 # cached copy.
                 mmap: dict[str, list[int]] = {}
                 for i, s in enumerate(stats):
+                    if s is None:       # on-watch: no fresh probe
+                        continue
                     for mid in s.get("model_ids", []):
                         mmap.setdefault(mid, []).append(i)
                 if mmap != self.model_map.get(name):

@@ -235,35 +235,41 @@ class GRPCProxyActor:
                         grpc.StatusCode.NOT_FOUND,
                         "no matching application")
                 # In-flight cap: shed before decoding the body or
-                # touching the routing plane.
+                # touching the routing plane. Reserve the slot
+                # IMMEDIATELY after the check — incrementing only
+                # after the _decode await would let a burst of
+                # concurrent calls all pass the check and overshoot
+                # the cap.
                 if proxy._inflight >= proxy._max_inflight:
                     proxy._m_shed.inc()
                     await context.abort(
                         grpc.StatusCode.UNAVAILABLE,
                         f"proxy at in-flight cap "
                         f"({proxy._max_inflight}); retry later")
-                arg, ctype = await _decode(request, md, context)
-                router = proxy._router_for(target)
-                deadline_ts = _deadline_ts(context)
-                loop = asyncio.get_running_loop()
-
-                def call():
-                    return router.call(
-                        method_name, (arg,), {},
-                        multiplexed_model_id=md.get(
-                            "multiplexed_model_id", ""),
-                        deadline_ts=deadline_ts)
-
                 proxy._inflight += 1
                 try:
-                    result = await loop.run_in_executor(None, call)
-                except Exception as e:  # noqa: BLE001
-                    await context.abort(
-                        getattr(grpc.StatusCode, grpc_code_name(e)),
-                        str(e)[:500])
+                    arg, ctype = await _decode(request, md, context)
+                    router = proxy._router_for(target)
+                    deadline_ts = _deadline_ts(context)
+                    loop = asyncio.get_running_loop()
+
+                    def call():
+                        return router.call(
+                            method_name, (arg,), {},
+                            multiplexed_model_id=md.get(
+                                "multiplexed_model_id", ""),
+                            deadline_ts=deadline_ts)
+
+                    try:
+                        result = await loop.run_in_executor(None, call)
+                    except Exception as e:  # noqa: BLE001
+                        await context.abort(
+                            getattr(grpc.StatusCode,
+                                    grpc_code_name(e)),
+                            str(e)[:500])
+                    return _encode(result, ctype)
                 finally:
                     proxy._inflight -= 1
-                return _encode(result, ctype)
             return unary
 
         def _make_stream(method_name: str):

@@ -137,6 +137,14 @@ class Replica:
         if ev is not None:
             ev.set()
 
+    def _release_slot(self) -> None:
+        """Undo a reserved admission slot for a request that will not
+        execute here (ledger replay / duplicate waiter)."""
+        with self._lock:
+            self._inflight -= 1
+            self._total -= 1
+        self._m_queue.set(float(self._inflight))
+
     def _replay(self, hit: tuple):
         self._m_dedupe.inc()
         kind, payload = hit
@@ -145,16 +153,18 @@ class Replica:
         return payload
 
     def _stream_wrapper(self, gen, multiplexed_model_id: str):
-        """Owns the inflight count for a streaming response: the
-        request is busy until the generator body finishes, not until
-        handle_request returns the (unstarted) generator."""
+        """Owns the inflight count AND the model pin for a streaming
+        response: handle_request hands its pin over (clearing its own
+        ``pinned`` flag) so the request stays busy and the model stays
+        pinned until the generator body finishes, not until
+        handle_request returns the (unstarted) generator. Do not pin
+        again here — pin_model is refcounted and a second pin with a
+        single unpin would leak one pin per streaming request."""
         from ray_tpu.serve.multiplex import (
-            _set_current_model_id, pin_model, unpin_model,
+            _set_current_model_id, unpin_model,
         )
         try:
             _set_current_model_id(multiplexed_model_id)
-            if multiplexed_model_id:
-                pin_model(self.callable, multiplexed_model_id)
             yield from gen
         finally:
             if multiplexed_model_id:
@@ -192,7 +202,10 @@ class Replica:
                 return self._replay(hit)
         # Admission gates — all fire before user code runs.
         now = _time.time()
-        if self._stopping and (now - self._stop_ts) >= self._stop_grace:
+        with self._lock:
+            shedding = (self._stopping
+                        and (now - self._stop_ts) >= self._stop_grace)
+        if shedding:
             self._m_shed.inc()
             raise ReplicaStoppingError(
                 f"replica {self.tag} is stopping")
@@ -200,11 +213,22 @@ class Replica:
             raise RequestDeadlineError(
                 f"request {request_id or '<anon>'} deadline expired "
                 f"{now - deadline_ts:.3f}s ago (not executed)")
-        if self._inflight >= self._max_queue:
+        # Queue bound is check-AND-reserve under one lock hold:
+        # concurrent calls must not all pass the check and overshoot
+        # max_ongoing_requests. Paths below that turn out not to
+        # execute (ledger replay, duplicate waiter) release the slot.
+        with self._lock:
+            depth = self._inflight
+            admitted = depth < self._max_queue
+            if admitted:
+                self._inflight += 1
+                self._total += 1
+        if not admitted:
             self._m_shed.inc()
             raise ReplicaOverloadedError(
                 f"replica {self.tag} queue full "
-                f"({self._inflight}/{self._max_queue})")
+                f"({depth}/{self._max_queue})")
+        self._m_queue.set(float(self._inflight))
         if dedupe:
             with self._lock:
                 hit = self._ledger.get(request_id)
@@ -213,10 +237,13 @@ class Replica:
                 if hit is None and waiter is None:
                     self._executing[request_id] = threading.Event()
             if hit is not None:
+                self._release_slot()
                 return self._replay(hit)
             if waiter is not None:
-                # Concurrent duplicate: wait out the first execution
-                # and answer from the ledger.
+                # Concurrent duplicate: only the first execution
+                # occupies a queue slot — release ours, then wait it
+                # out and answer from the ledger.
+                self._release_slot()
                 budget = (max(0.0, deadline_ts - _time.time())
                           if deadline_ts else self._wait_budget())
                 waiter.wait(budget)
@@ -229,10 +256,6 @@ class Replica:
                     f"waiting for the first execution")
 
         t_start = _time.perf_counter()
-        with self._lock:
-            self._inflight += 1
-            self._total += 1
-        self._m_queue.set(float(self._inflight))
         _set_current_model_id(multiplexed_model_id)
         streaming = False
         pinned = False
@@ -272,7 +295,7 @@ class Replica:
                         f"{method_name} returned a generator; call it "
                         f"through handle.options(stream=True)")
                 streaming = True    # wrapper owns decrement + unpin
-                pinned = False
+                pinned = False      # pin ownership transfers with it
                 return self._stream_wrapper(result,
                                             multiplexed_model_id)
             if stream:

@@ -176,13 +176,18 @@ class RequestContext:
 
     def retry(self, first_error, timeout=None):
         """Continue the attempt budget after the first (assign-path)
-        dispatch failed retryably; same request id → ledger dedupe."""
+        dispatch failed retryably; same request id → ledger dedupe.
+        The replica that just failed is excluded from the first
+        re-dispatch so a shed/dropped request doesn't burn a retry
+        attempt landing right back on it."""
+        failed_key = self._pending_key
         self.finish()
         return self.router.call(
             self.method_name, self.args, self.kwargs,
             multiplexed_model_id=self.model_id, timeout=timeout,
             deadline_ts=self.deadline_ts, request_id=self.request_id,
-            attempts_used=1, first_error=first_error)
+            attempts_used=1, first_error=first_error,
+            exclude={failed_key} if failed_key else None)
 
 
 class Router:
@@ -399,18 +404,26 @@ class Router:
             ctx = RequestContext(self, method_name, args, kwargs,
                                  multiplexed_model_id, request_id,
                                  deadline_ts, key)
-        ref = method.remote(
-            method_name, args, kwargs,
-            multiplexed_model_id=multiplexed_model_id,
-            stream=False, request_id=request_id,
-            deadline_ts=deadline_ts)
+        try:
+            ref = method.remote(
+                method_name, args, kwargs,
+                multiplexed_model_id=multiplexed_model_id,
+                stream=False, request_id=request_id,
+                deadline_ts=deadline_ts)
+        except BaseException:
+            # Synchronous dispatch failure (e.g. arg serialization):
+            # release the pending slot now or the pow-2 queue
+            # estimate for this replica is skewed forever.
+            if ctx is not None:
+                ctx.finish()
+            raise
         return ref, ctx
 
     def call(self, method_name: str, args, kwargs,
              multiplexed_model_id: str = "", timeout: float | None = None,
              deadline_ts: float = 0.0, retry: bool | None = None,
              request_id: str | None = None, attempts_used: int = 0,
-             first_error=None):
+             first_error=None, exclude: set | None = None):
         """Blocking request with the full retry/replay plane — the
         proxies' path, and DeploymentResponse.result()'s continuation
         path. Returns the response value or raises a terminal error
@@ -452,7 +465,7 @@ class Router:
             if kind == "replica_died":
                 self._invalidate()
             self._count_retry()
-        excluded: set[str] = set()
+        excluded: set[str] = set(exclude or ())
         empty_until = None
         while attempt < max_attempts:
             now = time.time()

@@ -214,6 +214,47 @@ def test_multiplex_load_failure_leaves_no_poisoned_slot():
     assert attempts == ["m1", "m1"]
 
 
+class _StreamMux:
+    def __call__(self, n):
+        from ray_tpu.serve.multiplex import get_multiplexed_model_id
+        mid = get_multiplexed_model_id()
+        for i in range(n):
+            yield f"{mid}:{i}"
+
+    def pins(self):
+        return dict(getattr(self, "__serve_mux_pins__", None) or {})
+
+
+def test_streaming_multiplexed_request_leaves_no_pin(serve_rt):
+    """handle_request pins the request's model and hands that pin to
+    _stream_wrapper; the wrapper must only UNpin. pin_model is
+    refcounted, so a wrapper that pinned again leaked one pin per
+    streaming request — pins never returned to 0 and deferred model
+    unloads never ran."""
+    import time as _t
+
+    from ray_tpu.serve.replica import Replica
+    r = Replica.options(num_cpus=0, max_concurrency=8).remote(
+        _StreamMux, (), {}, "dep#streampin")
+    for _ in range(2):          # the leak was per-request: two rounds
+        gen = r.handle_request.options(
+            num_returns="streaming").remote(
+            "__call__", (3,), {}, multiplexed_model_id="mA",
+            stream=True)
+        out = [ray_tpu.get(ref, timeout=60) for ref in gen]
+        assert out == ["mA:0", "mA:1", "mA:2"]
+    # The wrapper's finally runs as the generator closes; poll out
+    # the tail of that race. A leaked pin never clears.
+    pins = None
+    for _ in range(100):
+        pins = ray_tpu.get(r.handle_request.remote(
+            "pins", (), {}), timeout=60)
+        if not pins:
+            break
+        _t.sleep(0.05)
+    assert pins == {}
+
+
 # ---------- integration: executed-response ledger ----------
 
 class _Counting:
