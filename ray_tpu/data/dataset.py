@@ -1063,24 +1063,30 @@ class DataIterator:
     def iter_device_batches(self, batch_size: int, mesh=None,
                             seq_sharded: bool = False,
                             prefetch: int | None = None):
-        """Double-buffered device feed: host batches are device_put
-        ahead of consumption (the multi-host device-prefetch path,
-        SURVEY.md §2.4 data-pipeline row)."""
-        from ray_tpu.train.step import shard_batch
-        import collections
+        """Double-buffered device feed: a background thread pulls host
+        batches, shards them across the mesh, and keeps up to
+        ``prefetch`` device-resident batches queued ahead of the
+        consumer — host decode + H2D transfer overlap device compute
+        (the multi-host device-prefetch path, SURVEY.md §2.4
+        data-pipeline row; same pipeline as ``bench.py``'s hot loop
+        via ``ray_tpu.train.prefetch_to_device``)."""
+        from ray_tpu.train.prefetch import DevicePrefetcher
         if prefetch is None:
             from ray_tpu.data.context import DataContext
             prefetch = DataContext.get_current().prefetch_batches
-        buf = collections.deque()
-        it = self.iter_batches(batch_size, drop_last=True)
-        for batch in it:
-            if mesh is not None:
-                batch = shard_batch(batch, mesh, seq_sharded=seq_sharded)
-            buf.append(batch)
-            if len(buf) > prefetch:
-                yield buf.popleft()
-        while buf:
-            yield buf.popleft()
+        place = None
+        if mesh is not None:
+            from ray_tpu.train.step import shard_batch
+
+            def place(b):  # noqa: E306
+                return shard_batch(b, mesh, seq_sharded=seq_sharded)
+        pf = DevicePrefetcher(
+            self.iter_batches(batch_size, drop_last=True),
+            place=place, depth=max(1, int(prefetch)))
+        try:
+            yield from pf
+        finally:
+            pf.close()
 
 
 # -- executor helpers ------------------------------------------------------

@@ -41,6 +41,13 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16        # compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # What remat may KEEP from the fwd pass (jax.checkpoint_policies):
+    # "nothing" recomputes everything (min HBM, max recompute FLOPs);
+    # "dots" / "dots_no_batch" keep matmul outputs so backward only
+    # re-runs the cheap VPU ops; "everything" disables rematting while
+    # keeping the checkpoint structure. Sweepable via
+    # RAY_TPU_BENCH_REMAT in bench.py.
+    remat_policy: str = "nothing"
     attn_impl: str = "auto"          # "auto" | "dense" | "ring"
     sp_axis: str = "sp"
 
@@ -75,6 +82,25 @@ class GPT2Config:
             self.seq_len
         per_block = 12 * e * e + 13 * e  # qkv+proj+mlp + norms/biases
         return v * e + s * e + l * per_block + 2 * e
+
+
+_REMAT_POLICIES = {
+    "nothing": "nothing_saveable",
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+    "everything": "everything_saveable",
+}
+
+
+def remat_policy(name: str):
+    """Resolve a GPT2Config.remat_policy name to a
+    ``jax.checkpoint_policies`` policy callable."""
+    try:
+        return getattr(jax.checkpoint_policies, _REMAT_POLICIES[name])
+    except KeyError:
+        raise ValueError(
+            f"unknown remat policy {name!r}; "
+            f"one of {sorted(_REMAT_POLICIES)}") from None
 
 
 class CausalSelfAttention(nn.Module):
@@ -202,7 +228,7 @@ class GPT2(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(
                 Block, static_argnums=(2, 3),
-                policy=jax.checkpoint_policies.nothing_saveable)
+                policy=remat_policy(cfg.remat_policy))
         for i in range(cfg.n_layer):
             x = block_cls(cfg, name=f"h_{i}")(x, attn_fn, deterministic)
             x = self._constrain(x)

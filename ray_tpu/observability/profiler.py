@@ -31,7 +31,8 @@ import traceback
 __all__ = [
     "ProfilerBusyError", "is_active", "sample_stacks", "dump_stacks",
     "merge_collapsed", "collapsed_text", "parse_collapsed",
-    "to_speedscope", "trigger_device_profile", "handle_profile_op",
+    "to_speedscope", "trigger_device_profile", "device_trace_summary",
+    "handle_profile_op",
 ]
 
 
@@ -259,6 +260,17 @@ def trigger_device_profile(logdir: str = "/tmp/ray_tpu_profile",
             "pid": os.getpid(), "started": True}
 
 
+def device_trace_summary(logdir: str = "/tmp/ray_tpu_profile",
+                         top_k: int = 5, steps: int = 1) -> dict:
+    """Slice breakdown of a finished device capture: total / matmul /
+    non-matmul ms plus the top-``top_k`` slices each way, parsed from
+    the xplane protobufs :func:`trigger_device_profile` wrote (no
+    tensorflow needed — see ``observability.xplane``). ``steps``
+    normalizes ``ms`` figures per optimizer step."""
+    from ray_tpu.observability.xplane import summarize_trace
+    return summarize_trace(logdir, top_k=top_k, steps=steps)
+
+
 def handle_profile_op(op: str, args: dict) -> object:
     """Dispatch one remote profile request inside the target process —
     the shared handler behind the worker ``srv_req`` upcall and the
@@ -274,4 +286,9 @@ def handle_profile_op(op: str, args: dict) -> object:
         return trigger_device_profile(
             logdir=args.get("logdir", "/tmp/ray_tpu_profile"),
             duration_s=args.get("duration_s", 5.0))
+    if op == "trace_summary":
+        return device_trace_summary(
+            logdir=args.get("logdir", "/tmp/ray_tpu_profile"),
+            top_k=args.get("top_k", 5),
+            steps=args.get("steps", 1))
     raise ValueError(f"unknown profile op {op!r}")

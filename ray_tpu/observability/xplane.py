@@ -1,0 +1,306 @@
+"""Dependency-free XPlane (``*.xplane.pb``) trace reader.
+
+``jax.profiler`` captures land as TensorBoard ``XSpace`` protobufs
+(``plugins/profile/<run>/<host>.xplane.pb``). Reading them normally
+requires tensorflow + tensorboard_plugin_profile — neither ships in
+this image, and the bench harness must be able to turn a device
+capture into a *slice breakdown* (which ops ate the step, matmul vs
+not) with zero extra deps. So this module walks the protobuf wire
+format directly against the stable XPlane schema (tsl/profiler
+``xplane.proto`` field numbers, unchanged since 2020):
+
+    XSpace.planes=1
+    XPlane.name=2 .lines=3 .event_metadata=4 .stat_metadata=5
+    XLine.name=2 .events=4 .display_name=11
+    XEvent.metadata_id=1 .offset_ps=2 .duration_ps=3 .stats=4
+           .num_occurrences=5
+    XEventMetadata.id=1 .name=2 .metadata=3 .display_name=4
+    XStat.metadata_id=1 (+ oneof value fields 2-7)
+    XStatMetadata.id=1 .name=2
+
+Consumers: ``bench.py`` (BENCH ``extra.profile_slices``),
+``observability.profiler.device_trace_summary`` (the remote
+``profile_device`` post-processing), and the tier-1 smoke lane (the
+CPU backend also emits xplane files, so the parser is testable without
+a chip).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import struct
+
+__all__ = [
+    "parse_xspace", "trace_files", "summarize_trace",
+    "classify_event", "MATMUL_MARKERS",
+]
+
+# Markers (lowercased substring match on op name + display name +
+# hlo category) that classify a device slice as MXU/matmul work.
+# Best-effort by construction: an XLA fusion that embeds a dot only
+# counts when the fusion's HLO text (display_name) names it — which
+# TPU XLA emits for the GEMM-rooted fusions that matter here.
+# "convolution"/"conv2d" (not bare "conv": it matches "convert").
+MATMUL_MARKERS = ("dot", "matmul", "convolution", "conv2d",
+                  "conv_general", "einsum", "mxu", "gemm")
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format walker
+
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _fields(buf: bytes, start: int = 0, end: int | None = None):
+    """Yield (field_number, wire_type, value) triples.
+
+    value: int for varint(0)/fixed64(1)/fixed32(5), bytes-slice
+    (memoryview-free copy) for length-delimited(2).
+    """
+    i = start
+    end = len(buf) if end is None else end
+    while i < end:
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+        elif wire == 1:
+            val = struct.unpack_from("<Q", buf, i)[0]
+            i += 8
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            val = struct.unpack_from("<I", buf, i)[0]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _utf8(b: bytes) -> str:
+    return b.decode("utf-8", errors="replace")
+
+
+def _parse_event(buf: bytes) -> dict:
+    ev = {"metadata_id": 0, "offset_ps": 0, "duration_ps": 0,
+          "stats": []}
+    for f, _, v in _fields(buf):
+        if f == 1:
+            ev["metadata_id"] = v
+        elif f == 2:
+            ev["offset_ps"] = v
+        elif f == 3:
+            ev["duration_ps"] = v
+        elif f == 4:
+            ev["stats"].append(_parse_stat(v))
+        elif f == 5:
+            ev["num_occurrences"] = v
+    return ev
+
+
+def _parse_stat(buf: bytes) -> dict:
+    st: dict = {"metadata_id": 0, "value": None}
+    for f, wire, v in _fields(buf):
+        if f == 1:
+            st["metadata_id"] = v
+        elif f == 2:
+            st["value"] = struct.unpack("<d", struct.pack("<Q", v))[0]
+        elif f in (3, 4, 7):
+            st["value"] = v
+        elif f == 5:
+            st["value"] = _utf8(v)
+        elif f == 6:
+            st["value"] = v  # raw bytes
+    return st
+
+
+def _parse_line(buf: bytes) -> dict:
+    line = {"name": "", "display_name": "", "events": []}
+    for f, _, v in _fields(buf):
+        if f == 2:
+            line["name"] = _utf8(v)
+        elif f == 11:
+            line["display_name"] = _utf8(v)
+        elif f == 4:
+            line["events"].append(_parse_event(v))
+    return line
+
+
+def _parse_metadata_entry(buf: bytes) -> tuple[int, dict]:
+    """One map<int64, XEventMetadata|XStatMetadata> entry."""
+    key = 0
+    meta = {"name": "", "display_name": ""}
+    for f, _, v in _fields(buf):
+        if f == 1:
+            key = v
+        elif f == 2:
+            for mf, _, mv in _fields(v):
+                if mf == 1:
+                    key = key or mv
+                elif mf == 2:
+                    meta["name"] = _utf8(mv)
+                elif mf == 4:
+                    meta["display_name"] = _utf8(mv)
+    return key, meta
+
+
+def _parse_plane(buf: bytes) -> dict:
+    plane = {"name": "", "lines": [], "event_metadata": {},
+             "stat_metadata": {}}
+    for f, _, v in _fields(buf):
+        if f == 2:
+            plane["name"] = _utf8(v)
+        elif f == 3:
+            plane["lines"].append(_parse_line(v))
+        elif f == 4:
+            k, meta = _parse_metadata_entry(v)
+            plane["event_metadata"][k] = meta
+        elif f == 5:
+            k, meta = _parse_metadata_entry(v)
+            plane["stat_metadata"][k] = meta
+    return plane
+
+
+def parse_xspace(path: str) -> dict:
+    """Parse one ``.xplane.pb`` file -> {"planes": [...]}."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    planes = []
+    for f_no, _, v in _fields(buf):
+        if f_no == 1:
+            planes.append(_parse_plane(v))
+    return {"planes": planes}
+
+
+# ---------------------------------------------------------------------------
+# trace summary
+
+
+def trace_files(logdir: str) -> list[str]:
+    """All xplane protobufs under a ``jax.profiler`` logdir."""
+    pats = (os.path.join(logdir, "**", "*.xplane.pb"),
+            os.path.join(logdir, "*.xplane.pb"))
+    out: list[str] = []
+    for p in pats:
+        out.extend(glob.glob(p, recursive=True))
+    return sorted(set(out))
+
+
+def classify_event(name: str, display: str = "",
+                   category: str = "") -> bool:
+    """True when the slice is matmul/MXU work (best-effort name +
+    HLO-text + hlo_category substring match, see MATMUL_MARKERS)."""
+    hay = f"{name} {display} {category}".lower()
+    return any(m in hay for m in MATMUL_MARKERS)
+
+
+def _pick_plane(planes: list[dict]) -> dict | None:
+    """Device plane preference: TPU > GPU > any /device: > busiest."""
+    def n_events(p):
+        return sum(len(ln["events"]) for ln in p["lines"])
+    for marker in ("/device:tpu", "/device:gpu", "/device:"):
+        cand = [p for p in planes
+                if marker in p["name"].lower() and n_events(p)]
+        if cand:
+            return max(cand, key=n_events)
+    with_events = [p for p in planes if n_events(p)]
+    return max(with_events, key=n_events) if with_events else None
+
+
+def _pick_lines(plane: dict) -> list[dict]:
+    """Per-op lines only: 'XLA Ops' when present (the 'XLA Modules' /
+    'Steps' lines span whole programs and would double-count)."""
+    ops = [ln for ln in plane["lines"]
+           if "xla ops" in (ln["name"] or ln["display_name"]).lower()]
+    if ops:
+        return ops
+    lines = [ln for ln in plane["lines"] if ln["events"]]
+    if not lines:
+        return []
+    return [max(lines, key=lambda ln: len(ln["events"]))]
+
+
+def _stat_lookup(plane: dict, ev: dict, stat_name: str) -> str:
+    for st in ev.get("stats", ()):
+        meta = plane["stat_metadata"].get(st["metadata_id"])
+        if meta and meta["name"] == stat_name:
+            return str(st["value"])
+    return ""
+
+
+def summarize_trace(logdir: str, top_k: int = 5,
+                    steps: int = 1) -> dict:
+    """Aggregate a capture into the bench slice breakdown.
+
+    Returns ``{"plane", "total_ms", "matmul_ms", "non_matmul_ms",
+    "matmul_share", "top_non_matmul": [{"name", "ms", "share"}...],
+    "top_matmul": [...], "ms_per_step": ..., "files": n}`` — ms
+    figures are totals over the capture; ``ms_per_step`` divides the
+    total by ``steps`` (the number of optimizer steps the profiled
+    window ran). Raises ValueError when the logdir holds no usable
+    capture.
+    """
+    files = trace_files(logdir)
+    if not files:
+        raise ValueError(f"no xplane captures under {logdir}")
+    agg: dict[str, list] = {}   # name -> [total_ps, is_matmul]
+    plane_name = ""
+    for path in files:
+        space = parse_xspace(path)
+        plane = _pick_plane(space["planes"])
+        if plane is None:
+            continue
+        plane_name = plane_name or plane["name"]
+        for line in _pick_lines(plane):
+            for ev in line["events"]:
+                meta = plane["event_metadata"].get(
+                    ev["metadata_id"], {"name": f"#{ev['metadata_id']}",
+                                        "display_name": ""})
+                name = meta["name"] or meta["display_name"] \
+                    or f"#{ev['metadata_id']}"
+                cat = _stat_lookup(plane, ev, "hlo_category")
+                is_mm = classify_event(name, meta["display_name"], cat)
+                cell = agg.setdefault(name, [0, is_mm])
+                cell[0] += ev["duration_ps"]
+                cell[1] = cell[1] or is_mm
+    if not agg:
+        raise ValueError(
+            f"captures under {logdir} carry no per-op events")
+    total_ps = sum(v[0] for v in agg.values())
+    mm_ps = sum(v[0] for v in agg.values() if v[1])
+
+    def rows(matmul: bool):
+        items = sorted(
+            ((n, v[0]) for n, v in agg.items() if v[1] == matmul),
+            key=lambda kv: kv[1], reverse=True)[:top_k]
+        return [{"name": n[:120],
+                 "ms": round(ps / 1e9 / max(1, steps), 3),
+                 "share": round(ps / max(1, total_ps), 4)}
+                for n, ps in items]
+
+    return {
+        "plane": plane_name,
+        "files": len(files),
+        "total_ms": round(total_ps / 1e9, 3),
+        "ms_per_step": round(total_ps / 1e9 / max(1, steps), 3),
+        "matmul_ms": round(mm_ps / 1e9, 3),
+        "non_matmul_ms": round((total_ps - mm_ps) / 1e9, 3),
+        "matmul_share": round(mm_ps / max(1, total_ps), 4),
+        "top_non_matmul": rows(False),
+        "top_matmul": rows(True),
+    }

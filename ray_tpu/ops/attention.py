@@ -29,12 +29,20 @@ _NEG_INF = -1e30
 
 
 def _flash_ok(q, k, v) -> bool:
+    return (q.shape == k.shape == v.shape
+            and flash_eligible(q.shape[1], q.shape[-1]))
+
+
+def flash_eligible(t: int, d: int) -> bool:
+    """Would ``causal_attention`` dispatch [*, t, *, d] self-attention
+    to the Pallas flash kernel on this backend (absent an
+    ``RAY_TPU_ATTN_KERNEL`` override)? Benchmarks use this to refuse
+    silently measuring the XLA fallback."""
     from ray_tpu.ops.pallas.flash_attention import (
         flash_attention_shapes_ok,
     )
     return (jax.default_backend() == "tpu"
-            and q.shape == k.shape == v.shape
-            and flash_attention_shapes_ok(q.shape[1], q.shape[-1]))
+            and flash_attention_shapes_ok(t, d))
 
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,

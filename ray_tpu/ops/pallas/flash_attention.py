@@ -609,3 +609,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def flash_attention_shapes_ok(t: int, d: int) -> bool:
     return _pick_block(t) >= 128 and d % 8 == 0
+
+
+def resolved_flash_config(t: int, causal: bool = True) -> dict:
+    """The block tiling ``flash_attention`` resolves at seq len ``t``
+    under the current env overrides (RAY_TPU_FLASH_BQ/BK/SPLIT), with
+    no explicit block args. Benchmarks record this in their artifact
+    so a sweep's winner is reproducible from the JSON alone — the knob
+    *settings* alone don't say what tiling actually ran (0/absent
+    means auto-picked).
+    """
+    import os
+    bq = int(os.environ.get("RAY_TPU_FLASH_BQ", 0)) or _pick_block(t)
+    bk = int(os.environ.get("RAY_TPU_FLASH_BK", 0)) or _pick_block(t)
+    n_split = int(os.environ.get("RAY_TPU_FLASH_SPLIT", 0))
+    split_active = (causal and n_split > 1 and bq == t
+                    and t % n_split == 0 and (t // n_split) % 128 == 0)
+    return {"block_q": bq, "block_k": bk,
+            "split": n_split if split_active else 0}

@@ -196,6 +196,33 @@ def _run_benchmarks(rec, quick: bool) -> None:
     rec(row)
     del bufs
 
+    # DevicePrefetcher handoff tax: the background-thread queue hop
+    # per batch with a no-op source — the fixed cost the async input
+    # pipeline (train/prefetch.py) adds on top of whatever it
+    # overlaps; should stay O(10us), invisible next to any real step.
+    # Loaded by file path: ray_tpu.train.__init__ imports jax, and
+    # this harness stays jax-free (backend discovery can hang on a
+    # dead accelerator tunnel).
+    import importlib.util as _ilu
+    import os.path as _osp
+    _spec = _ilu.spec_from_file_location(
+        "_rt_prefetch",
+        _osp.join(_osp.dirname(_osp.abspath(__file__)),
+                  "train", "prefetch.py"))
+    _pfmod = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_pfmod)
+    n_batches = 500 if quick else 5000
+    pf = _pfmod.DevicePrefetcher(iter(range(n_batches)), depth=4)
+    t0 = time.perf_counter()
+    consumed = sum(1 for _ in pf)
+    dt = time.perf_counter() - t0
+    pf.close()
+    row = {"metric": "prefetch_handoff_overhead",
+           "value": round(dt / max(1, consumed) * 1e6, 2),
+           "unit": "us/batch", "extra": {"batches": consumed}}
+    print(json.dumps(row), flush=True)
+    rec(row)
+
     # -- tasks --
     rec(timeit("single_client_tasks_sync",
                lambda: ray_tpu.get(_small_task.remote()),

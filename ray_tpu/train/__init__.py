@@ -10,11 +10,14 @@ Two layers:
 
 from ray_tpu.train.step import (
     TrainState,
+    buffers_donated,
+    compile_count,
     init_train_state,
     make_multi_train_step,
     make_train_step,
     shard_batch,
 )
+from ray_tpu.train.prefetch import DevicePrefetcher, prefetch_to_device
 from ray_tpu.train.config import (
     TRAIN_DATASET_KEY,
     BackendConfig,
@@ -35,6 +38,8 @@ from ray_tpu.train.trainer import JaxTrainer, Result
 __all__ = [
     "TrainState", "init_train_state", "make_train_step",
     "make_multi_train_step", "shard_batch",
+    "compile_count", "buffers_donated",
+    "DevicePrefetcher", "prefetch_to_device",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "BackendConfig", "DataConfig", "SyncConfig", "TRAIN_DATASET_KEY",
     "Checkpoint", "get_checkpoint", "get_context", "get_dataset_shard", "report",

@@ -1,0 +1,168 @@
+"""Async input pipeline: overlap host batch production and H2D
+transfer with device compute.
+
+The train-step hot loop must never wait on the host. A synchronous
+loop pays, per step: host batch production (RNG / dataset decode) +
+``device_put`` dispatch + the step itself. :class:`DevicePrefetcher`
+moves the first two off the critical path: a background thread pulls
+host batches from the source, places them on device (``device_put``
+only *dispatches* the transfer — the copy itself proceeds async under
+the runtime), and parks up to ``depth`` device-resident batches in a
+bounded queue. The consuming loop pops a ready batch and immediately
+dispatches the next step, so step N's compute overlaps step N+1's
+input production and transfer (classic double buffering at
+``depth=2``).
+
+This is the single input-overlap implementation for the framework:
+``bench.py``'s hot loops, ``Dataset.iter_device_batches`` (the
+train.fit() path via ``get_dataset_shard``), and user loops through
+``ray_tpu.train.prefetch_to_device`` all ride it.
+
+Donation-safe: the queue drops its reference when a batch is yielded,
+so a jitted step with donated batch arguments (``donate_batch=True``
+in ``make_train_step``) can reuse the buffers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator
+
+__all__ = ["DevicePrefetcher", "prefetch_to_device"]
+
+_SENTINEL = object()
+
+
+class DevicePrefetcher:
+    """Iterator yielding device-placed batches produced ahead of
+    consumption by a background thread.
+
+    Parameters
+    ----------
+    source:
+        Iterable (or iterator) of host batches. May block (dataset
+        reads) — that is exactly the work being overlapped.
+    place:
+        ``batch -> device batch``; ``None`` passes batches through
+        (source already yields device-resident values, e.g. a jitted
+        on-device generator). Runs on the background thread.
+    depth:
+        Max batches in flight past the one being consumed. 2 = double
+        buffering; larger depths absorb burstier sources at the cost
+        of live-batch memory.
+
+    Stats (for bench/debug): ``batches``, ``stall_s`` (cumulative time
+    the consumer blocked waiting — ~0 means input is fully hidden),
+    ``produce_s`` (cumulative background production+placement time).
+    """
+
+    def __init__(self, source: Iterable | Iterator,
+                 place: Callable[[Any], Any] | None = None,
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._source = iter(source)
+        self._place = place
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: BaseException | None = None
+        self.depth = depth
+        self.batches = 0
+        self.stall_s = 0.0
+        self.produce_s = 0.0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="device_prefetch")
+        self._thread.start()
+
+    # -- background producer --
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    batch = next(self._source)
+                except StopIteration:
+                    break
+                if self._place is not None:
+                    batch = self._place(batch)
+                self.produce_s += time.perf_counter() - t0
+                # Bounded put, polling the stop flag so close() never
+                # deadlocks against a full queue.
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — surfaced on next()
+            self._err = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    # -- consumer side --
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        item = self._q.get()
+        self.stall_s += time.perf_counter() - t0
+        if item is _SENTINEL:
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        self.batches += 1
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and release queued batches."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def prefetch_to_device(batches: Iterable, mesh=None, *, depth: int = 2,
+                       batch_dim: int = 0, seq_sharded: bool = False,
+                       place: Callable[[Any], Any] | None = None):
+    """Wrap an iterable of host batches in a :class:`DevicePrefetcher`
+    that shards each batch across ``mesh`` (via
+    ``train.step.shard_batch``) ahead of consumption.
+
+    ``place`` overrides the placement function entirely (ignoring
+    ``mesh``); ``mesh=None`` without ``place`` dispatches a plain
+    ``jax.device_put``.
+    """
+    if place is None:
+        if mesh is not None:
+            from ray_tpu.train.step import shard_batch
+
+            def place(b):  # noqa: E306
+                return shard_batch(b, mesh, seq_sharded=seq_sharded,
+                                   batch_dim=batch_dim)
+        else:
+            import jax
+
+            def place(b):  # noqa: E306
+                return jax.tree_util.tree_map(jax.device_put, b)
+    return DevicePrefetcher(batches, place=place, depth=depth)

@@ -63,32 +63,50 @@ def _step_body(loss_fn, optimizer, has_extra, grad_norm):
     return step
 
 
+def _donate_argnums(donate: bool, donate_batch: bool) -> tuple:
+    return (() if not donate else ((0, 1) if donate_batch else (0,)))
+
+
 def make_train_step(loss_fn: Callable, optimizer,
                     has_extra: bool = False,
                     donate: bool = True,
-                    grad_norm: bool = True) -> Callable:
-    """Build the jitted step.
+                    grad_norm: bool = True,
+                    donate_batch: bool = False) -> Callable:
+    """Build the jitted step: forward, backward, gradient psum (via
+    sharding propagation) and the optimizer update fused into ONE
+    compiled program with the param/opt-state buffers donated — the
+    update happens in place in HBM, no re-materialized param copy.
 
     loss_fn: (params, batch) -> loss            (has_extra=False)
              (params, extra, batch) -> (loss, new_extra)  (True)
     Returns step(state, batch) -> (state, metrics).
     ``grad_norm=False`` skips the global-norm metric (a full f32 read
     of every gradient leaf — measurable on HBM-bound steps).
+    ``donate_batch=True`` additionally marks the batch buffers
+    donatable — safe when each batch is consumed exactly once (the
+    ``train.prefetch`` pipeline drops its reference on yield). Caveat:
+    XLA donation is input->output aliasing, so it only engages when
+    some output matches a batch leaf's shape/dtype; for a pure-input
+    batch (the usual LM token case) XLA ignores it with a warning,
+    which is why it is off by default.
     """
     step = _step_body(loss_fn, optimizer, has_extra, grad_norm)
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return jax.jit(step,
+                   donate_argnums=_donate_argnums(donate, donate_batch))
 
 
 def make_multi_train_step(loss_fn: Callable, optimizer,
                           has_extra: bool = False,
                           donate: bool = True,
-                          grad_norm: bool = True) -> Callable:
+                          grad_norm: bool = True,
+                          donate_batch: bool = False) -> Callable:
     """Scan variant: one compiled program runs K optimizer steps over
     a batch stack whose leaves carry a leading [K, ...] axis. Same
     math as K calls of the single step — the scan just amortizes
     per-dispatch overhead (host round-trip, arg handling) across K
     steps, exactly like queueing K async dispatches. Returns
-    (state, metrics_of_last_step)."""
+    (state, metrics_of_last_step). ``donate_batch`` donates the batch
+    stack buffers too (see :func:`make_train_step`)."""
     body = _step_body(loss_fn, optimizer, has_extra, grad_norm)
 
     def multi(state: TrainState, batches):
@@ -96,7 +114,36 @@ def make_multi_train_step(loss_fn: Callable, optimizer,
         last = jax.tree_util.tree_map(lambda x: x[-1], ms)
         return state, last
 
-    return jax.jit(multi, donate_argnums=(0,) if donate else ())
+    return jax.jit(multi,
+                   donate_argnums=_donate_argnums(donate, donate_batch))
+
+
+def compile_count(step_fn: Callable) -> int | None:
+    """Number of distinct executables compiled for a jitted step fn
+    (``None`` when the jax runtime doesn't expose it).
+
+    The fused-step contract after warmup is a STABLE count: one
+    compile for the initial input layouts plus at most one relayout
+    compile once donated outputs (whose layouts the compiler picks)
+    feed back as inputs — the count must never keep growing with
+    steps (a growing count means every dispatch pays a compile).
+    """
+    size = getattr(step_fn, "_cache_size", None)
+    if size is None:
+        return None
+    try:
+        return int(size())
+    except Exception:  # noqa: BLE001 — introspection must never raise
+        return None
+
+
+def buffers_donated(tree) -> bool:
+    """True when every jax array leaf of ``tree`` was consumed by a
+    donating dispatch (``is_deleted``) — the observable proof that a
+    donated step really took ownership of its input buffers."""
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "is_deleted")]
+    return bool(leaves) and all(x.is_deleted() for x in leaves)
 
 
 def batch_spec(mesh, *, seq_sharded: bool = False,

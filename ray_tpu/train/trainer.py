@@ -51,6 +51,16 @@ class JaxTrainer:
     train_loop_per_worker runs inside each gang worker; it uses
     ``ray_tpu.train.get_context()`` for rank/size and
     ``ray_tpu.train.report(metrics, checkpoint=...)`` to stream results.
+
+    For the device hot loop, use the same fused-step/prefetch plumbing
+    the bench measures (docs/training_perf.md): build the step with
+    ``train.make_train_step`` / ``make_multi_train_step`` (optimizer
+    update jitted into the step, param/opt-state buffers donated in
+    place) and feed it from
+    ``get_dataset_shard(name).iter_device_batches(batch_size, mesh)``
+    — or ``train.prefetch_to_device`` for a custom source — so host
+    input staging overlaps device compute instead of serializing with
+    it. ``DataContext.prefetch_batches`` is the overlap depth.
     """
 
     # Backend hook: which TrainWorker method builds the collective

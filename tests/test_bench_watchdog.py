@@ -52,6 +52,29 @@ def test_dead_backend_emits_error_json_and_exits_nonzero():
     assert dt < 45, dt
 
 
+def test_smoke_lane_proves_fused_step_claims():
+    """`bench.py --smoke` (the CPU tier-1 lane) must pass end to end:
+    fused step donates, compile count stable, prefetcher feeds the hot
+    loop, xplane parser reads back a real capture — one JSON line,
+    rc 0. No device-time claims are made or checked."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, str(BENCH), "--smoke"], capture_output=True,
+        text=True, timeout=420, env=env)
+    lines = [l for l in out.stdout.strip().splitlines()  # noqa: E741
+             if l.strip().startswith("{")]
+    assert lines, f"no JSON line: {out.stdout!r} / {out.stderr[-300:]!r}"
+    line = json.loads(lines[-1])
+    assert out.returncode == 0, (line, out.stderr[-300:])
+    assert line["metric"] == "bench_smoke" and line["ok"] is True
+    extra = line["extra"]
+    assert extra["donated"] is True
+    assert extra["compiles_stable"] is True
+    assert extra["fused_step_compiles"] <= 2
+    assert extra["prefetched_all"] is True
+    assert extra["xplane_parsed"] is True
+
+
 def test_child_crash_reports_json():
     # A child that raises (not hangs) must still print a JSON line.
     out = subprocess.run(

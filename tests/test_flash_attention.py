@@ -130,3 +130,30 @@ def test_causal_split_matches_dense():
             np.testing.assert_allclose(
                 np.asarray(gs), np.asarray(gd), atol=5e-4, rtol=5e-4,
                 err_msg=f"d{name} mismatch at n_split={n_split}")
+
+
+def test_resolved_flash_config_mirrors_env_knobs(monkeypatch):
+    """resolved_flash_config is what benchmarks write into their
+    artifact's extra.attn_blocks — it must track the kernel's own
+    env-override resolution (RAY_TPU_FLASH_BQ/BK/SPLIT)."""
+    from ray_tpu.ops.pallas.flash_attention import resolved_flash_config
+
+    for var in ("RAY_TPU_FLASH_BQ", "RAY_TPU_FLASH_BK",
+                "RAY_TPU_FLASH_SPLIT"):
+        monkeypatch.delenv(var, raising=False)
+    auto = resolved_flash_config(1024)
+    assert auto == {"block_q": 1024, "block_k": 1024, "split": 0}
+
+    monkeypatch.setenv("RAY_TPU_FLASH_BQ", "256")
+    monkeypatch.setenv("RAY_TPU_FLASH_BK", "512")
+    assert resolved_flash_config(1024) == {
+        "block_q": 256, "block_k": 512, "split": 0}
+
+    # Split engages only at full-T block_q with 128-aligned bands —
+    # the same predicate flash_attention itself applies.
+    monkeypatch.setenv("RAY_TPU_FLASH_SPLIT", "2")
+    assert resolved_flash_config(1024)["split"] == 0  # bq=256 != t
+    monkeypatch.delenv("RAY_TPU_FLASH_BQ")
+    monkeypatch.delenv("RAY_TPU_FLASH_BK")
+    assert resolved_flash_config(1024)["split"] == 2
+    assert resolved_flash_config(1024, causal=False)["split"] == 0

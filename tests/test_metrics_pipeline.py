@@ -24,7 +24,16 @@ from ray_tpu.util.metrics import (
 
 
 def _wait_for(fn, timeout=20.0, interval=0.25):
-    """Poll fn() until truthy; return its last value."""
+    """Poll fn() until truthy; return its last value.
+
+    Load-gated (same signal as conftest.perf_floor_gate): on an
+    oversubscribed host the exporter flush threads are starved of
+    scheduler slices, so the asserted state arrives late, not never —
+    stretch the deadline instead of flaking (tier-1 seed failure:
+    cluster-scrape timing out under driver load)."""
+    from conftest import LOAD_SOFT, host_load_factor
+    if host_load_factor() > LOAD_SOFT:
+        timeout *= 4.0
     deadline = time.monotonic() + timeout
     val = fn()
     while not val and time.monotonic() < deadline:

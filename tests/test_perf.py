@@ -234,3 +234,108 @@ def test_memory_summary_1k_objects_bounded(rt):
     assert len(ms["top_objects"]) == 20
     assert dt < 0.5, f"memory_summary took {dt:.3f}s for 1k objects"
     del refs
+
+
+# ---------------------------------------------------------------------------
+# Fused donated train step: step-time guardrails (PR 9)
+
+
+def _fused_step_time_ms(build, n_timed=3):
+    """Warm a fused donated step (2 calls), then median-of-n step
+    time. Returns (ms_per_step, compile_count_after)."""
+    import statistics
+    import time
+
+    from ray_tpu.train import compile_count
+
+    state, step, batches = build()
+    for b in batches[:2]:
+        state, m = step(state, b)
+    float(m["loss"])
+    times = []
+    for b in batches[2:2 + n_timed]:
+        t0 = time.perf_counter()
+        state, m = step(state, b)
+        float(m["loss"])
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * 1e3, compile_count(step)
+
+
+def test_gpt2_fused_step_time_guardrail():
+    """Tiny-GPT-2 fused donated step on the CPU backend: order-of-
+    magnitude guardrail (load-gated) + the compile-count pin on the
+    exact step construction bench.py times. Catches an accidentally
+    unfused/recompiling hot loop, not noise."""
+    from conftest import perf_floor_gate
+    relax = perf_floor_gate()
+    jax = pytest.importorskip("jax")
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.models.gpt2 import gpt2_loss_fn
+    from ray_tpu.train import init_train_state, make_train_step
+
+    def build():
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        state = init_train_state(
+            model.init_params(jax.random.key(0)), optax.adamw(1e-3))
+        step = make_train_step(gpt2_loss_fn(model, ce_chunk=64),
+                               optax.adamw(1e-3), grad_norm=False)
+        rng = np.random.default_rng(0)
+        batches = []
+        for _ in range(6):
+            toks = rng.integers(0, cfg.vocab_size,
+                                (2, cfg.seq_len)).astype(np.int32)
+            batches.append({"tokens": toks,
+                            "targets": np.roll(toks, -1, 1)})
+        return state, step, batches
+
+    ms, compiles = _fused_step_time_ms(build)
+    # Measured ~5-15 ms/step on this 1-core box; 150 ms = 10-30x
+    # headroom before the guardrail trips.
+    assert ms < 150 * relax, f"tiny-GPT-2 fused step {ms:.1f}ms"
+    assert compiles is None or compiles <= 2, (
+        f"fused step compiled {compiles} executables at one shape")
+
+
+def test_resnet_fused_step_time_guardrail():
+    """Same contract for the ResNet bench path (donated fused step
+    with batch_stats extra): load-gated step-time ceiling + stable
+    compile count."""
+    from conftest import perf_floor_gate
+    relax = perf_floor_gate()
+    jax = pytest.importorskip("jax")
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import ResNet, ResNet50Config
+    from ray_tpu.models.resnet import resnet_loss_fn
+    from ray_tpu.train import init_train_state, make_train_step
+
+    def build():
+        cfg = ResNet50Config.tiny()
+        model = ResNet(cfg)
+        variables = model.init_variables(jax.random.key(0), 32)
+        opt = optax.sgd(0.1, momentum=0.9)
+        state = init_train_state(variables["params"], opt,
+                                 extra=variables["batch_stats"])
+        step = make_train_step(resnet_loss_fn(model), opt,
+                               has_extra=True, grad_norm=False)
+        rng = np.random.default_rng(0)
+        batches = []
+        for _ in range(6):
+            batches.append({
+                "image": rng.standard_normal(
+                    (4, 32, 32, 3)).astype(np.float32),
+                "label": rng.integers(
+                    0, cfg.num_classes, (4,)).astype(np.int32),
+            })
+        return state, step, batches
+
+    ms, compiles = _fused_step_time_ms(build)
+    # Measured ~10-30 ms/step here; 300 ms = ~10-30x headroom.
+    assert ms < 300 * relax, f"tiny-ResNet fused step {ms:.1f}ms"
+    assert compiles is None or compiles <= 2, (
+        f"fused step compiled {compiles} executables at one shape")
