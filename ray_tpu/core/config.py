@@ -221,6 +221,31 @@ class Config:
     # stays ring-buffered for the next interval.
     metrics_flush_batch: int = 2048
 
+    # --- causal tracing (reference: tracing_helper.py span
+    # propagation around every .remote(); Dapper-style head-side
+    # assembly) ---
+    # Probability a new trace root is head-sampled. Roots that lose
+    # the roll are still recorded but marked deferred; the head keeps
+    # them only under the two rules below. Workers inherit this via
+    # RAY_TPU_TRACE_SAMPLE_RATE in their spawn env.
+    trace_sample_rate: float = 1.0
+    # Keep a deferred trace anyway if any span in it errored.
+    trace_sample_on_error: bool = True
+    # Keep a deferred trace anyway if its wall time crossed this many
+    # milliseconds (tail-latency force sampling; 0 = off).
+    trace_force_sample_ms: float = 0.0
+    # Open an ingress root span per proxied serve request (HTTP and
+    # gRPC), with router dispatch / retry attempts and replica
+    # execution as children. Off by default so the serve hot path
+    # stays span-free; sampling knobs above apply when on.
+    trace_serve_requests: bool = False
+    # Head-side TraceStore bounds: max assembled traces retained, how
+    # long a trace waits for missing parents before orphans are
+    # adopted, and idle TTL before a trace is swept.
+    trace_store_max_traces: int = 512
+    trace_orphan_grace_s: float = 3.0
+    trace_ttl_s: float = 900.0
+
     # --- serve request plane (reference: serve/_private/{router,
     # replica,proxy}.py — request retries, deployment health checks,
     # graceful draining, and proxy back-pressure) ---

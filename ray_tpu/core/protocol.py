@@ -234,10 +234,15 @@ ST_ERR = "err"
 # connection's FIFO gives per-caller call ordering without a head hop.
 
 OP_CALL_DIRECT = "call_direct"  # (OP_CALL_DIRECT, seq, task_id_bytes,
-                                #  method, args_blob, num_returns) —
-                                # args are INLINE in the frame
+                                #  method, args_blob, num_returns
+                                #  [, trace_ctx]) — args are INLINE in
+                                # the frame
                                 # (<= direct_call_inline_threshold;
                                 # larger calls head-route instead).
+                                # trace_ctx = (trace_id, span_id) is
+                                # an OPTIONAL 7th element: untraced
+                                # calls keep the 6-tuple shape so the
+                                # disabled path pays zero extra bytes.
 OP_CALL_DIRECT_BATCH = "call_direct_batch"
                                 # (OP_CALL_DIRECT_BATCH, [frame, ...])
                                 # — pipelining: everything queued in

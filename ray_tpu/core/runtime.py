@@ -496,6 +496,11 @@ class WorkerHandle:
         env.update(env_vars)
         env["RAY_TPU_WORKER"] = "1"
         env["RAY_TPU_NODE_ID"] = node_id
+        # Head-set sampling knob pushed to workers: the worker tracer
+        # reads it at construction, so the disabled/sampled-out path
+        # never pays a head round-trip.
+        env["RAY_TPU_TRACE_SAMPLE_RATE"] = str(
+            runtime.config.trace_sample_rate)
         # Propagate the driver's import path so workers resolve the same
         # modules (incl. a repo added to sys.path by the driver script).
         env["PYTHONPATH"] = os.pathsep.join(
@@ -1939,8 +1944,14 @@ class DriverRuntime:
             # dependency/placement error — already propagated
             self._prune_task(rec)
             return
+        from ray_tpu.util.tracing import get_tracer
+        t0 = time.time() if get_tracer().enabled else 0.0
         try:
             self._dispatch(rec, spawn_ok=spawn_ok)
+            if t0:
+                self._record_head_span(
+                    "head.dispatch", rec, t0, time.time(),
+                    {"task": rec.name, "node": rec.node_id})
         except self._InlineNeedsSpawn:
             raise
         except Exception:  # noqa: BLE001
@@ -2037,7 +2048,43 @@ class DriverRuntime:
         else:
             self._pending_classes[rec.sched_class] = c
 
+    def _record_head_span(self, name: str, rec: TaskRecord,
+                          start: float, end: float,
+                          attrs: dict | None = None) -> None:
+        """Record a head-side span under a traced task's trace. Spans
+        are synthesized post-hoc from (start, end) — the head never
+        holds an open span across scheduler lock boundaries, and an
+        untraced task (trace_ctx=None) costs nothing here."""
+        from ray_tpu.util.tracing import get_tracer
+        ctx = getattr(rec.options, "trace_ctx", None)
+        tr = get_tracer()
+        if not tr.enabled or not ctx:
+            return
+        import uuid
+        tr.add_spans([{
+            "name": name, "trace_id": ctx[0],
+            "span_id": uuid.uuid4().hex[:16], "parent_id": ctx[1],
+            "start": start, "end": end,
+            "attributes": dict(attrs or {}), "process": "head",
+        }])
+
     def _next_schedulable_locked(self) -> TaskRecord | None:
+        """Scan wrapper that times the resource scan for the causal
+        trace plane: a traced task that sat behind a long placement
+        scan shows a ``head.resource_scan`` span explaining the gap
+        between driver submit and worker execution."""
+        from ray_tpu.util.tracing import get_tracer
+        if not get_tracer().enabled:
+            return self._next_schedulable_scan_locked()
+        t0 = time.time()
+        rec = self._next_schedulable_scan_locked()
+        if rec is not None and rec.state != "FAILED":
+            self._record_head_span(
+                "head.resource_scan", rec, t0, time.time(),
+                {"task": rec.name, "node": rec.node_id})
+        return rec
+
+    def _next_schedulable_scan_locked(self) -> TaskRecord | None:
         unplaceable: set[tuple] = set()
         saw_deps = False
         for i, rec in enumerate(self._pending):
@@ -4271,6 +4318,19 @@ class DriverRuntime:
                 top_n=int(opts.get("top_n", 20)))
         if kind == "cluster_status":
             return self.cluster_status()
+        if kind == "trace":
+            opts = filters if isinstance(filters, dict) else {}
+            return self.get_trace(str(opts.get("trace_id", "")))
+        if kind == "traces":
+            opts = filters if isinstance(filters, dict) else {}
+            return self.list_traces(
+                limit=int(opts.get("limit", 50)),
+                slowest=bool(opts.get("slowest", False)))
+        if kind == "trace_export":
+            opts = filters if isinstance(filters, dict) else {}
+            return self.observability.export_trace(
+                str(opts.get("trace_id", "")),
+                str(opts.get("format", "chrome")))
         fns = {
             "tasks": state_api.list_tasks,
             "actors": state_api.list_actors,
@@ -4335,6 +4395,19 @@ class DriverRuntime:
         and autoscaler intent (reference: ray status)."""
         from ray_tpu.observability.introspect import cluster_status
         return cluster_status(self)
+
+    def get_trace(self, trace_id: str) -> dict | None:
+        """One assembled trace tree with critical-path analysis (the
+        'where did this request go?' surface; spans from every plane
+        — head, workers, serve — joined by trace_id)."""
+        return self.observability.get_trace(trace_id)
+
+    def list_traces(self, limit: int = 50,
+                    slowest: bool = False) -> list[dict]:
+        """Assembled-trace summaries, newest first (or slowest first
+        with ``slowest=True``)."""
+        return self.observability.list_traces(
+            limit=limit, slowest=slowest)
 
     # ------------- direct actor-call plane (location leases) ----------
 
@@ -5904,8 +5977,7 @@ class DriverRuntime:
             self.drop_stream(payload)
             return None
         if op == P.OP_SPANS:
-            from ray_tpu.util.tracing import get_tracer
-            get_tracer().add_spans(payload)
+            self.observability.ingest_spans(payload)
             return None
         if op == P.OP_METRICS_PUSH:
             self.observability.ingest_push(payload)
@@ -6016,6 +6088,19 @@ class DriverRuntime:
                     top_n=int(opts.get("top_n", 20)))
             if kind == "cluster_status":
                 return self.cluster_status()
+            if kind == "trace":
+                opts = filters if isinstance(filters, dict) else {}
+                return self.get_trace(str(opts.get("trace_id", "")))
+            if kind == "traces":
+                opts = filters if isinstance(filters, dict) else {}
+                return self.list_traces(
+                    limit=int(opts.get("limit", 50)),
+                    slowest=bool(opts.get("slowest", False)))
+            if kind == "trace_export":
+                opts = filters if isinstance(filters, dict) else {}
+                return self.observability.export_trace(
+                    str(opts.get("trace_id", "")),
+                    str(opts.get("format", "chrome")))
             return fns[kind](filters)
         if op == P.OP_PROFILE:
             action, spec = payload

@@ -28,6 +28,7 @@ from ray_tpu.core.exceptions import ActorError, GetTimeoutError, TaskError
 from ray_tpu.core.ids import ActorID, ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.serialization import SerializedObject
+from ray_tpu.util.tracing import get_tracer
 
 
 _EMPTY_ARGS_BLOB = None
@@ -114,8 +115,8 @@ class _DirectChannel:
         self._cv = threading.Condition(self._lock)
         self._seq = itertools.count()
         # seq -> (task_id_bytes, method, args_blob, num_returns,
-        #         [rid_bytes], [nonces]); insertion order IS seq order,
-        # which the fallback replay relies on.
+        #         [rid_bytes], [nonces], trace_ctx); insertion order
+        # IS seq order, which the fallback replay relies on.
         self.unacked: dict[int, tuple] = {}
         self._outbox: deque = deque()
         self._out_ev = threading.Event()
@@ -166,10 +167,15 @@ class _DirectChannel:
 
     def submit(self, task_id_bytes: bytes, method: str,
                args_blob: bytes, num_returns: int,
-               rid_bytes: list, nonces: list) -> None:
+               rid_bytes: list, nonces: list,
+               trace_ctx=None) -> None:
         """Enqueue one call frame; raises _DirectChannelDead instead
         of silently losing a call. Blocks (briefly) when the unacked
-        window is full — back-pressure bounds the replay buffer."""
+        window is full — back-pressure bounds the replay buffer.
+
+        ``trace_ctx`` rides as an OPTIONAL 7th frame element: the
+        untraced steady state keeps the exact 6-tuple frame shape
+        (zero extra wire bytes, zero extra frames)."""
         with self._cv:
             while not self.dead and len(self.unacked) >= self.window:
                 self._cv.wait(0.5)
@@ -177,10 +183,13 @@ class _DirectChannel:
                 raise _DirectChannelDead
             seq = next(self._seq)
             self.unacked[seq] = (task_id_bytes, method, args_blob,
-                                 num_returns, rid_bytes, nonces)
-            self._outbox.append(
-                (P.OP_CALL_DIRECT, seq, task_id_bytes, method,
-                 args_blob, num_returns))
+                                 num_returns, rid_bytes, nonces,
+                                 trace_ctx)
+            frame = (P.OP_CALL_DIRECT, seq, task_id_bytes, method,
+                     args_blob, num_returns)
+            if trace_ctx is not None:
+                frame += (trace_ctx,)
+            self._outbox.append(frame)
         self._out_ev.set()
 
     def _sender_loop(self) -> None:
@@ -375,7 +384,11 @@ class DirectCallServer:
                 pass
 
     def _handle_call(self, conn, send_lock, frame) -> None:
-        _op, seq, tid, method, args_blob, num_returns = frame
+        # Frame is 6 elements untraced, 7 with a propagated
+        # (trace_id, span_id) — the optional tail keeps the hot
+        # untraced path byte-identical.
+        _op, seq, tid, method, args_blob, num_returns = frame[:6]
+        trace_ctx = frame[6] if len(frame) > 6 else None
 
         def ack(status, payload):
             try:
@@ -412,7 +425,8 @@ class DirectCallServer:
             ack(*out)
 
         self.calls_served += 1
-        self._execute(tid, method, args_blob, num_returns, reply)
+        self._execute(tid, method, args_blob, num_returns, reply,
+                      trace_ctx)
 
     def _finish(self, tid: bytes, out: tuple) -> None:
         with self._state_lock:
@@ -423,17 +437,30 @@ class DirectCallServer:
         if ev is not None:
             ev.set()
 
-    def try_replay_on_exec(self, tid: bytes, send_fn) -> bool:
+    def try_replay_on_exec(self, tid: bytes, send_fn,
+                           claim: bool = False) -> bool:
         """Exec-channel dedupe: a head-routed push for a task this
         worker already executed directly replies the cached result
         (re-serialized as a normal RESULT frame) instead of re-running
-        the method. Returns False for fresh tasks."""
+        the method. Returns False for fresh tasks.
+
+        ``claim=True`` additionally registers a fresh tid as in flight
+        under the same lock, making the ledger symmetric: a direct
+        frame for the same task id still buffered on a dying
+        connection can be delivered AFTER the head replay executed,
+        and without the claim ``_handle_call`` would find an empty
+        ledger and run the method a second time. The caller must then
+        complete the execution through :meth:`exec_reply` /
+        :meth:`finish_exec` so direct-plane waiters get the cached
+        result."""
         with self._state_lock:
             cached = self._completed.get(tid)
             ev = None if cached is not None \
                 else self._inflight.get(tid)
-        if cached is None and ev is None:
-            return False
+            if cached is None and ev is None:
+                if claim:
+                    self._inflight[tid] = threading.Event()
+                return False
 
         def _send_cached(c):
             kind = P.RESULT_OK if c[0] == P.DC_OK else P.RESULT_ERR
@@ -452,6 +479,23 @@ class DirectCallServer:
 
         threading.Thread(target=_wait_send, daemon=True).start()
         return True
+
+    def finish_exec(self, tid: bytes, msg: tuple) -> None:
+        """Ledger completion for a head-routed execution claimed via
+        ``try_replay_on_exec(claim=True)``: cache the RESULT frame in
+        direct-ack shape so late direct-plane frames and waiters are
+        answered from the ledger."""
+        self._finish(tid, (P.DC_OK, msg[2]) if msg[0] == P.RESULT_OK
+                     else (P.DC_ERR, msg[2]))
+
+    def exec_reply(self, tid: bytes, send_fn):
+        """Result sink for a claimed head-routed execution: completes
+        the at-most-once ledger, then ships the normal exec-channel
+        RESULT frame."""
+        def reply(msg):
+            self.finish_exec(tid, msg)
+            send_fn(msg)
+        return reply
 
 class ClientRuntime:
     """Worker-side proxy of the driver runtime over the unix socket.
@@ -1092,6 +1136,23 @@ class ClientRuntime:
         so = self._direct_fetch(oid, timeout)
         if so is not None:
             return so
+        tr = get_tracer()
+        if not tr.enabled:
+            return self._head_get(oid, timeout)
+        # Object-plane fetch span: byte size + transfer kind, so a
+        # trace shows WHERE the wall time went when an argument or
+        # result pull dominates a task. Untraced processes skip
+        # straight through above — zero cost when tracing is off.
+        with tr.span("object.fetch",
+                     {"object_id": oid.hex()[:16],
+                      "source_node": "head"}) as s:
+            so = self._head_get(oid, timeout)
+            if s is not None:
+                s.attributes["bytes"] = so.total_size
+            return so
+
+    def _head_get(self, oid: ObjectID,
+                  timeout: float | None = None) -> SerializedObject:
         out = self._call(P.OP_GET,
                          (oid.binary(), timeout, self._allow_desc))
         if self._barrier_oids:
@@ -1122,6 +1183,21 @@ class ClientRuntime:
         which dominated worker-side get([...])
         (multi_client_tasks_async). Oversized lists split so one
         reply frame stays bounded."""
+        tr = get_tracer()
+        if not tr.enabled:
+            return self._get_serialized_many(oids, timeout)
+        with tr.span("object.fetch",
+                     {"num_objects": len(oids),
+                      "source_node": "head"}) as s:
+            objs = self._get_serialized_many(oids, timeout)
+            if s is not None:
+                s.attributes["bytes"] = sum(
+                    o.total_size for o in objs)
+            return objs
+
+    def _get_serialized_many(self, oids: list[ObjectID],
+                             timeout: float | None = None
+                             ) -> list[SerializedObject]:
         from ray_tpu.core.config import get_config
         batch = max(1, get_config().get_many_batch_size)
         entries: list = []
@@ -1516,32 +1592,33 @@ class ClientRuntime:
         # Direct fast path: worker->worker frame over the actor's
         # peer listener, ZERO head frames. Eligibility mirrors the
         # knobs documented in docs/actor_calls.md: a resolved lease,
-        # untraced, inline-size ref-free args. Everything else (and
+        # inline-size ref-free args. Traced calls stay eligible — the
+        # (trace_id, span_id) rides the call frame itself, so tracing
+        # no longer forces a head round-trip. Everything else (and
         # any channel failure) takes the head-routed path below.
         blob = None
-        if trace_ctx is None:
-            chan = self._direct_channel_for(aid)
-            with self._direct_res_lock:
-                if aid in self._direct_barrier:
-                    chan = None     # head stream not yet drained
-            if chan is not None:
-                blob = _args_blob(args, kwargs)
-                from ray_tpu.core.config import get_config
-                if (len(blob)
-                        <= get_config().direct_call_inline_threshold
-                        and not _has_toplevel_refs(args, kwargs)):
-                    with self._actor_lock_for(aid):
-                        try:
-                            self._direct_register_pending(rid_bytes)
-                            chan.submit(task_id.binary(), method,
-                                        blob, num_returns, rid_bytes,
-                                        nonces)
-                            self.actor_calls_direct += 1
-                            return self._direct_make_refs(
-                                return_ids, nonces)
-                        except _DirectChannelDead:
-                            self._direct_unregister_pending(rid_bytes)
-                            self._direct_fallback(aid, chan)
+        chan = self._direct_channel_for(aid)
+        with self._direct_res_lock:
+            if aid in self._direct_barrier:
+                chan = None     # head stream not yet drained
+        if chan is not None:
+            blob = _args_blob(args, kwargs)
+            from ray_tpu.core.config import get_config
+            if (len(blob)
+                    <= get_config().direct_call_inline_threshold
+                    and not _has_toplevel_refs(args, kwargs)):
+                with self._actor_lock_for(aid):
+                    try:
+                        self._direct_register_pending(rid_bytes)
+                        chan.submit(task_id.binary(), method,
+                                    blob, num_returns, rid_bytes,
+                                    nonces, trace_ctx)
+                        self.actor_calls_direct += 1
+                        return self._direct_make_refs(
+                            return_ids, nonces)
+                    except _DirectChannelDead:
+                        self._direct_unregister_pending(rid_bytes)
+                        self._direct_fallback(aid, chan)
         self.actor_calls_head_routed += 1
         self._call_async(P.OP_SUBMIT_ACTOR_OWNED, (
             aid, method,
@@ -1722,7 +1799,7 @@ class ClientRuntime:
             if items:
                 self.direct_call_fallbacks += 1
             for _seq, (tid_b, method, args_blob, num_returns,
-                       rid_bytes, nonces) in items:
+                       rid_bytes, nonces, trace_ctx) in items:
                 # Re-route the pending results to the head BEFORE the
                 # replay lands: a concurrent get() must block on the
                 # head path, not on a local event no ack will fire.
@@ -1744,8 +1821,12 @@ class ClientRuntime:
                         self._direct_results[rb] = ("head",)
                         ev.set()
                 try:
+                    # The replay carries the ORIGINAL trace_ctx: the
+                    # hosting worker's ledger dedupes an already-
+                    # executed tid (cached result, no re-run), so a
+                    # replayed traced call never emits a second span.
                     self._call_async(P.OP_SUBMIT_ACTOR_OWNED, (
-                        aid, method, args_blob, num_returns, None,
+                        aid, method, args_blob, num_returns, trace_ctx,
                         tid_b, rid_bytes, nonces))
                     for rb in dead_rids:
                         self._notify(P.OP_BORROW, ("release", rb))
@@ -2367,7 +2448,8 @@ def worker_main(conn, client_address: str) -> None:
         out_ev.set()
 
     def try_exec_on_loop(task_id_bytes, method, args_blob, resolved,
-                         num_returns, trace_ctx) -> bool:
+                         num_returns, trace_ctx,
+                         ledger=None) -> bool:
         """Direct-to-loop fast path for coroutine actor methods: the
         threadpool route costs two thread handoffs per call (pool
         thread -> loop -> pool thread blocked in Future.result()); on
@@ -2407,14 +2489,16 @@ def worker_main(conn, client_address: str) -> None:
             async with loop_sem:
                 try:
                     result = await bound(*args, **kwargs)
-                    send_from_loop((P.RESULT_OK, task_id_bytes,
-                                    _serialize_returns(result,
-                                                       num_returns)))
+                    msg = (P.RESULT_OK, task_id_bytes,
+                           _serialize_returns(result, num_returns))
                 except BaseException:  # noqa: BLE001
                     err = ActorError(method, traceback.format_exc(),
                                      None)
-                    send_from_loop((P.RESULT_ERR, task_id_bytes,
-                                    ser.dumps(err)))
+                    msg = (P.RESULT_ERR, task_id_bytes,
+                           ser.dumps(err))
+                if ledger is not None:
+                    ledger.finish_exec(task_id_bytes, msg)
+                send_from_loop(msg)
 
         asyncio.run_coroutine_threadsafe(_acall(), _ensure_actor_loop())
         return True
@@ -2430,14 +2514,14 @@ def worker_main(conn, client_address: str) -> None:
             return
 
         def _direct_execute(tid, method, args_blob, num_returns,
-                            reply):
+                            reply, trace_ctx=None):
             if executor is not None:
                 executor.submit(exec_actor_call, tid, method,
-                                args_blob, {}, num_returns, None,
+                                args_blob, {}, num_returns, trace_ctx,
                                 reply)
             else:
                 exec_actor_call(tid, method, args_blob, {},
-                                num_returns, None, reply)
+                                num_returns, trace_ctx, reply)
 
         try:
             _direct_server = DirectCallServer(
@@ -2495,9 +2579,14 @@ def worker_main(conn, client_address: str) -> None:
         elif kind == P.EXEC_ACTOR_CALL:
             (_, task_id_bytes, method, args_blob, resolved,
              num_returns, trace_ctx) = msg
+            # Streaming results never flow through the reply sink, so
+            # they cannot complete a ledger claim — leave them out.
+            claim = (_direct_server is not None
+                     and num_returns != "streaming")
             if _direct_server is not None and \
                     _direct_server.try_replay_on_exec(task_id_bytes,
-                                                      send):
+                                                      send,
+                                                      claim=claim):
                 # A fallback replay of a call this process already
                 # executed over the direct plane: the cached result
                 # was (or will be) re-sent — never run it twice.
@@ -2505,13 +2594,21 @@ def worker_main(conn, client_address: str) -> None:
             elif executor is not None:
                 if not try_exec_on_loop(task_id_bytes, method,
                                         args_blob, resolved,
-                                        num_returns, trace_ctx):
+                                        num_returns, trace_ctx,
+                                        _direct_server if claim
+                                        else None):
                     executor.submit(exec_actor_call, task_id_bytes,
                                     method, args_blob, resolved,
-                                    num_returns, trace_ctx)
+                                    num_returns, trace_ctx,
+                                    _direct_server.exec_reply(
+                                        task_id_bytes, send)
+                                    if claim else None)
             else:
                 exec_actor_call(task_id_bytes, method, args_blob,
-                                resolved, num_returns, trace_ctx)
+                                resolved, num_returns, trace_ctx,
+                                _direct_server.exec_reply(
+                                    task_id_bytes, send)
+                                if claim else None)
         return True
 
     try:

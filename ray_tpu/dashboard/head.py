@@ -7,6 +7,8 @@ Endpoints (reference: dashboard modules python/ray/dashboard/modules/):
   GET /api/summary            task-state counts
   GET /api/timeline           chrome-trace JSON (ray.timeline analog)
   GET /api/spans              tracing spans (util.tracing)
+  GET /api/v1/traces          assembled trace summaries (TraceStore)
+  GET /api/v1/traces/<id>     one trace tree (?format=chrome|perfetto)
   GET /metrics                Prometheus exposition (util.metrics)
   GET /api/v1/status          cluster_status (ray status analog)
   GET /api/v1/memory          memory_summary (ray memory analog)
@@ -115,6 +117,27 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.util.tracing import get_tracer
                 self._send_json(
                     [s.to_dict() for s in get_tracer().get_spans()])
+            elif path in ("/api/traces", "/api/v1/traces"):
+                # Assembled trace summaries from the head TraceStore
+                # (?slowest=1 ranks by duration, ?limit=N).
+                self._send_json(rt.list_traces(
+                    limit=self._qint("limit", 50),
+                    slowest=self._qstr("slowest") in ("1", "true")))
+            elif path.startswith(("/api/traces/",
+                                  "/api/v1/traces/")):
+                # One assembled trace tree; ?format=chrome|perfetto
+                # exports viewer JSON (chrome://tracing / Perfetto).
+                tid = path.rsplit("/", 1)[-1]
+                fmt = self._qstr("format")
+                if fmt in ("chrome", "perfetto"):
+                    out = rt.observability.export_trace(tid, fmt)
+                else:
+                    out = rt.get_trace(tid)
+                if out is None:
+                    self._send(404, json.dumps(
+                        {"error": f"unknown trace {tid}"}).encode())
+                else:
+                    self._send_json(out)
             elif path == "/api/serve/applications":
                 from ray_tpu import serve
                 self._send_json(serve.status())
@@ -347,6 +370,7 @@ padding:4px 10px}}</style></head><body>
 <a href="/api/placement_groups">placement_groups</a>
 <a href="/api/summary">summary</a>
 <a href="/api/timeline">timeline</a> <a href="/api/spans">spans</a>
+<a href="/api/v1/traces">traces</a>
 <a href="/metrics">metrics</a>
 <a href="/api/v1/status">status</a>
 <a href="/api/v1/memory">memory</a>

@@ -53,6 +53,7 @@ from ray_tpu.observability.task_events import (
     recording_enabled,
     set_recording,
 )
+from ray_tpu.observability.tracestore import TraceStore
 
 __all__ = [
     "ClusterMetricsAggregator",
@@ -60,6 +61,7 @@ __all__ = [
     "ObservabilityPlane",
     "ProfilerBusyError",
     "TaskEventStore",
+    "TraceStore",
     "cluster_status",
     "collapsed_text",
     "drain_events",

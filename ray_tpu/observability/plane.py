@@ -15,6 +15,7 @@ import time
 
 from ray_tpu.observability.aggregator import ClusterMetricsAggregator
 from ray_tpu.observability.task_events import TaskEventStore
+from ray_tpu.observability.tracestore import TraceStore
 
 
 class ObservabilityPlane:
@@ -25,6 +26,12 @@ class ObservabilityPlane:
         self.aggregator = ClusterMetricsAggregator()
         self.task_events = TaskEventStore(
             max_tasks=cfg.task_event_buffer_size)
+        self.traces = TraceStore(
+            max_traces=cfg.trace_store_max_traces,
+            orphan_grace_s=cfg.trace_orphan_grace_s,
+            ttl_s=cfg.trace_ttl_s,
+            sample_on_error=cfg.trace_sample_on_error,
+            force_sample_ms=cfg.trace_force_sample_ms)
         self.pushes_ingested = 0
 
     def set_enabled(self, on: bool) -> None:
@@ -59,12 +66,51 @@ class ObservabilityPlane:
             self.task_events.add_batch(node_id, worker_id, events)
         spans = payload.get("spans") or []
         if spans:
-            from ray_tpu.util.tracing import get_tracer
-            try:
-                get_tracer().add_spans(spans)
-            except (TypeError, KeyError):
-                pass           # malformed remote spans: drop, don't die
+            self.ingest_spans(spans)
         self.pushes_ingested += 1
+
+    def ingest_spans(self, spans: list) -> None:
+        """Remote finished spans (exporter batch or direct OP_SPANS
+        flush): into the head tracer ring (timeline surface) AND the
+        TraceStore (trace assembly). TraceStore dedupes by span id, so
+        double-delivery is a no-op."""
+        from ray_tpu.util.tracing import get_tracer
+        try:
+            get_tracer().add_spans(spans)
+        except (TypeError, KeyError):
+            pass               # malformed remote spans: drop, don't die
+        try:
+            self.traces.add_spans(spans)
+        except (TypeError, KeyError):
+            pass
+
+    def _sync_head_spans(self) -> None:
+        """Fold the head process's own finished spans (driver submit::
+        spans, head.dispatch instrumentation) into the TraceStore —
+        they never ride an exporter push. Dedupe makes this idempotent."""
+        from ray_tpu.util.tracing import get_tracer
+        self.traces.add_spans(
+            [s.to_dict() for s in get_tracer().get_spans()])
+
+    # -- trace query surfaces -------------------------------------------
+
+    def get_trace(self, trace_id: str) -> dict | None:
+        self._sync_head_spans()
+        return self.traces.get_trace(trace_id)
+
+    def list_traces(self, limit: int = 50,
+                    slowest: bool = False) -> list[dict]:
+        self._sync_head_spans()
+        return self.traces.list_traces(limit=limit, slowest=slowest)
+
+    def export_trace(self, trace_id: str,
+                     fmt: str = "chrome") -> list | dict | None:
+        self._sync_head_spans()
+        if self.traces.get_trace(trace_id) is None:
+            return None
+        if fmt == "perfetto":
+            return self.traces.perfetto_trace(trace_id)
+        return self.traces.chrome_trace(trace_id)
 
     # -- head-local task events ----------------------------------------
 

@@ -1,0 +1,303 @@
+"""Head-side trace assembly: spans -> trees -> critical paths.
+
+Finished spans already flow to the head (the PR 3 exporter batches
+them into ``OP_METRICS_PUSH``; ``OP_SPANS`` is the direct flush
+path).  This store is the other half of Dapper-style tracing: group
+those spans by ``trace_id``, join them into a tree over the real
+remote-parent linkage, and answer "where did this 800 ms request go?"
+with a per-trace critical path and per-span self-times.
+
+Semantics:
+
+- **Orphan grace** — a span whose parent has not arrived yet is held
+  as an orphan; within ``orphan_grace_s`` of the trace's last new
+  span the trace reports ``complete=False``.  After the grace window
+  the orphans are adopted under the root (tagged ``orphan=True``) so
+  a tree with a lost hop is still readable.
+- **Bounded retention** — at most ``max_traces`` traces, oldest
+  (by last activity) evicted first; traces idle past ``ttl_s`` are
+  swept.
+- **Deferred sampling** — a root carrying
+  :data:`ray_tpu.util.tracing.DEFERRED_ATTR` lost the worker-side
+  sampling roll.  Once its grace window closes, the trace is kept
+  only if it errored (``sample_on_error``) or its wall time crossed
+  ``force_sample_ms`` (tail-latency force sampling); otherwise it is
+  dropped and counted in ``traces_sampled_out``.
+- **Critical path** — walk from the root, at each level following
+  the child that *finishes last* (the blocking child); each step
+  contributes its self-time = duration minus the union of its own
+  children's intervals.  For nested (non-overlapping-sibling) trees
+  the self-times along the path sum to the root's wall time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu.util.tracing import DEFERRED_ATTR
+
+
+def _union_covered(span: dict, children: list[dict]) -> float:
+    """Seconds of ``span``'s interval covered by the given spans
+    (each clipped to ``span``'s own window)."""
+    ivs = sorted(
+        (max(c["start"], span["start"]), min(c["end"], span["end"]))
+        for c in children)
+    covered = 0.0
+    cur_s = cur_e = None
+    for s, e in ivs:
+        if e <= s:
+            continue
+        if cur_e is None:
+            cur_s, cur_e = s, e
+        elif s <= cur_e:
+            cur_e = max(cur_e, e)
+        else:
+            covered += cur_e - cur_s
+            cur_s, cur_e = s, e
+    if cur_e is not None:
+        covered += cur_e - cur_s
+    return covered
+
+
+class TraceStore:
+    def __init__(self, max_traces: int = 512,
+                 orphan_grace_s: float = 3.0,
+                 ttl_s: float = 900.0,
+                 sample_on_error: bool = True,
+                 force_sample_ms: float = 0.0):
+        self.max_traces = max_traces
+        self.orphan_grace_s = orphan_grace_s
+        self.ttl_s = ttl_s
+        self.sample_on_error = sample_on_error
+        self.force_sample_ms = force_sample_ms
+        self._lock = threading.Lock()
+        # trace_id -> {"spans": {span_id: span_dict},
+        #              "first_seen": ts, "last_seen": ts}
+        self._traces: dict[str, dict] = {}
+        self.spans_ingested = 0
+        self.traces_evicted = 0
+        self.traces_sampled_out = 0
+
+    # -- ingest ---------------------------------------------------------
+
+    def add_spans(self, span_dicts: list[dict],
+                  now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            for d in span_dicts:
+                if not isinstance(d, dict):
+                    continue
+                tid = d.get("trace_id")
+                sid = d.get("span_id")
+                if not tid or not sid:
+                    continue
+                tr = self._traces.get(tid)
+                if tr is None:
+                    tr = {"spans": {}, "first_seen": now,
+                          "last_seen": now}
+                    self._traces[tid] = tr
+                if sid not in tr["spans"]:        # dedupe: replays and
+                    tr["spans"][sid] = dict(d)    # double-feeds are no-ops
+                    tr["last_seen"] = now
+                    self.spans_ingested += 1
+            self._sweep_locked(now)
+
+    def _sweep_locked(self, now: float) -> None:
+        # TTL + deferred-sampling finalize, then size-bounded evict.
+        dead = []
+        for tid, tr in self._traces.items():
+            idle = now - tr["last_seen"]
+            if idle > self.ttl_s:
+                dead.append((tid, False))
+                continue
+            if idle > self.orphan_grace_s and self._deferred_drop(tr):
+                dead.append((tid, True))
+        for tid, sampled in dead:
+            del self._traces[tid]
+            if sampled:
+                self.traces_sampled_out += 1
+            else:
+                self.traces_evicted += 1
+        while len(self._traces) > self.max_traces:
+            oldest = min(self._traces,
+                         key=lambda t: self._traces[t]["last_seen"])
+            del self._traces[oldest]
+            self.traces_evicted += 1
+
+    def _deferred_drop(self, tr: dict) -> bool:
+        """True if this trace lost the sampling roll AND earned no
+        error/tail keep — drop it at finalize."""
+        spans = tr["spans"].values()
+        root = None
+        for s in spans:
+            if s.get("parent_id") is None:
+                if root is None or s["start"] < root["start"]:
+                    root = s
+        if root is None or not (root.get("attributes") or {}).get(
+                DEFERRED_ATTR):
+            return False
+        if self.sample_on_error and any(
+                (s.get("attributes") or {}).get("error")
+                for s in spans):
+            return False
+        if self.force_sample_ms > 0:
+            dur_ms = (max(s["end"] for s in spans)
+                      - min(s["start"] for s in spans)) * 1e3
+            if dur_ms >= self.force_sample_ms:
+                return False
+        return True
+
+    # -- assembly -------------------------------------------------------
+
+    def _assemble_locked(self, tid: str, now: float) -> dict | None:
+        tr = self._traces.get(tid)
+        if tr is None or not tr["spans"]:
+            return None
+        spans = sorted(tr["spans"].values(), key=lambda s: s["start"])
+        by_id = {s["span_id"]: s for s in spans}
+        children: dict[str, list[dict]] = {}
+        roots: list[dict] = []
+        orphans: list[dict] = []
+        for s in spans:
+            pid = s.get("parent_id")
+            if pid is None:
+                roots.append(s)
+            elif pid in by_id:
+                children.setdefault(pid, []).append(s)
+            else:
+                orphans.append(s)
+
+        in_grace = (now - tr["last_seen"]) < self.orphan_grace_s
+        root = roots[0] if roots else None
+        if root is None and orphans:
+            # No root at all (e.g. sampled-out caller): oldest orphan
+            # anchors the tree so the trace is still inspectable.
+            root = orphans.pop(0)
+        if root is None:
+            return None
+        adopted = 0
+        if orphans and not in_grace:
+            # Grace expired: adopt the strays under the root so the
+            # tree is complete-with-a-scar rather than broken.
+            for o in orphans:
+                o = dict(o)
+                o.setdefault("attributes", {})
+                o["attributes"]["orphan"] = True
+                children.setdefault(root["span_id"], []).append(o)
+                adopted += 1
+            orphans = []
+        for extra in roots[1:]:
+            children.setdefault(root["span_id"], []).append(extra)
+
+        def build(node: dict) -> tuple[dict, list[dict]]:
+            kids = sorted(children.get(node["span_id"], []),
+                          key=lambda s: s["start"])
+            built: list[dict] = []
+            desc: list[dict] = []
+            for k in kids:
+                sub, sub_desc = build(k)
+                built.append(sub)
+                desc.append(k)
+                desc.extend(sub_desc)
+            dur = max(0.0, node["end"] - node["start"])
+            # Self time subtracts ALL descendants, not just direct
+            # children: an async submit span ends when the handoff
+            # returns while the execution it spawned — its child —
+            # is still running, so the grandchild escapes the direct
+            # child's interval yet is attributed work, not self time
+            # of the ancestor.
+            self_s = max(0.0, dur - _union_covered(node, desc))
+            return ({**node,
+                     "duration_ms": round(dur * 1e3, 3),
+                     "self_time_ms": round(self_s * 1e3, 3),
+                     "children": built}, desc)
+
+        tree, _ = build(root)
+
+        # Critical path: follow the child that finishes last.
+        path = []
+        node = tree
+        while True:
+            path.append({
+                "span_id": node["span_id"], "name": node["name"],
+                "process": node.get("process", ""),
+                "duration_ms": node["duration_ms"],
+                "self_time_ms": node["self_time_ms"],
+            })
+            if not node["children"]:
+                break
+            node = max(node["children"], key=lambda c: c["end"])
+
+        wall_ms = (max(s["end"] for s in spans)
+                   - min(s["start"] for s in spans)) * 1e3
+        errors = [s["span_id"] for s in spans
+                  if (s.get("attributes") or {}).get("error")]
+        return {
+            "trace_id": tid,
+            "root": {"name": root["name"],
+                     "attributes": root.get("attributes") or {}},
+            "start": min(s["start"] for s in spans),
+            "duration_ms": round(wall_ms, 3),
+            "num_spans": len(spans),
+            "complete": not orphans,
+            "pending_orphans": len(orphans),
+            "orphans_adopted": adopted,
+            "errors": errors,
+            "tree": tree,
+            "critical_path": path,
+            "critical_path_self_ms": round(
+                sum(p["self_time_ms"] for p in path), 3),
+        }
+
+    # -- query surfaces -------------------------------------------------
+
+    def get_trace(self, trace_id: str,
+                  now: float | None = None) -> dict | None:
+        now = time.time() if now is None else now
+        with self._lock:
+            return self._assemble_locked(trace_id, now)
+
+    def list_traces(self, limit: int = 50, slowest: bool = False,
+                    now: float | None = None) -> list[dict]:
+        now = time.time() if now is None else now
+        with self._lock:
+            self._sweep_locked(now)
+            rows = []
+            for tid in list(self._traces):
+                t = self._assemble_locked(tid, now)
+                if t is None:
+                    continue
+                rows.append({k: t[k] for k in (
+                    "trace_id", "start", "duration_ms", "num_spans",
+                    "complete", "errors")} | {
+                    "root": t["root"]["name"]})
+        rows.sort(key=(lambda r: -r["duration_ms"]) if slowest
+                  else (lambda r: -r["start"]))
+        return rows[:max(1, int(limit))]
+
+    # -- export formats -------------------------------------------------
+
+    def chrome_trace(self, trace_id: str) -> list[dict]:
+        """One trace as Chrome-trace events (``chrome://tracing``)."""
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            spans = list(tr["spans"].values()) if tr else []
+        return [{
+            "name": s["name"], "ph": "X",
+            "pid": s.get("process") or "driver",
+            "tid": s["trace_id"],
+            "ts": s["start"] * 1e6,
+            "dur": max(0.0, s["end"] - s["start"]) * 1e6,
+            "args": s.get("attributes") or {},
+        } for s in sorted(spans, key=lambda s: s["start"])]
+
+    def perfetto_trace(self, trace_id: str) -> dict:
+        """Perfetto-openable JSON (Chrome-trace events wrapped in the
+        ``traceEvents`` envelope Perfetto's legacy importer reads)."""
+        return {"traceEvents": self.chrome_trace(trace_id),
+                "displayTimeUnit": "ms"}
+
+
+__all__ = ["TraceStore"]

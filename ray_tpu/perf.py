@@ -727,6 +727,31 @@ def _run_benchmarks(rec, quick: bool) -> None:
     print(json.dumps(row), flush=True)
     rec(row)
 
+    # trace_assembly_1k_spans: head-side TraceStore cost for one
+    # 1000-span trace — ingest (span-id dedupe) + full assembly
+    # (tree build, per-span self-times, critical path). This is what
+    # a runtime.get_trace / dashboard /api/v1/traces/<id> hit pays
+    # on a deep trace.
+    from ray_tpu.observability.tracestore import TraceStore as _TS
+    _tbase = time.time()
+    _tspans = [{
+        "name": f"s{i}", "trace_id": "a" * 16,
+        "span_id": f"sp{i:04d}",
+        "parent_id": None if i == 0 else f"sp{(i - 1) // 2:04d}",
+        "start": _tbase + i * 1e-4,
+        "end": _tbase + 0.5 + i * 1e-4,
+        "attributes": {}, "process": "perf",
+    } for i in range(1000)]
+
+    def _one_assembly():
+        ts = _TS(max_traces=4)
+        ts.add_spans(_tspans)
+        t = ts.get_trace("a" * 16)
+        assert t is not None and t["num_spans"] == 1000
+
+    rec(timeit("trace_assembly_1k_spans", _one_assembly,
+               unit="assemblies/s", quick=quick))
+
 
 def run_serve_bench(quick: bool = False) -> list[dict]:
     """Serve benchmarks: handle requests/s, HTTP proxy echo with the

@@ -173,6 +173,92 @@ def _cmd_summary(args) -> int:
     return 0
 
 
+def _render_trace(t: dict) -> str:
+    """Text rendering of one assembled trace tree: the span tree with
+    per-span total/self times, then the critical path."""
+    lines = [
+        f"trace {t['trace_id']}  root={t['root']['name']}  "
+        f"{t['duration_ms']:.1f} ms  spans={t['num_spans']}  "
+        f"complete={t['complete']}"
+        + (f"  errors={len(t['errors'])}" if t["errors"] else "")]
+
+    def walk(node: dict, depth: int) -> None:
+        attrs = node.get("attributes") or {}
+        extra = ""
+        if attrs.get("error"):
+            extra += f"  error={attrs['error']}"
+        if attrs.get("verdict"):
+            extra += f"  verdict={attrs['verdict']}"
+        if attrs.get("orphan"):
+            extra += "  (orphan)"
+        lines.append(
+            f"  {'  ' * depth}{node['name']}  "
+            f"{node['duration_ms']:.1f} ms "
+            f"(self {node['self_time_ms']:.1f} ms)  "
+            f"[{node.get('process', '')}]" + extra)
+        for k in node.get("children", ()):
+            walk(k, depth + 1)
+
+    walk(t["tree"], 0)
+    lines.append(f"critical path "
+                 f"({t['critical_path_self_ms']:.1f} ms self):")
+    for p in t["critical_path"]:
+        lines.append(f"  {p['name']}  self {p['self_time_ms']:.1f} ms"
+                     f"  [{p['process']}]")
+    return "\n".join(lines) + "\n"
+
+
+def _cmd_trace(args) -> int:
+    """``ray_tpu trace <id>``: one assembled trace tree from the head
+    TraceStore — span tree, per-span self-times, critical path.
+    --format chrome|perfetto writes viewer JSON to --output."""
+    c = _Client(_discover_address(args.address))
+    if args.format:
+        events = c.state("trace_export",
+                         {"trace_id": args.trace_id,
+                          "format": args.format})
+        if events is None:
+            print(f"unknown trace {args.trace_id}", file=sys.stderr)
+            return 1
+        out = args.output or f"trace-{args.trace_id}.json"
+        with open(out, "w") as f:
+            json.dump(events, f)
+        print(f"wrote {args.format} trace to {out}")
+        return 0
+    t = c.state("trace", {"trace_id": args.trace_id})
+    if t is None:
+        print(f"unknown trace {args.trace_id} (expired, sampled "
+              f"out, or never traced)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(t, indent=2, default=str))
+        return 0
+    sys.stdout.write(_render_trace(t))
+    return 0
+
+
+def _cmd_traces(args) -> int:
+    """``ray_tpu traces``: assembled-trace summaries, newest first
+    (--slowest ranks by duration instead)."""
+    c = _Client(_discover_address(args.address))
+    rows = c.state("traces", {"limit": args.limit,
+                              "slowest": args.slowest})
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    if not rows:
+        print("no traces (is tracing enabled? see "
+              "docs/observability.md)")
+        return 0
+    print(f"{'trace_id':17} {'duration_ms':>12} {'spans':>6} "
+          f"{'errs':>5} {'done':>5}  root")
+    for r in rows:
+        print(f"{r['trace_id']:17} {r['duration_ms']:>12.1f} "
+              f"{r['num_spans']:>6} {len(r['errors']):>5} "
+              f"{str(r['complete']):>5}  {r['root']}")
+    return 0
+
+
 def _cmd_timeline(args) -> int:
     c = _Client(_discover_address(args.address))
     events = c.state("timeline")
@@ -631,6 +717,31 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("usage", help="print local usage summary")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_usage)
+
+    p = sub.add_parser(
+        "trace", help="print one assembled causal trace (span tree, "
+                      "self-times, critical path)")
+    p.add_argument("trace_id", help="trace id (e.g. from an error "
+                                    "response's X-Request-Id join, "
+                                    "or `ray-tpu traces`)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--format", choices=["chrome", "perfetto"],
+                   default=None,
+                   help="write viewer JSON instead of text")
+    p.add_argument("--output", "-o", default=None,
+                   help="output path for --format (default "
+                        "trace-<id>.json)")
+    p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser(
+        "traces", help="list assembled causal traces")
+    p.add_argument("--address", default=None)
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--slowest", action="store_true",
+                   help="rank by duration (tail-latency triage)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_traces)
 
     p = sub.add_parser("timeline", help="dump chrome trace")
     p.add_argument("--output", "-o", default="timeline.json")

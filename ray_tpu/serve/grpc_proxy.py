@@ -228,7 +228,18 @@ class GRPCProxyActor:
 
         def _make_unary(method_name: str):
             async def unary(request: bytes, context):
+                import uuid
+
                 md = _md(context)
+                # Stable request id (PR 7 semantics, mirroring the
+                # HTTP proxy): honors an inbound x-request-id
+                # metadata entry, rides every retry attempt and the
+                # replica ledger, and is echoed back as trailing
+                # metadata so a failed call can be joined to its
+                # trace (``ray_tpu trace`` on the id attribute).
+                rid = md.get("x-request-id") or uuid.uuid4().hex
+                context.set_trailing_metadata(
+                    (("x-request-id", rid),))
                 target = proxy._target_for(md)
                 if target is None:
                     await context.abort(
@@ -258,7 +269,8 @@ class GRPCProxyActor:
                             method_name, (arg,), {},
                             multiplexed_model_id=md.get(
                                 "multiplexed_model_id", ""),
-                            deadline_ts=deadline_ts)
+                            deadline_ts=deadline_ts,
+                            request_id=rid)
 
                     try:
                         result = await loop.run_in_executor(None, call)
@@ -274,7 +286,12 @@ class GRPCProxyActor:
 
         def _make_stream(method_name: str):
             async def stream(request: bytes, context):
+                import uuid
+
                 md = _md(context)
+                rid = md.get("x-request-id") or uuid.uuid4().hex
+                context.set_trailing_metadata(
+                    (("x-request-id", rid),))
                 target = proxy._target_for(md)
                 if target is None:
                     await context.abort(

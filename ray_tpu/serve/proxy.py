@@ -31,18 +31,46 @@ import ray_tpu
 _RETRY_AFTER_S = "1"
 
 
-def error_response(e: BaseException):
+def _flush_trace_spans() -> None:
+    """Ship this proxy process's finished spans to the head NOW so a
+    just-completed request's trace assembles without waiting out the
+    exporter interval. Best-effort: on failure the spans stay ring-
+    buffered for the next exporter flush."""
+    try:
+        from ray_tpu.core import api
+        from ray_tpu.core import protocol as P
+        from ray_tpu.util.tracing import get_tracer
+        tr = get_tracer()
+        if not tr.enabled:
+            return
+        spans = tr.drain_dicts()
+        if spans:
+            rt = api.get_runtime()
+            try:
+                rt._call(P.OP_SPANS, spans)
+            except Exception:  # noqa: BLE001 — head briefly away:
+                tr.requeue_dicts(spans)   # next exporter flush owns it
+    except Exception:  # noqa: BLE001 — tracing must never fail a
+        pass           # request
+
+
+
+def error_response(e: BaseException, request_id: str = ""):
     """(status, headers, body-dict) for a failed routed request —
-    shared by the JSON and ASGI paths and golden-tested."""
+    shared by the JSON and ASGI paths and golden-tested. With a
+    request id, 503/504 answers carry ``X-Request-Id`` so a failed
+    request can be joined to its trace (``ray_tpu trace`` on the id
+    attribute)."""
     from ray_tpu.serve.exceptions import classify
     kind = classify(e)
+    rid_hdr = {"X-Request-Id": request_id} if request_id else {}
     if kind in ("overload", "replica_busy"):
-        return (503, {"Retry-After": _RETRY_AFTER_S},
+        return (503, {"Retry-After": _RETRY_AFTER_S, **rid_hdr},
                 {"error": "overloaded", "detail": str(e)[:500]})
     if kind == "deadline":
-        return (504, {},
+        return (504, dict(rid_hdr),
                 {"error": "deadline exceeded", "detail": str(e)[:500]})
-    return (500, {}, {"error": str(e)[:500]})
+    return (500, dict(rid_hdr), {"error": str(e)[:500]})
 
 
 @ray_tpu.remote
@@ -169,15 +197,48 @@ class ProxyActor:
 
         asyncio.new_event_loop().run_until_complete(run())
 
+    @staticmethod
+    def _traced_route(router, rid, path, name, payload_args,
+                      deadline_ts, retry):
+        """One routed request in an executor thread. When serve
+        tracing is on, the proxy ingress span is the TRACE ROOT and
+        carries the stable request id — the router/attempt/replica
+        spans all nest under it, and the whole tree is retrievable by
+        that id after a failure (X-Request-Id joins the two)."""
+        from ray_tpu.core.config import get_config as _gc
+
+        def _route():
+            return router.call("__call__", payload_args, {},
+                               deadline_ts=deadline_ts, retry=retry,
+                               request_id=rid)
+
+        if not _gc().trace_serve_requests:
+            return _route()
+        from ray_tpu.util.tracing import get_tracer
+        tr = get_tracer()
+        tr.enable()
+        try:
+            with tr.span("serve.ingress",
+                         {"request_id": rid, "route": path,
+                          "deployment": name, "proxy": "http"}):
+                return _route()
+        finally:
+            _flush_trace_spans()
+
     async def _dispatch(self, request, path, matched_prefix, name,
                         is_asgi):
         import asyncio
+        import uuid
 
         from aiohttp import web
         body = await request.read()
         router = self._router_for(name)
         deadline_ts = self._deadline_for(request)
         loop = asyncio.get_running_loop()
+        # Stable request id minted at the edge (PR 7 semantics: the
+        # same id rides every retry attempt and the replica ledger);
+        # also the trace join key on error responses.
+        rid = request.headers.get("X-Request-Id") or uuid.uuid4().hex
 
         if is_asgi:
             # ASGI mount (reference: HTTPProxy ASGI path,
@@ -197,14 +258,14 @@ class ProxyActor:
             }
 
             def call_asgi():
-                return router.call("__call__", (asgi_req,), {},
-                                   deadline_ts=deadline_ts,
-                                   retry=self._retry)
+                return self._traced_route(
+                    router, rid, path, name, (asgi_req,),
+                    deadline_ts, self._retry)
 
             try:
                 out = await loop.run_in_executor(None, call_asgi)
             except Exception as e:  # noqa: BLE001
-                status, headers, payload = error_response(e)
+                status, headers, payload = error_response(e, rid)
                 return web.json_response(payload, status=status,
                                          headers=headers)
             resp = web.Response(status=out.get("status", 200),
@@ -226,14 +287,14 @@ class ProxyActor:
             payload = dict(request.query)
 
         def call():
-            return router.call("__call__", (payload,), {},
-                               deadline_ts=deadline_ts,
-                               retry=self._retry)
+            return self._traced_route(
+                router, rid, path, name, (payload,),
+                deadline_ts, self._retry)
 
         try:
             result = await loop.run_in_executor(None, call)
         except Exception as e:  # noqa: BLE001
-            status, headers, out = error_response(e)
+            status, headers, out = error_response(e, rid)
             return web.json_response(out, status=status,
                                      headers=headers)
         if isinstance(result, (bytes, str)):

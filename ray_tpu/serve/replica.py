@@ -145,12 +145,19 @@ class Replica:
             self._total -= 1
         self._m_queue.set(float(self._inflight))
 
-    def _replay(self, hit: tuple):
-        self._m_dedupe.inc()
-        kind, payload = hit
-        if kind == "err":
-            raise payload
-        return payload
+    def _replay(self, hit: tuple, request_id: str = ""):
+        # Ledger hit: the span marks "answered from the ledger, not
+        # re-run" in the assembled trace — the causal explanation for
+        # a retried request with only ONE execute span.
+        from ray_tpu.util.tracing import get_tracer
+        with get_tracer().span(
+                "serve.replica.ledger_replay",
+                {"request_id": request_id, "replica": self.tag}):
+            self._m_dedupe.inc()
+            kind, payload = hit
+            if kind == "err":
+                raise payload
+            return payload
 
     def _stream_wrapper(self, gen, multiplexed_model_id: str):
         """Owns the inflight count AND the model pin for a streaming
@@ -199,7 +206,7 @@ class Replica:
             with self._lock:
                 hit = self._ledger.get(request_id)
             if hit is not None:
-                return self._replay(hit)
+                return self._replay(hit, request_id)
         # Admission gates — all fire before user code runs.
         now = _time.time()
         with self._lock:
@@ -238,7 +245,7 @@ class Replica:
                     self._executing[request_id] = threading.Event()
             if hit is not None:
                 self._release_slot()
-                return self._replay(hit)
+                return self._replay(hit, request_id)
             if waiter is not None:
                 # Concurrent duplicate: only the first execution
                 # occupies a queue slot — release ours, then wait it
@@ -250,7 +257,7 @@ class Replica:
                 with self._lock:
                     hit = self._ledger.get(request_id)
                 if hit is not None:
-                    return self._replay(hit)
+                    return self._replay(hit, request_id)
                 raise RequestDeadlineError(
                     f"duplicate of request {request_id} timed out "
                     f"waiting for the first execution")
@@ -288,7 +295,15 @@ class Replica:
             fn = (getattr(self.callable, method_name)
                   if hasattr(self.callable, method_name)
                   else self.callable)
-            result = fn(*args, **kwargs)
+            from ray_tpu.util.tracing import get_tracer
+            with get_tracer().span(
+                    "serve.replica.execute",
+                    {"request_id": request_id, "replica": self.tag,
+                     "method": method_name}):
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    import asyncio
+                    result = asyncio.run(result)
             if inspect.isgenerator(result):
                 if not stream:
                     raise TypeError(
@@ -302,9 +317,6 @@ class Replica:
                 raise TypeError(
                     f"stream=True but {method_name} returned "
                     f"{type(result).__name__}, not a generator")
-            if inspect.iscoroutine(result):
-                import asyncio
-                result = asyncio.run(result)
             if dedupe:
                 self._record(request_id, "ok", result)
             return result

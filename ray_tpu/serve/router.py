@@ -449,6 +449,22 @@ class Router:
 
         if request_id is None:
             request_id = uuid.uuid4().hex
+        from ray_tpu.util.tracing import get_tracer
+        with get_tracer().span(
+                "serve.router",
+                {"deployment": self._name, "request_id": request_id,
+                 "attempts_used": attempts_used}):
+            return self._call_with_retry(
+                cfg, method_name, args, kwargs, multiplexed_model_id,
+                deadline_ts, per_call, request_id, attempts_used,
+                first_error, exclude)
+
+    def _call_with_retry(self, cfg, method_name, args, kwargs,
+                         multiplexed_model_id, deadline_ts, per_call,
+                         request_id, attempts_used, first_error,
+                         exclude):
+        from ray_tpu.util.tracing import get_tracer
+        tr = get_tracer()
         overall_deadline = time.time() + per_call
         max_attempts = 1 + max(0, cfg.serve_request_max_retries)
         attempt = attempts_used
@@ -500,12 +516,25 @@ class Router:
                 budget = overall_deadline - time.time()
                 if deadline_ts:
                     budget = min(budget, deadline_ts - time.time())
-                ref = replica.handle_request.remote(
-                    method_name, args, kwargs,
-                    multiplexed_model_id=multiplexed_model_id,
-                    stream=False, request_id=request_id,
-                    deadline_ts=deadline_ts)
-                return ray_tpu.get(ref, timeout=max(0.01, budget))
+                # Attempt span: the replica's execute span becomes its
+                # child (the .remote() below propagates this context),
+                # and a failed attempt carries the classifier verdict
+                # the retry decision was made on.
+                with tr.span("serve.attempt",
+                             {"attempt": attempt, "replica": key,
+                              "request_id": request_id}) as att:
+                    try:
+                        ref = replica.handle_request.remote(
+                            method_name, args, kwargs,
+                            multiplexed_model_id=multiplexed_model_id,
+                            stream=False, request_id=request_id,
+                            deadline_ts=deadline_ts)
+                        return ray_tpu.get(ref,
+                                           timeout=max(0.01, budget))
+                    except Exception as e:
+                        if att is not None:
+                            att.attributes["verdict"] = classify(e)
+                        raise
             except Exception as e:  # noqa: BLE001 — classified below
                 kind = classify(e)
                 if kind == "deadline":

@@ -183,6 +183,28 @@ def cluster_status() -> dict[str, Any]:
     return rt.cluster_status()
 
 
+def get_trace(trace_id: str) -> dict | None:
+    """One assembled trace tree from the head TraceStore: nested
+    spans, critical path, per-span self-times (see
+    docs/observability.md "Causal tracing"). Works from the driver
+    AND from worker-side clients (served over OP_STATE). ``None`` if
+    the trace is unknown (expired, sampled out, or never traced)."""
+    rt = _rt()
+    if not hasattr(rt, "_task_lock"):
+        return rt.list_state("trace", {"trace_id": trace_id})
+    return rt.get_trace(trace_id)
+
+
+def list_traces(limit: int = 50, slowest: bool = False) -> list[dict]:
+    """Trace summaries (root name, duration, span count, error flag)
+    — newest first, or slowest first with ``slowest=True``."""
+    rt = _rt()
+    if not hasattr(rt, "_task_lock"):
+        return rt.list_state(
+            "traces", {"limit": limit, "slowest": slowest})
+    return rt.list_traces(limit=limit, slowest=slowest)
+
+
 def summarize_tasks() -> dict[str, Any]:
     """Counts by (name, state) — reference: ray summary tasks."""
     summary: dict[str, dict[str, int]] = {}
@@ -197,5 +219,5 @@ def summarize_tasks() -> dict[str, Any]:
 __all__ = [
     "list_tasks", "list_actors", "list_objects", "list_nodes",
     "list_placement_groups", "summarize_tasks", "memory_summary",
-    "cluster_status",
+    "cluster_status", "get_trace", "list_traces",
 ]

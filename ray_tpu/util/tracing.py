@@ -19,6 +19,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
+import random
 import threading
 import time
 import uuid
@@ -27,6 +28,13 @@ from dataclasses import dataclass, field
 
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_current_span", default=None)
+
+# Root-span attribute marking a trace whose sampling decision is
+# deferred: it was below ``trace_sample_rate`` at the root, but may
+# still be kept by the head if it errored (sample-on-error) or crossed
+# the tail-latency threshold (force-sample-above-ms). The TraceStore
+# drops deferred traces that earn neither at finalize time.
+DEFERRED_ATTR = "trace.deferred"
 
 
 @dataclass
@@ -50,6 +58,25 @@ class Span:
         }
 
 
+class _RemoteParent:
+    """Context carrier for a span started in ANOTHER process.
+
+    Not a recordable span: it exists only so ``span()`` parents its
+    children under the real remote (trace_id, span_id). The old
+    implementation faked this with a ``Span(name="<remote-parent>",
+    parent_id=None)``, which could leak a bogus root into exports and
+    broke assembled trees at every process hop.
+    """
+
+    __slots__ = ("trace_id", "span_id", "deferred")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 deferred: bool = False):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.deferred = deferred
+
+
 class Tracer:
     def __init__(self, maxlen: int = 100_000):
         self.enabled = False
@@ -60,6 +87,14 @@ class Tracer:
         # ``ray_tpu_tracing_spans_dropped`` plane self-metric so a
         # span-heavy workload can see its trace is incomplete.
         self.spans_dropped = 0
+        # Probabilistic head sampling: roots rolled out by the rate
+        # are still recorded but carry DEFERRED_ATTR; the head's
+        # TraceStore keeps them only on error or tail latency.
+        try:
+            self.sample_rate = float(
+                os.environ.get("RAY_TPU_TRACE_SAMPLE_RATE", "1.0"))
+        except ValueError:
+            self.sample_rate = 1.0
 
     def _append_locked(self, span: "Span") -> None:
         if (self._spans.maxlen is not None
@@ -83,6 +118,13 @@ class Tracer:
             yield None
             return
         parent = _current.get()
+        attrs = dict(attributes or {})
+        if parent is None:
+            # New root: roll the sampling dice once per trace. The
+            # span is still recorded either way — a deferred root lets
+            # the head apply error/tail keep rules before dropping.
+            if self.sample_rate < 1.0 and random.random() >= self.sample_rate:
+                attrs[DEFERRED_ATTR] = True
         s = Span(
             name=name,
             trace_id=(parent.trace_id if parent
@@ -90,12 +132,17 @@ class Tracer:
             span_id=uuid.uuid4().hex[:16],
             parent_id=parent.span_id if parent else None,
             start=time.time(),
-            attributes=dict(attributes or {}),
+            attributes=attrs,
             process=f"pid:{os.getpid()}",
         )
         token = _current.set(s)
         try:
             yield s
+        except BaseException as e:
+            # Error tagging: sample-on-error and verdict joins need to
+            # see failures in the tree, and the span must still close.
+            s.attributes.setdefault("error", type(e).__name__)
+            raise
         finally:
             _current.reset(token)
             s.end = time.time()
@@ -109,14 +156,18 @@ class Tracer:
 
     @contextlib.contextmanager
     def remote_parent(self, ctx: tuple[str, str] | None):
-        """Re-hydrate a propagated context in the executing worker."""
+        """Re-hydrate a propagated context in the executing worker.
+
+        Installs a :class:`_RemoteParent` carrier so spans opened here
+        parent under the REAL remote span id — the tree joins cleanly
+        across the process hop instead of breaking at a fake
+        ``<remote-parent>`` root.
+        """
         if ctx is None or not self.enabled:
             yield
             return
-        trace_id, span_id = ctx
-        fake = Span(name="<remote-parent>", trace_id=trace_id,
-                    span_id=span_id, parent_id=None, start=0.0)
-        token = _current.set(fake)
+        trace_id, span_id = ctx[0], ctx[1]
+        token = _current.set(_RemoteParent(trace_id, span_id))
         try:
             yield
         finally:
@@ -192,6 +243,13 @@ def enable() -> None:
 
 def disable() -> None:
     _tracer.disable()
+
+
+def set_sample_rate(rate: float) -> None:
+    """Probability a new trace root is head-sampled (0..1). Roots
+    rolled out are still recorded but marked deferred; the head keeps
+    them only on error or tail latency."""
+    _tracer.sample_rate = max(0.0, min(1.0, float(rate)))
 
 
 def span(name: str, attributes: dict | None = None):

@@ -60,6 +60,7 @@ OBSERVABILITY_METRICS = (
 INTROSPECTION_METRICS = (
     "memory_summary_1k_objects",
     "profiler_sampling_overhead",
+    "trace_assembly_1k_spans",
 )
 
 # Direct actor-call plane (ray_tpu/perf.py): worker->worker bypass

@@ -217,6 +217,81 @@ def test_profiler_inactive_near_zero():
                    for t in threading.enumerate())
 
 
+def test_tracing_disabled_zero_span_frames(rt):
+    """Causal-tracing guardrail: with tracing OFF (the default), a
+    warm direct-call burst must send ZERO span-flush frames to the
+    head and record ZERO spans in either process's ring — the
+    disabled path is a flag check, not a sampling decision."""
+    from ray_tpu.core import protocol as P
+
+    @ray_tpu.remote(num_cpus=0)
+    class Bounce:
+        def hit(self, i):
+            return i
+
+    @ray_tpu.remote(num_cpus=1)
+    def burst(handle, n):
+        import time as _t
+
+        from ray_tpu.util.tracing import get_tracer
+        runtime = ray_tpu.core.api.get_runtime()
+        deadline = _t.monotonic() + 15
+        while _t.monotonic() < deadline:
+            before = runtime.actor_calls_direct
+            ray_tpu.get(handle.hit.remote(-1), timeout=60)
+            if runtime.actor_calls_direct > before:
+                break
+            _t.sleep(0.2)
+        d0 = runtime.actor_calls_direct
+        vals = ray_tpu.get([handle.hit.remote(i) for i in range(n)],
+                           timeout=120)
+        tr = get_tracer()
+        return (vals, runtime.actor_calls_direct - d0,
+                tr.enabled, len(tr.get_spans()))
+
+    a = Bounce.remote()
+    ray_tpu.get(burst.remote(a, 5), timeout=120)      # warm caller
+    rt_obj = ray_tpu.core.api.get_runtime()
+    spans0 = rt_obj.client_op_counts.get(P.OP_SPANS, 0)
+    vals, direct, enabled, ring = ray_tpu.get(burst.remote(a, 60),
+                                              timeout=120)
+    assert vals == list(range(60))
+    assert direct >= 60, "burst did not take the direct path"
+    assert enabled is False, "tracing enabled without opt-in"
+    assert ring == 0, f"{ring} spans recorded with tracing disabled"
+    assert rt_obj.client_op_counts.get(P.OP_SPANS, 0) == spans0, (
+        "tracing-disabled burst flushed span frames to the head")
+
+
+def test_tracing_disabled_ctx_read_near_zero():
+    """The submit-path presence of tracing when disabled is one
+    ``current_context`` read (flag + contextvar) — budget 2µs/op on
+    this
+    slow box, same contract as the task-event and profiler flags."""
+    import time
+
+    from ray_tpu.util.tracing import get_tracer
+
+    tr = get_tracer()
+    tr.disable()
+    # The global ring may hold spans from earlier tests in this
+    # process — the contract here is that the reads record NOTHING
+    # new, not that history is empty.
+    ring0 = len(tr.get_spans())
+    try:
+        n = 50_000
+        read = tr.current_context
+        t0 = time.perf_counter()
+        for _ in range(n):
+            read()
+        per_op = (time.perf_counter() - t0) / n
+        assert per_op < 2e-6, (
+            f"disabled trace-ctx read costs {per_op * 1e9:.0f}ns/op")
+        assert len(tr.get_spans()) == ring0
+    finally:
+        tr.disable()
+
+
 def test_memory_summary_1k_objects_bounded(rt):
     """memory_summary over a 1000-object directory must stay a
     lock-scoped snapshot + sort — budget 0.5s/call on this box (the
