@@ -1,0 +1,195 @@
+"""Head admission control: bounded control-plane queues with
+client-visible pushback.
+
+Reference analogs: the raylet's backpressure on task submission
+(SURVEY §L2) and serve's proxy 503 + Retry-After semantics
+(serve/_private/proxy.py), applied here to the task/actor/PG planes.
+The head is a single Python process; without admission an owned-submit
+flood grows ``_pending`` without bound, every scheduling scan slows
+with it, and a saturated head starves heartbeats into false-positive
+channel kills. Admission keeps the queue at a configured watermark and
+converts overload into explicit, retryable ``ST_BUSY`` replies — load
+the clients hold, not the head.
+
+Policy (all knobs in core/config.py):
+
+- depth < high_water: admit, UNLESS 2+ clients are active and this
+  client already holds more than ``max(high*fair_fraction,
+  high/active)`` pending tasks (one flooder must not starve others
+  long before the queue is nominally full).
+- high_water <= depth < high*hard_factor: only clients under their
+  fair share (``high/active``) are admitted — light clients keep
+  making progress through a flood.
+- depth >= high*hard_factor: everything submit-class sheds.
+
+Owned ACTOR submits are never hard-shed (per-caller call order is part
+of the actor contract; rejecting call N while admitting N+1 would
+invert it) — clients pace them from the advertised busy hint instead.
+
+The controller also owns the ``ray_tpu_head_*`` gauges on the cluster
+scrape: queue depth, admissions rejected, busiest-client share, and
+the admission state the CLI/dashboard surface.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AdmissionController"]
+
+
+class AdmissionController:
+    """Decision + accounting object owned by the driver runtime.
+
+    Accounting is per client_key ("driver" for in-process submits, a
+    per-connection key for wire clients): incremented when a task is
+    admitted into the pending queues, decremented when it leaves
+    (dispatch, cancel, dep-failure). Counts are plain ints mutated
+    under the runtime's ``_res_cv`` lock (the same lock every pending
+    mutation already holds), read unlocked by decisions — a stale
+    read sheds or admits one frame early, never corrupts state.
+    """
+
+    def __init__(self, config):
+        self.enabled = bool(config.admission_enabled)
+        self.high = max(1, int(config.head_pending_high_water))
+        self.hard = max(
+            self.high,
+            int(self.high * config.admission_hard_factor))
+        self.fair_fraction = float(config.admission_fair_fraction)
+        self.retry_after_s = float(config.admission_retry_after_s)
+        self.dial_reject_depth = max(
+            self.high,
+            int(self.high * config.admission_dial_reject_factor))
+        # client_key -> pending tasks currently held in head queues.
+        self.client_pending: dict[str, int] = {}
+        self._reject_lock = threading.Lock()
+        self.rejected = 0
+        self.rejected_by_op: dict[str, int] = {}
+        self.dials_rejected = 0
+        self._gauges = None
+
+    # -- accounting (called under the runtime's _res_cv) ---------------
+
+    def note_enqueued(self, client_key: str) -> None:
+        if not client_key:
+            return
+        self.client_pending[client_key] = \
+            self.client_pending.get(client_key, 0) + 1
+
+    def note_dequeued(self, client_key: str) -> None:
+        if not client_key:
+            return
+        n = self.client_pending.get(client_key, 0) - 1
+        if n <= 0:
+            self.client_pending.pop(client_key, None)
+        else:
+            self.client_pending[client_key] = n
+
+    # -- decisions (lock-free reads; see class docstring) --------------
+
+    def _fair_share(self, active: int) -> int:
+        return max(int(self.high * self.fair_fraction),
+                   self.high // max(1, active))
+
+    def check(self, depth: int, client_key: str,
+              op: str = "") -> float | None:
+        """None = admit; a float = shed, retry after that many
+        seconds (pre-jitter; the client jitters)."""
+        if not self.enabled:
+            return None
+        if depth >= self.hard:
+            return self._shed(depth, op)
+        mine = self.client_pending.get(client_key, 0)
+        active = len(self.client_pending)
+        if depth >= self.high:
+            # Over the watermark: only clients under their fair share
+            # still land (light clients make progress through a flood,
+            # bounded by the hard cap above).
+            if mine >= self.high // max(1, active):
+                return self._shed(depth, op)
+            return None
+        if active >= 2 and mine > self._fair_share(active):
+            # Under the watermark but this client is hogging the
+            # queue while others are active: early per-client shed.
+            return self._shed(depth, op)
+        return None
+
+    def _shed(self, depth: int, op: str) -> float:
+        with self._reject_lock:
+            self.rejected += 1
+            if op:
+                self.rejected_by_op[op] = \
+                    self.rejected_by_op.get(op, 0) + 1
+        # Scale the hint with overload: a queue 2x over the watermark
+        # advertises a longer wait than one just past it.
+        return self.retry_after_s * (1.0 + depth / self.high)
+
+    def reject_dial(self, depth: int) -> float | None:
+        """Severe-overload connect rejection (wire busy hint)."""
+        if not self.enabled or depth < self.dial_reject_depth:
+            return None
+        with self._reject_lock:
+            self.dials_rejected += 1
+        return self.retry_after_s * (1.0 + depth / self.high)
+
+    # -- observability --------------------------------------------------
+
+    def busiest(self) -> tuple[str, int]:
+        best_k, best_v = "", 0
+        # Snapshot: the dict mutates under another lock.
+        for k, v in list(self.client_pending.items()):
+            if v > best_v:
+                best_k, best_v = k, v
+        return best_k, best_v
+
+    def state(self, depth: int) -> str:
+        return ("BUSY" if self.enabled and depth >= self.high
+                else "OK")
+
+    def snapshot(self, depth: int) -> dict:
+        busiest_key, busiest_n = self.busiest()
+        return {
+            "enabled": self.enabled,
+            "state": self.state(depth),
+            "queue_depth": depth,
+            "high_water": self.high,
+            "hard_cap": self.hard,
+            "active_clients": len(self.client_pending),
+            "admissions_rejected": self.rejected,
+            "rejected_by_op": dict(self.rejected_by_op),
+            "dials_rejected": self.dials_rejected,
+            "busiest_client": busiest_key,
+            "busiest_client_pending": busiest_n,
+        }
+
+    def export_gauges(self, depth: int, loop_lag_s: float) -> None:
+        """Refresh the ``ray_tpu_head_*`` series in the head's local
+        metrics registry (merged into the cluster scrape by the
+        observability plane). Called from the head's periodic loops —
+        never from the submit hot path."""
+        if self._gauges is None:
+            from ray_tpu.util import metrics as m
+            self._gauges = {
+                "depth": m.Gauge(
+                    "ray_tpu_head_queue_depth",
+                    "head pending task queue depth"),
+                "rejected": m.Gauge(
+                    "ray_tpu_head_admissions_rejected",
+                    "submit-class ops shed with ST_BUSY"),
+                "busiest": m.Gauge(
+                    "ray_tpu_head_busiest_client_pending",
+                    "pending tasks held by the busiest client"),
+                "state": m.Gauge(
+                    "ray_tpu_head_admission_state",
+                    "0 = OK, 1 = BUSY (depth at/over high water)"),
+                "lag": m.Gauge(
+                    "ray_tpu_head_loop_lag_ms",
+                    "head control-loop scheduling lag (EWMA)"),
+            }
+        g = self._gauges
+        g["depth"].set(float(depth))
+        g["rejected"].set(float(self.rejected))
+        g["busiest"].set(float(self.busiest()[1]))
+        g["state"].set(1.0 if self.state(depth) == "BUSY" else 0.0)
+        g["lag"].set(round(loop_lag_s * 1000.0, 3))

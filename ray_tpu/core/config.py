@@ -307,6 +307,46 @@ class Config:
     # re-dispatch dedupe (mirrors direct_call_result_cache).
     serve_result_ledger_size: int = 2048
 
+    # --- head admission / backpressure (reference: raylet
+    # backpressure + serve's 503/Retry-After semantics, applied to
+    # the task/actor/PG control planes; SURVEY §L2) ---
+    # Master switch. Off = pre-admission behavior: every submit is
+    # accepted, queues grow without bound (the ≈0-overhead disabled
+    # path is guardrailed in tests/test_perf.py).
+    admission_enabled: bool = True
+    # High-water mark on the head's pending task queue: a submit-class
+    # op arriving past it is answered ST_BUSY + retry-after instead of
+    # being enqueued. Sized so ordinary bursts (thousands of tasks)
+    # never see pushback — backpressure is for floods.
+    head_pending_high_water: int = 20000
+    # Hard cap as a multiple of the high-water mark: light clients
+    # (under their fair share) are still admitted between high and
+    # high*hard_factor, so one flooder can't lock everyone out the
+    # moment it fills the queue.
+    admission_hard_factor: float = 1.25
+    # Fairness: with 2+ active clients, one client may hold at most
+    # max(high*fair_fraction, high/active_clients) pending tasks
+    # before ITS submits shed while lighter clients' still land.
+    admission_fair_fraction: float = 0.5
+    # Base retry-after hint (seconds) in busy replies; scaled up with
+    # overload depth, jittered client-side.
+    admission_retry_after_s: float = 0.05
+    # Sync (blocking) client ops give up with ConnectionError after
+    # retrying busy replies for this long.
+    admission_client_max_wait_s: float = 120.0
+    # Driver-local submits (no wire to push back on) BLOCK while the
+    # queue sits at the high-water mark — at most this long, then
+    # admit anyway (a bounded wait can't deadlock dependency chains).
+    admission_driver_block_s: float = 30.0
+    # Reject client dials (server-sent busy hint + close, honored by
+    # wire.dial backoff) once depth crosses high*this factor — only
+    # under severe overload; exec/node channels are never rejected.
+    admission_dial_reject_factor: float = 2.0
+    # Debug invariant check on the pending-queue bookkeeping (count ==
+    # sum of per-class counts == sum of structure lengths), verified
+    # on every mutation. Costs O(classes) per enqueue — tests only.
+    debug_pending_invariants: bool = False
+
     # --- workers ---
     # Env vars CLEARED in CPU-only workers' environments (comma
     # separated). Default: the ambient TPU-plugin sitecustomize

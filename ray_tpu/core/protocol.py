@@ -222,6 +222,18 @@ OP_PULL = "pull"                # chunked object pull (ObjectManager
 # client channel, driver -> worker: (req_id, status, payload)
 ST_OK = "ok"
 ST_ERR = "err"
+ST_BUSY = "busy"                # head admission pushback (serve's 503
+                                # semantics on the task/actor/PG
+                                # planes): payload (retry_after_s,
+                                # queue_depth). The op was NOT applied;
+                                # the client sleeps a jittered
+                                # retry_after and re-sends the SAME
+                                # dd-tagged op. Only submit-class ops
+                                # are ever answered busy; owned ACTOR
+                                # submits are exempt (rejecting call N
+                                # while admitting call N+1 would break
+                                # the per-caller ordering contract) —
+                                # they are paced client-side instead.
 
 # ---------------------------------------------------------------------------
 # direct call channel (caller worker <-> hosting worker), one

@@ -253,6 +253,14 @@ class TaskRecord:
     # Lease pipelining: True when this task rides a worker's existing
     # resource acquisition (no acquire ran; finish must not release).
     leased: bool = False
+    # Admission attribution: which client's submits put this task in
+    # the pending queues ("driver" for in-process submits) — the
+    # per-client fairness counts key on it.
+    client_key: str = ""
+    # Global enqueue sequence: the class-indexed ready queues pick the
+    # lowest-seq head for cross-class FIFO. Assigned once on first
+    # enqueue; retries keep it (original submission order).
+    seq: int = 0
 
 
 @dataclass
@@ -824,11 +832,40 @@ class DriverRuntime:
         self._tasks: dict[TaskID, TaskRecord] = {}
         self._done_tasks: deque[TaskRecord] = deque(
             maxlen=config.task_event_buffer_size)
-        self._pending: deque[TaskRecord] = deque()
+        # Pending queues, split by dependency state (replaces the old
+        # single O(n)-scanned deque):
+        #   _pending_deps    — tasks with unresolved arg refs; the
+        #                      scheduler walks these linearly (dep
+        #                      state can flip per result store, and
+        #                      dep errors must propagate to each).
+        #   _ready_classes   — dep-free tasks indexed by scheduling
+        #                      class, FIFO per class; one placement
+        #                      probe per DISTINCT class serves any
+        #                      queue depth (reference: per-
+        #                      SchedulingClass queues,
+        #                      scheduling_class_util.h). The 100k-task
+        #                      drain scans 1 class, not 100k records.
+        self._pending_deps: deque[TaskRecord] = deque()
+        self._ready_classes: dict[tuple, deque[TaskRecord]] = {}
+        # Total pending count — admission's load signal and the
+        # introspection/dashboard depth gauge. Mutated under _res_cv,
+        # read unlocked (a stale int, never a torn structure).
+        self._pending_count = 0
+        self._pending_seq = itertools.count(1)
         # Pending-count per scheduling class (see _sched_class): lets
         # a scheduling scan stop as soon as every class present has
-        # failed placement this pass.
+        # failed placement this pass. Audited against the queues by
+        # _check_pending_invariants_locked (debug knob).
         self._pending_classes: dict[tuple, int] = {}
+        # Admission + backpressure (tentpole): bounded control-plane
+        # queueing with client-visible ST_BUSY pushback.
+        from ray_tpu.core.admission import AdmissionController
+        self.admission = AdmissionController(config)
+        # EWMA of how late this process's periodic threads wake vs.
+        # what they asked for — the head-saturation signal liveness
+        # deadlines stretch by (false-positive fix) and the
+        # ray_tpu_head_loop_lag_ms gauge.
+        self._head_loop_lag_s = 0.0
         # True while any PENDING task might be waiting on arg deps:
         # gates the per-result-store dispatcher wake. Set on every
         # dep-carrying enqueue; cleared only by a full dispatcher scan
@@ -990,6 +1027,9 @@ class DriverRuntime:
         # Cached threads for blocking client ops (thread-per-message
         # spawn was ~12% of head CPU in the task-storm profile).
         self._client_op_pool = _CachedThreadPool("client_op")
+        # Per-connection admission identity (fairness accounting keys
+        # on it; a reconnect gets a fresh key).
+        self._client_key_seq = itertools.count(1)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="client_accept")
         self._accept_thread.start()
@@ -1017,6 +1057,11 @@ class DriverRuntime:
             self._dispatch_thread = threading.Thread(
                 target=self._dispatch_loop, daemon=True, name="dispatcher")
             self._dispatch_thread.start()
+            # Health/gauge loop runs from birth, not from the first
+            # daemon registration: a daemon-less head still owes the
+            # scrape its ray_tpu_head_* admission gauges and needs
+            # the loop-lag EWMA feeding lag-scaled deadlines.
+            self._ensure_health_thread()
 
         # Memory monitor / OOM killer (reference: MemoryMonitor N26)
         self.memory_monitor = None
@@ -1572,7 +1617,8 @@ class DriverRuntime:
                     fn_name: str, args: tuple, kwargs: dict,
                     options: TaskOptions,
                     preminted: tuple | None = None,
-                    packed: tuple | None = None
+                    packed: tuple | None = None,
+                    client_key: str = "driver"
                     ) -> list[ObjectRef]:
         """``packed=(args_blob, arg_refs)`` reuses an already-encoded
         args payload (owned submits: the client's blob, proven
@@ -1587,6 +1633,23 @@ class DriverRuntime:
         (tests/test_core_regressions.py pins their equivalence)."""
         if fn_blob is not None:
             self._fn_cache.setdefault(fn_id, fn_blob)
+        if (client_key == "driver" and not self.local_mode
+                and self.admission.enabled
+                and self._pending_count >= self.admission.high):
+            # Driver-local backpressure: in-process submits have no
+            # wire channel to push ST_BUSY down, so the submitting
+            # thread blocks until the queue drains below the
+            # watermark. BOUNDED: a queue full of tasks that can only
+            # run after THIS submission's downstream consumers (dep
+            # chains) must not deadlock the driver — past the bound
+            # the task is admitted anyway.
+            deadline = (time.monotonic()
+                        + self.config.admission_driver_block_s)
+            with self._res_cv:
+                while (self._pending_count >= self.admission.high
+                       and time.monotonic() < deadline
+                       and not self._shutdown):
+                    self._res_cv.wait(0.05)
         # Resolve the runtime env now: a broken env (task- OR
         # job-level) fails at .remote() with RuntimeEnvSetupError, and
         # dispatch/retries reuse the resolved result.
@@ -1614,7 +1677,8 @@ class DriverRuntime:
             task_id=task_id, fn_id=fn_id, name=fn_name or "task",
             args_blob=args_blob, arg_refs=arg_refs, options=options,
             return_ids=return_ids, submitted_at=time.time(),
-            env_key=env_key, env_vars=env_vars)
+            env_key=env_key, env_vars=env_vars,
+            client_key=client_key)
         with self._task_lock:
             self._tasks[task_id] = rec
         effective_retries = (options.max_retries
@@ -1914,11 +1978,7 @@ class DriverRuntime:
         spawn a worker — a synchronous process boot that must not run
         on a result-recv thread)."""
         with self._res_cv:
-            self._pending.appendleft(rec)
-            self._pending_classes[rec.sched_class] = (
-                self._pending_classes.get(rec.sched_class, 0) + 1)
-            if rec.arg_refs:
-                self._pending_has_deps = True
+            self._pending_readd_front_locked(rec)
             self._res_cv.notify_all()
         self._release(rec.need or {},
                       rec.options.placement_group,
@@ -2021,9 +2081,16 @@ class DriverRuntime:
                 options.placement_group_bundle_index,
                 options.node_id, options.soft)
 
+    def pending_count(self) -> int:
+        """Head pending-queue depth — admission's load signal and the
+        introspection gauge. Plain int read, safe without _res_cv."""
+        return self._pending_count
+
     def _pending_add_locked(self, rec: TaskRecord) -> None:
-        """Enqueue under _res_cv, keeping the per-class count and the
-        deps flag coherent. Class + need are computed once here."""
+        """Enqueue under _res_cv, keeping the count, the per-class
+        counts, and the deps flag coherent. Class + need are computed
+        once here; the global seq is assigned on FIRST enqueue only
+        (retries keep their original submission order)."""
         if rec.sched_class is None:
             # Options instances are shared across calls of one remote
             # handle — cache the derived class there so repeat submits
@@ -2034,19 +2101,81 @@ class DriverRuntime:
                 cache = (need, self._sched_class(need, rec.options))
                 rec.options._sched_cache = cache
             rec.need, rec.sched_class = cache
-        self._pending.append(rec)
+        if rec.seq == 0:
+            rec.seq = next(self._pending_seq)
+        if rec.arg_refs:
+            self._pending_deps.append(rec)
+            self._pending_has_deps = True
+        else:
+            q = self._ready_classes.get(rec.sched_class)
+            if q is None:
+                q = self._ready_classes[rec.sched_class] = deque()
+            q.append(rec)
+        self._pending_enqueued_locked(rec)
+
+    def _pending_readd_front_locked(self, rec: TaskRecord) -> None:
+        """Put a just-picked record back at the FRONT of its queue
+        (inline hand-back, pipeline undo): seq is preserved, so the
+        lowest-seq pick returns it before anything enqueued since."""
+        if rec.arg_refs:
+            self._pending_deps.appendleft(rec)
+            self._pending_has_deps = True
+        else:
+            q = self._ready_classes.get(rec.sched_class)
+            if q is None:
+                q = self._ready_classes[rec.sched_class] = deque()
+            q.appendleft(rec)
+        self._pending_enqueued_locked(rec)
+
+    def _pending_enqueued_locked(self, rec: TaskRecord) -> None:
+        self._pending_count += 1
         self._pending_classes[rec.sched_class] = (
             self._pending_classes.get(rec.sched_class, 0) + 1)
-        if rec.arg_refs:
-            self._pending_has_deps = True
+        self.admission.note_enqueued(rec.client_key)
+        if self.config.debug_pending_invariants:
+            self._check_pending_invariants_locked()
 
-    def _pending_del_locked(self, i: int, rec: TaskRecord) -> None:
-        del self._pending[i]
+    def _pending_removed_locked(self, rec: TaskRecord) -> None:
+        """Bookkeeping for a record the caller already removed from
+        its queue (both removal sites below and the class-queue pops
+        in the scheduler/pipeliner)."""
+        self._pending_count -= 1
         c = self._pending_classes.get(rec.sched_class, 0) - 1
         if c <= 0:
             self._pending_classes.pop(rec.sched_class, None)
         else:
             self._pending_classes[rec.sched_class] = c
+        self.admission.note_dequeued(rec.client_key)
+        if self.config.debug_pending_invariants:
+            self._check_pending_invariants_locked()
+
+    def _ready_pop_locked(self, klass: tuple,
+                          q: "deque[TaskRecord]") -> TaskRecord:
+        rec = q.popleft()
+        if not q:
+            # Empty class deques must not linger: the scheduler scan
+            # is O(len(_ready_classes)).
+            del self._ready_classes[klass]
+        self._pending_removed_locked(rec)
+        return rec
+
+    def _check_pending_invariants_locked(self) -> None:
+        """Debug audit (config.debug_pending_invariants): the three
+        views of the pending set — total counter, per-class counts,
+        and the actual queue contents — must agree after every
+        mutation. Guards the hand-back/re-enqueue paths against
+        bookkeeping drift under concurrent floods."""
+        actual = len(self._pending_deps) + sum(
+            len(q) for q in self._ready_classes.values())
+        by_class = sum(self._pending_classes.values())
+        if not (actual == by_class == self._pending_count):
+            raise AssertionError(
+                f"pending bookkeeping drift: queues hold {actual}, "
+                f"class counts sum to {by_class}, counter says "
+                f"{self._pending_count}")
+        if any(not q for q in self._ready_classes.values()):
+            raise AssertionError(
+                "empty class deque left in _ready_classes")
 
     def _record_head_span(self, name: str, rec: TaskRecord,
                           start: float, end: float,
@@ -2086,15 +2215,21 @@ class DriverRuntime:
 
     def _next_schedulable_scan_locked(self) -> TaskRecord | None:
         unplaceable: set[tuple] = set()
-        saw_deps = False
-        for i, rec in enumerate(self._pending):
-            if rec.arg_refs:
-                saw_deps = True
+        # Phase 1 — dep-carrying tasks: legacy linear walk (usually a
+        # small minority of the queue). Dependency state can flip per
+        # result store, and dep ERRORS must propagate to every
+        # affected task, so these can't ride the class index.
+        dq = self._pending_deps
+        i = 0
+        while i < len(dq):
+            rec = dq[i]
             deps = self._deps_state(rec)
             if deps == "error":
                 # Propagate the dependency's error to this task's
-                # returns (reference: error propagation through lineage).
-                self._pending_del_locked(i, rec)
+                # returns (reference: error propagation through
+                # lineage).
+                del dq[i]
+                self._pending_removed_locked(rec)
                 for r in rec.arg_refs:
                     blob = self._errors.get(r.id)
                     if blob is not None:
@@ -2103,40 +2238,65 @@ class DriverRuntime:
                         break
                 rec.state = "FAILED"
                 return rec
-            if deps != "ready":
-                continue
-            klass = rec.sched_class
-            if klass in unplaceable:
-                continue
-            try:
-                placed = self._try_place_locked(rec.need, rec.options)
-            except PlacementError as e:
-                # Infeasible forever: fail the task now instead of
-                # leaving it pending (and keep the dispatcher alive).
-                self._pending_del_locked(i, rec)
-                blob = ser.dumps(TaskError(rec.name, str(e), e))
-                for oid in rec.return_ids:
-                    self._store_error(oid, blob)
-                rec.state = "FAILED"
-                return rec
-            if placed is not None:
-                rec.node_id, rec.pg_bundle = placed
-                self._pending_del_locked(i, rec)
-                return rec
-            unplaceable.add(klass)
-            if (not self._pending_has_deps
-                    and len(unplaceable) >= len(self._pending_classes)):
-                # Every class present in the queue has failed
-                # placement this pass — the rest can't fare better.
-                # Gated on the deps flag: dep-error propagation must
-                # reach tasks deeper in the queue, so dep-carrying
-                # queues always scan fully.
+            if deps == "ready" and rec.sched_class not in unplaceable:
+                try:
+                    placed = self._try_place_locked(rec.need,
+                                                    rec.options)
+                except PlacementError as e:
+                    # Infeasible forever: fail the task now instead
+                    # of leaving it pending (and keep the dispatcher
+                    # alive).
+                    del dq[i]
+                    self._pending_removed_locked(rec)
+                    blob = ser.dumps(TaskError(rec.name, str(e), e))
+                    for oid in rec.return_ids:
+                        self._store_error(oid, blob)
+                    rec.state = "FAILED"
+                    return rec
+                if placed is not None:
+                    rec.node_id, rec.pg_bundle = placed
+                    del dq[i]
+                    self._pending_removed_locked(rec)
+                    return rec
+                unplaceable.add(rec.sched_class)
+            i += 1
+        if not dq:
+            # Full fruitless dep walk (under _res_cv): result stores
+            # stop waking the dispatcher until a dep-carrying task is
+            # enqueued again.
+            self._pending_has_deps = False
+        # Phase 2 — dep-free tasks, indexed by scheduling class: one
+        # placement probe per DISTINCT class (within one pass the
+        # cluster's free resources don't change, so a class that
+        # failed once fails for every queued task of that class).
+        # Among placeable classes the lowest-seq head is picked, so
+        # dispatch stays globally FIFO. O(classes²) worst case on the
+        # min-scan, with classes = handful — not O(pending).
+        while True:
+            best_k = best_q = best = None
+            for klass, q in self._ready_classes.items():
+                if klass in unplaceable or not q:
+                    continue
+                head = q[0]
+                if best is None or head.seq < best.seq:
+                    best, best_k, best_q = head, klass, q
+            if best is None:
                 return None
-        # FULL fruitless scan: refresh the deps flag (under _res_cv)
-        # so result stores stop waking us when no pending task has
-        # arg deps at all.
-        self._pending_has_deps = saw_deps
-        return None
+            try:
+                placed = self._try_place_locked(best.need,
+                                                best.options)
+            except PlacementError as e:
+                self._ready_pop_locked(best_k, best_q)
+                blob = ser.dumps(TaskError(best.name, str(e), e))
+                for oid in best.return_ids:
+                    self._store_error(oid, blob)
+                best.state = "FAILED"
+                return best
+            if placed is not None:
+                best.node_id, best.pg_bundle = placed
+                self._ready_pop_locked(best_k, best_q)
+                return best
+            unplaceable.add(best_k)
 
     # -- node-aware placement (ClusterResourceScheduler analog,
     #    cluster_resource_scheduler.cc:146 GetBestSchedulableNode) ------
@@ -3215,7 +3375,7 @@ class DriverRuntime:
         # pipeline — skip the _res_cv acquisition and node scan (this
         # runs on EVERY dispatch; a stale read just means one missed
         # pipelining opportunity that the normal path picks up).
-        if not self._pending:
+        if not self._pending_count:
             return
         extras: list[TaskRecord] = []
         with self._res_cv:
@@ -3233,20 +3393,22 @@ class DriverRuntime:
                    and self._fits_pool(n.resources, need)
                    for n in self._schedulable_nodes()):
                 return
-            i = 0
-            while i < len(self._pending) and len(extras) < room:
-                cand = self._pending[i]
-                if (cand.sched_class == rec.sched_class
-                        and not cand.arg_refs
-                        and cand.state != "FAILED"
-                        and self._pipelineable(cand)):
-                    self._pending_del_locked(i, cand)
-                    cand.node_id = rec.node_id
-                    cand.pg_bundle = -1
-                    cand.leased = True
-                    extras.append(cand)
-                    continue       # i now indexes the next element
-                i += 1
+            # The class index holds exactly the dep-free same-class
+            # candidates the old full-queue walk was looking for:
+            # take from its head while the front matches (stopping at
+            # the first non-pipelineable head keeps the pop O(1) and
+            # preserves in-class FIFO).
+            q = self._ready_classes.get(rec.sched_class)
+            while q and len(extras) < room:
+                cand = q[0]
+                if (cand.state == "FAILED"
+                        or not self._pipelineable(cand)):
+                    break
+                self._ready_pop_locked(rec.sched_class, q)
+                cand.node_id = rec.node_id
+                cand.pg_bundle = -1
+                cand.leased = True
+                extras.append(cand)
         for i, cand in enumerate(extras):
             try:
                 self._dispatch_leased(cand, w)
@@ -4051,14 +4213,36 @@ class DriverRuntime:
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         task_id = ref.id.task_id()
         with self._res_cv:
-            for i, rec in enumerate(self._pending):
-                if rec.task_id == task_id:
-                    self._pending_del_locked(i, rec)
-                    blob = ser.dumps(TaskCancelledError(rec.name))
-                    for oid in rec.return_ids:
-                        self._store_error(oid, blob)
-                    rec.state = "CANCELLED"
-                    return
+            # Rare path: a linear probe over both pending structures
+            # is fine here (cancel is explicit and infrequent; the
+            # hot-path scans are the indexed ones).
+            rec = None
+            dq = self._pending_deps
+            for i in range(len(dq)):
+                if dq[i].task_id == task_id:
+                    rec = dq[i]
+                    del dq[i]
+                    break
+            if rec is None:
+                hit = None
+                for klass, q in self._ready_classes.items():
+                    for i in range(len(q)):
+                        if q[i].task_id == task_id:
+                            rec = q[i]
+                            del q[i]
+                            hit = klass
+                            break
+                    if rec is not None:
+                        break
+                if hit is not None and not self._ready_classes[hit]:
+                    del self._ready_classes[hit]
+            if rec is not None:
+                self._pending_removed_locked(rec)
+                blob = ser.dumps(TaskCancelledError(rec.name))
+                for oid in rec.return_ids:
+                    self._store_error(oid, blob)
+                rec.state = "CANCELLED"
+                return
         if force:
             rec = self._tasks.get(task_id)
             if rec is not None and rec.worker is not None \
@@ -4238,8 +4422,12 @@ class DriverRuntime:
         bundle."""
         out: list[dict[str, float]] = []
         with self._res_cv:
-            for rec in self._pending:
+            for rec in self._pending_deps:
                 out.append(dict(self._effective_resources(rec.options)))
+            for q in self._ready_classes.values():
+                for rec in q:
+                    out.append(dict(
+                        self._effective_resources(rec.options)))
         # Lease backlogs: tasks queued on a worker beyond the one
         # executing are demand the cluster could not spread — without
         # this the pipeline would HIDE load from the autoscaler
@@ -4731,10 +4919,32 @@ class DriverRuntime:
             conn.set_peer(kind=wire.K_NODE)
             self._serve_node(conn)
         else:
+            hint = self.admission.reject_dial(self._pending_count)
+            if hint is not None:
+                # Severe overload (depth past the dial-reject
+                # factor): turn the NEW client away with a busy hint
+                # instead of adding another reader thread — the wire
+                # layer records the hint and the client's next dial
+                # honors it. Exec/node channels above are never
+                # turned away (workers finishing tasks is how the
+                # queue drains).
+                conn.send_busy(hint)
+                conn.close()
+                return
             self._serve_client(conn)
+
+    # Submit-class ops the admission gate may answer ST_BUSY (serve's
+    # 503 semantics on the task/actor/PG planes). OP_SUBMIT_ACTOR_OWNED
+    # is deliberately absent: per-caller actor-call ORDER is part of
+    # the actor contract, and shedding call N while admitting N+1
+    # would invert it — clients pace those from the busy hint instead.
+    _SHEDDABLE_OPS = (P.OP_SUBMIT, P.OP_SUBMIT_OWNED,
+                      P.OP_CREATE_ACTOR, P.OP_SUBMIT_ACTOR,
+                      P.OP_PG_CREATE)
 
     def _serve_client(self, conn) -> None:
         send_lock = threading.Lock()
+        client_key = f"client-{next(self._client_key_seq)}"
 
         def reply(req_id, status, payload):
             try:
@@ -4742,6 +4952,21 @@ class DriverRuntime:
                     conn.send((req_id, status, payload))
             except (OSError, BrokenPipeError):
                 pass
+
+        def try_shed(req_id, op) -> bool:
+            # Admission gate, checked BEFORE dd bookkeeping (a shed
+            # op was never applied, so its eventual replay must not
+            # hit a cached result). req_id -1 has no reply path to
+            # carry ST_BUSY down — admit those (they are rare:
+            # notifies, not submits).
+            if req_id == -1 or op not in self._SHEDDABLE_OPS:
+                return False
+            hint = self.admission.check(self._pending_count,
+                                        client_key, op)
+            if hint is None:
+                return False
+            reply(req_id, P.ST_BUSY, (hint, self._pending_count))
+            return True
 
         def handle(req_id, op, payload):
             dd, payload = P.unwrap_dd(payload)
@@ -4751,7 +4976,8 @@ class DriverRuntime:
                     reply(req_id, *cached)
                     return
             try:
-                out = (P.ST_OK, self._handle_client_op(op, payload))
+                out = (P.ST_OK, self._handle_client_op(
+                    op, payload, client_key=client_key))
             except BaseException as e:  # noqa: BLE001
                 out = (P.ST_ERR, ser.dumps(e))
             if dd is not None:
@@ -4852,12 +5078,15 @@ class DriverRuntime:
                 # ORDER (part of the actor contract) follows
                 # connection order. Failures land as errors ON
                 # the preminted return ids.
+                if try_shed(req_id, op):
+                    return
                 handler = (self._handle_owned_submit
                            if op == P.OP_SUBMIT_OWNED
                            else self._handle_owned_actor_submit)
                 dd, sp = P.unwrap_dd(payload)
                 if dd is None or self._dd_begin(dd) is None:
-                    handler(sp, on_borrowed=record_conn_borrow)
+                    handler(sp, on_borrowed=record_conn_borrow,
+                            client_key=client_key)
                     if dd is not None:
                         self._dd_finish(dd, (P.ST_OK, None))
                 if req_id != -1:
@@ -4908,6 +5137,8 @@ class DriverRuntime:
                 # blocking capture requests fall through to the pool.
                 do_profile_notify(payload)
                 return
+            if try_shed(req_id, op):
+                return
             self._client_op_pool.submit(handle, req_id, op, payload)
 
         def handle_submit_run(subs) -> None:
@@ -4920,8 +5151,16 @@ class DriverRuntime:
             reader thread is still here."""
             to_run: list = []
             dds: list = []
+            acks: list = []
             for req_id, _op, payload in subs:
                 self._count_client_op(_op)
+                if try_shed(req_id, _op):
+                    # Shed BEFORE dd bookkeeping: the client re-sends
+                    # the same dd-tagged op after its backoff and it
+                    # must apply then, not hit a cached no-op.
+                    continue
+                if req_id != -1:
+                    acks.append(req_id)
                 dd, sp = P.unwrap_dd(payload)
                 if dd is not None and self._dd_begin(dd) is not None:
                     dd = None          # replayed: cached, skip run
@@ -4936,16 +5175,17 @@ class DriverRuntime:
                     # via the scalar handler) runs the task.
                     for sp in to_run:
                         self._handle_owned_submit(
-                            sp, on_borrowed=record_conn_borrow)
+                            sp, on_borrowed=record_conn_borrow,
+                            client_key=client_key)
                 else:
                     self._handle_owned_submit_many(
-                        to_run, on_borrowed=record_conn_borrow)
+                        to_run, on_borrowed=record_conn_borrow,
+                        client_key=client_key)
                 for dd in dds:
                     if dd is not None:
                         self._dd_finish(dd, (P.ST_OK, None))
-            for req_id, _op, _payload in subs:
-                if req_id != -1:
-                    reply(req_id, P.ST_OK, None)
+            for req_id in acks:
+                reply(req_id, P.ST_OK, None)
 
         try:
             while True:
@@ -5050,12 +5290,30 @@ class DriverRuntime:
         period = self.config.health_check_period_s
         thresh = self.config.health_check_failure_threshold
         while not self._shutdown:
+            t0 = time.monotonic()
             time.sleep(period)
+            # Head loop lag: how late this thread woke vs. what it
+            # asked for. Under head saturation (GIL contention from a
+            # task storm) EVERY deadline in this process slips by
+            # about this much — the daemons pong'd on time, WE
+            # processed late — so the liveness deadline stretches
+            # with it instead of declaring false-positive deaths
+            # (same shape as the PR 9 load-gated chaos fixtures).
+            overshoot = max(0.0, (time.monotonic() - t0) - period)
+            self._head_loop_lag_s = (0.7 * self._head_loop_lag_s
+                                     + 0.3 * overshoot)
+            lag_allowance = thresh * self._head_loop_lag_s
+            try:
+                self.admission.export_gauges(self._pending_count,
+                                             self._head_loop_lag_s)
+            except Exception:  # noqa: BLE001 — gauges must never
+                pass           # kill the health checker
             now = time.monotonic()
             for node in list(self._nodes.values()):
                 if not (node.alive and node.is_daemon):
                     continue
-                if now - node.last_pong > period * thresh:
+                if now - node.last_pong > period * thresh \
+                        + lag_allowance:
                     print(f"ray_tpu: node {node.node_id} missed "
                           f"{thresh} health checks — declaring it "
                           f"dead", flush=True)
@@ -5545,7 +5803,8 @@ class DriverRuntime:
             return
         self.shm_store.delete(oid)
 
-    def _handle_owned_submit(self, payload, on_borrowed=None) -> None:
+    def _handle_owned_submit(self, payload, on_borrowed=None,
+                             client_key: str = "") -> None:
         """Register a client-minted task. Any failure — bad env, bad
         pickle, unknown options — is stored as the error of every
         preminted return id: the client already returned refs to its
@@ -5592,7 +5851,7 @@ class DriverRuntime:
             refs = self.submit_task(
                 fn_id, fn_blob, fn_name, args, kwargs, options,
                 preminted=(TaskID(tid_bytes), return_ids),
-                packed=packed)
+                packed=packed, client_key=client_key)
             # The remote client holds the only refs. The escape pin
             # and its consuming borrow-add are registered HERE in one
             # step (the client registers only the release finalizer):
@@ -5610,7 +5869,8 @@ class DriverRuntime:
                 self._store_error(oid, blob)
 
     def _handle_owned_submit_many(self, payloads: list,
-                                  on_borrowed=None) -> None:
+                                  on_borrowed=None,
+                                  client_key: str = "") -> None:
         """Batch transaction for a RUN of owned submits arriving in
         one client REQ_BATCH frame: per-item decode/record-build with
         per-item error isolation (failures land on that item's
@@ -5656,7 +5916,8 @@ class DriverRuntime:
                     arg_refs=arg_refs, options=options,
                     return_ids=return_ids,
                     submitted_at=time.time(),
-                    env_key=env_key, env_vars=env_vars)
+                    env_key=env_key, env_vars=env_vars,
+                    client_key=client_key)
                 # Anything _pending_add_locked derives (scheduling
                 # class, effective resources) is derived HERE, inside
                 # this item's isolation, so a malformed options dict
@@ -5744,8 +6005,8 @@ class DriverRuntime:
             except BaseException as e:  # noqa: BLE001
                 fail_item(rec, return_ids, e)
 
-    def _handle_owned_actor_submit(self, payload,
-                                   on_borrowed=None) -> None:
+    def _handle_owned_actor_submit(self, payload, on_borrowed=None,
+                                   client_key: str = "") -> None:
         """Register a client-minted actor call; failures (dead/unknown
         actor, bad pickle) land as errors on the preminted return ids
         — the caller observes them at get(). ``on_borrowed``: see
@@ -5830,13 +6091,15 @@ class DriverRuntime:
         if ev is not None:
             ev.set()
 
-    def _handle_client_op(self, op: str, payload):
+    def _handle_client_op(self, op: str, payload,
+                          client_key: str = "driver"):
         if op == P.OP_SUBMIT:
             fn_id, fn_blob, fn_name, args_kwargs_blob, opts_blob = payload
             args, kwargs = ser.loads(args_kwargs_blob)
             options = self._loads_options_cached(opts_blob)
             refs = self.submit_task(fn_id, fn_blob, fn_name, args,
-                                    kwargs, options)
+                                    kwargs, options,
+                                    client_key=client_key)
             if isinstance(refs, ObjectRefGenerator):
                 # Ownership moves to the remote client: this local
                 # generator object is about to be GC'd, and its owner

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import socket
 import struct
 import threading
@@ -66,6 +67,43 @@ _loads = pickle.loads
 # Frame envelope: little-endian (seq: u64, crc32(payload): u32).
 _HDR = struct.Struct("<QI")
 _HB = "__hb__"          # heartbeat frames: (_HB, "ping") / (_HB, "pong")
+_BUSY = "__busy__"      # server busy hint: (_BUSY, retry_after_s) —
+                        # sent by an overloaded head just before it
+                        # closes a freshly accepted connection.
+                        # Absorbed in recv (never surfaced); dial()
+                        # honors the hint on its next retry instead
+                        # of hammering the saturated accept loop.
+
+# Busy hints by dial key (the dialed address repr): populated when a
+# recv absorbs a (_BUSY, hint) frame, consulted by dial() retries and
+# by client reconnect loops. Entries expire on their own hint.
+_busy_hints: dict = {}
+_busy_lock = threading.Lock()
+
+
+def note_server_busy(dial_key: str, hint_s: float) -> None:
+    if not dial_key:
+        return
+    _bump("server_busy_hints")
+    with _busy_lock:
+        _busy_hints[dial_key] = (time.monotonic() + hint_s,
+                                 float(hint_s))
+
+
+def server_busy_hint(dial_key: str) -> float:
+    """Seconds the server at ``dial_key`` asked dialers to hold off,
+    or 0.0 when no unexpired hint is recorded."""
+    if not dial_key:
+        return 0.0
+    with _busy_lock:
+        entry = _busy_hints.get(dial_key)
+        if entry is None:
+            return 0.0
+        expires, hint = entry
+        if time.monotonic() >= expires:
+            del _busy_hints[dial_key]
+            return 0.0
+        return hint
 
 # Channel kinds (labels rules match on).
 K_CLIENT = "client"     # worker/CLI/remote-driver ↔ head (or splice)
@@ -335,13 +373,19 @@ class WireConnection:
         self.peer_node = peer_node
         self.crosses_nodes = crosses_nodes
         self._checksum = bool(checksum)
-        self._wlock = threading.Lock()
+        # RLock so ping_nowait can probe-then-send under one
+        # acquisition without racing other senders.
+        self._wlock = threading.RLock()
         self._sseq = 0           # next seq to send
         self._rseq = 0           # next seq expected
         self.last_recv = time.monotonic()
         self.last_send = self.last_recv
         self._rngs: dict = {}    # rule.id -> RNG (per-conn determinism)
         self._broken = False
+        # Address key this connection was dial()ed with (empty on the
+        # accept side): busy hints absorbed on recv are recorded
+        # against it so future dials to the same server back off.
+        self.dial_key = ""
         if "RAY_TPU_CHAOS_FILE" in os.environ:
             # Chaos runs need the plan poll even on processes that
             # never register a heartbeat monitor.
@@ -491,16 +535,63 @@ class WireConnection:
                     f"(seq {seq}, {len(payload)}B) — refusing to "
                     f"deserialize")
             obj = _loads(payload)
-            if isinstance(obj, tuple) and len(obj) == 2 \
-                    and obj[0] == _HB:
-                if obj[1] == "ping":
-                    self._pong()
-                continue           # liveness only, never surfaced
+            if isinstance(obj, tuple) and len(obj) == 2:
+                if obj[0] == _HB:
+                    if obj[1] == "ping":
+                        self._pong()
+                    continue       # liveness only, never surfaced
+                if obj[0] == _BUSY:
+                    # Server-side overload pushback: record the hint
+                    # for future dials to this address, keep reading
+                    # (the server closes right after — the natural
+                    # EOF surfaces through the normal path).
+                    try:
+                        note_server_busy(self.dial_key,
+                                         float(obj[1]))
+                    except (TypeError, ValueError):
+                        pass
+                    continue
             return obj
 
     def ping(self) -> None:
         _bump("heartbeats_sent")
         self.send((_HB, "ping"))
+
+    def ping_nowait(self) -> str:
+        """Heartbeat send that never blocks the shared monitor loop
+        (one congested channel must not starve every other channel's
+        liveness accounting). Returns:
+
+        - ``"sent"``  — ping went out normally;
+        - ``"lock"``  — another thread is mid-send on this channel
+          (bulk transfer in flight: the channel is demonstrably not
+          idle outbound, no liveness conclusion either way);
+        - ``"full"``  — the socket send buffer is full: the peer has
+          stopped draining, which is itself missed-heartbeat evidence.
+        """
+        if not self._wlock.acquire(blocking=False):
+            return "lock"
+        try:
+            try:
+                writable = select.select(
+                    [], [self.fileno()], [], 0)[1]
+            except (OSError, ValueError):
+                writable = True     # can't probe: let send() decide
+            if not writable:
+                return "full"
+            self.ping()
+            return "sent"
+        finally:
+            self._wlock.release()
+
+    def send_busy(self, retry_after_s: float) -> None:
+        """Overload pushback on a connection about to be turned away
+        (head accept-side shedding): ship the hint, swallow failures
+        (the dialer may already be gone)."""
+        try:
+            self.send((_BUSY, float(retry_after_s)))
+        except (OSError, ValueError):
+            pass
 
     # -- liveness / teardown -------------------------------------------
 
@@ -663,20 +754,33 @@ def dial(address, family: str = "AF_INET",
             timeout = 10.0 if timeout is None else timeout
             retries = 3 if retries is None else retries
     peer = peer or f"{kind} peer"
+    dial_key = repr(address)
     attempts = max(1, int(retries))
     last_err: Exception | None = None
     for attempt in range(attempts):
         if attempt:
             _bump("connect_retries")
-            # Full-jitter exponential backoff: a fleet re-dialing the
-            # same restarted peer must not arrive in lockstep.
-            time.sleep(min(2.0, 0.1 * (2 ** attempt))
-                       * random.uniform(0.5, 1.5))
+            # A server-sent busy hint (recorded when a prior recv on
+            # a connection to this address absorbed a __busy__ frame)
+            # outranks the default backoff: the head said exactly how
+            # long to hold off — hammering its accept loop sooner
+            # only deepens the overload.
+            hint = server_busy_hint(dial_key)
+            if hint > 0:
+                time.sleep(hint * random.uniform(0.75, 1.25))
+            else:
+                # Full-jitter exponential backoff: a fleet re-dialing
+                # the same restarted peer must not arrive in
+                # lockstep.
+                time.sleep(min(2.0, 0.1 * (2 ** attempt))
+                           * random.uniform(0.5, 1.5))
         try:
             raw = _dial_once(address, family, authkey, timeout, peer)
-            return WireConnection(raw, kind=kind, peer=peer,
+            conn = WireConnection(raw, kind=kind, peer=peer,
                                   peer_node=peer_node,
                                   crosses_nodes=crosses_nodes)
+            conn.dial_key = dial_key
+            return conn
         except ConnectionError as e:
             last_err = e
     raise ConnectionError(
@@ -695,8 +799,13 @@ class WireListener:
                  authkey: bytes | None = None, *,
                  kind: str = K_CLIENT, crosses_nodes: bool = False):
         # Auth runs in accept() under our watchdog, so the underlying
-        # listener is created without an authkey.
-        self._listener = mpc.Listener(address, family=family)
+        # listener is created without an authkey. backlog: mpc's
+        # default of 1 collapses under a worker-spawn wave (an actor
+        # wave dials 50+ sockets at once and connect() gets EAGAIN
+        # long before the dial retry budget saturates) — size it for
+        # the scale envelope, not the default.
+        self._listener = mpc.Listener(address, family=family,
+                                      backlog=512)
         self._authkey = authkey
         self._kind = kind
         self._crosses = crosses_nodes
@@ -781,6 +890,13 @@ class Heartbeater:
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
+        # EWMA of how much later this loop woke than the tick it
+        # asked for. Under process saturation (GIL contention from a
+        # task storm on the head) EVERY thread's deadline slips by
+        # about this much — including the peer's pong processing — so
+        # liveness deadlines stretch by it instead of declaring
+        # false-positive channel deaths.
+        self.loop_lag_s = 0.0
 
     def register(self, conn: WireConnection,
                  interval: float | None = None,
@@ -835,6 +951,12 @@ class Heartbeater:
         while True:
             _plan.maybe_refresh()
             now = time.monotonic()
+            # Liveness deadlines scale with measured loop lag: a
+            # saturated process that woke 3s late must grant its
+            # peers those same 3s (they pong'd on time; WE read
+            # late). One missed-deadline multiple of the lag covers
+            # the recv-thread slippage too.
+            lag_allowance = 3.0 * self.loop_lag_s
             with self._lock:
                 mons = list(self._monitors.items())
             for key, m in mons:
@@ -852,7 +974,27 @@ class Heartbeater:
                         m.pinged_at = None
                         continue
                     if m.pinged_at is not None \
-                            and idle >= m.timeout:
+                            and idle >= m.timeout + lag_allowance:
+                        # Reader-behind exemption + last-chance
+                        # grace: peer bytes sitting unread in OUR
+                        # buffer mean the peer is talking and the
+                        # local recv loop is behind (bulk object
+                        # pull, task storm, GIL saturation) — and a
+                        # pong from a saturated-but-alive peer may be
+                        # milliseconds away. Both are overload, not
+                        # partition: wait one bounded beat for ANY
+                        # byte before killing a live channel. A
+                        # silent partition yields nothing, so its
+                        # detection slips by at most this grace.
+                        grace = min(1.0, 0.5 * m.timeout)
+                        try:
+                            backlogged = conn.poll(grace)
+                        except (OSError, ValueError):
+                            backlogged = False
+                        if backlogged:
+                            conn.last_recv = time.monotonic()
+                            m.pinged_at = None
+                            continue
                         _bump("heartbeats_missed")
                         _bump("channel_resets")
                         self.unregister(conn)
@@ -860,17 +1002,36 @@ class Heartbeater:
                         continue
                     if m.pinged_at is None \
                             or now - m.pinged_at >= m.interval:
-                        m.pinged_at = now
                         try:
-                            conn.ping()
+                            outcome = conn.ping_nowait()
                         except (OSError, ValueError):
                             # Send path already dead: same outcome.
                             self.unregister(conn)
                             self._declare_dead(m)
+                            continue
+                        if outcome == "sent":
+                            m.pinged_at = now
+                        elif outcome == "full" \
+                                and m.pinged_at is None:
+                            # Peer not draining its socket: start the
+                            # death clock (it resets if anything is
+                            # received), but never block on the send.
+                            m.pinged_at = now
                 except Exception:  # noqa: BLE001 — one bad monitor
                     self.unregister(conn)   # must not stop the rest
-            self._wake.wait(self._tick_interval())
+            tick = self._tick_interval()
+            t0 = time.monotonic()
+            self._wake.wait(tick)
+            woke_early = self._wake.is_set()
             self._wake.clear()
+            if not woke_early:
+                # Timed-out wait: overshoot vs. the requested tick is
+                # pure scheduler/GIL lag. (An explicit wake returns
+                # early — no lag signal there.)
+                overshoot = max(
+                    0.0, (time.monotonic() - t0) - tick)
+                self.loop_lag_s = (0.7 * self.loop_lag_s
+                                   + 0.3 * overshoot)
 
     def _declare_dead(self, m: _Monitor) -> None:
         try:

@@ -584,6 +584,11 @@ class ClientRuntime:
         self._client_job = JobID(os.urandom(JobID.SIZE))
         self._async_q: deque = deque()
         self._async_event = threading.Event()
+        # Admission pacing: while the head answers ST_BUSY, new
+        # fire-and-forget submits sleep until this monotonic stamp
+        # before enqueueing (jittered backoff client-side instead of
+        # piling frames onto a saturated head).
+        self._head_busy_until = 0.0
         # Async ops whose connection died before their ack: replayed
         # IN ORDER by the reconnect fence (never by the drainer — a
         # late replay behind newer sends would reorder actor calls).
@@ -637,7 +642,7 @@ class ClientRuntime:
         self.local_mode = False
         self._monitor_conn(self._conn)
 
-    def _dial(self):
+    def _dial(self, check_busy: bool = False):
         """Open the control connection: unix path for a same-host
         head/daemon, host:port (authenticated) for a remote head.
         Connect + handshake are deadline-bounded (connect_timeout_s)
@@ -657,7 +662,37 @@ class ClientRuntime:
             conn = wirelib.dial(addr, family="AF_UNIX",
                                 kind=wirelib.K_CLIENT, peer="head")
         conn.send(("hello", "client", ""))
+        if check_busy:
+            # Reconnect path only: a head shedding dials (severe
+            # overload) answers the hello with a busy hint and
+            # closes. Poll briefly so the reject surfaces HERE — the
+            # recv absorbs the hint frame (recording it against the
+            # dial key for the retry sleep) and raises on the close —
+            # instead of after this connection was already swapped in
+            # as live, which would thrash the reconnect machinery.
+            try:
+                if conn.poll(0.05):
+                    conn.recv()
+            except (EOFError, OSError) as e:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                raise ConnectionError(
+                    "head is shedding new connections (busy)") from e
         return conn
+
+    def _dial_busy_hint(self) -> float:
+        """Unexpired server busy hint recorded against our head
+        address, or 0.0."""
+        addr = self._address
+        if isinstance(addr, str) and ":" in addr \
+                and not addr.startswith("/"):
+            host, _, port = addr.rpartition(":")
+            key = repr((host or "127.0.0.1", int(port)))
+        else:
+            key = repr(addr)
+        return wirelib.server_busy_hint(key)
 
     def _monitor_conn(self, conn) -> None:
         """Liveness deadline on the head channel: while requests are
@@ -681,9 +716,12 @@ class ClientRuntime:
         deadline = _time.monotonic() + self._reconnect_window_s
         while _time.monotonic() < deadline:
             try:
-                conn = self._dial()
+                conn = self._dial(check_busy=True)
             except (OSError, ConnectionError, EOFError, Exception):
-                _time.sleep(0.3)
+                # An overloaded head's busy hint outranks the default
+                # retry cadence — it said exactly how long to wait.
+                hint = self._dial_busy_hint()
+                _time.sleep(hint if hint > 0 else 0.3)
                 continue
             with self._conn_lock:
                 self._conn = conn
@@ -978,39 +1016,69 @@ class ClientRuntime:
                     f"head connection lost (op {op})")
         if _dd is None and self._needs_dd(op, payload):
             _dd = f"{self._dd_prefix}:{next(self._dd_counter)}"
-        req_id = next(self._req_counter)
-        event = threading.Event()
-        slot: list = []
-        with self._pending_lock:
-            self._pending[req_id] = (event, slot)
-        self.wire_rounds += 1
-        try:
-            self._enqueue_wire((req_id, op, P.wrap_dd(_dd, payload)))
-        except (OSError, BrokenPipeError) as e:
+        busy_deadline = None
+        while True:
+            req_id = next(self._req_counter)
+            event = threading.Event()
+            slot: list = []
             with self._pending_lock:
-                self._pending.pop(req_id, None)
-            if not _retried and self._try_reconnect():
-                return self._call(op, payload, timeout, _retried=True,
-                                  _dd=_dd)
-            raise ConnectionError(
-                f"head connection lost during {op}") from e
-        if not event.wait(timeout):
-            with self._pending_lock:
-                self._pending.pop(req_id, None)
-            raise GetTimeoutError(f"driver op {op} timed out")
-        status, result = slot[0]
-        if status == P.ST_ERR:
-            err = ser.loads(result)
-            if isinstance(err, ConnectionError) and not _retried \
-                    and self._try_reconnect():
-                # The in-flight request died with the old head; replay
-                # it (same dd id: if the old head already executed it
-                # and the cluster state survived, the repeat is
-                # dropped server-side).
-                return self._call(op, payload, timeout, _retried=True,
-                                  _dd=_dd)
-            raise err
-        return result
+                self._pending[req_id] = (event, slot)
+            self.wire_rounds += 1
+            try:
+                self._enqueue_wire(
+                    (req_id, op, P.wrap_dd(_dd, payload)))
+            except (OSError, BrokenPipeError) as e:
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+                if not _retried and self._try_reconnect():
+                    return self._call(op, payload, timeout,
+                                      _retried=True, _dd=_dd)
+                raise ConnectionError(
+                    f"head connection lost during {op}") from e
+            if not event.wait(timeout):
+                with self._pending_lock:
+                    self._pending.pop(req_id, None)
+                raise GetTimeoutError(f"driver op {op} timed out")
+            status, result = slot[0]
+            if status == P.ST_BUSY:
+                # Head admission pushback (serve's 503 semantics on
+                # the task/actor/PG planes): sleep the jittered
+                # retry-after and re-send the SAME dd-tagged op —
+                # bounded by admission_client_max_wait_s, past which
+                # overload surfaces as an explicit error.
+                import random
+                try:
+                    hint = max(0.001, float(result[0]))
+                except (TypeError, ValueError, IndexError):
+                    hint = 0.05
+                if busy_deadline is None:
+                    from ray_tpu.core.config import get_config
+                    busy_deadline = (
+                        time.monotonic()
+                        + get_config().admission_client_max_wait_s)
+                if time.monotonic() + hint >= busy_deadline:
+                    depth = (result[1]
+                             if isinstance(result, tuple)
+                             and len(result) > 1 else "?")
+                    raise ConnectionError(
+                        f"head busy: op {op} shed past the client "
+                        f"admission wait bound (head queue depth "
+                        f"{depth})")
+                time.sleep(min(5.0, hint)
+                           * random.uniform(0.5, 1.5))
+                continue
+            if status == P.ST_ERR:
+                err = ser.loads(result)
+                if isinstance(err, ConnectionError) and not _retried \
+                        and self._try_reconnect():
+                    # The in-flight request died with the old head;
+                    # replay it (same dd id: if the old head already
+                    # executed it and the cluster state survived, the
+                    # repeat is dropped server-side).
+                    return self._call(op, payload, timeout,
+                                      _retried=True, _dd=_dd)
+                raise err
+            return result
 
     # -- object API --
 
@@ -1419,6 +1487,13 @@ class ClientRuntime:
             if _retried or not self._try_reconnect():
                 raise ConnectionError(
                     f"head connection lost (op {op})")
+        pause = self._head_busy_until - time.monotonic()
+        if pause > 0:
+            # The head recently shed our submits (ST_BUSY seen by the
+            # drainer): pace new fire-and-forget traffic instead of
+            # piling more frames on. Bounded so one stale stamp never
+            # stalls a caller long.
+            time.sleep(min(1.0, pause))
         if _dd is None and self._needs_dd(op, payload):
             _dd = f"{self._dd_prefix}:{next(self._dd_counter)}"
         req_id = next(self._req_counter)
@@ -1465,6 +1540,30 @@ class ClientRuntime:
                 replay = True
             else:
                 status, result = slot[0]
+                if status == P.ST_BUSY:
+                    # Head admission shed this owned submit: it was
+                    # NOT applied. Sleep the jittered retry-after,
+                    # stamp the pacing window (new _call_async
+                    # traffic slows down), and re-send under the SAME
+                    # dd via the async path — safe for NORMAL task
+                    # submits (no cross-task ordering contract;
+                    # owned ACTOR submits are never answered busy
+                    # precisely because their order IS contractual).
+                    import random
+                    try:
+                        hint = max(0.001, float(result[0]))
+                    except (TypeError, ValueError, IndexError):
+                        hint = 0.05
+                    self._head_busy_until = time.monotonic() + hint
+                    time.sleep(min(5.0, hint)
+                               * random.uniform(0.5, 1.5))
+                    try:
+                        self._call_async(op, payload, _dd=dd)
+                    except Exception:  # noqa: BLE001
+                        with self._replay_lock:
+                            self._lost_async.append(
+                                (op, payload, dd))
+                    continue
                 if status == P.ST_ERR:
                     try:
                         err = ser.loads(result)

@@ -20,6 +20,10 @@ _PANELS = [
     ("Actors alive", "ray_tpu_actors_alive", "stat"),
     ("Tasks pending", "ray_tpu_tasks_pending", "timeseries"),
     ("Tasks running", "ray_tpu_tasks_running", "timeseries"),
+    ("Head queue depth", "ray_tpu_head_queue_depth", "timeseries"),
+    ("Admission state", "ray_tpu_head_admission_state", "stat"),
+    ("Admissions rejected", "ray_tpu_head_admissions_rejected",
+     "timeseries"),
     ("Object store bytes", "ray_tpu_object_store_bytes",
      "timeseries"),
     ("Objects tracked", "ray_tpu_objects_total", "timeseries"),

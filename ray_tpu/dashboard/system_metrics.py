@@ -106,8 +106,7 @@ class SystemMetricsCollector:
             nodes = list(getattr(rt, "_nodes", {}).values())
             g["nodes_alive"].set(
                 float(sum(1 for n in nodes if n.alive)))
-            with rt._res_cv:
-                g["tasks_pending"].set(float(len(rt._pending)))
+            g["tasks_pending"].set(float(rt.pending_count()))
             with rt._task_lock:
                 running = sum(1 for r in rt._tasks.values()
                               if r.state == "RUNNING")

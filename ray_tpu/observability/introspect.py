@@ -143,7 +143,7 @@ def cluster_status(rt) -> dict:
     requests)."""
     with rt._res_cv:
         node_recs = list(rt._nodes.values())
-        pending = len(rt._pending)
+        pending = rt.pending_count()
     with rt._task_lock:
         running = sum(1 for r in rt._tasks.values()
                       if r.state == "RUNNING")
@@ -183,11 +183,15 @@ def cluster_status(rt) -> dict:
         })
 
     demand = rt.resource_demand()
+    head = dict(rt.admission.snapshot(pending))
+    head["loop_lag_ms"] = round(
+        getattr(rt, "_head_loop_lag_s", 0.0) * 1000.0, 3)
     return {
         "ts": time.time(),
         "nodes": nodes,
         "tasks": {"pending": pending, "running": running,
                   "tracked": total_tracked, "finished": finished},
+        "head": head,
         "actors": actor_counts,
         "workers": {"total": workers_total, "idle": idle},
         "autoscaler": {
@@ -271,6 +275,16 @@ def format_cluster_status(cs: dict) -> str:
     t = cs["tasks"]
     lines.append(f"tasks: {t['pending']} pending, {t['running']} "
                  f"running, {t['finished']} finished")
+    h = cs.get("head")
+    if h:
+        extra = ""
+        if h.get("admissions_rejected"):
+            extra = (f", rejected={h['admissions_rejected']}"
+                     f" (dials={h.get('dials_rejected', 0)})")
+        lines.append(
+            f"head: queue {h['queue_depth']}/{h['high_water']} "
+            f"admission={h['state']} "
+            f"lag={h.get('loop_lag_ms', 0):g}ms{extra}")
     if cs["actors"]:
         lines.append("actors: " + ", ".join(
             f"{k}={v}" for k, v in sorted(cs["actors"].items())))

@@ -752,6 +752,81 @@ def _run_benchmarks(rec, quick: bool) -> None:
     rec(timeit("trace_assembly_1k_spans", _one_assembly,
                unit="assemblies/s", quick=quick))
 
+    # -- scale envelope (PR-13 indexed pending paths) ------------------
+    # One-shot throughput rows pinning the scheduler's indexed
+    # structures at tier-1-sized N; the full envelope (1k actors,
+    # 100k tasks, 500 PGs, chaos overlay) is scripts/scale_driver.py
+    # -> SCALE_r01.json. Each row reports a rate plus elapsed and the
+    # peak head queue depth observed while it ran.
+    import threading as _sthr
+
+    def _run_with_depth_sampler(fn):
+        peak = [0]
+        stop = _sthr.Event()
+
+        def _sample():
+            while not stop.wait(0.005):
+                peak[0] = max(peak[0], rt_obj.pending_count())
+
+        s = _sthr.Thread(target=_sample, daemon=True)
+        s.start()
+        t0 = time.perf_counter()
+        fn()
+        el = time.perf_counter() - t0
+        stop.set()
+        s.join(timeout=1.0)
+        return el, peak[0]
+
+    n_act = 25 if quick else 100
+
+    def _actor_wave():
+        handles = [_Actor.remote() for _ in range(n_act)]
+        ray_tpu.get([h.small_value.remote() for h in handles],
+                    timeout=300)
+        for h in handles:
+            ray_tpu.kill(h)
+
+    el, peak = _run_with_depth_sampler(_actor_wave)
+    row = {"metric": "actors_create_call_100",
+           "value": round(n_act / el, 1), "unit": "actors/s",
+           "extra": {"n": n_act, "elapsed_s": round(el, 3),
+                     "peak_queue_depth": peak}}
+    print(json.dumps(row), flush=True)
+    rec(row)
+
+    n_drain = 1000 if quick else 5000
+
+    def _flood_drain():
+        refs = [_small_task.remote() for _ in range(n_drain)]
+        ray_tpu.get(refs, timeout=600)
+
+    el, peak = _run_with_depth_sampler(_flood_drain)
+    row = {"metric": "task_drain_5k",
+           "value": round(n_drain / el, 1), "unit": "tasks/s",
+           "extra": {"n": n_drain, "elapsed_s": round(el, 3),
+                     "peak_queue_depth": peak}}
+    print(json.dumps(row), flush=True)
+    rec(row)
+
+    from ray_tpu.util import (placement_group as _pg_create,
+                              remove_placement_group as _pg_remove)
+    n_pg = 10 if quick else 50
+
+    def _pg_wave():
+        pgs = [_pg_create([{"CPU": 0.01}]) for _ in range(n_pg)]
+        for pg in pgs:
+            assert pg.ready(timeout=60), "pg never became ready"
+        for pg in pgs:
+            _pg_remove(pg)
+
+    el, peak = _run_with_depth_sampler(_pg_wave)
+    row = {"metric": "pg_create_50",
+           "value": round(n_pg / el, 1), "unit": "pgs/s",
+           "extra": {"n": n_pg, "elapsed_s": round(el, 3),
+                     "peak_queue_depth": peak}}
+    print(json.dumps(row), flush=True)
+    rec(row)
+
 
 def run_serve_bench(quick: bool = False) -> list[dict]:
     """Serve benchmarks: handle requests/s, HTTP proxy echo with the

@@ -96,6 +96,16 @@ WIRE_METRICS = (
     "heartbeat_overhead",
 )
 
+# Scale-envelope metrics (ray_tpu/perf.py): small-N throughput rows
+# over the indexed pending-queue paths — the tier-1-sized shadow of
+# the full scripts/scale_driver.py envelope (SCALE_r01.json). Same
+# must-be-present contract.
+SCALE_METRICS = (
+    "actors_create_call_100",
+    "task_drain_5k",
+    "pg_create_50",
+)
+
 
 def one_run(path: str, serve: bool, timeout: float,
             quick: bool = False) -> list[dict]:
@@ -157,6 +167,7 @@ def main() -> None:
         missing = [m for m in OBJECT_PLANE_METRICS
                    + ROBUSTNESS_METRICS
                    + WIRE_METRICS
+                   + SCALE_METRICS
                    + OBSERVABILITY_METRICS
                    + INTROSPECTION_METRICS
                    + DIRECT_CALL_METRICS

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Full-N scale-envelope lane + the SCALE artifact generator.
+#
+# Run ON AN IDLE HOST. Serial on purpose: every stage floods the box
+# (100k-task drains, 1000-actor waves) — anything else running turns
+# the measured envelope into noise. Seeds are pinned inside the
+# driver (--round N fixes the chaos schedule); PYTHONHASHSEED pins
+# the remaining ambient randomness.
+#
+# Tier-1 runs the small-N variants of these same invariants
+# (tests/test_scale_envelope.py without -m scale); this lane is the
+# full production-scale envelope from ROADMAP.md.
+#
+# Usage: scripts/run_scale.sh [round]   (default round: 1)
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PYTHONHASHSEED=0
+
+ROUND="${1:-1}"
+rc=0
+
+echo "=== scale lane (full-N envelope tests: 100k drain, 1000 actors," \
+     "500 PGs, 32 nodes) ==="
+python -m pytest tests/ -q -m scale -p no:cacheprovider -p no:xdist \
+    -p no:randomly --continue-on-collection-errors || rc=1
+
+echo "=== SCALE artifact (scripts/scale_driver.py --round ${ROUND}) ==="
+python scripts/scale_driver.py --round "${ROUND}" || rc=1
+
+exit $rc

@@ -1,0 +1,367 @@
+"""One-host scale-envelope driver -> SCALE_rNN.json.
+
+Drives the full production-scale envelope on this host and records
+the measured artifact:
+
+- 32 logical nodes over 8 real node-daemon processes (+ head)
+- 1 GiB broadcast to every daemon (checksummed: zero object loss)
+- >= 1,000 actors created AND called (waves)
+- >= 500 placement groups created/ready/removed (waves)
+- >= 100k queued tasks drained through 4 wire flooder clients
+  (exercising ST_BUSY admission + fairness), with a seeded chaos
+  overlay DURING the drain: one node kill + one silent partition —
+  zero task loss required, peak head queue depth bounded by the
+  admission hard cap.
+
+Run ON AN IDLE HOST (this is the artifact generator, not a test):
+    python scripts/scale_driver.py [--round 1] [--quick]
+
+``--quick`` shrinks every axis (driver debugging only — never the
+checked-in artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# The chaos plan file must be in the environment BEFORE the cluster
+# starts so every daemon/worker polls it (partition rules publish
+# cluster-wide through it).
+_PLAN = os.path.join(tempfile.gettempdir(),
+                     f"scale_chaos_{os.getpid()}.json")
+os.environ.setdefault("RAY_TPU_CHAOS_FILE", _PLAN)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import ray_tpu  # noqa: E402
+from ray_tpu.cluster_utils import Cluster  # noqa: E402
+from ray_tpu.core import wire  # noqa: E402
+from ray_tpu.core.api import get_runtime  # noqa: E402
+from ray_tpu.core.remote_function import make_task_options  # noqa: E402
+from ray_tpu.core.worker import ClientRuntime  # noqa: E402
+from ray_tpu.util.chaos import ResourceKiller  # noqa: E402
+from ray_tpu.util.scheduling_strategies import (  # noqa: E402
+    NodeAffinitySchedulingStrategy,
+)
+
+
+def log(msg: str) -> None:
+    print(f"[scale +{time.monotonic() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+T0 = time.monotonic()
+
+
+class DepthSampler:
+    """Peak head-queue-depth watcher (the bounded-by-watermark
+    evidence in the artifact)."""
+
+    def __init__(self, rt):
+        self.rt = rt
+        self.peak = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.wait(0.005):
+            d = self.rt.pending_count()
+            if d > self.peak:
+                self.peak = d
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=1)
+
+
+@ray_tpu.remote(num_cpus=0)
+class _Echo:
+    def ping(self, i):
+        return i
+
+
+def _scale_echo(i):
+    return i
+
+
+def _checksum_task(*chunks):
+    total, crc = 0, 0
+    for c in chunks:
+        total += len(c)
+        crc = zlib.adler32(c, crc)
+    return total, crc
+
+
+def phase_nodes(cluster, n_daemons: int, n_logical: int) -> dict:
+    log(f"booting {n_daemons} daemons + {n_logical} logical nodes")
+    daemons = []
+    for _ in range(n_daemons):
+        daemons.append(cluster.add_node(num_cpus=1, timeout_s=60.0))
+    rt = get_runtime()
+    for i in range(n_logical):
+        rt.add_node({"CPU": 1.0}, labels={"scale": f"logical{i}"})
+    alive = sum(1 for n in ray_tpu.nodes() if n["Alive"])
+    log(f"cluster up: {alive} alive nodes")
+    return {"daemons": n_daemons, "logical": n_logical,
+            "total_alive": alive,
+            "daemon_node_ids": [d.node_id for d in daemons]}
+
+
+def phase_broadcast(daemon_ids: list[str], total_mib: int) -> dict:
+    """Put total_mib of payload (64 MiB chunks) and pull the whole
+    set onto every daemon, checksummed end-to-end."""
+    chunk_mib = min(64, total_mib)
+    n_chunks = max(1, total_mib // chunk_mib)
+    log(f"broadcast: {n_chunks} x {chunk_mib} MiB to "
+        f"{len(daemon_ids)} daemons")
+    payloads = [os.urandom(chunk_mib * 1024 * 1024)
+                for _ in range(n_chunks)]
+    expect_crc = 0
+    for p in payloads:
+        expect_crc = zlib.adler32(p, expect_crc)
+    expect_bytes = sum(len(p) for p in payloads)
+    refs = [ray_tpu.put(p) for p in payloads]
+    del payloads
+
+    probe = ray_tpu.remote(num_cpus=1)(_checksum_task)
+    t0 = time.perf_counter()
+    probes = [probe.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            nid, soft=False)).remote(*refs) for nid in daemon_ids]
+    out = ray_tpu.get(probes, timeout=1800)
+    seconds = time.perf_counter() - t0
+    for total, crc in out:
+        assert total == expect_bytes and crc == expect_crc, \
+            "broadcast corrupted or lost bytes"
+    gib = expect_bytes * len(daemon_ids) / 2 ** 30
+    log(f"broadcast done in {seconds:.1f}s "
+        f"({gib / max(seconds, 1e-9):.2f} GiB/s aggregate)")
+    del refs
+    return {"bytes_per_daemon": expect_bytes,
+            "daemons": len(daemon_ids),
+            "seconds": round(seconds, 2),
+            "agg_gib_per_s": round(gib / max(seconds, 1e-9), 3),
+            "zero_loss": True}
+
+
+def phase_actors(n: int, wave: int) -> dict:
+    log(f"actors: {n} created+called in waves of {wave}")
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        k = min(wave, n - done)
+        hs = [_Echo.remote() for _ in range(k)]
+        vals = ray_tpu.get(
+            [h.ping.remote(done + j) for j, h in enumerate(hs)],
+            timeout=600)
+        assert vals == list(range(done, done + k)), "actor wave lost"
+        for h in hs:
+            ray_tpu.kill(h)
+        done += k
+        if done % (wave * 4) == 0:
+            log(f"  actors {done}/{n}")
+    seconds = time.perf_counter() - t0
+    log(f"actors done in {seconds:.1f}s ({n / seconds:.1f}/s)")
+    return {"n": n, "seconds": round(seconds, 2),
+            "per_s": round(n / seconds, 2), "zero_loss": True}
+
+
+def phase_pgs(n: int, wave: int) -> dict:
+    from ray_tpu.util import placement_group, remove_placement_group
+    log(f"placement groups: {n} in waves of {wave}")
+    t0 = time.perf_counter()
+    made = 0
+    while made < n:
+        k = min(wave, n - made)
+        pgs = [placement_group([{"CPU": 0.001}]) for _ in range(k)]
+        for pg in pgs:
+            assert pg.ready(timeout=120), "pg never ready"
+        for pg in pgs:
+            remove_placement_group(pg)
+        made += k
+    seconds = time.perf_counter() - t0
+    rt = get_runtime()
+    assert not rt._pgs, "placement groups leaked"
+    log(f"pgs done in {seconds:.1f}s ({n / seconds:.1f}/s)")
+    return {"n": n, "seconds": round(seconds, 2),
+            "per_s": round(n / seconds, 2)}
+
+
+def phase_drain(n_tasks: int, n_clients: int, chaos: bool,
+                seed: int) -> dict:
+    """The 100k drain through wire flooder clients, chaos overlaid
+    mid-flight. Every client asserts its full result set."""
+    rt = get_runtime()
+    fn_id, fn_blob = rt.register_function(_scale_echo)
+    per_client = n_tasks // n_clients
+    log(f"drain: {n_tasks} tasks over {n_clients} wire clients"
+        f"{' + chaos' if chaos else ''}")
+    rejected0 = rt.admission.rejected
+    errors: list = []
+    done_counts = [0] * n_clients
+
+    def flood(ci: int):
+        client = ClientRuntime(rt.client_address)
+        try:
+            base = ci * per_client
+            refs = []
+            for i in range(per_client):
+                refs.extend(client.submit_task(
+                    fn_id, fn_blob, "_scale_echo", (base + i,), {},
+                    make_task_options()))
+            # Drain in bounded windows so ref memory stays flat.
+            for lo in range(0, per_client, 5000):
+                window = refs[lo:lo + 5000]
+                vals = client.get(window, timeout=1800)
+                if vals != list(range(base + lo,
+                                      base + lo + len(window))):
+                    raise AssertionError(
+                        f"client {ci} lost tasks in [{lo}, "
+                        f"{lo + len(window)})")
+                done_counts[ci] += len(window)
+        except Exception as e:  # noqa: BLE001
+            errors.append((ci, repr(e)))
+        finally:
+            client.shutdown()
+
+    killers: list[ResourceKiller] = []
+    decisions: list = []
+    t0 = time.perf_counter()
+    with DepthSampler(rt) as sampler:
+        threads = [threading.Thread(target=flood, args=(ci,),
+                                    daemon=True)
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        if chaos:
+            # Let the flood build a real queue, then hit it: one cold
+            # node kill and one 2s silent partition, both seeded.
+            while (rt.pending_count() < 1000
+                   and any(t.is_alive() for t in threads)):
+                time.sleep(0.05)
+            log("chaos overlay: node kill + partition during drain")
+            killers = [
+                ResourceKiller(kind="node", interval_s=2.0,
+                               max_kills=1, seed=seed).start(),
+                ResourceKiller(kind="partition", interval_s=4.0,
+                               max_kills=1, seed=seed + 1,
+                               partition_duration_s=2.0,
+                               plan_file=_PLAN).start(),
+            ]
+        for t in threads:
+            t.join()
+        seconds = time.perf_counter() - t0
+    for k in killers:
+        k.stop()
+        decisions.extend(k.decisions)
+    assert not errors, f"drain lost tasks: {errors}"
+    assert sum(done_counts) == per_client * n_clients
+    log(f"drain done in {seconds:.1f}s "
+        f"({n_tasks / seconds:.0f} tasks/s), peak queue depth "
+        f"{sampler.peak}, "
+        f"{rt.admission.rejected - rejected0} busy sheds")
+    return {"n": per_client * n_clients, "clients": n_clients,
+            "seconds": round(seconds, 2),
+            "per_s": round(n_tasks / seconds, 1),
+            "peak_queue_depth": sampler.peak,
+            "admissions_rejected": rt.admission.rejected - rejected0,
+            "zero_loss": True,
+            "chaos": {"enabled": chaos, "seed": seed,
+                      "decisions": [list(d) for d in decisions]}}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=1)
+    ap.add_argument("--quick", action="store_true",
+                    help="shrunken axes: driver debugging only")
+    ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--broadcast-mib", type=int, default=1024)
+    args = ap.parse_args()
+
+    wire.write_plan_file(_PLAN, [])
+    q = args.quick
+    n_daemons = 2 if q else 8
+    n_logical = 4 if q else 24
+    n_actors = 60 if q else 1000
+    n_pgs = 50 if q else 500
+    n_tasks = 4000 if q else 100_000
+    bcast_mib = min(args.broadcast_mib, 64 if q else args.broadcast_mib)
+
+    load0 = os.getloadavg()[0]
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    rt = get_runtime()
+    artifact: dict = {
+        "round": args.round,
+        "host": {"cores": os.cpu_count(),
+                 "load1_at_start": round(load0, 2)},
+        "config": {
+            "admission_enabled": rt.admission.enabled,
+            "high_water": rt.admission.high,
+            "hard_cap": rt.admission.hard,
+        },
+        "quick": q,
+    }
+    try:
+        artifact["nodes"] = phase_nodes(cluster, n_daemons, n_logical)
+        artifact["broadcast"] = phase_broadcast(
+            artifact["nodes"]["daemon_node_ids"], bcast_mib)
+        artifact["actors"] = phase_actors(n_actors,
+                                          wave=20 if q else 50)
+        artifact["pgs"] = phase_pgs(n_pgs, wave=25 if q else 100)
+        artifact["drain"] = phase_drain(
+            n_tasks, n_clients=4, chaos=not args.no_chaos,
+            seed=args.round * 100 + 7)
+        # Bounded-by-watermark evidence: the queue never ran away
+        # past the admission hard cap (plus in-flight batch slack).
+        slack = 512
+        assert artifact["drain"]["peak_queue_depth"] <= \
+            rt.admission.hard + slack, (
+            f"queue ran away: peak "
+            f"{artifact['drain']['peak_queue_depth']} vs hard cap "
+            f"{rt.admission.hard}")
+        artifact["head"] = {
+            "loop_lag_ms": round(rt._head_loop_lag_s * 1000.0, 3),
+            "admission": rt.admission.snapshot(rt.pending_count()),
+        }
+        artifact["zero_loss"] = all(
+            artifact[k].get("zero_loss", True)
+            for k in ("broadcast", "actors", "drain"))
+        artifact["elapsed_s"] = round(time.monotonic() - T0, 1)
+        artifact["ts"] = time.time()
+    finally:
+        try:
+            cluster.shutdown()
+        except Exception:  # noqa: BLE001 — artifact already measured
+            pass
+        try:
+            os.unlink(_PLAN)
+        except OSError:
+            pass
+
+    name = ("SCALE_quick.json" if q
+            else f"SCALE_r{args.round:02d}.json")
+    out = os.path.join(REPO, name)
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    log(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,10 @@ def pytest_configure(config):
         "markers",
         "partition: network-fault run (ChaosTransport frame faults "
         "/ silent partitions)")
+    config.addinivalue_line(
+        "markers",
+        "scale: full-N scale-envelope run (scripts/run_scale.sh; "
+        "tier-1 runs the small-N variants)")
 
 
 @pytest.fixture

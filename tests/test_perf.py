@@ -49,6 +49,14 @@ def test_microbench_floors(rt):
     assert results["heartbeat_overhead"] < 15.0 * relax, (
         f"wire envelope tax {results['heartbeat_overhead']}us — "
         f"hot path regressed")
+    # Scale-envelope rows (PR 13): order-of-magnitude pins on the
+    # indexed pending-queue paths. Measured on this 1-core box:
+    # ~7 actors/s created+called, ~2.4k tasks/s drained, PG create
+    # near-instant — floors sit far below so only a regression back
+    # to an O(n) scan (or worse) trips them.
+    assert results["actors_create_call_100"] > 1.0 / relax
+    assert results["task_drain_5k"] > 300 / relax
+    assert results["pg_create_50"] > 5.0 / relax
 
 
 @pytest.mark.slow
@@ -169,6 +177,31 @@ def test_task_event_recording_disabled_near_zero():
             "disabled recording must not buffer events"
     finally:
         te.set_recording(True)
+
+
+def test_admission_disabled_check_near_zero():
+    """Overload-control guardrail: with admission disabled the only
+    hot-path presence on every client submit is one flag read in
+    ``AdmissionController.check`` — budget 2µs/op on this slow box
+    (same contract as the task-event / profiler / tracing flags)."""
+    import time
+
+    from ray_tpu.core.admission import AdmissionController
+    from ray_tpu.core.config import env_overrides, get_config
+
+    with env_overrides(admission_enabled=False):
+        ac = AdmissionController(get_config())
+    assert ac.check(10 ** 9, "flooder") is None, \
+        "disabled admission must admit everything"
+    n = 50_000
+    check = ac.check
+    t0 = time.perf_counter()
+    for _ in range(n):
+        check(0, "driver")
+    per_op = (time.perf_counter() - t0) / n
+    assert per_op < 2e-6, (
+        f"disabled admission check costs {per_op * 1e9:.0f}ns/op")
+    assert ac.rejected == 0
 
 
 def test_head_pipeline_disabled_skips_store(rt):
