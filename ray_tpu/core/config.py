@@ -221,6 +221,39 @@ class Config:
     # stays ring-buffered for the next interval.
     metrics_flush_batch: int = 2048
 
+    # --- signals plane / SLO alerting (head-side time series over
+    # the aggregator's merged registry; reference: the dashboard's
+    # Prometheus-backed series + SRE-workbook multiwindow burn-rate
+    # alerts, done in-process) ---
+    # Master switch for head-side sampling + SLO evaluation. Off =
+    # no sampling thread and a bare flag check per tick (guardrail
+    # in tests/test_perf.py). Requires metrics_export_enabled too.
+    signals_enabled: bool = True
+    # Seconds between head samples of the merged registry into the
+    # per-series ring buffers.
+    signals_sample_interval_s: float = 1.0
+    # Raw-tier retention: queries with windows inside it read
+    # full-resolution points.
+    signals_retention_s: float = 600.0
+    # Coarse tier keeps every Nth sample for signals_coarse_retention_s
+    # — longer windows downsample instead of growing memory.
+    signals_coarse_factor: int = 10
+    signals_coarse_retention_s: float = 7200.0
+    # Hard cap on tracked (name, tag-set) series; overflow is counted
+    # (series_dropped), never grown.
+    signals_max_series: int = 2048
+    # Per-deployment serve p99 SLO target in milliseconds; > 0 auto-
+    # creates a burn-rate rule per deployment seen in the latency
+    # histogram. 0 disables the serve auto-rules.
+    slo_serve_p99_target_ms: float = 0.0
+    # Multiwindow burn-rate shape: both windows must burn for a rule
+    # to leave OK — fast catches sudden regressions, slow suppresses
+    # blips. WARN at burn_warn x target, PAGE at burn_page x.
+    slo_window_fast_s: float = 60.0
+    slo_window_slow_s: float = 300.0
+    slo_burn_warn: float = 1.0
+    slo_burn_page: float = 2.0
+
     # --- causal tracing (reference: tracing_helper.py span
     # propagation around every .remote(); Dapper-style head-side
     # assembly) ---

@@ -132,6 +132,10 @@ OP_REQ_BATCH = "req_batch"      # (-1, OP_REQ_BATCH,
                                 # costs one pickle+send+reader wakeup.
 OP_RESOURCES = "resources"
 OP_STATE = "state"            # (kind, filters) -> list[dict] | dict
+                              # kinds incl. "timeseries" (signal-
+                              # store queries), "alerts" (SLO burn
+                              # states), "deployment_signals" (per-
+                              # deployment p99/shed digest)
 OP_PG_CREATE = "pg_create"
 OP_PG_REMOVE = "pg_remove"
 OP_STREAM_NEXT = "stream_next"  # (task_id_bytes, timeout) ->

@@ -1062,6 +1062,17 @@ class DriverRuntime:
             # scrape its ray_tpu_head_* admission gauges and needs
             # the loop-lag EWMA feeding lag-scaled deadlines.
             self._ensure_health_thread()
+            # Signals loop: samples the merged registry into the
+            # head-side time-series store and evaluates the SLO
+            # burn-rate rules. Its own thread (not the health loop)
+            # so the sampling cadence is independent of
+            # health_check_period_s; never started when disabled.
+            if config.metrics_export_enabled \
+                    and config.signals_enabled:
+                self._signals_thread = threading.Thread(
+                    target=self._signals_loop, daemon=True,
+                    name="signals")
+                self._signals_thread.start()
 
         # Memory monitor / OOM killer (reference: MemoryMonitor N26)
         self.memory_monitor = None
@@ -4519,6 +4530,15 @@ class DriverRuntime:
             return self.observability.export_trace(
                 str(opts.get("trace_id", "")),
                 str(opts.get("format", "chrome")))
+        if kind == "timeseries":
+            return self.observability.signals.query(filters)
+        if kind == "alerts":
+            return self.observability.alerts()
+        if kind == "deployment_signals":
+            opts = filters if isinstance(filters, dict) else {}
+            return self.observability.deployment_signals(
+                str(opts.get("name", "")),
+                window_s=opts.get("window"))
         fns = {
             "tasks": state_api.list_tasks,
             "actors": state_api.list_actors,
@@ -5340,6 +5360,19 @@ class DriverRuntime:
                     threading.Thread(target=self._safe_ping,
                                      args=(node,),
                                      daemon=True).start()
+
+    def _signals_loop(self) -> None:
+        """Head signals cadence: one SignalStore sample + SLO
+        evaluation per ``signals_sample_interval_s``. Reads the
+        plane's live-tunable interval each lap so tests can crank the
+        cadence on a running head."""
+        while not self._shutdown:
+            time.sleep(max(0.05,
+                           self.observability.signals_interval))
+            try:
+                self.observability.signals_tick(force=True)
+            except Exception:  # noqa: BLE001 — sampling must never
+                pass           # kill the loop
 
     # ---------------- resource-view sync (ray_syncer analog) ----------
 
@@ -6364,6 +6397,19 @@ class DriverRuntime:
                 return self.observability.export_trace(
                     str(opts.get("trace_id", "")),
                     str(opts.get("format", "chrome")))
+            if kind == "timeseries":
+                # Signals-plane time-series queries (rate / windowed
+                # quantile / delta / last-N / sparklines) over the
+                # client protocol — what the CLI and the SLO-aware
+                # serve autoscaler consume.
+                return self.observability.signals.query(filters)
+            if kind == "alerts":
+                return self.observability.alerts()
+            if kind == "deployment_signals":
+                opts = filters if isinstance(filters, dict) else {}
+                return self.observability.deployment_signals(
+                    str(opts.get("name", "")),
+                    window_s=opts.get("window"))
             return fns[kind](filters)
         if op == P.OP_PROFILE:
             action, spec = payload

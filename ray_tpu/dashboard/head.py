@@ -15,6 +15,10 @@ Endpoints (reference: dashboard modules python/ray/dashboard/modules/):
   GET /api/v1/stack           live stack dumps (ray stack analog)
   GET /api/v1/profile         remote flame graph (speedscope JSON;
                               ?duration_s=&hz=&target=&format=)
+  GET /api/v1/timeseries      head signal store queries (?kind=rate|
+                              quantile|sparklines|..., ?name=,
+                              ?window=, ?q=, ?deployment=)
+  GET /api/v1/alerts          SLO burn-rate alert states
 """
 
 from __future__ import annotations
@@ -138,6 +142,34 @@ class _Handler(BaseHTTPRequestHandler):
                         {"error": f"unknown trace {tid}"}).encode())
                 else:
                     self._send_json(out)
+            elif path in ("/api/timeseries", "/api/v1/timeseries"):
+                # Head signal store queries: ?kind=rate|delta|avg|
+                # latest|quantile|last|sparklines|names plus
+                # ?name=, ?window=, ?q=, ?n=, ?points= and an
+                # optional ?deployment= tag shorthand.
+                spec = {"kind": self._qstr("kind", "names")}
+                for key, get in (("name", self._qstr),
+                                 ("tag_key", self._qstr)):
+                    v = get(key)
+                    if v is not None:
+                        spec[key] = v
+                for key in ("window", "q"):
+                    v = self._qstr(key)
+                    if v is not None:
+                        spec[key] = float(v)
+                for key in ("n", "points"):
+                    v = self._qstr(key)
+                    if v is not None:
+                        spec[key] = int(v)
+                dep = self._qstr("deployment")
+                if dep is not None:
+                    spec["tags"] = {"deployment": dep}
+                self._send_json(
+                    rt.observability.signals.query(spec))
+            elif path in ("/api/alerts", "/api/v1/alerts"):
+                # SLO burn-rate alert states + signal store health
+                # (the `ray_tpu alerts` payload).
+                self._send_json(rt.observability.alerts())
             elif path == "/api/serve/applications":
                 from ray_tpu import serve
                 self._send_json(serve.status())

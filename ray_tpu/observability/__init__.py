@@ -45,6 +45,7 @@ from ray_tpu.observability.profiler import (
     sample_stacks,
     to_speedscope,
 )
+from ray_tpu.observability.slo import SloEngine, SloRule
 from ray_tpu.observability.snapshot import snapshot_registry
 from ray_tpu.observability.task_events import (
     TaskEventStore,
@@ -53,12 +54,16 @@ from ray_tpu.observability.task_events import (
     recording_enabled,
     set_recording,
 )
+from ray_tpu.observability.timeseries import SignalStore
 from ray_tpu.observability.tracestore import TraceStore
 
 __all__ = [
     "ClusterMetricsAggregator",
     "MetricsExporter",
     "ObservabilityPlane",
+    "SignalStore",
+    "SloEngine",
+    "SloRule",
     "ProfilerBusyError",
     "TaskEventStore",
     "TraceStore",

@@ -203,7 +203,13 @@ def cluster_status(rt) -> dict:
             "metric_pushes_ingested":
                 rt.observability.pushes_ingested,
             "task_events_tracked": len(rt.observability.task_events),
+            "tracestore": rt.observability.traces.self_health(),
+            "signals": rt.observability.signals.stats(),
         },
+        # SLO burn-rate verdicts from the signals plane (the
+        # ``ray_tpu alerts`` payload's alert list, inlined here so
+        # one status call answers "is anything on fire").
+        "alerts": list(rt.observability.slo.last_alerts),
     }
 
 
@@ -285,6 +291,24 @@ def format_cluster_status(cs: dict) -> str:
             f"head: queue {h['queue_depth']}/{h['high_water']} "
             f"admission={h['state']} "
             f"lag={h.get('loop_lag_ms', 0):g}ms{extra}")
+    alerts = cs.get("alerts") or []
+    if alerts:
+        firing = [a for a in alerts if a["state"] != "OK"]
+        lines.append(f"alerts: {len(firing)} firing / "
+                     f"{len(alerts)} rules")
+        for a in firing[:8]:
+            lines.append(
+                f"  [{a['state']}] {a['rule']}: "
+                f"burn fast={a['burn_fast']:.2f} "
+                f"slow={a['burn_slow']:.2f} "
+                f"(value={a['value_fast']} target={a['target']:g})")
+    ts = (cs.get("observability") or {}).get("tracestore")
+    if ts:
+        lines.append(
+            f"tracestore: {ts['traces_retained']} retained, "
+            f"{ts['traces_dropped']} dropped, "
+            f"{ts['orphans_adopted']} orphans adopted, "
+            f"{ts['spans_deduped']} deduped")
     if cs["actors"]:
         lines.append("actors: " + ", ".join(
             f"{k}={v}" for k, v in sorted(cs["actors"].items())))

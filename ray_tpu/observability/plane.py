@@ -14,7 +14,9 @@ from __future__ import annotations
 import time
 
 from ray_tpu.observability.aggregator import ClusterMetricsAggregator
+from ray_tpu.observability.slo import SloEngine
 from ray_tpu.observability.task_events import TaskEventStore
+from ray_tpu.observability.timeseries import SignalStore
 from ray_tpu.observability.tracestore import TraceStore
 
 
@@ -33,6 +35,22 @@ class ObservabilityPlane:
             sample_on_error=cfg.trace_sample_on_error,
             force_sample_ms=cfg.trace_force_sample_ms)
         self.pushes_ingested = 0
+        # Signals plane (snapshots -> time series -> decisions): the
+        # head's signals loop ticks signals_tick() every
+        # signals_interval seconds; both attributes are live-tunable
+        # (tests crank the cadence without rebuilding the runtime).
+        self.signals_enabled = bool(cfg.signals_enabled
+                                    and cfg.metrics_export_enabled)
+        self.signals_interval = cfg.signals_sample_interval_s
+        self.signals = SignalStore(
+            interval_s=cfg.signals_sample_interval_s,
+            retention_s=cfg.signals_retention_s,
+            coarse_factor=cfg.signals_coarse_factor,
+            coarse_retention_s=cfg.signals_coarse_retention_s,
+            max_series=cfg.signals_max_series)
+        self.slo = SloEngine(cfg)
+        self._signals_last = 0.0
+        self._tracestore_gauges = None
 
     def set_enabled(self, on: bool) -> None:
         """Runtime toggle for the head-side pipeline (the perf
@@ -132,10 +150,97 @@ class ObservabilityPlane:
     def mark_node_live(self, node_id: str) -> None:
         self.aggregator.mark_node_live(node_id)
 
+    # -- signals plane (time series + SLO burn-rate alerts) -------------
+
+    def signals_tick(self, now: float | None = None,
+                     force: bool = False) -> bool:
+        """One sampling tick: refresh the head self-health gauges,
+        fold the merged registry into the signal store, evaluate the
+        SLO rules. Called by the runtime's signals loop; the disabled
+        path is a bare flag check (guardrailed < 2µs in
+        tests/test_perf.py, matching the admission/tracing pattern)."""
+        if not (self.enabled and self.signals_enabled):
+            return False
+        now = time.time() if now is None else now
+        if not force and now - self._signals_last \
+                < self.signals_interval:
+            return False
+        self._signals_last = now
+        merged = self.aggregator.merged(
+            extra_procs=[self._local_proc()])
+        self.signals.sample(merged, now)
+        self.slo.evaluate(self.signals, now)
+        return True
+
+    def alerts(self) -> dict:
+        """The ``ray_tpu alerts`` / ``/api/v1/alerts`` payload: last
+        SLO evaluation plus store health, so the deciding signal
+        values are visible next to the verdicts."""
+        return {
+            "ts": self.slo.last_eval_ts,
+            "evals": self.slo.evals,
+            "alerts": list(self.slo.last_alerts),
+            "signals": self.signals.stats(),
+        }
+
+    def deployment_signals(self, name: str,
+                           window_s: float | None = None) -> dict:
+        """Per-deployment digest for the SLO-aware autoscaler, one
+        round trip: p99-over-window across ALL the deployment's
+        replicas, shed rate, and the head queue gauge."""
+        import math as _math
+        w = float(window_s or 30.0)
+        tags = {"deployment": name}
+        p99 = self.signals.quantile_over_window(
+            "ray_tpu_serve_request_latency_s", 0.99, w, tags=tags)
+        wh = self.signals.window_histogram(
+            "ray_tpu_serve_request_latency_s", w, tags=tags)
+        shed = self.signals.rate(
+            "ray_tpu_serve_replica_shed_total", w, tags=tags)
+        qd = self.signals.latest("ray_tpu_head_queue_depth")
+
+        def _clean(v):
+            return None if isinstance(v, float) and _math.isnan(v) \
+                else v
+        return {
+            "deployment": name,
+            "ts": time.time(),
+            "window_s": w,
+            "p99_s": _clean(p99),
+            "samples": wh[2] if wh else 0,
+            "shed_rate": _clean(shed) or 0.0,
+            "queue_depth": _clean(qd),
+            "signals_enabled": bool(self.enabled
+                                    and self.signals_enabled),
+        }
+
+    def _refresh_tracestore_gauges(self) -> None:
+        """TraceStore self-health -> head-local gauges, refreshed at
+        snapshot time so every scrape/sample sees current retention
+        pressure (same lazy-gauge shape as admission.export_gauges)."""
+        if self._tracestore_gauges is None:
+            from ray_tpu.util import metrics as m
+            self._tracestore_gauges = {
+                k: m.Gauge(f"ray_tpu_tracestore_{k}", desc)
+                for k, desc in (
+                    ("traces_retained",
+                     "assembled traces currently held"),
+                    ("traces_dropped",
+                     "traces evicted or sampled out, cumulative"),
+                    ("orphans_adopted",
+                     "orphan spans adopted under roots after grace"),
+                    ("spans_deduped",
+                     "replayed/double-fed spans dropped by dedupe"),
+                )}
+        health = self.traces.self_health()
+        for k, g in self._tracestore_gauges.items():
+            g.set(health[k])
+
     # -- export surfaces ------------------------------------------------
 
     def _local_proc(self) -> tuple:
         from ray_tpu.observability.snapshot import snapshot_registry
+        self._refresh_tracestore_gauges()
         return (self._rt.head_node_id, "head", snapshot_registry(),
                 time.time())
 

@@ -1,0 +1,221 @@
+"""Declarative SLO rules + multiwindow burn-rate alerting.
+
+Reference shape: the SRE-workbook multiwindow, multi-burn-rate alert
+(also the Prometheus ``slo-libsonnet`` lineage): each rule compares an
+observed signal to a target over a FAST window (catches sudden
+regressions quickly) and a SLOW window (suppresses blips), and fires
+only when **both** burn — WARN at ``burn_warn``x the target, PAGE at
+``burn_page``x. Burn is simply ``observed / target``, so 1.0 means
+"exactly at the objective".
+
+Rules are evaluated by the head's signals loop against the
+:class:`~ray_tpu.observability.timeseries.SignalStore`; results are
+
+- exported as head-local gauges (``ray_tpu_slo_state`` 0/1/2,
+  ``ray_tpu_slo_burn_fast``, ``ray_tpu_slo_burn_slow`` — scraped,
+  sampled back into the signal store, alertable by external
+  Prometheus too);
+- surfaced in ``ray_tpu alerts`` / ``ray_tpu status`` /
+  ``cluster_status()["alerts"]`` / ``GET /api/v1/alerts``.
+
+Default rules cover the head queue depth (vs the admission high-water
+mark) and TraceStore drop pressure; per-deployment serve p99 rules
+are auto-discovered from the latency histogram's ``deployment`` tag
+whenever ``serve_p99_target_ms`` is set. A rule with no data in the
+store evaluates to OK with ``no_data`` marked — absence of signal is
+not an outage.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["SloRule", "SloEngine", "STATE_OK", "STATE_WARN",
+           "STATE_PAGE"]
+
+STATE_OK, STATE_WARN, STATE_PAGE = "OK", "WARN", "PAGE"
+_STATE_NUM = {STATE_OK: 0, STATE_WARN: 1, STATE_PAGE: 2}
+
+
+@dataclass
+class SloRule:
+    name: str
+    signal: str                    # metric family in the SignalStore
+    kind: str = "gauge"            # "gauge" | "rate" | "quantile"
+    target: float = 1.0            # burn = observed / target
+    q: float = 0.99                # quantile rules only
+    tags: dict = field(default_factory=dict)
+    window_fast_s: float = 60.0
+    window_slow_s: float = 300.0
+    burn_warn: float = 1.0
+    burn_page: float = 2.0
+    description: str = ""
+
+    def observe(self, store, window_s: float,
+                now: float) -> float:
+        tags = self.tags or None
+        if self.kind == "rate":
+            return store.rate(self.signal, window_s, now=now,
+                              tags=tags)
+        if self.kind == "quantile":
+            return store.quantile_over_window(
+                self.signal, self.q, window_s, now=now, tags=tags)
+        return store.avg(self.signal, window_s, now=now, tags=tags)
+
+
+def _burn(value: float, target: float) -> float:
+    if math.isnan(value):
+        return 0.0
+    if target <= 0:
+        return math.inf if value > 0 else 0.0
+    return value / target
+
+
+class SloEngine:
+    def __init__(self, config=None, rules: list[SloRule] | None = None,
+                 auto_rules: bool = True, export_gauges: bool = True):
+        self.rules: list[SloRule] = list(rules or [])
+        self.auto_rules = auto_rules
+        self.export_gauges = export_gauges
+        self._auto: dict[str, SloRule] = {}
+        self._gauges = None
+        self.last_alerts: list[dict] = []
+        self.last_eval_ts = 0.0
+        self.evals = 0
+        # Knobs lifted off the config so tests (and a live head) can
+        # retune without rebuilding the engine.
+        self.window_fast_s = getattr(config, "slo_window_fast_s", 60.0)
+        self.window_slow_s = getattr(config, "slo_window_slow_s",
+                                     300.0)
+        self.burn_warn = getattr(config, "slo_burn_warn", 1.0)
+        self.burn_page = getattr(config, "slo_burn_page", 2.0)
+        self.serve_p99_target_ms = getattr(
+            config, "slo_serve_p99_target_ms", 0.0)
+        if auto_rules and config is not None:
+            self.rules.extend(self._builtin_rules(config))
+
+    # -- rule construction ----------------------------------------------
+
+    def _builtin_rules(self, cfg) -> list[SloRule]:
+        high = float(getattr(cfg, "head_pending_high_water", 20000))
+        return [
+            SloRule(
+                name="head_queue_depth",
+                signal="ray_tpu_head_queue_depth", kind="gauge",
+                # Burning at 1.0 when the mean queue sits at 80% of
+                # the admission high-water mark — i.e. BEFORE
+                # shedding starts, which is the whole point of the
+                # scale-before-shed ordering.
+                target=0.8 * high,
+                window_fast_s=self.window_fast_s,
+                window_slow_s=self.window_slow_s,
+                burn_warn=self.burn_warn, burn_page=self.burn_page,
+                description="head pending queue approaching the "
+                            "admission high-water mark"),
+            SloRule(
+                name="tracestore_drops",
+                signal="ray_tpu_tracestore_traces_dropped",
+                kind="rate", target=1.0,
+                window_fast_s=self.window_fast_s,
+                window_slow_s=self.window_slow_s,
+                burn_warn=self.burn_warn, burn_page=self.burn_page,
+                description="TraceStore evicting/sampling-out more "
+                            "than 1 trace/s — retention pressure"),
+        ]
+
+    def add_rule(self, rule: SloRule) -> None:
+        self.rules.append(rule)
+
+    def _refresh_auto_rules(self, store) -> None:
+        """Per-deployment serve tail-latency rules, discovered from
+        the latency histogram's deployment tag."""
+        target_ms = self.serve_p99_target_ms
+        if not self.auto_rules or target_ms <= 0:
+            self._auto.clear()
+            return
+        for dep in store.tag_values(
+                "ray_tpu_serve_request_latency_s", "deployment"):
+            rname = f"serve_p99:{dep}"
+            if rname in self._auto:
+                continue
+            self._auto[rname] = SloRule(
+                name=rname,
+                signal="ray_tpu_serve_request_latency_s",
+                kind="quantile", q=0.99,
+                target=target_ms / 1e3,
+                tags={"deployment": dep},
+                window_fast_s=self.window_fast_s,
+                window_slow_s=self.window_slow_s,
+                burn_warn=self.burn_warn, burn_page=self.burn_page,
+                description=f"p99 latency of deployment {dep!r} vs "
+                            f"the {target_ms:g}ms objective")
+
+    # -- evaluation -----------------------------------------------------
+
+    def evaluate(self, store, now: float | None = None) -> list[dict]:
+        now = time.time() if now is None else now
+        self._refresh_auto_rules(store)
+        alerts = []
+        for rule in list(self.rules) + list(self._auto.values()):
+            vf = rule.observe(store, rule.window_fast_s, now)
+            vs = rule.observe(store, rule.window_slow_s, now)
+            bf, bs = _burn(vf, rule.target), _burn(vs, rule.target)
+            no_data = math.isnan(vf) and math.isnan(vs)
+            if bf >= rule.burn_page and bs >= rule.burn_page:
+                state = STATE_PAGE
+            elif bf >= rule.burn_warn and bs >= rule.burn_warn:
+                state = STATE_WARN
+            else:
+                state = STATE_OK
+
+            def _clean(x):
+                return None if math.isnan(x) else round(x, 6)
+            alerts.append({
+                "rule": rule.name, "state": state,
+                "signal": rule.signal, "kind": rule.kind,
+                "target": rule.target,
+                "tags": dict(rule.tags or {}),
+                "value_fast": _clean(vf), "value_slow": _clean(vs),
+                "burn_fast": round(bf, 4) if math.isfinite(bf)
+                else bf, "burn_slow": round(bs, 4)
+                if math.isfinite(bs) else bs,
+                "window_fast_s": rule.window_fast_s,
+                "window_slow_s": rule.window_slow_s,
+                "no_data": no_data,
+                "description": rule.description,
+            })
+        self.last_alerts = alerts
+        self.last_eval_ts = now
+        self.evals += 1
+        if self.export_gauges:
+            self._export(alerts)
+        return alerts
+
+    def _export(self, alerts: list[dict]) -> None:
+        if self._gauges is None:
+            from ray_tpu.util import metrics as m
+            self._gauges = {
+                "state": m.Gauge(
+                    "ray_tpu_slo_state",
+                    "SLO alert state per rule (0=OK 1=WARN 2=PAGE)",
+                    tag_keys=("rule",)),
+                "burn_fast": m.Gauge(
+                    "ray_tpu_slo_burn_fast",
+                    "fast-window burn rate per SLO rule",
+                    tag_keys=("rule",)),
+                "burn_slow": m.Gauge(
+                    "ray_tpu_slo_burn_slow",
+                    "slow-window burn rate per SLO rule",
+                    tag_keys=("rule",)),
+            }
+        for a in alerts:
+            tags = {"rule": a["rule"]}
+            self._gauges["state"].set(
+                _STATE_NUM[a["state"]], tags=tags)
+            bf, bs = a["burn_fast"], a["burn_slow"]
+            self._gauges["burn_fast"].set(
+                bf if math.isfinite(bf) else 1e9, tags=tags)
+            self._gauges["burn_slow"].set(
+                bs if math.isfinite(bs) else 1e9, tags=tags)

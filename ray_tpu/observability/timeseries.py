@@ -1,0 +1,428 @@
+"""Head-side signals plane: snapshots -> time series -> queries.
+
+The PR 3 metrics pipeline is last-write-wins per scrape: the
+aggregator answers "what is true right now" and nothing else. This
+store is the missing time axis — every ``signals_sample_interval_s``
+the head samples the aggregator's merged registry (worker pushes +
+the head's own self-health gauges + the serve latency histograms)
+into per-series ring buffers, then serves PromQL-shaped questions
+without a PromQL engine:
+
+- ``rate(name, window)`` — per-second counter increase, reset-aware;
+- ``quantile_over_window(name, q, window)`` — histogram-bucket deltas
+  over the window (summed across matching tag sets, e.g. every
+  replica of one deployment) fed through ``histogram_quantile``;
+- ``delta``, ``last``-N, ``latest``, ``avg`` — the small primitives
+  the SLO engine and the SLO-aware autoscaler are built from;
+- ``sparklines`` — downsampled value strips for dashboard tiles.
+
+Retention is two-tier (reference: Prometheus recording-rule
+downsampling, scope-reduced): a **raw** ring covering
+``retention_s`` at the sample interval, and a **coarse** ring that
+keeps every ``coarse_factor``-th sample for ``coarse_retention_s``.
+Queries whose window fits the raw tier read it; longer windows fall
+back to the coarse tier. Everything is bounded: series count by
+``max_series`` (overflow counted, never grown), points per series by
+the deque maxlens — the store can run for weeks without growing.
+
+Dependency-free and lock-scoped like the aggregator; the only caller
+of ``sample()`` is the head's signals loop.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+
+from ray_tpu.util.metrics import histogram_quantile
+
+__all__ = ["SignalStore"]
+
+
+class _Series:
+    """One (metric name, tag set) stream: raw + coarse point rings.
+
+    Scalar points are ``(ts, float)``; histogram points are
+    ``(ts, (bucket_counts_tuple, sum, count))`` cumulative snapshots
+    (windowed views are first-vs-last deltas, like PromQL's
+    ``increase`` on ``_bucket`` series).
+    """
+
+    __slots__ = ("typ", "boundaries", "raw", "coarse", "n")
+
+    def __init__(self, typ: str, boundaries, raw_len: int,
+                 coarse_len: int):
+        self.typ = typ
+        self.boundaries = list(boundaries or [])
+        self.raw: deque = deque(maxlen=max(2, raw_len))
+        self.coarse: deque = deque(maxlen=max(2, coarse_len))
+        self.n = 0
+
+    def push(self, ts: float, value, coarse_factor: int) -> None:
+        self.raw.append((ts, value))
+        if self.n % max(1, coarse_factor) == 0:
+            self.coarse.append((ts, value))
+        self.n += 1
+
+    def points(self, window_s: float, now: float,
+               raw_span_s: float) -> list:
+        """Points within ``[now - window_s, now]`` from the tier that
+        can cover the window (raw when it fits, else coarse; coarse
+        falls back to raw when still empty early in life)."""
+        tier = self.raw if window_s <= raw_span_s else (
+            self.coarse or self.raw)
+        cutoff = now - window_s
+        out = []
+        for ts, v in reversed(tier):
+            if ts < cutoff:
+                break
+            out.append((ts, v))
+        out.reverse()
+        return out
+
+
+def _scalar_increase(points: list) -> float:
+    """Counter increase over the points, Prometheus-style reset
+    handling: a drop means the process restarted, so the post-reset
+    value is all new increase."""
+    inc = 0.0
+    prev = None
+    for _, v in points:
+        if prev is not None:
+            inc += (v - prev) if v >= prev else v
+        prev = v
+    return inc
+
+
+class SignalStore:
+    def __init__(self, interval_s: float = 1.0,
+                 retention_s: float = 600.0,
+                 coarse_factor: int = 10,
+                 coarse_retention_s: float = 7200.0,
+                 max_series: int = 2048):
+        self.interval_s = max(1e-3, float(interval_s))
+        self.retention_s = float(retention_s)
+        self.coarse_factor = max(1, int(coarse_factor))
+        self.coarse_retention_s = float(coarse_retention_s)
+        self.max_series = int(max_series)
+        self._raw_len = int(math.ceil(
+            self.retention_s / self.interval_s)) + 1
+        self._coarse_len = int(math.ceil(
+            self.coarse_retention_s
+            / (self.interval_s * self.coarse_factor))) + 1
+        self._lock = threading.Lock()
+        # (name, tags_items_tuple) -> _Series
+        self._series: dict[tuple, _Series] = {}
+        self.samples_taken = 0
+        self.series_dropped = 0
+        self.last_sample_ts = 0.0
+
+    # -- ingest ---------------------------------------------------------
+
+    def sample(self, merged: dict, now: float) -> None:
+        """One tick: fold the aggregator's merged view (see
+        ``ClusterMetricsAggregator.merged``) into the rings."""
+        with self._lock:
+            for name, fam in merged.items():
+                typ = fam.get("type", "untyped")
+                bounds = fam.get("boundaries")
+                for key, val in (fam.get("series") or {}).items():
+                    sk = (name, key)
+                    s = self._series.get(sk)
+                    if s is None:
+                        if len(self._series) >= self.max_series:
+                            self.series_dropped += 1
+                            continue
+                        s = _Series(typ, bounds, self._raw_len,
+                                    self._coarse_len)
+                        self._series[sk] = s
+                    if typ == "histogram":
+                        point = (tuple(val[0]), float(val[1]),
+                                 int(val[2]))
+                    else:
+                        point = float(val)
+                    s.push(now, point, self.coarse_factor)
+            self.samples_taken += 1
+            self.last_sample_ts = now
+
+    # -- matching -------------------------------------------------------
+
+    def _match_locked(self, name: str,
+                      tags: dict | None) -> list[tuple[tuple, "_Series"]]:
+        want = tuple(sorted((tags or {}).items()))
+        out = []
+        for (n, key), s in self._series.items():
+            if n != name:
+                continue
+            if want and not set(want).issubset(set(key)):
+                continue
+            out.append((key, s))
+        return out
+
+    def names(self) -> list[dict]:
+        """Distinct metric families tracked, with type and series
+        count — the discovery surface for CLI/dashboard."""
+        with self._lock:
+            fams: dict[str, dict] = {}
+            for (n, _key), s in self._series.items():
+                row = fams.setdefault(
+                    n, {"name": n, "type": s.typ, "series": 0})
+                row["series"] += 1
+            return sorted(fams.values(), key=lambda r: r["name"])
+
+    def tag_values(self, name: str, tag_key: str) -> list[str]:
+        """Distinct values of one tag across a family's series (the
+        SLO engine's per-deployment rule discovery)."""
+        with self._lock:
+            vals = set()
+            for (n, key), _s in self._series.items():
+                if n != name:
+                    continue
+                for k, v in key:
+                    if k == tag_key:
+                        vals.add(v)
+            return sorted(vals)
+
+    # -- query primitives -----------------------------------------------
+
+    def rate(self, name: str, window_s: float,
+             now: float | None = None,
+             tags: dict | None = None) -> float:
+        """Per-second increase over the window, summed across
+        matching series (counter semantics; NaN = no usable data)."""
+        now = self.last_sample_ts if now is None else now
+        total, any_data = 0.0, False
+        with self._lock:
+            matches = self._match_locked(name, tags)
+            for _key, s in matches:
+                pts = s.points(window_s, now, self.retention_s)
+                if len(pts) < 2:
+                    continue
+                if s.typ == "histogram":
+                    pts = [(t, v[2]) for t, v in pts]
+                dt = pts[-1][0] - pts[0][0]
+                if dt <= 0:
+                    continue
+                total += _scalar_increase(pts) / dt
+                any_data = True
+        return total if any_data else float("nan")
+
+    def delta(self, name: str, window_s: float,
+              now: float | None = None,
+              tags: dict | None = None) -> float:
+        """Last-minus-first over the window, summed across matching
+        series (signed — gauges may fall; histograms use the count)."""
+        now = self.last_sample_ts if now is None else now
+        total, any_data = 0.0, False
+        with self._lock:
+            for _key, s in self._match_locked(name, tags):
+                pts = s.points(window_s, now, self.retention_s)
+                if len(pts) < 2:
+                    continue
+                if s.typ == "histogram":
+                    total += pts[-1][1][2] - pts[0][1][2]
+                else:
+                    total += pts[-1][1] - pts[0][1]
+                any_data = True
+        return total if any_data else float("nan")
+
+    def avg(self, name: str, window_s: float,
+            now: float | None = None,
+            tags: dict | None = None) -> float:
+        """Time-window mean of the summed matching series (gauge
+        semantics: per-series point means, summed across series)."""
+        now = self.last_sample_ts if now is None else now
+        total, any_data = 0.0, False
+        with self._lock:
+            for _key, s in self._match_locked(name, tags):
+                pts = s.points(window_s, now, self.retention_s)
+                if not pts:
+                    continue
+                if s.typ == "histogram":
+                    vals = [v[2] for _, v in pts]
+                else:
+                    vals = [v for _, v in pts]
+                total += sum(vals) / len(vals)
+                any_data = True
+        return total if any_data else float("nan")
+
+    def latest(self, name: str, tags: dict | None = None) -> float:
+        """Most recent value, summed across matching series."""
+        total, any_data = 0.0, False
+        with self._lock:
+            for _key, s in self._match_locked(name, tags):
+                if not s.raw:
+                    continue
+                v = s.raw[-1][1]
+                total += v[2] if s.typ == "histogram" else v
+                any_data = True
+        return total if any_data else float("nan")
+
+    def window_histogram(self, name: str, window_s: float,
+                         now: float | None = None,
+                         tags: dict | None = None):
+        """``(boundaries, bucket_deltas, count_delta)`` over the
+        window, bucket deltas summed element-wise across matching
+        series — the substrate for windowed quantiles. ``None`` when
+        no series has two snapshots in the window. A counter reset
+        (count went down) treats the last snapshot as all-new mass."""
+        now = self.last_sample_ts if now is None else now
+        bounds: list | None = None
+        deltas: list[float] | None = None
+        count = 0
+        with self._lock:
+            for _key, s in self._match_locked(name, tags):
+                if s.typ != "histogram" or not s.boundaries:
+                    continue
+                pts = s.points(window_s, now, self.retention_s)
+                if len(pts) < 2:
+                    continue
+                (b0, _s0, c0) = pts[0][1]
+                (b1, _s1, c1) = pts[-1][1]
+                if len(b0) != len(b1):
+                    continue
+                if c1 < c0:          # reset: everything since is new
+                    d = list(b1)
+                    dc = c1
+                else:
+                    d = [x1 - x0 for x0, x1 in zip(b0, b1)]
+                    dc = c1 - c0
+                if bounds is None:
+                    bounds = list(s.boundaries)
+                    deltas = d
+                elif len(d) == len(deltas):
+                    deltas = [a + b for a, b in zip(deltas, d)]
+                else:
+                    continue
+                count += dc
+        if bounds is None or deltas is None:
+            return None
+        return bounds, deltas, count
+
+    def quantile_over_window(self, name: str, q: float,
+                             window_s: float,
+                             now: float | None = None,
+                             tags: dict | None = None) -> float:
+        """The ``q``-quantile of observations that LANDED inside the
+        window (bucket deltas -> histogram_quantile); NaN without at
+        least two snapshots or with zero in-window mass."""
+        wh = self.window_histogram(name, window_s, now=now, tags=tags)
+        if wh is None:
+            return float("nan")
+        bounds, deltas, _count = wh
+        return histogram_quantile(q, bounds, deltas)
+
+    def last(self, name: str, n: int = 60,
+             tags: dict | None = None) -> list[dict]:
+        """Most recent ``n`` raw points per matching series (scalar
+        value; histograms report the cumulative count)."""
+        n = max(1, int(n))
+        out = []
+        with self._lock:
+            for key, s in self._match_locked(name, tags):
+                pts = list(s.raw)[-n:]
+                if s.typ == "histogram":
+                    pts = [(t, v[2]) for t, v in pts]
+                out.append({"tags": dict(key),
+                            "points": [[round(t, 3), v]
+                                       for t, v in pts]})
+        return out
+
+    def sparkline(self, name: str, points: int = 40,
+                  window_s: float | None = None,
+                  tags: dict | None = None) -> list:
+        """``points`` evenly-spaced bins over the window, each the
+        mean of the summed matching series in that bin (None = no
+        sample landed there) — the dashboard overview-tile strip."""
+        points = max(2, int(points))
+        window_s = window_s or self.retention_s
+        now = self.last_sample_ts or 0.0
+        per_bin: list[list[float]] = [[] for _ in range(points)]
+        width = window_s / points
+        with self._lock:
+            matches = self._match_locked(name, tags)
+            # Sum across series per timestamp first (a deployment's
+            # replicas land at the same sample ts).
+            by_ts: dict[float, float] = {}
+            for _key, s in matches:
+                for t, v in s.points(window_s, now,
+                                     self.retention_s):
+                    val = v[2] if s.typ == "histogram" else v
+                    by_ts[t] = by_ts.get(t, 0.0) + val
+        for t, v in by_ts.items():
+            idx = int((t - (now - window_s)) / max(width, 1e-9))
+            if 0 <= idx < points:
+                per_bin[idx].append(v)
+        return [round(sum(b) / len(b), 6) if b else None
+                for b in per_bin]
+
+    def sparklines(self, names: list[str] | None = None,
+                   points: int = 40,
+                   window_s: float | None = None) -> dict:
+        if names is None:
+            names = [r["name"] for r in self.names()]
+        return {n: self.sparkline(n, points=points,
+                                  window_s=window_s)
+                for n in names}
+
+    # -- serving surface (OP_STATE "timeseries" / HTTP) -----------------
+
+    def query(self, spec: dict | None) -> dict:
+        """One JSON-able query: ``{"kind": ..., "name": ...,
+        "window": s, "q": 0.99, "n": N, "points": N, "tags": {...}}``
+        or ``{"queries": [spec, ...]}`` batched. NaN is rendered as
+        None so the reply is JSON-clean."""
+        spec = spec if isinstance(spec, dict) else {}
+        if isinstance(spec.get("queries"), list):
+            return {"results": [self.query(q)
+                                for q in spec["queries"]]}
+        kind = str(spec.get("kind") or "names")
+        name = str(spec.get("name") or "")
+        window = float(spec.get("window") or 60.0)
+        tags = spec.get("tags") if isinstance(spec.get("tags"),
+                                              dict) else None
+
+        def _clean(v):
+            return None if isinstance(v, float) and math.isnan(v) \
+                else v
+        out: dict = {"kind": kind, "name": name, "window_s": window,
+                     "ts": self.last_sample_ts,
+                     "samples_taken": self.samples_taken}
+        if kind == "names":
+            out["names"] = self.names()
+        elif kind == "rate":
+            out["value"] = _clean(self.rate(name, window, tags=tags))
+        elif kind == "delta":
+            out["value"] = _clean(self.delta(name, window, tags=tags))
+        elif kind == "avg":
+            out["value"] = _clean(self.avg(name, window, tags=tags))
+        elif kind == "latest":
+            out["value"] = _clean(self.latest(name, tags=tags))
+        elif kind == "quantile":
+            q = float(spec.get("q") or 0.99)
+            out["q"] = q
+            out["value"] = _clean(self.quantile_over_window(
+                name, q, window, tags=tags))
+        elif kind == "last":
+            out["series"] = self.last(
+                name, n=int(spec.get("n") or 60), tags=tags)
+        elif kind == "sparklines":
+            names = spec.get("names")
+            out["sparklines"] = self.sparklines(
+                names if isinstance(names, list) else None,
+                points=int(spec.get("points") or 40),
+                window_s=float(spec.get("window") or 0) or None)
+        else:
+            out["error"] = f"unknown timeseries query kind {kind!r}"
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"series": len(self._series),
+                    "samples_taken": self.samples_taken,
+                    "series_dropped": self.series_dropped,
+                    "last_sample_ts": self.last_sample_ts,
+                    "interval_s": self.interval_s,
+                    "retention_s": self.retention_s,
+                    "coarse_retention_s": self.coarse_retention_s}

@@ -79,6 +79,8 @@ class TraceStore:
         self.spans_ingested = 0
         self.traces_evicted = 0
         self.traces_sampled_out = 0
+        self.spans_deduped = 0
+        self.orphans_adopted_total = 0
 
     # -- ingest ---------------------------------------------------------
 
@@ -102,6 +104,8 @@ class TraceStore:
                     tr["spans"][sid] = dict(d)    # double-feeds are no-ops
                     tr["last_seen"] = now
                     self.spans_ingested += 1
+                else:
+                    self.spans_deduped += 1
             self._sweep_locked(now)
 
     def _sweep_locked(self, now: float) -> None:
@@ -188,6 +192,13 @@ class TraceStore:
                 children.setdefault(root["span_id"], []).append(o)
                 adopted += 1
             orphans = []
+            # Self-health: assembly is a non-destructive read that
+            # re-adopts on every call, so only NEW adoptions (beyond
+            # this trace's previous high-water) count globally.
+            prev = tr.get("orphans_counted", 0)
+            if adopted > prev:
+                self.orphans_adopted_total += adopted - prev
+                tr["orphans_counted"] = adopted
         for extra in roots[1:]:
             children.setdefault(root["span_id"], []).append(extra)
 
@@ -250,6 +261,23 @@ class TraceStore:
             "critical_path_self_ms": round(
                 sum(p["self_time_ms"] for p in path), 3),
         }
+
+    # -- self-health ----------------------------------------------------
+
+    def self_health(self) -> dict:
+        """Retention-pressure counters for the cluster scrape (the
+        ``ray_tpu_tracestore_*`` gauges) and ``ray_tpu status``."""
+        with self._lock:
+            return {
+                "traces_retained": len(self._traces),
+                "traces_dropped": self.traces_evicted
+                + self.traces_sampled_out,
+                "traces_evicted": self.traces_evicted,
+                "traces_sampled_out": self.traces_sampled_out,
+                "orphans_adopted": self.orphans_adopted_total,
+                "spans_deduped": self.spans_deduped,
+                "spans_ingested": self.spans_ingested,
+            }
 
     # -- query surfaces -------------------------------------------------
 

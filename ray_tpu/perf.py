@@ -752,6 +752,42 @@ def _run_benchmarks(rec, quick: bool) -> None:
     rec(timeit("trace_assembly_1k_spans", _one_assembly,
                unit="assemblies/s", quick=quick))
 
+    # -- signals plane (head time series + SLO engine) -----------------
+    # signals_ingest_overhead: SignalStore.sample() calls/s over the
+    # same 100-series registry the flush row uses — what the head's
+    # signals loop pays once per signals_sample_interval_s. Timestamps
+    # advance a fake clock: sample() is keyed on monotonic ts, and
+    # wall time would collapse the whole bench into one ring slot.
+    from ray_tpu.observability.slo import SloEngine as _Slo
+    from ray_tpu.observability.slo import SloRule as _SloRule
+    from ray_tpu.observability.timeseries import SignalStore as _SS
+
+    sig_store = _SS(interval_s=1.0, retention_s=600.0)
+    _sig_ts = [time.time()]
+
+    def _one_sample():
+        _sig_ts[0] += 1.0
+        sig_store.sample(agg.merged(), _sig_ts[0])
+
+    rec(timeit("signals_ingest_overhead", _one_sample,
+               unit="samples/s", quick=quick))
+
+    # slo_eval_1k_rules: full burn-rate evaluations/s of a 1000-rule
+    # SLO engine against the store just filled above (each rule is a
+    # rate query over fast+slow windows). export_gauges=False keeps
+    # 3k synthetic gauge series out of the live registry.
+    slo_rules = [
+        _SloRule(name=f"perf_rule_{i}",
+                 signal=f"perf_flush_metric_{i % 100}",
+                 kind="rate", target=1e12)
+        for i in range(1000)]
+    slo_eng = _Slo(rules=slo_rules, auto_rules=False,
+                   export_gauges=False)
+
+    rec(timeit("slo_eval_1k_rules",
+               lambda: slo_eng.evaluate(sig_store, _sig_ts[0]),
+               unit="evals/s", quick=quick))
+
     # -- scale envelope (PR-13 indexed pending paths) ------------------
     # One-shot throughput rows pinning the scheduler's indexed
     # structures at tier-1-sized N; the full envelope (1k actors,

@@ -88,6 +88,48 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _cmd_alerts(args) -> int:
+    """``ray_tpu alerts``: last SLO burn-rate evaluation from the
+    head signals plane — every rule with its state (OK/WARN/PAGE),
+    the fast/slow burn rates, and the deciding signal values. Exit
+    code escalates with the worst state: 0 OK, 1 WARN, 2 PAGE."""
+    c = _Client(_discover_address(args.address))
+    payload = c.state("alerts")
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        alerts = payload.get("alerts") or []
+        sig = payload.get("signals") or {}
+        print(f"slo rules: {len(alerts)}  evals: "
+              f"{payload.get('evals', 0)}  signal series: "
+              f"{sig.get('series', 0)}  samples: "
+              f"{sig.get('samples_taken', 0)}")
+        if not alerts:
+            print("no SLO rules evaluated yet (signals plane "
+                  "warming up or disabled)")
+        for a in alerts:
+            tags = a.get("tags") or {}
+            tag_s = ("{" + ",".join(f"{k}={v}" for k, v
+                                    in sorted(tags.items())) + "}"
+                     if tags else "")
+            if a.get("no_data"):
+                detail = "no data"
+            else:
+                vf = a.get("value_fast")
+                vf_s = f"{vf:.4g}" if vf is not None else "n/a"
+                detail = (f"burn fast={a['burn_fast']:.2f} "
+                          f"slow={a['burn_slow']:.2f} "
+                          f"value={vf_s} target={a['target']:.4g}")
+            print(f"  [{a['state']:4s}] {a['rule']}{tag_s} "
+                  f"({a['kind']}:{a['signal']}) {detail}")
+    worst = {s.get("state") for s in (payload.get("alerts") or [])}
+    if "PAGE" in worst:
+        return 2
+    if "WARN" in worst:
+        return 1
+    return 0
+
+
 def _cmd_memory(args) -> int:
     """``ray_tpu memory`` (reference: ray memory): per-node object
     store usage and the top-N objects by size with owner/ref-count/
@@ -662,6 +704,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the text rendering")
     p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser("alerts", help="SLO burn-rate alert states "
+                                      "from the head signals plane")
+    p.add_argument("--address", default=None)
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON (rules, burns, signal values)")
+    p.set_defaults(fn=_cmd_alerts)
 
     p = sub.add_parser("memory", help="object-store state debugger "
                                       "(ray memory analog)")

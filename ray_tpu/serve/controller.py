@@ -35,7 +35,9 @@ import time
 import ray_tpu
 from ray_tpu.core.config import get_config
 from ray_tpu.core.exceptions import ActorDiedError
-from ray_tpu.serve.autoscaling import AutoscalingConfig, AutoscalingState
+from ray_tpu.serve.autoscaling import (AutoscalingConfig,
+                                       AutoscalingState,
+                                       SloAwareAutoscalingPolicy)
 from ray_tpu.serve.replica import Replica
 
 CONTROLLER_NAME = "ray_tpu_serve_controller"
@@ -51,7 +53,9 @@ class ServeController:
         # readiness gate, receiving no traffic.
         self.starting: dict[str, list] = {}
         self.versions: dict[str, int] = {}
-        self.autoscaling: dict[str, AutoscalingState] = {}
+        # name -> policy object (AutoscalingState or
+        # SloAwareAutoscalingPolicy), duck-typed record()/decide()
+        self.autoscaling: dict = {}
         # name -> {model_id -> [replica indices]} from last probe
         self.model_map: dict[str, dict[str, list[int]]] = {}
         # name -> {actor_id hex -> consecutive failed probes}
@@ -165,13 +169,30 @@ class ServeController:
         }
         if autoscaling_config:
             cfg = AutoscalingConfig.from_dict(autoscaling_config)
-            self.autoscaling[name] = AutoscalingState(config=cfg)
+            self.autoscaling[name] = self._make_policy(name, cfg)
             self.desired[name]["num_replicas"] = cfg.min_replicas
         else:
             self.autoscaling.pop(name, None)
         self.versions.setdefault(name, 0)
         self._reconcile_once()
         return True
+
+    def _make_policy(self, name: str, cfg: AutoscalingConfig):
+        """Per-deployment policy selection (duck-typed on
+        record/decide). ``slo_aware`` closes the observability loop:
+        each decide() pulls the head's per-deployment signals digest
+        (p99-over-window, shed rate, queue depth) over OP_STATE."""
+        if cfg.policy != "slo_aware":
+            return AutoscalingState(config=cfg)
+
+        def fetch_signals():
+            rt = ray_tpu.core.api.get_runtime()
+            return rt.list_state(
+                "deployment_signals",
+                {"name": name, "window": cfg.signal_window_s})
+
+        return SloAwareAutoscalingPolicy(cfg,
+                                         fetch_signals=fetch_signals)
 
     def delete_deployment(self, name: str) -> bool:
         """Remove a deployment from the desired state; replicas drain

@@ -54,6 +54,14 @@ OBSERVABILITY_METRICS = (
     "task_submit_uninstrumented",
 )
 
+# Signals-plane metrics (ray_tpu/perf.py): head time-series sampling
+# cost and the 1k-rule SLO burn-rate evaluation rate. Same
+# must-be-present contract.
+SIGNALS_METRICS = (
+    "signals_ingest_overhead",
+    "slo_eval_1k_rules",
+)
+
 # Introspection-plane metrics (ray_tpu/perf.py): the state-debugger
 # serving cost and the live-capture sampling tax. Same
 # must-be-present contract.
@@ -169,6 +177,7 @@ def main() -> None:
                    + WIRE_METRICS
                    + SCALE_METRICS
                    + OBSERVABILITY_METRICS
+                   + SIGNALS_METRICS
                    + INTROSPECTION_METRICS
                    + DIRECT_CALL_METRICS
                    + (SERVE_METRICS if args.serve else ())

@@ -57,6 +57,12 @@ def test_microbench_floors(rt):
     assert results["actors_create_call_100"] > 1.0 / relax
     assert results["task_drain_5k"] > 300 / relax
     assert results["pg_create_50"] > 5.0 / relax
+    # Signals-plane rows (PR 19): the head's per-interval sampling
+    # tick over a 100-series registry and a deliberately oversized
+    # 1k-rule SLO evaluation. Order-of-magnitude floors only — a trip
+    # means a linear path went quadratic, not host jitter.
+    assert results["signals_ingest_overhead"] > 20 / relax
+    assert results["slo_eval_1k_rules"] > 2 / relax
 
 
 @pytest.mark.slow
@@ -202,6 +208,29 @@ def test_admission_disabled_check_near_zero():
     assert per_op < 2e-6, (
         f"disabled admission check costs {per_op * 1e9:.0f}ns/op")
     assert ac.rejected == 0
+
+
+def test_signals_disabled_tick_near_zero(rt):
+    """Signals-plane guardrail: with sampling disabled the head loop's
+    per-lap presence is one flag read in ``signals_tick`` — budget
+    2µs/op (same contract as the admission / tracing flags)."""
+    import time
+
+    plane = ray_tpu.core.api.get_runtime().observability
+    was = plane.signals_enabled
+    plane.signals_enabled = False
+    try:
+        assert plane.signals_tick() is False
+        n = 50_000
+        tick = plane.signals_tick
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tick()
+        per_op = (time.perf_counter() - t0) / n
+        assert per_op < 2e-6, (
+            f"disabled signals tick costs {per_op * 1e9:.0f}ns/op")
+    finally:
+        plane.signals_enabled = was
 
 
 def test_head_pipeline_disabled_skips_store(rt):

@@ -1,0 +1,389 @@
+"""Signals plane: SignalStore golden queries, SLO burn-rate engine,
+histogram_quantile edge cases, TraceStore self-health counters, and
+the OP_STATE timeseries/alerts verbs end to end.
+
+The store/engine tests inject synthetic merged-registry dicts with
+controlled timestamps — no cluster, no sleeping — so the rate /
+quantile arithmetic is checked against hand-computed values.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+import ray_tpu
+from ray_tpu.observability.slo import (STATE_OK, STATE_PAGE,
+                                       STATE_WARN, SloEngine, SloRule)
+from ray_tpu.observability.timeseries import SignalStore
+from ray_tpu.util.metrics import histogram_quantile
+
+BOUNDS = [0.01, 0.1, 1.0]
+
+
+def counter_merged(name: str, value: float, tags: dict | None = None):
+    key = tuple(sorted((tags or {"node_id": "n1"}).items()))
+    return {name: {"type": "counter", "desc": "",
+                   "series": {key: float(value)}}}
+
+
+def gauge_merged(name: str, value: float, tags: dict | None = None):
+    key = tuple(sorted((tags or {"node_id": "n1"}).items()))
+    return {name: {"type": "gauge", "desc": "",
+                   "series": {key: float(value)}}}
+
+
+def hist_merged(name: str, series: dict):
+    """series: tags_dict_items -> [buckets(len=len(BOUNDS)+1), sum,
+    count] cumulative."""
+    return {name: {"type": "histogram", "desc": "",
+                   "boundaries": list(BOUNDS),
+                   "series": {tuple(sorted(t)): v
+                              for t, v in series.items()}}}
+
+
+# -- SignalStore golden queries ----------------------------------------
+
+
+def test_rate_golden_linear_counter():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    # 10 samples, +10/s: t=0..9, value = 10 * t.
+    for t in range(10):
+        st.sample(counter_merged("c_total", 10.0 * t), float(t))
+    r = st.rate("c_total", 9.0, now=9.0)
+    assert r == pytest.approx(10.0)
+    # Sub-window: increase 10->90 over the 5 samples in [5, 9].
+    r = st.rate("c_total", 4.0, now=9.0)
+    assert r == pytest.approx(10.0)
+
+
+def test_rate_counter_reset_is_new_increase():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    for t, v in enumerate([0.0, 50.0, 100.0, 3.0, 6.0]):
+        st.sample(counter_merged("c_total", v), float(t))
+    # increase = 50 + 50 + 3 (post-reset value all new) + 3 over 4s.
+    assert st.rate("c_total", 4.0, now=4.0) == pytest.approx(106 / 4)
+
+
+def test_rate_sums_across_tagged_series_and_nan_when_empty():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    for t in range(5):
+        st.sample(
+            counter_merged("c_total", 2.0 * t, {"node_id": "a"}),
+            float(t))
+        st.sample(
+            counter_merged("c_total", 3.0 * t, {"node_id": "b"}),
+            float(t))
+    assert st.rate("c_total", 4.0, now=4.0) == pytest.approx(5.0)
+    assert st.rate("c_total", 4.0, now=4.0,
+                   tags={"node_id": "b"}) == pytest.approx(3.0)
+    assert math.isnan(st.rate("missing", 60.0, now=4.0))
+    # A single sample is not enough for a rate.
+    st2 = SignalStore()
+    st2.sample(counter_merged("c_total", 5.0), 0.0)
+    assert math.isnan(st2.rate("c_total", 60.0, now=0.0))
+
+
+def test_delta_latest_avg_gauge():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    for t, v in enumerate([10.0, 20.0, 5.0]):
+        st.sample(gauge_merged("g", v), float(t))
+    assert st.delta("g", 2.0, now=2.0) == pytest.approx(-5.0)
+    assert st.latest("g") == pytest.approx(5.0)
+    assert st.avg("g", 2.0, now=2.0) == pytest.approx(35.0 / 3)
+
+
+def test_quantile_over_window_golden_vs_direct():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    # Snapshot 0: 10 obs in bucket0; snapshot 1: +20 obs in bucket1.
+    st.sample(hist_merged("lat_s", {
+        (("node_id", "n1"),): [[10, 0, 0, 0], 0.05, 10]}), 0.0)
+    st.sample(hist_merged("lat_s", {
+        (("node_id", "n1"),): [[10, 20, 0, 0], 1.05, 30]}), 1.0)
+    wh = st.window_histogram("lat_s", 10.0, now=1.0)
+    assert wh is not None
+    bounds, deltas, count = wh
+    assert bounds == BOUNDS and deltas == [0, 20, 0, 0]
+    assert count == 20
+    q = st.quantile_over_window("lat_s", 0.5, 10.0, now=1.0)
+    assert q == pytest.approx(
+        histogram_quantile(0.5, BOUNDS, [0, 20, 0, 0]))
+    # All in-window mass in (0.01, 0.1]: p50 interpolates inside it.
+    assert 0.01 < q <= 0.1
+
+
+def test_quantile_merges_tag_sets_across_replicas():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    base = {(("deployment", "d"), ("replica", "r1")): [[0, 0, 0, 0],
+                                                      0.0, 0],
+            (("deployment", "d"), ("replica", "r2")): [[0, 0, 0, 0],
+                                                      0.0, 0]}
+    st.sample(hist_merged("lat_s", base), 0.0)
+    st.sample(hist_merged("lat_s", {
+        (("deployment", "d"), ("replica", "r1")): [[8, 0, 0, 0],
+                                                   0.04, 8],
+        (("deployment", "d"), ("replica", "r2")): [[0, 0, 12, 0],
+                                                   9.0, 12]}), 1.0)
+    wh = st.window_histogram("lat_s", 10.0, now=1.0,
+                             tags={"deployment": "d"})
+    assert wh is not None and wh[1] == [8, 0, 12, 0] and wh[2] == 20
+    q99 = st.quantile_over_window("lat_s", 0.99, 10.0, now=1.0,
+                                  tags={"deployment": "d"})
+    assert q99 == pytest.approx(
+        histogram_quantile(0.99, BOUNDS, [8, 0, 12, 0]))
+
+
+def test_quantile_histogram_reset_counts_last_snapshot():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    st.sample(hist_merged("lat_s", {
+        (("node_id", "n1"),): [[100, 0, 0, 0], 0.5, 100]}), 0.0)
+    # Replica restarted: cumulative count fell — window mass is the
+    # whole post-reset snapshot.
+    st.sample(hist_merged("lat_s", {
+        (("node_id", "n1"),): [[0, 5, 0, 0], 0.25, 5]}), 1.0)
+    wh = st.window_histogram("lat_s", 10.0, now=1.0)
+    assert wh is not None and wh[1] == [0, 5, 0, 0] and wh[2] == 5
+
+
+def test_coarse_tier_serves_long_windows():
+    st = SignalStore(interval_s=1.0, retention_s=10.0,
+                     coarse_factor=5, coarse_retention_s=1000.0)
+    for t in range(200):
+        st.sample(counter_merged("c_total", float(t)), float(t))
+    # Raw ring spans ~10s; a 100s window must fall back to coarse
+    # (every 5th sample kept) and still see the 1/s slope.
+    r = st.rate("c_total", 100.0, now=199.0)
+    assert r == pytest.approx(1.0)
+    # Short window stays on raw.
+    assert st.rate("c_total", 5.0, now=199.0) == pytest.approx(1.0)
+
+
+def test_max_series_bound_drops_and_counts():
+    st = SignalStore(max_series=3)
+    for i in range(6):
+        st.sample(counter_merged("c_total", 1.0,
+                                 {"node_id": f"n{i}"}), float(i))
+    assert st.stats()["series"] == 3
+    assert st.stats()["series_dropped"] == 3
+
+
+def test_last_names_sparklines_query_surface():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    for t in range(8):
+        st.sample(gauge_merged("g", float(t)), float(t))
+    rows = st.last("g", n=3)
+    assert len(rows) == 1
+    assert [p[1] for p in rows[0]["points"]] == [5.0, 6.0, 7.0]
+    assert rows[0]["tags"] == {"node_id": "n1"}
+    assert st.names() == [{"name": "g", "type": "gauge", "series": 1}]
+    spark = st.sparkline("g", points=4, window_s=8.0)
+    assert len(spark) == 4 and any(v is not None for v in spark)
+    # query() dispatch + NaN -> None JSON cleaning.
+    out = st.query({"kind": "latest", "name": "g"})
+    assert out["value"] == 7.0
+    out = st.query({"kind": "rate", "name": "nope", "window": 60})
+    assert out["value"] is None
+    batch = st.query({"queries": [{"kind": "names"},
+                                  {"kind": "latest", "name": "g"}]})
+    assert len(batch["results"]) == 2
+    assert "error" in st.query({"kind": "bogus"})
+
+
+# -- SLO engine ---------------------------------------------------------
+
+
+def test_slo_engine_ok_warn_page_transitions():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    eng = SloEngine(rules=[SloRule(
+        name="r", signal="g", kind="gauge", target=10.0,
+        window_fast_s=4.0, window_slow_s=8.0,
+        burn_warn=1.0, burn_page=2.0)],
+        auto_rules=False, export_gauges=False)
+    # Mean 5 -> burn 0.5 -> OK.
+    for t in range(9):
+        st.sample(gauge_merged("g", 5.0), float(t))
+    [a] = eng.evaluate(st, now=8.0)
+    assert a["state"] == STATE_OK and a["burn_fast"] == \
+        pytest.approx(0.5)
+    # Mean 12 on BOTH windows -> WARN (>= 1x, < 2x).
+    st2 = SignalStore()
+    for t in range(9):
+        st2.sample(gauge_merged("g", 12.0), float(t))
+    [a] = eng.evaluate(st2, now=8.0)
+    assert a["state"] == STATE_WARN
+    # Mean 25 -> burn 2.5x on both windows -> PAGE.
+    st3 = SignalStore()
+    for t in range(9):
+        st3.sample(gauge_merged("g", 25.0), float(t))
+    [a] = eng.evaluate(st3, now=8.0)
+    assert a["state"] == STATE_PAGE
+    assert a["burn_slow"] == pytest.approx(2.5)
+
+
+def test_slo_fast_burn_alone_does_not_fire():
+    """Multiwindow shape: a fast-window spike with a calm slow window
+    must NOT page — both windows must burn."""
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    eng = SloEngine(rules=[SloRule(
+        name="r", signal="g", kind="gauge", target=10.0,
+        window_fast_s=2.0, window_slow_s=20.0,
+        burn_warn=1.0, burn_page=2.0)],
+        auto_rules=False, export_gauges=False)
+    for t in range(20):
+        st.sample(gauge_merged("g", 1.0), float(t))
+    for t in range(20, 23):
+        st.sample(gauge_merged("g", 50.0), float(t))
+    [a] = eng.evaluate(st, now=22.0)
+    assert a["burn_fast"] >= 2.0
+    assert a["burn_slow"] < 1.0
+    assert a["state"] == STATE_OK
+
+
+def test_slo_no_data_is_ok_not_alert():
+    eng = SloEngine(rules=[SloRule(name="r", signal="absent",
+                                   kind="rate", target=1.0)],
+                    auto_rules=False, export_gauges=False)
+    [a] = eng.evaluate(SignalStore(), now=100.0)
+    assert a["state"] == STATE_OK and a["no_data"] is True
+    assert a["value_fast"] is None and a["burn_fast"] == 0.0
+
+
+def test_slo_auto_rules_per_deployment_and_gauge_export():
+    st = SignalStore(interval_s=1.0, retention_s=600.0)
+    st.sample(hist_merged("ray_tpu_serve_request_latency_s", {
+        (("deployment", "echo"), ("replica", "r1")):
+            [[0, 0, 0, 0], 0.0, 0]}), 0.0)
+    st.sample(hist_merged("ray_tpu_serve_request_latency_s", {
+        (("deployment", "echo"), ("replica", "r1")):
+            [[0, 0, 10, 0], 5.0, 10]}), 1.0)
+    eng = SloEngine(auto_rules=True, export_gauges=True)
+    eng.serve_p99_target_ms = 50.0      # p99 will be ~1s >> 50ms
+    alerts = eng.evaluate(st, now=1.0)
+    byname = {a["rule"]: a for a in alerts}
+    assert "serve_p99:echo" in byname
+    a = byname["serve_p99:echo"]
+    assert a["kind"] == "quantile" and a["burn_fast"] > 1.0
+    # Exported gauges visible to the next scrape.
+    from ray_tpu.util.metrics import collect_all
+    reg = collect_all()
+    assert "ray_tpu_slo_state" in reg
+    assert any(tags.get("rule") == "serve_p99:echo"
+               for tags, _v in reg["ray_tpu_slo_state"].collect())
+
+
+# -- histogram_quantile edge cases (satellite c) ------------------------
+
+
+def test_histogram_quantile_empty_and_zero():
+    assert math.isnan(histogram_quantile(0.5, [], []))
+    assert math.isnan(histogram_quantile(0.5, [1.0, 2.0], [0, 0, 0]))
+
+
+def test_histogram_quantile_single_bucket_interpolates():
+    # All mass in the first bucket (0, 1]: p50 = 0.5 by linear
+    # interpolation from the implicit 0 lower edge.
+    assert histogram_quantile(0.5, [1.0], [10, 0]) == \
+        pytest.approx(0.5)
+
+
+def test_histogram_quantile_inf_only_mass_returns_top_boundary():
+    # Every observation overflowed: no upper edge to interpolate
+    # toward — Prometheus convention returns the top finite boundary.
+    assert histogram_quantile(0.99, [0.1, 1.0], [0, 0, 7]) == \
+        pytest.approx(1.0)
+
+
+def test_histogram_quantile_monotone_p50_p95_p99():
+    counts = [5, 30, 40, 20, 5]
+    bounds = [0.01, 0.05, 0.1, 0.5]
+    p50 = histogram_quantile(0.50, bounds, counts)
+    p95 = histogram_quantile(0.95, bounds, counts)
+    p99 = histogram_quantile(0.99, bounds, counts)
+    assert p50 <= p95 <= p99
+
+
+# -- TraceStore self-health (satellite a) -------------------------------
+
+
+def test_tracestore_self_health_counters():
+    from ray_tpu.observability.tracestore import TraceStore
+    ts = TraceStore(max_traces=8, orphan_grace_s=0.0)
+    spans = [
+        {"name": "root", "trace_id": "t1", "span_id": "a",
+         "parent_id": None, "start": 1.0, "end": 2.0,
+         "attributes": {}, "process": "p"},
+        {"name": "child", "trace_id": "t1", "span_id": "b",
+         "parent_id": "a", "start": 1.1, "end": 1.9,
+         "attributes": {}, "process": "p"},
+        # Orphan: parent never arrives.
+        {"name": "lost", "trace_id": "t1", "span_id": "c",
+         "parent_id": "zz", "start": 1.2, "end": 1.3,
+         "attributes": {}, "process": "p"},
+    ]
+    ts.add_spans(spans)
+    ts.add_spans(spans)          # exact replay: all deduped
+    h = ts.self_health()
+    assert h["spans_deduped"] == 3
+    assert h["traces_retained"] == 1
+    assert h["spans_ingested"] == 3
+    # Assembly adopts the orphan (grace 0) and counts it ONCE even
+    # though assembly re-runs per read.
+    t = ts.get_trace("t1")
+    assert t is not None
+    t = ts.get_trace("t1")
+    assert ts.self_health()["orphans_adopted"] == 1
+
+
+def test_tracestore_gauges_reach_cluster_scrape(rt):
+    rt_obj = ray_tpu.core.api.get_runtime()
+    text = rt_obj.observability.prometheus_text()
+    assert "ray_tpu_tracestore_traces_retained" in text
+    assert "ray_tpu_tracestore_spans_deduped" in text
+    cs = rt_obj.cluster_status()
+    tsh = cs["observability"]["tracestore"]
+    assert set(tsh) >= {"traces_retained", "traces_dropped",
+                        "orphans_adopted", "spans_deduped"}
+
+
+# -- runtime integration: verbs, CLI payload, status --------------------
+
+
+def test_timeseries_and_alerts_verbs_end_to_end(rt):
+    rt_obj = ray_tpu.core.api.get_runtime()
+    plane = rt_obj.observability
+    assert plane.signals_tick(force=True) is True
+    # The sampled registry includes head self-health gauges.
+    names = {r["name"] for r in plane.signals.names()}
+    assert "ray_tpu_tracestore_traces_retained" in names
+    out = rt_obj.list_state("timeseries", {"kind": "names"})
+    assert any(r["name"] == "ray_tpu_tracestore_traces_retained"
+               for r in out["names"])
+    out = rt_obj.list_state(
+        "timeseries",
+        {"kind": "latest",
+         "name": "ray_tpu_tracestore_traces_retained"})
+    assert out["value"] is not None
+    alerts = rt_obj.list_state("alerts", None)
+    rules = {a["rule"] for a in alerts["alerts"]}
+    assert {"head_queue_depth", "tracestore_drops"} <= rules
+    assert all(a["state"] == STATE_OK for a in alerts["alerts"])
+    assert alerts["signals"]["samples_taken"] >= 1
+    # cluster_status carries the same alert rows + store stats.
+    cs = rt_obj.cluster_status()
+    assert {a["rule"] for a in cs["alerts"]} == rules
+    assert cs["observability"]["signals"]["series"] > 0
+    # deployment_signals degrades cleanly for an unknown deployment.
+    sig = rt_obj.list_state("deployment_signals",
+                            {"name": "ghost", "window": 30})
+    assert sig["p99_s"] is None and sig["samples"] == 0
+    assert sig["signals_enabled"] is True
+
+
+def test_status_renders_alert_and_tracestore_lines(rt):
+    from ray_tpu.observability.introspect import format_cluster_status
+    rt_obj = ray_tpu.core.api.get_runtime()
+    rt_obj.observability.signals_tick(force=True)
+    txt = format_cluster_status(rt_obj.cluster_status())
+    assert "alerts:" in txt
+    assert "tracestore:" in txt
